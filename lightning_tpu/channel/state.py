@@ -1,0 +1,328 @@
+"""BOLT#2 channel + HTLC state machines.
+
+Behavioral parity targets in the reference: the channel lifecycle enum
+(lightningd/channel_state.h:7), the 20-state HTLC machine
+(common/htlc_state.h:9-39) and the dual-view commitment bookkeeping of
+channeld/full_channel.c.  Re-derived from BOLT#2: states advance on the
+four commitment-flow events (send/recv commitment_signed, send/recv
+revoke_and_ack); each state statically implies which side's commitment
+transaction includes the HTLC.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from .commitment import Htlc, Side
+
+
+class ChannelState(enum.Enum):
+    """Channel lifecycle (semantic mirror of lightningd/channel_state.h)."""
+
+    OPENING = "opening"
+    AWAITING_LOCKIN = "awaiting_lockin"
+    NORMAL = "normal"
+    AWAITING_SPLICE = "awaiting_splice"
+    SHUTTING_DOWN = "shutting_down"
+    CLOSINGD_SIGEXCHANGE = "closingd_sigexchange"
+    CLOSINGD_COMPLETE = "closingd_complete"
+    AWAITING_UNILATERAL = "awaiting_unilateral"
+    FUNDING_SPEND_SEEN = "funding_spend_seen"
+    ONCHAIN = "onchain"
+    CLOSED = "closed"
+
+
+_LIFECYCLE = {
+    ChannelState.OPENING: {ChannelState.AWAITING_LOCKIN, ChannelState.CLOSED},
+    ChannelState.AWAITING_LOCKIN: {ChannelState.NORMAL,
+                                   ChannelState.AWAITING_UNILATERAL,
+                                   ChannelState.FUNDING_SPEND_SEEN},
+    ChannelState.NORMAL: {ChannelState.SHUTTING_DOWN,
+                          ChannelState.AWAITING_SPLICE,
+                          ChannelState.AWAITING_UNILATERAL,
+                          ChannelState.FUNDING_SPEND_SEEN},
+    ChannelState.AWAITING_SPLICE: {ChannelState.NORMAL,
+                                   ChannelState.AWAITING_UNILATERAL,
+                                   ChannelState.FUNDING_SPEND_SEEN},
+    ChannelState.SHUTTING_DOWN: {ChannelState.CLOSINGD_SIGEXCHANGE,
+                                 ChannelState.AWAITING_UNILATERAL,
+                                 ChannelState.FUNDING_SPEND_SEEN},
+    ChannelState.CLOSINGD_SIGEXCHANGE: {ChannelState.CLOSINGD_COMPLETE,
+                                        ChannelState.AWAITING_UNILATERAL,
+                                        ChannelState.FUNDING_SPEND_SEEN},
+    ChannelState.CLOSINGD_COMPLETE: {ChannelState.ONCHAIN,
+                                     ChannelState.FUNDING_SPEND_SEEN},
+    ChannelState.AWAITING_UNILATERAL: {ChannelState.FUNDING_SPEND_SEEN,
+                                       ChannelState.ONCHAIN},
+    ChannelState.FUNDING_SPEND_SEEN: {ChannelState.ONCHAIN},
+    ChannelState.ONCHAIN: {ChannelState.CLOSED},
+    ChannelState.CLOSED: set(),
+}
+
+
+class HtlcState(enum.Enum):
+    """The 20 HTLC states (common/htlc_state.h naming).  First half:
+    HTLCs we offered; second half: HTLCs the peer offered."""
+
+    SENT_ADD_HTLC = 0
+    SENT_ADD_COMMIT = 1
+    RCVD_ADD_REVOCATION = 2
+    RCVD_ADD_ACK_COMMIT = 3
+    SENT_ADD_ACK_REVOCATION = 4
+    RCVD_REMOVE_HTLC = 5
+    RCVD_REMOVE_COMMIT = 6
+    SENT_REMOVE_REVOCATION = 7
+    SENT_REMOVE_ACK_COMMIT = 8
+    RCVD_REMOVE_ACK_REVOCATION = 9
+
+    RCVD_ADD_HTLC = 10
+    RCVD_ADD_COMMIT = 11
+    SENT_ADD_REVOCATION = 12
+    SENT_ADD_ACK_COMMIT = 13
+    RCVD_ADD_ACK_REVOCATION = 14
+    SENT_REMOVE_HTLC = 15
+    SENT_REMOVE_COMMIT = 16
+    RCVD_REMOVE_REVOCATION = 17
+    RCVD_REMOVE_ACK_COMMIT = 18
+    SENT_REMOVE_ACK_REVOCATION = 19
+
+
+HS = HtlcState
+
+# Which commitment view includes an HTLC in each state:
+# state -> (in_local_commitment, in_remote_commitment)
+_INCLUSION = {
+    HS.SENT_ADD_HTLC: (False, False),
+    HS.SENT_ADD_COMMIT: (False, True),
+    HS.RCVD_ADD_REVOCATION: (False, True),
+    HS.RCVD_ADD_ACK_COMMIT: (True, True),
+    HS.SENT_ADD_ACK_REVOCATION: (True, True),
+    HS.RCVD_REMOVE_HTLC: (True, True),
+    HS.RCVD_REMOVE_COMMIT: (False, True),
+    HS.SENT_REMOVE_REVOCATION: (False, True),
+    HS.SENT_REMOVE_ACK_COMMIT: (False, False),
+    HS.RCVD_REMOVE_ACK_REVOCATION: (False, False),
+    HS.RCVD_ADD_HTLC: (False, False),
+    HS.RCVD_ADD_COMMIT: (True, False),
+    HS.SENT_ADD_REVOCATION: (True, False),
+    HS.SENT_ADD_ACK_COMMIT: (True, True),
+    HS.RCVD_ADD_ACK_REVOCATION: (True, True),
+    HS.SENT_REMOVE_HTLC: (True, True),
+    HS.SENT_REMOVE_COMMIT: (True, False),
+    HS.RCVD_REMOVE_REVOCATION: (True, False),
+    HS.RCVD_REMOVE_ACK_COMMIT: (False, False),
+    HS.SENT_REMOVE_ACK_REVOCATION: (False, False),
+}
+
+# Event-driven transitions: event -> {from: to}
+_ON_SEND_COMMIT = {
+    HS.SENT_ADD_HTLC: HS.SENT_ADD_COMMIT,
+    HS.SENT_ADD_REVOCATION: HS.SENT_ADD_ACK_COMMIT,
+    HS.SENT_REMOVE_HTLC: HS.SENT_REMOVE_COMMIT,
+    HS.SENT_REMOVE_REVOCATION: HS.SENT_REMOVE_ACK_COMMIT,
+}
+_ON_RECV_REVOKE = {
+    HS.SENT_ADD_COMMIT: HS.RCVD_ADD_REVOCATION,
+    HS.SENT_ADD_ACK_COMMIT: HS.RCVD_ADD_ACK_REVOCATION,
+    HS.SENT_REMOVE_COMMIT: HS.RCVD_REMOVE_REVOCATION,
+    HS.SENT_REMOVE_ACK_COMMIT: HS.RCVD_REMOVE_ACK_REVOCATION,
+}
+_ON_RECV_COMMIT = {
+    HS.RCVD_ADD_HTLC: HS.RCVD_ADD_COMMIT,
+    HS.RCVD_ADD_REVOCATION: HS.RCVD_ADD_ACK_COMMIT,
+    HS.RCVD_REMOVE_HTLC: HS.RCVD_REMOVE_COMMIT,
+    HS.RCVD_REMOVE_REVOCATION: HS.RCVD_REMOVE_ACK_COMMIT,
+}
+_ON_SEND_REVOKE = {
+    HS.RCVD_ADD_COMMIT: HS.SENT_ADD_REVOCATION,
+    HS.RCVD_ADD_ACK_COMMIT: HS.SENT_ADD_ACK_REVOCATION,
+    HS.RCVD_REMOVE_COMMIT: HS.SENT_REMOVE_REVOCATION,
+    HS.RCVD_REMOVE_ACK_COMMIT: HS.SENT_REMOVE_ACK_REVOCATION,
+}
+
+_FINAL_REMOVED = {HS.RCVD_REMOVE_ACK_REVOCATION, HS.SENT_REMOVE_ACK_REVOCATION}
+
+
+class ChannelError(Exception):
+    pass
+
+
+@dataclass
+class LiveHtlc:
+    htlc: Htlc  # offered=True ⇔ we offered it
+    state: HtlcState
+    preimage: bytes | None = None
+    fail_reason: bytes | None = None
+
+    @property
+    def in_local(self) -> bool:
+        return _INCLUSION[self.state][0]
+
+    @property
+    def in_remote(self) -> bool:
+        return _INCLUSION[self.state][1]
+
+    @property
+    def removed(self) -> bool:
+        return self.state in _FINAL_REMOVED
+
+
+@dataclass
+class ChannelCore:
+    """The funds/HTLC bookkeeping of one channel (full_channel.c
+    equivalent).  Balances are the *settled* amounts; in-flight HTLCs are
+    subtracted from the offerer's balance until resolution."""
+
+    funding_sat: int
+    to_local_msat: int
+    to_remote_msat: int
+    max_accepted_htlcs: int = 30
+    max_htlc_value_in_flight_msat: int = 0xFFFFFFFFFFFFFFFF
+    htlc_minimum_msat: int = 0
+    channel_reserve_msat: int = 0
+    state: ChannelState = ChannelState.NORMAL
+    htlcs: dict = field(default_factory=dict)  # (offered_by_us, id) -> LiveHtlc
+    next_htlc_id: dict = field(default_factory=lambda: {True: 0, False: 0})
+
+    # -- lifecycle --------------------------------------------------------
+
+    def transition(self, new: ChannelState):
+        if new not in _LIFECYCLE[self.state]:
+            raise ChannelError(f"illegal transition {self.state} → {new}")
+        self.state = new
+
+    # -- HTLC add/remove --------------------------------------------------
+
+    def _offered_balance_msat(self, by_us: bool) -> int:
+        bal = self.to_local_msat if by_us else self.to_remote_msat
+        in_flight = sum(
+            lh.htlc.amount_msat
+            for lh in self.htlcs.values()
+            if lh.htlc.offered == by_us and not lh.removed
+        )
+        return bal - in_flight
+
+    def add_htlc(self, by_us: bool, amount_msat: int, payment_hash: bytes,
+                 cltv_expiry: int) -> LiveHtlc:
+        if self.state is not ChannelState.NORMAL:
+            raise ChannelError(f"cannot add HTLC in {self.state}")
+        if amount_msat < self.htlc_minimum_msat:
+            raise ChannelError("below htlc_minimum_msat")
+        live = [h for h in self.htlcs.values()
+                if h.htlc.offered == by_us and not h.removed]
+        if len(live) >= self.max_accepted_htlcs:
+            raise ChannelError("max_accepted_htlcs exceeded")
+        if sum(h.htlc.amount_msat for h in live) + amount_msat > \
+                self.max_htlc_value_in_flight_msat:
+            raise ChannelError("max_htlc_value_in_flight exceeded")
+        if self._offered_balance_msat(by_us) - amount_msat < self.channel_reserve_msat:
+            raise ChannelError("insufficient balance (reserve)")
+        hid = self.next_htlc_id[by_us]
+        self.next_htlc_id[by_us] = hid + 1
+        lh = LiveHtlc(
+            Htlc(by_us, amount_msat, payment_hash, cltv_expiry, id=hid),
+            HS.SENT_ADD_HTLC if by_us else HS.RCVD_ADD_HTLC,
+        )
+        self.htlcs[(by_us, hid)] = lh
+        return lh
+
+    def _get_removable(self, offered_by_us: bool, hid: int) -> LiveHtlc:
+        lh = self.htlcs.get((offered_by_us, hid))
+        if lh is None:
+            raise ChannelError("unknown HTLC")
+        final_add = (HS.SENT_ADD_ACK_REVOCATION if offered_by_us
+                     else HS.RCVD_ADD_ACK_REVOCATION)
+        if lh.state is not final_add:
+            raise ChannelError(f"HTLC not fully committed ({lh.state})")
+        return lh
+
+    def fulfill_htlc(self, offered_by_us: bool, hid: int, preimage: bytes):
+        """offered_by_us=True: peer fulfilled ours (we received
+        update_fulfill); False: we fulfill theirs (we send it)."""
+        import hashlib
+
+        lh = self._get_removable(offered_by_us, hid)
+        if hashlib.sha256(preimage).digest() != lh.htlc.payment_hash:
+            raise ChannelError("bad preimage")
+        lh.preimage = preimage
+        lh.state = HS.RCVD_REMOVE_HTLC if offered_by_us else HS.SENT_REMOVE_HTLC
+
+    def fail_htlc(self, offered_by_us: bool, hid: int, reason: bytes = b""):
+        lh = self._get_removable(offered_by_us, hid)
+        lh.fail_reason = reason or b"\x00"
+        lh.state = HS.RCVD_REMOVE_HTLC if offered_by_us else HS.SENT_REMOVE_HTLC
+
+    # -- commitment flow events -------------------------------------------
+
+    def _apply(self, table) -> list[LiveHtlc]:
+        changed = []
+        for lh in self.htlcs.values():
+            new = table.get(lh.state)
+            if new is not None:
+                lh.state = new
+                changed.append(lh)
+        return changed
+
+    def send_commit(self) -> list[LiveHtlc]:
+        changed = self._apply(_ON_SEND_COMMIT)
+        if not changed:
+            # BOLT#2: MUST NOT send commitment_signed with no changes —
+            # callers decide; we surface it
+            pass
+        return changed
+
+    def recv_revoke(self) -> list[LiveHtlc]:
+        changed = self._apply(_ON_RECV_REVOKE)
+        self._settle_removed()
+        return changed
+
+    def recv_commit(self) -> list[LiveHtlc]:
+        return self._apply(_ON_RECV_COMMIT)
+
+    def send_revoke(self) -> list[LiveHtlc]:
+        changed = self._apply(_ON_SEND_REVOKE)
+        self._settle_removed()
+        return changed
+
+    def _settle_removed(self):
+        dead = [k for k, lh in self.htlcs.items() if lh.removed]
+        for k in dead:
+            lh = self.htlcs.pop(k)
+            amt = lh.htlc.amount_msat
+            if lh.preimage is not None:  # paid to the recipient
+                if lh.htlc.offered:
+                    self.to_local_msat -= amt
+                    self.to_remote_msat += amt
+                else:
+                    self.to_remote_msat -= amt
+                    self.to_local_msat += amt
+            # failed: funds simply return to the offerer (no change —
+            # balances were never moved; HTLCs are tracked as in-flight)
+
+    # -- commitment views -------------------------------------------------
+
+    def view(self, side: Side) -> tuple[int, int, list[Htlc]]:
+        """(to_self_msat, to_other_msat, htlcs) for `side`'s commitment tx.
+        HTLC list entries have offered= relative to that side."""
+        local = side is Side.LOCAL
+        incl = [lh for lh in self.htlcs.values()
+                if (lh.in_local if local else lh.in_remote)]
+        ours = self.to_local_msat - sum(
+            lh.htlc.amount_msat for lh in incl if lh.htlc.offered
+        )
+        theirs = self.to_remote_msat - sum(
+            lh.htlc.amount_msat for lh in incl if not lh.htlc.offered
+        )
+        htlcs = [
+            Htlc(
+                offered=(lh.htlc.offered == local),
+                amount_msat=lh.htlc.amount_msat,
+                payment_hash=lh.htlc.payment_hash,
+                cltv_expiry=lh.htlc.cltv_expiry,
+                id=lh.htlc.id,
+            )
+            for lh in incl
+        ]
+        if local:
+            return ours, theirs, htlcs
+        return theirs, ours, htlcs

@@ -1,0 +1,400 @@
+"""256-bit modular arithmetic in JAX, built for batched TPU execution.
+
+Design notes (TPU-first, not a translation of libsecp256k1):
+
+* A field element is 20 little-endian limbs of 13 bits held in uint32,
+  shape ``(..., 20)`` — a *redundant* representation: stored limbs may
+  exceed 13 bits (invariant: < 2^15), so carry propagation after every op
+  is a SINGLE parallel shift-and-add, not a sequential ripple chain.  This
+  is the decisive choice for both XLA compile time (programs stay small)
+  and TPU execution (no serial dependency chains on the VPU).
+* Radix 2^13 with limbs < 2^15 keeps every intermediate exactly inside
+  uint32: products ≤ (2^15-1)^2 < 2^30, 20-term column sums < 2^22 —
+  no 64-bit integers anywhere (TPUs have no fast native u64).
+* Reduction uses the pseudo-Mersenne shape of the secp256k1 moduli:
+  2^260 ≡ c260 (mod m) with c260 = 16·(2^256 - m).  Folding
+  H·2^260 + L → L + H·c260 repeats until an exact interval analysis
+  (done in Python bigints at trace time) proves the value fits 260 bits;
+  fold counts are therefore static and minimal per modulus.
+* Values stay redundant (< 2^260, limbs < 2^15) between ops;
+  ``normalize`` produces the canonical value in [0, m) and is only needed
+  at comparisons and the batch boundary.
+
+The reference implementation this replaces does one signature at a time
+through libsecp256k1 (see /root/reference/bitcoin/signature.c:174
+check_signed_hash and gossipd/sigcheck.c); here the same math is a data-
+parallel program over the whole batch.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+LIMB_BITS = 13
+LIMB_MASK = (1 << LIMB_BITS) - 1
+NLIMBS = 20  # 260 bits ≥ 256
+LOOSE_BOUND = 1 << 15  # stored-limb invariant (exclusive)
+REPR_BITS = LIMB_BITS * NLIMBS  # 260
+REPR_BOUND = 1 << REPR_BITS  # values are kept < 2^260
+
+
+def int_to_limbs(x: int, n: int = NLIMBS) -> np.ndarray:
+    assert 0 <= x < (1 << (LIMB_BITS * n)), "value does not fit"
+    return np.array(
+        [(x >> (LIMB_BITS * i)) & LIMB_MASK for i in range(n)], dtype=np.uint32
+    )
+
+
+def limbs_to_int(limbs) -> int:
+    limbs = np.asarray(limbs)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(limbs.reshape(-1)))
+
+
+class Modulus:
+    """Static per-modulus constants, computed once with Python bigints."""
+
+    def __init__(self, m: int, name: str):
+        assert (1 << 255) < m < (1 << 256), "modulus must be 256-bit"
+        self.name = name
+        self.m = m
+        self.c260 = REPR_BOUND % m  # 2^260 ≡ c260 (mod m)
+        kc = max(1, (self.c260.bit_length() + LIMB_BITS - 1) // LIMB_BITS)
+        self.kc = kc
+        self.c_limbs = int_to_limbs(self.c260, kc)
+        self.m_limbs = int_to_limbs(m, NLIMBS)
+        # Borrow-safe decomposition of K·m (K·m ≥ the max representable
+        # loose value) with per-limb floor LOOSE_BOUND-1, so M[k] - b[k] ≥ 0
+        # limb-wise for any loose b.  Used by sub().
+        max_loose = (LOOSE_BOUND - 1) * ((1 << REPR_BITS) - 1) // LIMB_MASK
+        K = -(-max_loose // m)  # ceil
+        while True:
+            Km = K * m
+            nd = (Km.bit_length() + LIMB_BITS - 1) // LIMB_BITS
+            d = [(Km >> (LIMB_BITS * k)) & LIMB_MASK for k in range(nd)]
+            # give every low limb +5 radix units from the next limb up:
+            # d[k] ∈ [40955, 49151] ≥ LOOSE_BOUND-1 afterwards
+            for k in range(NLIMBS):
+                d[k] += 5 << LIMB_BITS
+                d[k + 1] -= 5
+            ok = (
+                all(d[k] >= LOOSE_BOUND - 1 for k in range(NLIMBS))
+                and all(v >= 0 for v in d)
+                and all(v < (1 << 18) for v in d)
+            )
+            if ok:
+                break
+            K += 1  # more headroom in the top limbs
+        assert sum(v << (LIMB_BITS * k) for k, v in enumerate(d)) == Km
+        self.neg_limbs = np.array(d, dtype=np.uint32)
+        self.neg_bound = Km  # value of the constant
+        # MSB-first bits of m-2 (Fermat inversion exponent).
+        self.inv_bits = np.array(
+            [(m - 2) >> i & 1 for i in range(255, -1, -1)], dtype=np.uint32
+        )
+
+    def __repr__(self):
+        return f"Modulus({self.name})"
+
+
+# secp256k1 field prime and group order.
+P_INT = 2**256 - 2**32 - 977
+N_INT = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+FP = Modulus(P_INT, "p")
+FN = Modulus(N_INT, "n")
+
+_MAX_LOOSE_VAL = (LOOSE_BOUND - 1) * ((1 << REPR_BITS) - 1) // LIMB_MASK
+
+
+# ---------------------------------------------------------------------------
+# Low-level limb helpers.
+
+
+def _carry_once(cols, out_limbs: int):
+    """One parallel carry pass: limb' = (col & MASK) + carry(col[k-1]).
+    Input columns must be < 2^32 - 2^19; output limbs < 2^13 + 2^19·…/2^13
+    (callers reason with intervals).  NOT a full normalization."""
+    lo = cols & LIMB_MASK
+    hi = cols >> LIMB_BITS
+    n = cols.shape[-1]
+    total = max(out_limbs, n + 1)
+    lo = jnp.pad(lo, [(0, 0)] * (cols.ndim - 1) + [(0, total - n)])
+    hi = jnp.pad(hi, [(0, 0)] * (cols.ndim - 1) + [(1, total - n - 1)])
+    return (lo + hi)[..., :out_limbs]
+
+
+def _pad_last(x, before: int, total: int):
+    pad = [(0, 0)] * (x.ndim - 1) + [(before, total - before - x.shape[-1])]
+    return jnp.pad(x, pad)
+
+
+def _mul_cols(a, b, na: int, nb: int):
+    """Column sums of the schoolbook product (radix-split), NOT carried.
+    Inputs: limbs < 2^16 (so products < 2^32).  Output: na+nb+1 columns,
+    each < 2^23 for na,nb ≤ 20 — caller must carry."""
+    prod = a[..., :, None] * b[..., None, :]  # (..., na, nb)
+    lo = prod & LIMB_MASK
+    hi = prod >> LIMB_BITS
+    # reduce over the anti-diagonals via one one-hot contraction
+    key = _diag_onehot(na, nb)
+    cols_lo = jnp.einsum("...ij,ijk->...k", lo, key)
+    cols_hi = jnp.einsum("...ij,ijk->...k", hi, key)
+    return _combine(cols_lo, cols_hi, na + nb)
+
+
+def _combine(cols_lo, cols_hi, ncols):
+    pad = [(0, 0)] * (cols_lo.ndim - 1)
+    lo = jnp.pad(cols_lo, pad + [(0, 1)])
+    hi = jnp.pad(cols_hi, pad + [(1, 0)])
+    return lo + hi  # ncols+1 columns
+
+
+_DIAG_CACHE: dict = {}
+
+
+def _diag_onehot(na: int, nb: int):
+    key = (na, nb)
+    if key not in _DIAG_CACHE:
+        e = np.zeros((na, nb, na + nb), np.uint32)
+        for i in range(na):
+            for j in range(nb):
+                e[i, j, i + j] = 1
+        _DIAG_CACHE[key] = e  # numpy: jnp.asarray per trace (no tracer leak)
+    return jnp.asarray(_DIAG_CACHE[key])
+
+
+def _reduce(mod: Modulus, limbs, vmax: int):
+    """Fold limbs (value ≤ vmax, limbs < 2^16) until the value provably
+    fits in NLIMBS limbs (< 2^260).  Static, minimal fold sequence."""
+    c = mod.c260
+    c_arr = jnp.asarray(mod.c_limbs)
+    while vmax > REPR_BOUND - 1:
+        n = limbs.shape[-1]
+        n_needed = max(NLIMBS, (vmax.bit_length() + LIMB_BITS - 1) // LIMB_BITS)
+        if n > n_needed:
+            limbs = limbs[..., :n_needed]
+            n = n_needed
+        if n <= NLIMBS:
+            break
+        L = limbs[..., :NLIMBS]
+        H = limbs[..., NLIMBS:]
+        hn = n - NLIMBS
+        hcols = _mul_cols(H, c_arr, hn, mod.kc)  # hn+kc+1 columns
+        ncols = max(NLIMBS, hn + mod.kc + 1)
+        cols = _pad_last(L, 0, ncols) + _pad_last(hcols, 0, ncols)
+        # interval: maximize L + h*c260 s.t. h*2^260 + L ≤ vmax, L < 2^260·loose
+        hmax = vmax >> REPR_BITS
+        h1 = max(0, (vmax - (REPR_BOUND - 1)) >> REPR_BITS)
+        new_vmax = 0
+        for h in {0, min(h1, hmax), min(h1 + 1, hmax), hmax}:
+            lmax = min(REPR_BOUND - 1, vmax - (h << REPR_BITS))
+            if lmax < 0:
+                continue
+            new_vmax = max(new_vmax, lmax + h * c)
+        out_limbs = max(
+            NLIMBS, (new_vmax.bit_length() + LIMB_BITS - 1) // LIMB_BITS
+        )
+        limbs = _carry_once(cols, out_limbs)
+        assert new_vmax < vmax, "fold failed to make progress"
+        vmax = new_vmax
+    if limbs.shape[-1] > NLIMBS:
+        limbs = limbs[..., :NLIMBS]
+    return limbs
+
+
+# ---------------------------------------------------------------------------
+# Public modular ops.  Stored representatives: < 2^260, limbs < 2^15.
+
+
+def zero(shape=()):
+    return jnp.zeros((*shape, NLIMBS), dtype=jnp.uint32)
+
+
+def one(shape=()):
+    return jnp.broadcast_to(
+        jnp.concatenate(
+            [jnp.ones((1,), jnp.uint32), jnp.zeros((NLIMBS - 1,), jnp.uint32)]
+        ),
+        (*shape, NLIMBS),
+    )
+
+
+def from_const(x: int, shape=()):
+    arr = jnp.asarray(int_to_limbs(x % REPR_BOUND))
+    return jnp.broadcast_to(arr, (*shape, NLIMBS))
+
+
+def add(mod: Modulus, a, b):
+    cols = a + b  # < 2^16
+    limbs = _carry_once(cols, NLIMBS + 1)
+    return _reduce(mod, limbs, 2 * (REPR_BOUND - 1))
+
+
+def add3(mod: Modulus, a, b, c):
+    cols = a + b + c  # < 3·2^15 < 2^17
+    limbs = _carry_once(cols, NLIMBS + 1)
+    return _reduce(mod, limbs, 3 * (REPR_BOUND - 1))
+
+
+def sub(mod: Modulus, a, b):
+    neg = jnp.asarray(mod.neg_limbs)  # borrow-safe K·m, limbs < 2^18
+    nn = len(mod.neg_limbs)
+    d = neg - _pad_last(b, 0, nn)  # ≥ 0 limb-wise
+    cols = d + _pad_last(a, 0, nn)  # < 2^18 + 2^15
+    limbs = _carry_once(cols, nn + 1)
+    return _reduce(mod, limbs, mod.neg_bound + REPR_BOUND - 1)
+
+
+def mul(mod: Modulus, a, b):
+    cols = _mul_cols(a, b, NLIMBS, NLIMBS)
+    limbs = _carry_once(cols, 2 * NLIMBS + 1)
+    return _reduce(mod, limbs, (REPR_BOUND - 1) ** 2)
+
+
+def sqr(mod: Modulus, a):
+    return mul(mod, a, a)
+
+
+def mul_small(mod: Modulus, a, k: int):
+    """Multiply by a small constant.  k < 6144 keeps the single carry
+    pass inside the loose-limb invariant (out-limb < 2^13 + 4k < 2^15)."""
+    assert 0 <= k < 6144
+    cols = a * jnp.uint32(k)  # < 2^15·k < 2^28
+    limbs = _carry_once(cols, NLIMBS + 2)
+    return _reduce(mod, limbs, (REPR_BOUND - 1) * k)
+
+
+def _ripple(cols, out_limbs: int):
+    """Full sequential carry propagation to canonical limbs (< 2^13).
+    Only used inside normalize()."""
+    out = []
+    carry = jnp.zeros_like(cols[..., 0])
+    n = cols.shape[-1]
+    for k in range(out_limbs):
+        v = carry + (cols[..., k] if k < n else 0)
+        out.append(v & LIMB_MASK)
+        carry = v >> LIMB_BITS
+    return jnp.stack(out, axis=-1)
+
+
+def normalize(mod: Modulus, a):
+    """Map a redundant representative (< 2^260) to canonical [0, m):
+    full ripple, then conditional subtracts of 16m, 8m, 4m, 2m, m."""
+    x = _ripple(a, NLIMBS)
+    for k in (16, 8, 4, 2, 1):
+        km = jnp.asarray(int_to_limbs(k * mod.m, NLIMBS + 1)).astype(jnp.int32)
+        xi = _pad_last(x, 0, NLIMBS + 1).astype(jnp.int32)
+        outs = []
+        carry = jnp.zeros_like(xi[..., 0])
+        for i in range(NLIMBS + 1):
+            v = xi[..., i] - km[i] + carry
+            outs.append(v & LIMB_MASK)
+            carry = v >> LIMB_BITS  # arithmetic: -1 on borrow
+        t = jnp.stack(outs, axis=-1).astype(jnp.uint32)[..., :NLIMBS]
+        x = jnp.where((carry == 0)[..., None], t, x)
+    return x
+
+
+def is_zero(mod: Modulus, a):
+    return jnp.all(normalize(mod, a) == 0, axis=-1)
+
+
+def eq(mod: Modulus, a, b):
+    return jnp.all(normalize(mod, a) == normalize(mod, b), axis=-1)
+
+
+def select(cond, a, b):
+    """cond: bool (...,); a,b: (..., NLIMBS). Branchless select."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def inv(mod: Modulus, a):
+    """Fermat inversion a^(m-2) via a 256-step square-and-multiply scan.
+    inv(0) = 0 by convention (useful for branchless point formulas)."""
+    bits = jnp.asarray(mod.inv_bits)
+
+    def body(acc, bit):
+        acc = mul(mod, acc, acc)
+        acc = select(bit != 0, mul(mod, acc, a), acc)
+        return acc, None
+
+    acc0 = one(a.shape[:-1])
+    acc, _ = lax.scan(body, acc0, bits)
+    return acc
+
+
+def pow_const(mod: Modulus, a, e: int):
+    """a^e for a static exponent via scan over its bits."""
+    assert e >= 1
+    nbits = e.bit_length()
+    if nbits == 1:
+        return a
+    bits = jnp.asarray(
+        np.array([(e >> i) & 1 for i in range(nbits - 2, -1, -1)], np.uint32)
+    )
+
+    def body(acc, bit):
+        acc = mul(mod, acc, acc)
+        acc = select(bit != 0, mul(mod, acc, a), acc)
+        return acc, None
+
+    acc, _ = lax.scan(body, a, bits)
+    return acc
+
+
+def odd(a):
+    """Parity of a CANONICAL (normalized) value."""
+    return (a[..., 0] & 1) != 0
+
+
+def canonical_bits(a, nbits: int = 256):
+    """Canonical limbs → (..., nbits) bit array, LSB first (traced)."""
+    shifts = jnp.arange(LIMB_BITS, dtype=jnp.uint32)
+    bits = (a[..., :, None] >> shifts) & 1  # (..., 20, 13)
+    return bits.reshape(*a.shape[:-1], NLIMBS * LIMB_BITS)[..., :nbits]
+
+
+def lt_const(a, c: int):
+    """a < c for canonical-limb a and a static 260-bit constant (traced)."""
+    climbs = int_to_limbs(c, NLIMBS)
+    ai = a.astype(jnp.int32)
+    carry = jnp.zeros_like(ai[..., 0])
+    for k in range(NLIMBS):
+        v = ai[..., k] - jnp.int32(int(climbs[k])) + carry
+        carry = v >> LIMB_BITS
+    return carry < 0
+
+
+# ---------------------------------------------------------------------------
+# Host-side conversions (numpy, not traced)
+
+
+def from_bytes_be(data: np.ndarray) -> np.ndarray:
+    """(..., 32) uint8 big-endian → (..., 20) uint32 canonical limbs."""
+    data = np.asarray(data, dtype=np.uint8)
+    assert data.shape[-1] == 32
+    bits = np.unpackbits(data, axis=-1, bitorder="big")  # (..., 256) MSB-first
+    bits = bits[..., ::-1]  # LSB-first
+    pad = np.zeros((*bits.shape[:-1], REPR_BITS - 256), np.uint8)
+    bits = np.concatenate([bits, pad], axis=-1).reshape(*bits.shape[:-1], NLIMBS, LIMB_BITS)
+    weights = (1 << np.arange(LIMB_BITS, dtype=np.uint32))
+    return (bits.astype(np.uint32) * weights).sum(axis=-1, dtype=np.uint32)
+
+
+def to_bytes_be(limbs: np.ndarray) -> np.ndarray:
+    """(..., 20) uint32 canonical limbs → (..., 32) uint8 big-endian."""
+    limbs = np.asarray(limbs, dtype=np.uint32)
+    shifts = np.arange(LIMB_BITS, dtype=np.uint32)
+    bits = ((limbs[..., :, None] >> shifts) & 1).astype(np.uint8)
+    bits = bits.reshape(*limbs.shape[:-1], REPR_BITS)[..., :256]
+    return np.packbits(bits[..., ::-1], axis=-1, bitorder="big")
+
+
+def from_int_array(xs, shape=None) -> np.ndarray:
+    """List/array of Python ints → (..., 20) uint32 limbs (host-side)."""
+    xs = list(xs)
+    out = np.zeros((len(xs), NLIMBS), dtype=np.uint32)
+    for i, x in enumerate(xs):
+        out[i] = int_to_limbs(x % REPR_BOUND)
+    return out

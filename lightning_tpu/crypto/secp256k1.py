@@ -1,0 +1,528 @@
+"""Batched secp256k1 in JAX: point arithmetic + ECDSA/Schnorr verify/sign.
+
+This replaces the reference's serial libsecp256k1 call sites —
+check_signed_hash (/root/reference/bitcoin/signature.c:174, used by
+gossipd/sigcheck.c for every gossip message), check_schnorr_sig
+(signature.c:408) and sign_hash (signature.c:97, low-R grinding) — with
+data-parallel kernels over a whole batch of signatures at once.
+
+TPU-first design choices:
+
+* Points are homogeneous projective (X:Y:Z) over the redundant limb
+  engine in ``field.py``; infinity is (0:1:0).  All point ops use the
+  Renes–Costello–Batina *complete* formulas (EUROCRYPT 2016, a=0
+  specialization): exception-free and branchless — no selects, no
+  equality tests, no special cases anywhere in the hot loop, so one
+  traced program serves every input including ∞, P=Q and P=-Q.
+* The double-scalar multiply u1·G + u2·Q (the ECDSA/Schnorr hot loop)
+  interleaves a constant 4-bit window table for G with a per-element
+  4-bit window table for Q as a 64-step ``lax.scan``: 4 doublings + two
+  table adds per step, all batched.
+* Signing grinds low-R the batched way: GRIND_CANDIDATES nonce candidates
+  per signature are evaluated in one fixed-base batch and the first low-R
+  candidate is chosen branchlessly (the reference loops+retries serially,
+  signature.c:102-117).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import field as F
+from . import ref_python as ref
+from .field import FP, FN, NLIMBS
+
+P_INT = F.P_INT
+N_INT = F.N_INT
+B3 = 21  # 3·b for y² = x³ + 7
+
+_add = functools.partial(F.add, FP)
+_add3 = functools.partial(F.add3, FP)
+_sub = functools.partial(F.sub, FP)
+_mul = functools.partial(F.mul, FP)
+_sqr = functools.partial(F.sqr, FP)
+
+
+def _b3(a):
+    return F.mul_small(FP, a, B3)
+
+
+WINDOW = 4
+NDIGITS = 256 // WINDOW  # 64
+
+
+# ---------------------------------------------------------------------------
+# Constant tables (host-side precompute with the exact-int oracle)
+
+
+@functools.lru_cache(maxsize=1)
+def _comb_table() -> np.ndarray:
+    """(NDIGITS, 16, 2, NLIMBS) uint32: entry [j][v] = affine (x, y) of
+    v * 2^(4j) * G.  v=0 entries are dummies (masked at use in the comb
+    path; replaced by (0:1:0) in the projective window path)."""
+    table = np.zeros((NDIGITS, 16, 2, NLIMBS), dtype=np.uint32)
+    base = ref.G
+    for j in range(NDIGITS):
+        acc = ref.INFINITY
+        for v in range(1, 16):
+            acc = ref.point_add(acc, base)
+            table[j, v, 0] = F.int_to_limbs(acc.x)
+            table[j, v, 1] = F.int_to_limbs(acc.y)
+        for _ in range(WINDOW):
+            base = ref.point_double(base)
+    return table
+
+
+@functools.lru_cache(maxsize=1)
+def _g_window_proj() -> np.ndarray:
+    """(16, 3, NLIMBS): projective window table for G — entry v = v·G with
+    Z=1, entry 0 = (0:1:0)."""
+    comb = _comb_table()
+    out = np.zeros((16, 3, NLIMBS), dtype=np.uint32)
+    out[0, 1, 0] = 1  # infinity (0:1:0)
+    for v in range(1, 16):
+        out[v, 0] = comb[0, v, 0]
+        out[v, 1] = comb[0, v, 1]
+        out[v, 2, 0] = 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Complete projective point ops (RCB, a=0).  A point is a tuple (X, Y, Z).
+
+
+def point_select(cond, p, q):
+    return tuple(F.select(cond, a, b) for a, b in zip(p, q))
+
+
+def point_inf(shape=()):
+    return (F.zero(shape), F.one(shape), F.zero(shape))
+
+
+def point_is_inf(p):
+    return F.is_zero(FP, p[2])
+
+
+def point_double(p):
+    """RCB complete doubling, a=0 (alg 9): 3M + 2S + 1 small. Handles ∞."""
+    X, Y, Z = p
+    t0 = _sqr(Y)
+    Z3 = _add(t0, t0)
+    Z3 = _add(Z3, Z3)
+    Z3 = _add(Z3, Z3)
+    t1 = _mul(Y, Z)
+    t2 = _sqr(Z)
+    t2 = _b3(t2)
+    X3 = _mul(t2, Z3)
+    Y3 = _add(t0, t2)
+    Z3 = _mul(t1, Z3)
+    t1 = _add(t2, t2)
+    t2 = _add(t1, t2)
+    t0 = _sub(t0, t2)
+    Y3 = _mul(t0, Y3)
+    Y3 = _add(X3, Y3)
+    t1 = _mul(X, Y)
+    X3 = _mul(t0, t1)
+    X3 = _add(X3, X3)
+    return (X3, Y3, Z3)
+
+
+def point_add(p1, p2):
+    """RCB complete addition, a=0 (alg 7): 12M + 2 small.  Exception-free:
+    covers ∞ operands, P=Q (acts as doubling) and P=-Q (yields ∞)."""
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    t0 = _mul(X1, X2)
+    t1 = _mul(Y1, Y2)
+    t2 = _mul(Z1, Z2)
+    t3 = _add(X1, Y1)
+    t4 = _add(X2, Y2)
+    t3 = _mul(t3, t4)
+    t4 = _add(t0, t1)
+    t3 = _sub(t3, t4)
+    t4 = _add(Y1, Z1)
+    X3 = _add(Y2, Z2)
+    t4 = _mul(t4, X3)
+    X3 = _add(t1, t2)
+    t4 = _sub(t4, X3)
+    X3 = _add(X1, Z1)
+    Y3 = _add(X2, Z2)
+    X3 = _mul(X3, Y3)
+    Y3 = _add(t0, t2)
+    Y3 = _sub(X3, Y3)
+    X3 = _add(t0, t0)
+    t0 = _add(X3, t0)
+    t2 = _b3(t2)
+    Z3 = _add(t1, t2)
+    t1 = _sub(t1, t2)
+    Y3 = _b3(Y3)
+    X3 = _mul(t4, Y3)
+    t2 = _mul(t3, t1)
+    X3 = _sub(t2, X3)
+    Y3 = _mul(Y3, t0)
+    t1 = _mul(t1, Z3)
+    Y3 = _add(t1, Y3)
+    t0 = _mul(t0, t3)
+    Z3 = _mul(Z3, t4)
+    Z3 = _add(Z3, t0)
+    return (X3, Y3, Z3)
+
+
+def point_to_affine(p):
+    """(x, y) with (0, 0) for infinity (inv(0)=0 convention)."""
+    X, Y, Z = p
+    zi = F.inv(FP, Z)
+    return _mul(X, zi), _mul(Y, zi)
+
+
+# ---------------------------------------------------------------------------
+# Scalar digit machinery
+
+
+def _digits4(scalar):
+    """CANONICAL (normalized) scalar limbs → (..., 64) 4-bit digits,
+    little-endian digit order."""
+    bits = F.canonical_bits(scalar, 256)  # (..., 256) LSB-first
+    nib = bits.reshape(*bits.shape[:-1], NDIGITS, 4)
+    w = jnp.asarray(np.array([1, 2, 4, 8], np.uint32))
+    return jnp.einsum("...ij,j->...i", nib, w)
+
+
+def _table_lookup(table, idx):
+    """table: (B, 16, 3, NLIMBS); idx: (B,) → 3 coords (B, NLIMBS)."""
+    B, nv, k, nl = table.shape
+    flat = table.reshape(B, nv, k * nl)
+    ii = jnp.broadcast_to(idx[:, None, None].astype(jnp.int32), (B, 1, k * nl))
+    out = jnp.take_along_axis(flat, ii, axis=1).reshape(B, k, nl)
+    return out[:, 0], out[:, 1], out[:, 2]
+
+
+def _build_window(qx, qy):
+    """Per-element projective window table T[v] = v·Q, v = 0..15:
+    (B, 16, 3, NLIMBS)."""
+    Bsz = qx.shape[0]
+    entries = [point_inf((Bsz,)), (qx, qy, F.one((Bsz,)))]
+    for v in range(2, 16):
+        entries.append(point_add(entries[v - 1], entries[1]))
+    return jnp.stack([jnp.stack(e, axis=-2) for e in entries], axis=-3)
+
+
+def dual_mul(u1, u2, qx, qy):
+    """u1·G + u2·Q batched (u1, u2 canonical limbs; qx, qy affine limbs).
+    Returns a projective point tuple."""
+    qtab = _build_window(qx, qy)
+    gtab = jnp.asarray(_g_window_proj())  # (16, 3, NLIMBS)
+    d1 = _digits4(u1)
+    d2 = _digits4(u2)
+    xs = (jnp.flip(d1, axis=-1).T, jnp.flip(d2, axis=-1).T)  # (64, B)
+
+    def body(acc, x):
+        dg1, dg2 = x
+        for _ in range(WINDOW):
+            acc = point_double(acc)
+        acc = point_add(acc, _table_lookup(qtab, dg2))
+        ge = jnp.take(gtab.reshape(16, -1), dg1.astype(jnp.int32), axis=0)
+        ge = ge.reshape(-1, 3, NLIMBS)
+        acc = point_add(acc, (ge[:, 0], ge[:, 1], ge[:, 2]))
+        return acc, None
+
+    acc, _ = lax.scan(body, point_inf((u1.shape[0],)), xs)
+    return acc
+
+
+def fixed_base_mul(k):
+    """k·G batched via the doubling-free comb (64 adds of precomputed
+    v·2^(4j)·G windows).  k: canonical limbs."""
+    Bsz = k.shape[0]
+    comb = _comb_table()  # (64, 16, 2, NLIMBS) affine
+    proj = np.zeros((NDIGITS, 16, 3, NLIMBS), dtype=np.uint32)
+    proj[:, :, 0] = comb[:, :, 0]
+    proj[:, :, 1] = comb[:, :, 1]
+    proj[:, 1:, 2, 0] = 1
+    proj[:, 0, 1, 0] = 1
+    proj[:, 0, 0] = 0
+    proj = jnp.asarray(proj)
+    digits = _digits4(k)  # (B, 64)
+
+    def body(acc, x):
+        tg, dg = x  # tg: (16, 3, NLIMBS)
+        ge = jnp.take(tg.reshape(16, -1), dg.astype(jnp.int32), axis=0)
+        ge = ge.reshape(-1, 3, NLIMBS)
+        acc = point_add(acc, (ge[:, 0], ge[:, 1], ge[:, 2]))
+        return acc, None
+
+    acc, _ = lax.scan(body, point_inf((Bsz,)), (proj, digits.T))
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Curve / pubkey helpers
+
+
+def _nonzero(a):
+    return jnp.any(a != 0, axis=-1)
+
+
+def decompress(qx, parity):
+    """Canonical x, parity bit → (y, on_curve)."""
+    y2 = _add(_mul(_sqr(qx), qx), F.from_const(7, qx.shape[:-1]))
+    y = F.pow_const(FP, y2, (P_INT + 1) // 4)
+    on_curve = F.eq(FP, _sqr(y), y2)
+    yn = F.normalize(FP, y)
+    flip = (yn[..., 0] & 1) != parity.astype(jnp.uint32)
+    y = F.select(flip, F.sub(FP, F.zero(qx.shape[:-1]), y), y)
+    return y, on_curve
+
+
+# ---------------------------------------------------------------------------
+# ECDSA
+
+
+def ecdsa_verify_kernel(z, r, s, qx, q_parity):
+    """Batched ECDSA verify.
+
+    z: (B, 20) hash limbs (raw 256-bit value, reduced mod n implicitly)
+    r, s: (B, 20) canonical signature scalar limbs
+    qx: (B, 20) canonical pubkey x limbs; q_parity: (B,) y parity (0/1)
+    Returns bool (B,).  Fully branchless; invalid encodings yield False.
+    """
+    r_ok = F.lt_const(r, N_INT) & _nonzero(r)
+    s_ok = F.lt_const(s, N_INT) & _nonzero(s)
+    q_ok = F.lt_const(qx, P_INT)
+    qy, on_curve = decompress(qx, q_parity)
+
+    w = F.inv(FN, s)
+    u1 = F.normalize(FN, F.mul(FN, z, w))
+    u2 = F.normalize(FN, F.mul(FN, r, w))
+    R = dual_mul(u1, u2, qx, qy)
+    Rx, _, Rz = R
+    not_inf = ~F.is_zero(FP, Rz)
+    # projective x(R) ≡ r (mod n) check without inversion:
+    # x(R) = Rx/Rz; candidates r' ∈ {r, r+n} with r' < p
+    chk1 = F.eq(FP, Rx, _mul(r, Rz))
+    r_plus_n = _add(r, F.from_const(N_INT, r.shape[:-1]))
+    small_r = F.lt_const(r, P_INT - N_INT)
+    chk2 = small_r & F.eq(FP, Rx, _mul(r_plus_n, Rz))
+    return r_ok & s_ok & q_ok & on_curve & not_inf & (chk1 | chk2)
+
+
+GRIND_CANDIDATES = 4
+
+
+def _low_r(r_norm):
+    """low-R ⇔ r < 2^255 ⇔ bit 255 (bit 8 of limb 19) clear."""
+    return ((r_norm[..., NLIMBS - 1] >> (255 - 13 * 19)) & 1) == 0
+
+
+def ecdsa_sign_kernel(z, d, ks):
+    """Batched ECDSA sign with batched low-R grinding.
+
+    z: (B, 20) hash limbs; d: (B, 20) secret key limbs (< n);
+    ks: (B, C, 20) canonical nonce candidates (RFC6979 stream, host-made).
+    Returns (r, s, ok, grind_ok).  Picks the first low-R candidate
+    branchlessly (reference grinds serially, bitcoin/signature.c:97-118);
+    falls back to candidate 0 (valid but non-low-R) if none qualifies.
+    """
+    B, C, _ = ks.shape
+    kf = ks.reshape(B * C, NLIMBS)
+    rx, _ = point_to_affine(fixed_base_mul(kf))
+    r_all = F.normalize(FN, F.normalize(FP, rx)).reshape(B, C, NLIMBS)
+    low_r = _low_r(r_all) & _nonzero(r_all)  # (B, C)
+    choice = jnp.argmax(low_r, axis=1)  # first True, else 0
+    ok_grind = jnp.any(low_r, axis=1)
+    take = lambda arr: jnp.take_along_axis(
+        arr, choice[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    r_sel = take(r_all)
+    k_sel = take(ks)
+    ki = F.inv(FN, k_sel)
+    s = F.mul(FN, ki, F.add(FN, z, F.mul(FN, r_sel, d)))
+    s = F.normalize(FN, s)
+    s_ok = _nonzero(s)
+    # low-S normalization (matching libsecp sign output)
+    high = ~F.lt_const(s, (N_INT + 1) // 2)
+    s = F.select(high, F.normalize(FN, F.sub(FN, F.zero((B,)), s)), s)
+    r_ok = _nonzero(r_sel)
+    return r_sel, s, r_ok & s_ok, ok_grind
+
+
+def ecdsa_sign_simple_kernel(z, d, k):
+    """Single-nonce sign (no low-R grinding): r = x(k·G) mod n,
+    s = k⁻¹(z + r·d) mod n, low-S normalized.  Used for bulk synthesis."""
+    rx, _ = point_to_affine(fixed_base_mul(k))
+    r = F.normalize(FN, F.normalize(FP, rx))
+    s = F.mul(FN, F.inv(FN, k), F.add(FN, z, F.mul(FN, r, d)))
+    s = F.normalize(FN, s)
+    high = ~F.lt_const(s, (N_INT + 1) // 2)
+    s = F.select(high, F.normalize(FN, F.sub(FN, F.zero(z.shape[:-1]), s)), s)
+    ok = _nonzero(r) & _nonzero(s)
+    return r, s, ok
+
+
+def derive_pubkeys_kernel(d):
+    """d·G → (x, y) normalized affine limbs (batch pubkey derivation)."""
+    x, y = point_to_affine(fixed_base_mul(d))
+    return F.normalize(FP, x), F.normalize(FP, y)
+
+
+def derive_pubkeys(seckeys: np.ndarray) -> np.ndarray:
+    """(B, 20) canonical seckey limbs → (B, 33) compressed SEC1 pubkeys."""
+    x, y = jax.jit(derive_pubkeys_kernel)(jnp.asarray(seckeys))
+    xb = F.to_bytes_be(np.asarray(x))
+    parity = (np.asarray(y)[:, 0] & 1).astype(np.uint8)
+    out = np.empty((len(xb), 33), np.uint8)
+    out[:, 0] = 2 + parity
+    out[:, 1:] = xb
+    return out
+
+
+# ---------------------------------------------------------------------------
+# BIP340 Schnorr
+
+
+def schnorr_verify_kernel(e, rx, s, px):
+    """Batched BIP340 verify given precomputed challenge e (raw 256-bit).
+
+    e = int(tagged_hash("BIP0340/challenge", rx || px || msg)); computing
+    it is the caller's job (see crypto.sha256 — it's a batched hash too).
+    """
+    r_ok = F.lt_const(rx, P_INT)
+    s_ok = F.lt_const(s, N_INT)
+    p_ok = F.lt_const(px, P_INT)
+    py, on_curve = decompress(px, jnp.zeros(px.shape[:-1], jnp.uint32))
+    e_n = F.normalize(FN, e)
+    u = F.normalize(FN, F.sub(FN, F.zero(e.shape[:-1]), e_n))  # n - e
+    R = dual_mul(F.normalize(FN, s), u, px, py)
+    not_inf = ~F.is_zero(FP, R[2])
+    x_aff, y_aff = point_to_affine(R)
+    yn = F.normalize(FP, y_aff)
+    even = (yn[..., 0] & 1) == 0
+    x_eq = F.eq(FP, x_aff, rx)
+    return r_ok & s_ok & p_ok & on_curve & not_inf & even & x_eq
+
+
+# ---------------------------------------------------------------------------
+# Host-facing numpy APIs.  All pad to a fixed bucket so each kernel
+# compiles exactly once per (bucket, platform) and is served from the
+# persistent cache afterwards.
+
+VERIFY_BUCKET = 64
+
+
+def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:
+    if a.shape[0] == n:
+        return a
+    return np.pad(a, [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
+
+
+@functools.lru_cache(maxsize=2)
+def _jit_verify():
+    return jax.jit(ecdsa_verify_kernel)
+
+
+def ecdsa_verify_batch(msg_hashes: np.ndarray, sigs64: np.ndarray,
+                       pubkeys33: np.ndarray, bucket: int = VERIFY_BUCKET):
+    """msg_hashes: (B, 32) uint8; sigs64: (B, 64) compact r||s;
+    pubkeys33: (B, 33) SEC1 compressed. Returns np bool (B,)."""
+    B = msg_hashes.shape[0]
+    z = F.from_bytes_be(msg_hashes)
+    r = F.from_bytes_be(sigs64[:, :32])
+    s = F.from_bytes_be(sigs64[:, 32:])
+    qx = F.from_bytes_be(pubkeys33[:, 1:])
+    parity = (pubkeys33[:, 0] & 1).astype(np.uint32)
+    tag_ok = (pubkeys33[:, 0] == 2) | (pubkeys33[:, 0] == 3)
+    out = np.zeros(B, bool)
+    kern = _jit_verify()
+    for start in range(0, B, bucket):
+        end = min(start + bucket, B)
+        sl = slice(start, end)
+        ok = kern(
+            jnp.asarray(_pad_rows(z[sl], bucket)),
+            jnp.asarray(_pad_rows(r[sl], bucket)),
+            jnp.asarray(_pad_rows(s[sl], bucket)),
+            jnp.asarray(_pad_rows(qx[sl], bucket)),
+            jnp.asarray(_pad_rows(parity[sl], bucket)),
+        )
+        out[sl] = np.asarray(ok)[: end - start]
+    return out & tag_ok
+
+
+@functools.lru_cache(maxsize=2)
+def _jit_schnorr():
+    return jax.jit(schnorr_verify_kernel)
+
+
+def schnorr_verify_batch(msgs32: np.ndarray, sigs64: np.ndarray,
+                         pubkeys32: np.ndarray, bucket: int = VERIFY_BUCKET):
+    """BIP340 over 32-byte messages (the reference only signs hashes)."""
+    import hashlib
+
+    from . import sha256 as H
+
+    B = msgs32.shape[0]
+    th = hashlib.sha256(b"BIP0340/challenge").digest()
+    msgs = [
+        th + th + bytes(sigs64[i, :32]) + bytes(pubkeys32[i]) + bytes(msgs32[i])
+        for i in range(B)
+    ]
+    blocks, nblocks = H.pack_messages(msgs)
+    e_words = H.sha256_blocks(jnp.asarray(blocks), jnp.asarray(nblocks))
+    e = np.asarray(H.digest_words_to_limbs(e_words))
+    rx = F.from_bytes_be(sigs64[:, :32])
+    s = F.from_bytes_be(sigs64[:, 32:])
+    px = F.from_bytes_be(pubkeys32)
+    out = np.zeros(B, bool)
+    kern = _jit_schnorr()
+    for start in range(0, B, bucket):
+        end = min(start + bucket, B)
+        sl = slice(start, end)
+        ok = kern(
+            jnp.asarray(_pad_rows(e[sl], bucket)),
+            jnp.asarray(_pad_rows(rx[sl], bucket)),
+            jnp.asarray(_pad_rows(s[sl], bucket)),
+            jnp.asarray(_pad_rows(px[sl], bucket)),
+        )
+        out[sl] = np.asarray(ok)[: end - start]
+    return out
+
+
+SIGN_BUCKET = 16
+
+
+def ecdsa_sign_batch(msg_hashes: np.ndarray, seckeys: list[int],
+                     bucket: int = SIGN_BUCKET):
+    """Batched deterministic ECDSA sign (RFC6979 nonces host-side, point
+    math + low-R grinding on device). Returns (B, 64) compact sigs."""
+    B = msg_hashes.shape[0]
+    ks = np.zeros((B, GRIND_CANDIDATES, NLIMBS), np.uint32)
+    for i in range(B):
+        h = bytes(msg_hashes[i])
+        for c in range(GRIND_CANDIDATES):
+            extra = None if c == 0 else c.to_bytes(32, "little")
+            ks[i, c] = F.int_to_limbs(ref.rfc6979_nonce(h, seckeys[i], extra))
+    z = F.from_bytes_be(msg_hashes)
+    d = F.from_int_array(seckeys)
+    kern = jax.jit(ecdsa_sign_kernel)
+    out = np.empty((B, 64), np.uint8)
+    for start in range(0, B, bucket):
+        end = min(start + bucket, B)
+        sl = slice(start, end)
+        kpad = np.tile(
+            F.int_to_limbs(1), (bucket, GRIND_CANDIDATES, 1)
+        ).astype(np.uint32)
+        kpad[: end - start] = ks[sl]
+        r, s, ok, _ = kern(
+            jnp.asarray(_pad_rows(z[sl], bucket)),
+            jnp.asarray(_pad_rows(d[sl], bucket)),
+            jnp.asarray(kpad),
+        )
+        got = end - start
+        assert bool(np.all(np.asarray(ok)[:got])), "degenerate nonce"
+        out[sl, :32] = F.to_bytes_be(np.asarray(r))[:got]
+        out[sl, 32:] = F.to_bytes_be(np.asarray(s))[:got]
+    return out

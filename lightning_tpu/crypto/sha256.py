@@ -1,0 +1,187 @@
+"""Batched SHA256 / SHA256d in JAX.
+
+The reference hashes each gossip message serially on the CPU right before
+verifying its signature (sha256_double in gossipd/sigcheck.c:33,75,141).
+Here hashing is a data-parallel program: a batch of B messages is packed
+host-side into a (B, max_blocks, 16) uint32 word tensor (standard SHA256
+padding included), and the device runs the compression function over the
+block axis with a per-message active-block mask.  All ops are uint32
+adds/rotates/xors — pure VPU work that fuses into one XLA computation
+with the downstream signature verification.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+_K = np.array([
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+    0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+    0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+    0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+    0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+    0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2], dtype=np.uint32)
+
+_IV = np.array([
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19], dtype=np.uint32)
+
+
+def _rotr(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _compress(state, block):
+    """One SHA256 compression. state: (..., 8); block: (..., 16) uint32.
+
+    Both the message schedule and the 64 rounds run as small lax.scans:
+    a fully unrolled compression is a ~1.5k-op sequential dependency chain
+    that XLA:CPU's backend compiles pathologically slowly once several
+    blocks are jitted together.  Scan bodies stay tiny and the round loop
+    is still one fused on-device loop."""
+    # message schedule: rolling 16-word window, 48 generated words
+    w_init = jnp.moveaxis(block, -1, 0)  # (16, ...)
+
+    def sched(win, _):
+        s0 = _rotr(win[1], 7) ^ _rotr(win[1], 18) ^ (win[1] >> 3)
+        s1 = _rotr(win[14], 17) ^ _rotr(win[14], 19) ^ (win[14] >> 10)
+        new = win[0] + s0 + win[9] + s1
+        return jnp.concatenate([win[1:], new[None]], axis=0), new
+
+    _, gen = lax.scan(sched, w_init, None, length=48)
+    W = jnp.concatenate([w_init, gen], axis=0)  # (64, ...)
+
+    def round_(carry, xw):
+        a, b, c, d, e, f, g, h = carry
+        w_t, k_t = xw
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k_t + w_t
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[..., i] for i in range(8))
+    out, _ = lax.scan(round_, init, (W, jnp.asarray(_K)))
+    return jnp.stack([state[..., i] + v for i, v in enumerate(out)], axis=-1)
+
+
+def sha256_blocks(blocks, n_blocks):
+    """Batched SHA256 over pre-padded blocks.
+
+    blocks: (B, max_blocks, 16) uint32 (big-endian words, padding included)
+    n_blocks: (B,) int32 — active block count per message
+    returns: (B, 8) uint32 digests
+    """
+    max_blocks = blocks.shape[-2]
+    state = jnp.broadcast_to(jnp.asarray(_IV), (*blocks.shape[:-2], 8))
+    if max_blocks == 1:
+        return _compress(state, blocks[..., 0, :])
+    # Static unroll over the block axis (max_blocks is a static shape):
+    # avoids a dynamic while loop, which XLA:CPU mis-schedules on 1-core
+    # hosts, and lets XLA pipeline the whole hash as straight-line code.
+    for i in range(max_blocks):
+        new = _compress(state, blocks[..., i, :])
+        active = (jnp.int32(i) < n_blocks)[..., None]
+        state = jnp.where(active, new, state)
+    return state
+
+
+def sha256_fixed(words):
+    """Batched SHA256 where every message has the same static block count.
+    words: (..., nblocks, 16) uint32 pre-padded. No masking needed."""
+    state = jnp.broadcast_to(jnp.asarray(_IV), (*words.shape[:-2], 8))
+    for i in range(words.shape[-2]):
+        state = _compress(state, words[..., i, :])
+    return state
+
+
+def _digest_to_block(digest):
+    """Pad a 32-byte digest (as 8 uint32 words) into a single SHA256 block."""
+    shape = digest.shape[:-1]
+    pad = jnp.broadcast_to(
+        jnp.asarray(
+            np.array([0x80000000, 0, 0, 0, 0, 0, 0, 256], dtype=np.uint32)
+        ),
+        (*shape, 8),
+    )
+    return jnp.concatenate([digest, pad], axis=-1)[..., None, :]
+
+
+def sha256d_blocks(blocks, n_blocks):
+    """Batched double-SHA256 (the gossip signed-hash: sha256(sha256(msg)))."""
+    inner = sha256_blocks(blocks, n_blocks)
+    return sha256_fixed(_digest_to_block(inner))
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing (numpy)
+
+
+def pack_messages(msgs: list[bytes], max_blocks: int | None = None):
+    """Pack variable-length messages with SHA256 padding.
+
+    Returns (blocks (B, max_blocks, 16) uint32, n_blocks (B,) int32).
+    """
+    padded = []
+    counts = []
+    for m in msgs:
+        bitlen = len(m) * 8
+        m = m + b"\x80"
+        m = m + b"\x00" * ((56 - len(m)) % 64)
+        m = m + bitlen.to_bytes(8, "big")
+        assert len(m) % 64 == 0
+        padded.append(m)
+        counts.append(len(m) // 64)
+    nb = max_blocks or max(counts)
+    assert nb >= max(counts), "message exceeds max_blocks"
+    B = len(msgs)
+    out = np.zeros((B, nb * 64), dtype=np.uint8)
+    for i, m in enumerate(padded):
+        out[i, : len(m)] = np.frombuffer(m, np.uint8)
+    words = out.reshape(B, nb, 16, 4)
+    words = (
+        (words[..., 0].astype(np.uint32) << 24)
+        | (words[..., 1].astype(np.uint32) << 16)
+        | (words[..., 2].astype(np.uint32) << 8)
+        | words[..., 3].astype(np.uint32)
+    )
+    return words, np.array(counts, dtype=np.int32)
+
+
+def digest_to_bytes(digest: np.ndarray) -> np.ndarray:
+    """(..., 8) uint32 → (..., 32) uint8 big-endian."""
+    digest = np.asarray(digest, dtype=np.uint32)
+    b = np.stack(
+        [
+            (digest >> 24).astype(np.uint8),
+            ((digest >> 16) & 0xFF).astype(np.uint8),
+            ((digest >> 8) & 0xFF).astype(np.uint8),
+            (digest & 0xFF).astype(np.uint8),
+        ],
+        axis=-1,
+    )
+    return b.reshape(*digest.shape[:-1], 32)
+
+
+def digest_words_to_limbs(digest):
+    """(..., 8) uint32 big-endian digest words → (..., 20) uint32 canonical
+    radix-2^13 field limbs of the big-endian 256-bit integer. Traced."""
+    out = []
+    for k in range(20):
+        t0 = 13 * k  # global bit position (LSB-first) of this limb
+        wi = 7 - t0 // 32  # big-endian word holding bit t0
+        sh = t0 % 32
+        v = digest[..., wi] >> sh
+        if sh + 13 > 32 and wi >= 1:
+            v = v | (digest[..., wi - 1] << (32 - sh))
+        out.append(v & 0x1FFF)
+    return jnp.stack(out, axis=-1)

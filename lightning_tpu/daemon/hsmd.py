@@ -1,0 +1,152 @@
+"""hsmd-equivalent: the key service.
+
+The reference's hsmd (hsmd/hsmd.c:867, dispatch libhsmd.c:2184) is the
+sole holder of secrets; every signature crosses a socketpair to it, one
+request at a time — channeld's commitment flow does up to 483 serial
+round-trips (channeld/channeld.c:1048-1071).
+
+This service keeps the same trust boundary (a single object owning
+secrets; callers hold capability-scoped client handles, mirroring
+hsmd/permissions.h) but exposes *batched* signing entry points: a whole
+commitment's HTLC signatures are one device call
+(sign_batch → ecdsa_sign kernels), and bulk verification rides the same
+kernels as gossip.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..btc import keys as K
+from ..btc import tx as T
+from ..crypto import field as F
+from ..crypto import ref_python as ref
+from ..crypto import secp256k1 as S
+
+# Capability bits (shape mirrors hsmd/permissions.h)
+CAP_ECDH = 1
+CAP_SIGN_GOSSIP = 2
+CAP_SIGN_ONCHAIN = 4
+CAP_SIGN_COMMITMENT = 8
+CAP_MASTER = 0xFF
+
+
+class HsmError(Exception):
+    pass
+
+
+@dataclass
+class HsmClient:
+    """A capability-scoped handle (one per subdaemon in the reference,
+    hsmd/hsm_control.c:27)."""
+
+    hsm: "Hsm"
+    caps: int
+    channel_seed: bytes | None = None
+
+    def _need(self, cap: int):
+        if not (self.caps & cap):
+            raise HsmError("capability denied")
+
+
+class Hsm:
+    """Owner of hsm_secret.  Derivations follow our own scheme (the
+    reference's exact derivation tree is an implementation detail of its
+    hsm_secret format; what matters for protocol parity is that channel
+    basepoints and the shachain are deterministic from one secret)."""
+
+    def __init__(self, secret: bytes):
+        assert len(secret) == 32
+        self._secret = secret
+        self.node_key = self._derive_int(b"nodeid")
+        self.node_pubkey = ref.pubkey_create(self.node_key)
+
+    @classmethod
+    def generate(cls) -> "Hsm":
+        return cls(os.urandom(32))
+
+    def _derive(self, tag: bytes) -> bytes:
+        return hmac.new(self._secret, tag, hashlib.sha256).digest()
+
+    def _derive_int(self, tag: bytes) -> int:
+        v = int.from_bytes(self._derive(tag), "big") % ref.N
+        return v or 1
+
+    def client(self, caps: int, peer_id: bytes = b"", dbid: int = 0) -> HsmClient:
+        chseed = None
+        if dbid:
+            chseed = self._derive(b"chan" + peer_id + dbid.to_bytes(8, "big"))
+        return HsmClient(self, caps, chseed)
+
+    # -- node-level ops ---------------------------------------------------
+
+    def ecdh(self, client: HsmClient, point: ref.Point) -> bytes:
+        client._need(CAP_ECDH)
+        return hashlib.sha256(
+            ref.pubkey_serialize(ref.point_mul(self.node_key, point))
+        ).digest()
+
+    def sign_node_announcement_hash(self, client: HsmClient, h32: bytes):
+        client._need(CAP_SIGN_GOSSIP)
+        return ref.ecdsa_sign(h32, self.node_key)
+
+    # -- channel-level ops ------------------------------------------------
+
+    def channel_secrets(self, client: HsmClient) -> K.BaseSecrets:
+        if client.channel_seed is None:
+            raise HsmError("client has no channel")
+        return K.BaseSecrets.from_seed(client.channel_seed)
+
+    def channel_basepoints(self, client: HsmClient) -> K.Basepoints:
+        return self.channel_secrets(client).basepoints()
+
+    def per_commitment_secret(self, client: HsmClient, commitment_number: int) -> bytes:
+        secs = self.channel_secrets(client)
+        shaseed = hashlib.sha256(
+            client.channel_seed + b"shachain"
+        ).digest()
+        index = K.LARGEST_INDEX - commitment_number
+        return K.shachain_derive_secret(shaseed, index)
+
+    def per_commitment_point(self, client: HsmClient, commitment_number: int) -> ref.Point:
+        return K.per_commitment_point(
+            self.per_commitment_secret(client, commitment_number)
+        )
+
+    # -- batched signing (the TPU fan-out path) ---------------------------
+
+    def sign_htlc_batch(
+        self,
+        client: HsmClient,
+        sighashes: list[bytes],
+        remote_per_commitment_point: ref.Point,
+    ) -> np.ndarray:
+        """Sign every HTLC sighash of a remote commitment in ONE device
+        call (vs the reference's per-HTLC hsmd_sign_remote_htlc_tx round
+        trips).  Returns (N, 64) compact sigs."""
+        client._need(CAP_SIGN_COMMITMENT)
+        if not sighashes:
+            return np.zeros((0, 64), np.uint8)
+        secs = self.channel_secrets(client)
+        htlc_priv = K.derive_privkey(secs.htlc, remote_per_commitment_point)
+        hashes = np.stack([np.frombuffer(h, np.uint8) for h in sighashes])
+        return S.ecdsa_sign_batch(hashes, [htlc_priv] * len(sighashes))
+
+    def sign_remote_commitment(
+        self, client: HsmClient, sighash: bytes
+    ) -> bytes:
+        """The single funding-key signature on the remote commitment tx."""
+        client._need(CAP_SIGN_COMMITMENT)
+        secs = self.channel_secrets(client)
+        r, s = ref.ecdsa_sign(sighash, secs.funding)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def check_sigs_batch(self, msg_hashes: np.ndarray, sigs: np.ndarray,
+                         pubkeys: np.ndarray) -> np.ndarray:
+        """Batched verify (the self-check the reference does per-HTLC with
+        check_tx_sig, channeld/channeld.c:1068 — here one call)."""
+        return S.ecdsa_verify_batch(msg_hashes, sigs, pubkeys)

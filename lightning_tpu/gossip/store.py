@@ -1,0 +1,138 @@
+"""gossip_store file I/O, format-compatible with the reference.
+
+On-disk format (see /root/reference/common/gossip_store.h:15-50 — studied
+for interop, re-implemented here):
+  byte 0: version (major in top 3 bits — must be 0; minor in low 5)
+  then records: be16 flags | be16 len | be32 crc | be32 timestamp | msg
+  crc = crc32c(timestamp, msg) (gossipd/gossip_store.c:67)
+  flag 0x8000 = deleted, 0x2000 = completed write, 0x0800 = dying.
+
+The reader is built for the replay benchmark: one mmap + native scan into
+flat numpy arrays; no per-record Python objects anywhere.
+"""
+from __future__ import annotations
+
+import mmap
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..utils import native
+
+VERSION_BYTE = 0x10  # major 0, minor 16
+FLAG_DELETED = 0x8000
+FLAG_COMPLETED = 0x2000
+FLAG_DYING = 0x0800
+
+
+@dataclass
+class StoreIndex:
+    """Flat view of a scanned store: numpy arrays, one row per record."""
+
+    buf: np.ndarray  # uint8 view of the whole file
+    offsets: np.ndarray  # uint64, start of each message body
+    lengths: np.ndarray  # uint32
+    flags: np.ndarray  # uint16
+    timestamps: np.ndarray  # uint32
+    crcs: np.ndarray  # uint32
+    types: np.ndarray  # uint16
+
+    def alive(self) -> np.ndarray:
+        return (self.flags & FLAG_DELETED) == 0
+
+    def select(self, mask: np.ndarray) -> "StoreIndex":
+        return StoreIndex(
+            self.buf, self.offsets[mask], self.lengths[mask],
+            self.flags[mask], self.timestamps[mask], self.crcs[mask],
+            self.types[mask],
+        )
+
+    def check_crcs(self) -> np.ndarray:
+        """crc32c(timestamp-seeded) over each message; True = intact."""
+        got = native.crc32c_batch(self.buf, self.offsets, self.lengths,
+                                  self.timestamps)
+        return got == self.crcs
+
+    def message(self, i: int) -> bytes:
+        o, l = int(self.offsets[i]), int(self.lengths[i])
+        return bytes(self.buf[o : o + l])
+
+    def __len__(self):
+        return len(self.offsets)
+
+
+def load_store(path: str) -> StoreIndex:
+    with open(path, "rb") as f:
+        raw = f.read()
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    if len(buf) < 1:
+        raise ValueError("empty gossip store")
+    ver = int(buf[0])
+    if ver >> 5 != 0:
+        raise ValueError(f"incompatible gossip store major version {ver >> 5}")
+    d = native.gossip_store_scan(buf, start_off=1)
+    return StoreIndex(buf, **d)
+
+
+class StoreWriter:
+    """Append-only store writer (used by gossipd-equivalent + test/bench
+    synthesis)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+        self.f = open(path, "ab")
+        if fresh:
+            self.f.write(bytes([VERSION_BYTE]))
+
+    def append(self, msg: bytes, timestamp: int = 0, flags: int = 0):
+        crc = native.crc32c(timestamp, msg)
+        hdr = (
+            int(flags).to_bytes(2, "big")
+            + len(msg).to_bytes(2, "big")
+            + crc.to_bytes(4, "big")
+            + int(timestamp).to_bytes(4, "big")
+        )
+        self.f.write(hdr + msg)
+
+    def append_many(self, msgs, timestamps=None):
+        parts = []
+        for i, msg in enumerate(msgs):
+            ts = int(timestamps[i]) if timestamps is not None else 0
+            crc = native.crc32c(ts, msg)
+            parts.append(
+                (0).to_bytes(2, "big") + len(msg).to_bytes(2, "big")
+                + crc.to_bytes(4, "big") + ts.to_bytes(4, "big") + msg
+            )
+        self.f.write(b"".join(parts))
+
+    def close(self):
+        self.f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def compact_store(src: str, dst: str) -> int:
+    """Rewrite the store dropping deleted records (the reference runs this
+    as a dedicated subdaemon, gossipd/compactd.c).  Returns record count."""
+    idx = load_store(src)
+    keep = idx.select(idx.alive())
+    with open(dst, "wb") as f:
+        f.write(bytes([VERSION_BYTE]))
+        out = []
+        for i in range(len(keep)):
+            o, l = int(keep.offsets[i]), int(keep.lengths[i])
+            hdr = (
+                int(keep.flags[i]).to_bytes(2, "big")
+                + l.to_bytes(2, "big")
+                + int(keep.crcs[i]).to_bytes(4, "big")
+                + int(keep.timestamps[i]).to_bytes(4, "big")
+            )
+            out.append(hdr + bytes(keep.buf[o : o + l]))
+        f.write(b"".join(out))
+    return len(keep)

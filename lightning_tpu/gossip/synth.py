@@ -1,0 +1,163 @@
+"""Synthetic gossip-network generator (tests + benchmarks).
+
+Produces a spec-valid gossip_store with n_channels channel_announcements
+(4 real ECDSA sigs each), 2 channel_updates per channel and one
+node_announcement per node — the same shape of workload as the reference's
+"million channels project" store used by tools/bench-gossipd.sh.
+
+Signing runs on-device in bulk (ecdsa_sign_simple_kernel); hashing at
+generation time uses hashlib so test data is independent of the JAX SHA
+kernel under test.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..crypto import field as F
+from ..crypto import secp256k1 as S
+from . import wire
+from .store import StoreWriter
+
+SIGN_BUCKET = 1 << 12  # production/bench default; tests pass a small one
+
+
+def _sha256d(b: bytes) -> bytes:
+    return hashlib.sha256(hashlib.sha256(b).digest()).digest()
+
+
+def _rand_scalars(rng: np.random.Generator, n: int) -> list[int]:
+    return [int.from_bytes(rng.bytes(32), "big") % (F.N_INT - 1) + 1 for _ in range(n)]
+
+
+def _sign_bulk(hashes: list[bytes], keys: list[int], rng,
+               bucket: int = SIGN_BUCKET) -> np.ndarray:
+    """Batched device sign → (N, 64) compact sigs."""
+    N = len(hashes)
+    out = np.empty((N, 64), np.uint8)
+    kern = jax.jit(S.ecdsa_sign_simple_kernel)
+    for start in range(0, N, bucket):
+        end = min(start + bucket, N)
+        B = bucket
+        zs = np.zeros((B, F.NLIMBS), np.uint32)
+        ds = np.zeros((B, F.NLIMBS), np.uint32)
+        ks = np.zeros((B, F.NLIMBS), np.uint32)
+        for i in range(start, end):
+            zs[i - start] = F.int_to_limbs(int.from_bytes(hashes[i], "big"))
+            ds[i - start] = F.int_to_limbs(keys[i])
+            ks[i - start] = F.int_to_limbs(int.from_bytes(rng.bytes(32), "big") % (F.N_INT - 1) + 1)
+        r, s, ok = kern(jnp.asarray(zs), jnp.asarray(ds), jnp.asarray(ks))
+        assert bool(np.asarray(ok)[: end - start].all())
+        out[start:end, :32] = F.to_bytes_be(np.asarray(r))[: end - start]
+        out[start:end, 32:] = F.to_bytes_be(np.asarray(s))[: end - start]
+    return out
+
+
+def make_network_store(
+    path: str,
+    n_channels: int,
+    n_nodes: int | None = None,
+    updates_per_channel: int = 2,
+    node_announcements: bool = True,
+    seed: int = 7,
+    sign_bucket: int = SIGN_BUCKET,
+):
+    """Generate and write a synthetic, fully-signed gossip store.
+    Returns a dict of counts."""
+    rng = np.random.default_rng(seed)
+    n_nodes = n_nodes or max(2, n_channels // 8)
+    seckeys = _rand_scalars(rng, n_nodes)
+    pubs = S.derive_pubkeys(
+        np.stack([F.int_to_limbs(k) for k in seckeys]).astype(np.uint32)
+    )
+    pub_bytes = [bytes(p) for p in pubs]
+
+    # channel endpoints; BOLT7: node_id_1 is the lexically lesser key
+    a = rng.integers(0, n_nodes, n_channels)
+    b = (a + 1 + rng.integers(0, n_nodes - 1, n_channels)) % n_nodes
+    swap = np.array([pub_bytes[x] > pub_bytes[y] for x, y in zip(a, b)])
+    n1 = np.where(swap, b, a)
+    n2 = np.where(swap, a, b)
+
+    # --- channel_announcements: build unsigned, hash, bulk-sign, patch
+    ca_msgs = []
+    for i in range(n_channels):
+        scid = (500000 + i // 2016) << 40 | (i % 2016) << 16 | 0
+        ca = wire.ChannelAnnouncement(
+            short_channel_id=int(scid),
+            node_id_1=pub_bytes[n1[i]],
+            node_id_2=pub_bytes[n2[i]],
+            bitcoin_key_1=pub_bytes[n1[i]],
+            bitcoin_key_2=pub_bytes[n2[i]],
+        )
+        ca_msgs.append(bytearray(ca.serialize()))
+    ca_hashes = [_sha256d(bytes(m[wire.CA_SIGNED_OFFSET:])) for m in ca_msgs]
+    sig_jobs_h, sig_jobs_k, patch = [], [], []
+    for i in range(n_channels):
+        for j, signer in enumerate((n1[i], n2[i], n1[i], n2[i])):
+            sig_jobs_h.append(ca_hashes[i])
+            sig_jobs_k.append(seckeys[signer])
+            patch.append((i, wire.CA_SIG_OFFSETS[j]))
+    sigs = _sign_bulk(sig_jobs_h, sig_jobs_k, rng, sign_bucket)
+    for (i, off), sig in zip(patch, sigs):
+        ca_msgs[i][off : off + 64] = bytes(sig)
+
+    # --- channel_updates
+    cu_msgs, cu_hashes, cu_keys = [], [], []
+    for i in range(n_channels):
+        for d in range(updates_per_channel):
+            direction = d % 2
+            cu = wire.ChannelUpdate(
+                short_channel_id=int((500000 + i // 2016) << 40 | (i % 2016) << 16),
+                timestamp=1700000000 + i,
+                channel_flags=direction,
+                htlc_maximum_msat=int(rng.integers(1, 1 << 40)),
+                fee_base_msat=int(rng.integers(0, 5000)),
+                fee_proportional_millionths=int(rng.integers(0, 10000)),
+            )
+            m = bytearray(cu.serialize())
+            cu_msgs.append(m)
+            cu_hashes.append(_sha256d(bytes(m[wire.CU_SIGNED_OFFSET:])))
+            cu_keys.append(seckeys[(n1 if direction == 0 else n2)[i]])
+    if cu_msgs:
+        sigs = _sign_bulk(cu_hashes, cu_keys, rng, sign_bucket)
+        for m, sig in zip(cu_msgs, sigs):
+            m[wire.CU_SIG_OFFSET : wire.CU_SIG_OFFSET + 64] = bytes(sig)
+
+    # --- node_announcements
+    na_msgs = []
+    if node_announcements:
+        na_hashes, na_keys = [], []
+        for i in range(n_nodes):
+            na = wire.NodeAnnouncement(
+                timestamp=1700000000 + i,
+                node_id=pub_bytes[i],
+                alias=(b"tpu-node-%06d" % i).ljust(32, b"\x00"),
+            )
+            m = bytearray(na.serialize())
+            na_msgs.append(m)
+            na_hashes.append(_sha256d(bytes(m[wire.NA_SIGNED_OFFSET:])))
+            na_keys.append(seckeys[i])
+        sigs = _sign_bulk(na_hashes, na_keys, rng, sign_bucket)
+        for m, sig in zip(na_msgs, sigs):
+            m[wire.NA_SIG_OFFSET : wire.NA_SIG_OFFSET + 64] = bytes(sig)
+
+    with StoreWriter(path) as w:
+        w.append_many([bytes(m) for m in ca_msgs],
+                      [1700000000 + i for i in range(len(ca_msgs))])
+        w.append_many([bytes(m) for m in cu_msgs],
+                      [1700000000 + i for i in range(len(cu_msgs))])
+        w.append_many([bytes(m) for m in na_msgs],
+                      [1700000000 + i for i in range(len(na_msgs))])
+    return {
+        "channels": n_channels,
+        "nodes": n_nodes,
+        "channel_updates": len(cu_msgs),
+        "node_announcements": len(na_msgs),
+        "sigs": 4 * n_channels + len(cu_msgs) + len(na_msgs),
+        "seckeys": seckeys,
+    }

@@ -1,0 +1,84 @@
+/* Native host-side helpers for the gossip_store → TPU verify pipeline.
+ *
+ * Store record layout matches the reference's on-disk format
+ * (common/gossip_store.h:44-50): version byte, then records of
+ *   be16 flags | be16 len | be32 crc | be32 timestamp | msg[len]
+ * where msg starts with the be16 wire message type.
+ *
+ * These scanners exist so a ~1M-record replay spends host time at memcpy
+ * speed: the Python layer gets flat numpy arrays (offsets/lengths/types)
+ * and slices signature/pubkey fields with vectorized gathers, while the
+ * signed regions are packed (with SHA256 padding pre-applied) straight
+ * into the pinned staging buffer the device hashes from.
+ */
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+static inline uint16_t rd_be16(const uint8_t *p) {
+    return (uint16_t)((p[0] << 8) | p[1]);
+}
+static inline uint32_t rd_be32(const uint8_t *p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | p[3];
+}
+
+/* Scan records from `off` to end of buffer.  Returns record count, or -1 if
+ * a record header/body would run past the end (truncated store).  Arrays
+ * must have capacity for (size - off) / 12 entries. */
+int64_t gossip_store_scan(const uint8_t *buf, uint64_t size, uint64_t off,
+                          uint64_t *offsets, uint32_t *lengths,
+                          uint16_t *flags, uint32_t *timestamps,
+                          uint32_t *crcs, uint16_t *types) {
+    int64_t n = 0;
+    while (off < size) {
+        if (off + 12 > size) return -1;
+        uint16_t f = rd_be16(buf + off);
+        uint16_t len = rd_be16(buf + off + 2);
+        if (off + 12 + len > size) return -1;
+        offsets[n] = off + 12;
+        lengths[n] = len;
+        flags[n] = f;
+        crcs[n] = rd_be32(buf + off + 4);
+        timestamps[n] = rd_be32(buf + off + 8);
+        types[n] = len >= 2 ? rd_be16(buf + off + 12) : 0xFFFF;
+        n++;
+        off += 12 + (uint64_t)len;
+    }
+    return n;
+}
+
+/* Pack variable-length signed regions into fixed-size SHA256 block rows.
+ *
+ * For record i: copies buf[offsets[i] .. offsets[i]+lengths[i]) into
+ * out + i*row_bytes, applies SHA256 padding (0x80, zeros, 64-bit bit
+ * length), zero-fills the rest, and writes the number of 64-byte blocks
+ * to n_blocks[i].  Returns -1 if any region needs more than row_bytes. */
+int64_t sha256_pack(const uint8_t *buf, const uint64_t *offsets,
+                    const uint32_t *lengths, size_t n, uint8_t *out,
+                    uint64_t row_bytes, uint32_t *n_blocks) {
+    for (size_t i = 0; i < n; i++) {
+        uint32_t len = lengths[i];
+        uint64_t padded = ((uint64_t)len + 1 + 8 + 63) & ~63ull;
+        if (padded > row_bytes) return -1;
+        uint8_t *row = out + i * row_bytes;
+        memcpy(row, buf + offsets[i], len);
+        row[len] = 0x80;
+        memset(row + len + 1, 0, padded - len - 1 - 8);
+        uint64_t bits = (uint64_t)len * 8;
+        for (int b = 0; b < 8; b++)
+            row[padded - 1 - b] = (uint8_t)(bits >> (8 * b));
+        if (padded < row_bytes)
+            memset(row + padded, 0, row_bytes - padded);
+        n_blocks[i] = (uint32_t)(padded / 64);
+    }
+    return 0;
+}
+
+/* Gather fixed-size fields at per-record offsets: out[i] = buf[offsets[i]
+ * + field_off .. +field_len).  Bounds are the caller's responsibility. */
+void gather_fields(const uint8_t *buf, const uint64_t *offsets, size_t n,
+                   uint64_t field_off, uint32_t field_len, uint8_t *out) {
+    for (size_t i = 0; i < n; i++)
+        memcpy(out + i * field_len, buf + offsets[i] + field_off, field_len);
+}

@@ -1,0 +1,84 @@
+"""Device-mesh and sharding helpers for the batched-crypto data plane.
+
+CLN's "distributed backend" is a fleet of single-purpose processes wired by
+socketpairs (SURVEY.md §2.5); the TPU-native equivalent moves the heavy
+math (signature verify/sign fan-out) onto a device mesh and keeps the
+protocol plane on host.  Scaling axis:
+
+* ``batch``: data-parallel over signatures.  A verify batch of B sigs is
+  sharded (B/n per device); each device runs the identical branchless
+  kernel; the only collective is the boolean gather at the end (and a
+  psum for the "all valid" fast path) — pure ICI traffic, no host hop.
+
+This mirrors how the reference scales gossip verification across...
+nothing (it is serial, gossipd/sigcheck.c) — the mesh IS the delta.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+BATCH_AXIS = "batch"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """1-D data-parallel mesh over all (or the given) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (BATCH_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(BATCH_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0):
+    """Pad with trailing zeros so shape[axis] % multiple == 0.
+    Returns (padded, original_length)."""
+    n = arr.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr, n
+    pad = [(0, 0)] * arr.ndim
+    pad[axis] = (0, rem)
+    return np.pad(arr, pad), n
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """device_put each array with leading-axis sharding over the mesh.
+    Arrays must already be padded to a multiple of the mesh size."""
+    sh = batch_sharding(mesh)
+    return tuple(jax.device_put(a, sh) for a in arrays)
+
+
+@functools.lru_cache(maxsize=16)
+def sharded_verify_fn(mesh: Mesh):
+    """jit-compiled ECDSA verify step sharded over the mesh's batch axis.
+
+    Inputs: z, r, s, qx (B,16) uint32; parity (B,) uint32 — B divisible by
+    mesh size.  Output: bool (B,) with the same sharding, plus a replicated
+    scalar count of valid sigs (forces a psum collective, which doubles as
+    the aggregate "how many failed" signal gossipd wants).
+    """
+    from ..crypto import secp256k1 as S
+
+    sh = batch_sharding(mesh)
+    rep = replicated(mesh)
+
+    def step(z, r, s, qx, parity):
+        ok = S.ecdsa_verify_kernel(z, r, s, qx, parity)
+        return ok, jnp.sum(ok.astype(jnp.uint32))
+
+    return jax.jit(
+        step,
+        in_shardings=(sh, sh, sh, sh, sh),
+        out_shardings=(sh, rep),
+    )

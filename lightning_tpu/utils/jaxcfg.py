@@ -1,0 +1,22 @@
+"""Shared JAX configuration: persistent compilation cache.
+
+The crypto kernels are scan-heavy (256-step field inversions, 64-step
+windowed point multiplies); a cold compile takes minutes on a small host.
+The persistent cache makes every process after the first start instantly,
+which matters for the subdaemon architecture (each daemon process jits the
+same kernels) and for repeated bench/test runs.
+"""
+import os
+
+import jax
+
+_DEFAULT_CACHE = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), ".jax_cache")
+
+
+def setup_cache(path: str | None = None) -> None:
+    path = path or os.environ.get("LIGHTNING_TPU_JAX_CACHE", _DEFAULT_CACHE)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

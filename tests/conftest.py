@@ -1,0 +1,24 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh so
+multi-chip sharding is exercised without TPU hardware (the driver separately
+dry-runs the multichip path; see __graft_entry__.py).
+
+NOTE: the environment preloads jax with JAX_PLATFORMS=axon (real TPU via a
+network tunnel) from sitecustomize, so we must override the platform via
+jax.config, not just env vars, and before any backend is initialized."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.default_backend() == "cpu", "tests must run on the CPU mesh"
+assert jax.device_count() == 8, "expected virtual 8-device CPU mesh"
+
+from lightning_tpu.utils.jaxcfg import setup_cache
+
+setup_cache()

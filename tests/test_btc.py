@@ -1,0 +1,167 @@
+"""Bitcoin primitives + BOLT#3 derivation tests."""
+import hashlib
+
+import pytest
+
+from lightning_tpu.btc import keys as K
+from lightning_tpu.btc import script as SC
+from lightning_tpu.btc import tx as T
+from lightning_tpu.crypto import ref_python as ref
+
+
+class TestShachain:
+    def test_bolt3_generation_vectors(self):
+        # BOLT#3 appendix 'generation tests' (public spec vectors)
+        assert K.shachain_derive_secret(b"\x00" * 32, 0xFFFFFFFFFFFF).hex() == \
+            "02a40c85b6f28da08dfdbe0926c53fab2de6d28c10301f8f7c4073d5e42e3148"
+        assert K.shachain_derive_secret(b"\xff" * 32, 0xFFFFFFFFFFFF).hex() == \
+            "7cc854b54e3e0dcdb010d7a3fee464a9687be6e8db3be6854c475621e007a5dc"
+
+    def test_derivation_consistency(self):
+        seed = hashlib.sha256(b"seed").digest()
+        # parent with b trailing zeros derives all children in its subtree
+        parent_idx = 0b101000  # 3 trailing zeros
+        parent = K.shachain_derive_secret(seed, parent_idx)
+        for child in range(parent_idx, parent_idx + 8):
+            assert K._derive(parent_idx, child, parent) == \
+                K.shachain_derive_secret(seed, child)
+
+    def test_receiver_accepts_valid_sequence(self):
+        seed = hashlib.sha256(b"r").digest()
+        recv = K.ShachainReceiver()
+        start = K.LARGEST_INDEX
+        inserted = []
+        for i in range(50):
+            idx = start - i
+            assert recv.insert(idx, K.shachain_derive_secret(seed, idx)), i
+            inserted.append(idx)
+            # storage stays logarithmic
+            assert sum(1 for s in recv.known if s is not None) <= 49
+        for idx in inserted:
+            assert recv.lookup(idx) == K.shachain_derive_secret(seed, idx)
+
+    def test_receiver_rejects_inconsistent(self):
+        seed = hashlib.sha256(b"r").digest()
+        recv = K.ShachainReceiver()
+        idx = K.LARGEST_INDEX
+        assert recv.insert(idx, K.shachain_derive_secret(seed, idx))
+        bad = hashlib.sha256(b"lie").digest()
+        # idx-1 has more capacity (trailing zero) and must derive idx's
+        assert not recv.insert(idx - 1, bad)
+
+    def test_lookup_unknown_returns_none(self):
+        recv = K.ShachainReceiver()
+        assert recv.lookup(123) is None
+
+
+class TestKeyDerivation:
+    SEED = hashlib.sha256(b"channel-seed").digest()
+
+    def test_pub_priv_consistency(self):
+        base = K.BaseSecrets.from_seed(self.SEED)
+        pc_secret = K.shachain_derive_secret(self.SEED, K.LARGEST_INDEX)
+        pc_point = K.per_commitment_point(pc_secret)
+        # derive_pubkey(basepoint) == G * derive_privkey(basesecret)
+        pub = K.derive_pubkey(base.basepoints().payment, pc_point)
+        priv = K.derive_privkey(base.payment, pc_point)
+        assert ref.pubkey_create(priv) == pub
+
+    def test_revocation_consistency(self):
+        base = K.BaseSecrets.from_seed(self.SEED)
+        pc_secret_b = K.shachain_derive_secret(self.SEED, 42)
+        pc_secret = int.from_bytes(pc_secret_b, "big") % ref.N
+        pc_point = ref.pubkey_create(pc_secret)
+        pub = K.derive_revocation_pubkey(base.basepoints().revocation, pc_point)
+        priv = K.derive_revocation_privkey(base.revocation, pc_secret)
+        assert ref.pubkey_create(priv) == pub
+
+
+class TestTx:
+    def _mk_tx(self):
+        return T.Tx(
+            version=2,
+            inputs=[T.TxInput(hashlib.sha256(b"prev").digest(), 1,
+                              sequence=0x80000001)],
+            outputs=[T.TxOutput(50_000, SC.p2wpkh(b"\x02" + b"\x11" * 32)),
+                     T.TxOutput(25_000, SC.p2wsh(b"\x51"))],
+            locktime=0x20ABCDEF,
+        )
+
+    def test_serialize_parse_roundtrip(self):
+        tx = self._mk_tx()
+        tx2 = T.Tx.parse(tx.serialize())
+        assert tx2.serialize() == tx.serialize()
+        tx.inputs[0].witness = [b"", b"\x01" * 71, b"\x02" * 33]
+        tx3 = T.Tx.parse(tx.serialize())
+        assert tx3.serialize() == tx.serialize()
+        assert tx3.inputs[0].witness == tx.inputs[0].witness
+
+    def test_txid_ignores_witness(self):
+        tx = self._mk_tx()
+        txid1 = tx.txid()
+        tx.inputs[0].witness = [b"\x00" * 64]
+        assert tx.txid() == txid1
+        assert tx.wtxid() != txid1
+
+    def test_weight(self):
+        tx = self._mk_tx()
+        base = len(tx.serialize(include_witness=False))
+        assert tx.weight() == base * 4  # no witness
+        tx.inputs[0].witness = [b"x" * 10]
+        assert tx.weight() == base * 3 + len(tx.serialize())
+
+    def test_sighash_sign_verify_cycle(self):
+        """BIP143 sighash signed and verified via the oracle: internal
+        consistency of the sighash pipeline."""
+        key = 0xABCDEF123456789
+        pub = ref.pubkey_serialize(ref.pubkey_create(key))
+        ws = SC.funding_script(pub, b"\x02" + b"\x42" * 32)
+        tx = self._mk_tx()
+        h = tx.sighash_segwit(0, ws, 75_000)
+        r, s = ref.ecdsa_sign(h, key)
+        assert ref.ecdsa_verify(h, r, s, ref.pubkey_create(key))
+        # sighash commits to the script and amount
+        assert tx.sighash_segwit(0, ws, 75_001) != h
+        assert tx.sighash_segwit(0, ws + b"\x00", 75_000) != h
+
+    def test_der_roundtrip(self):
+        for r, s in [(1, 2), (ref.N - 1, ref.N // 2), (1 << 255, 77)]:
+            der = T.sig_to_der(r, s)
+            assert T.der_to_sig(der) == (r, s, 1)
+
+
+class TestScripts:
+    PUB1 = b"\x02" + b"\x11" * 32
+    PUB2 = b"\x03" + b"\x22" * 32
+    PUB3 = b"\x02" + b"\x33" * 32
+    PH = hashlib.sha256(b"preimage").digest()
+
+    def test_funding_script_sorted(self):
+        s1 = SC.funding_script(self.PUB1, self.PUB2)
+        s2 = SC.funding_script(self.PUB2, self.PUB1)
+        assert s1 == s2
+        assert s1[0] == SC.OP_2 and s1[-1] == SC.OP_CHECKMULTISIG
+
+    def test_to_local_script_structure(self):
+        s = SC.to_local_script(self.PUB1, 144, self.PUB2)
+        assert s[0] == SC.OP_IF and s[-1] == SC.OP_CHECKSIG
+        assert self.PUB1 in s and self.PUB2 in s
+
+    def test_htlc_scripts_contain_ripemd(self):
+        for anchors in (False, True):
+            off = SC.offered_htlc_script(self.PUB1, self.PUB2, self.PUB3,
+                                         self.PH, anchors)
+            rec = SC.received_htlc_script(self.PUB1, self.PUB2, self.PUB3,
+                                          self.PH, 500000, anchors)
+            assert SC.ripemd160(self.PH) in off
+            assert SC.ripemd160(self.PH) in rec
+            assert (SC.script(SC.push_num(1), SC.OP_CHECKSEQUENCEVERIFY,
+                              SC.OP_DROP) in off) == anchors
+
+    def test_push_num_minimal(self):
+        assert SC.push_num(0) == bytes([SC.OP_0])
+        assert SC.push_num(1) == bytes([SC.OP_1])
+        assert SC.push_num(16) == bytes([SC.OP_16])
+        assert SC.push_num(17) == b"\x01\x11"
+        assert SC.push_num(144) == b"\x02\x90\x00"  # needs 0x00 pad (0x90 has high bit)
+        assert SC.push_num(500000) == b"\x03\x20\xa1\x07"

@@ -1,0 +1,144 @@
+"""BOLT#3 commitment construction tests (structure + invariants; the
+reference pins this down with channeld/test/run-full_channel.c — our
+equivalent drives the same construction through oracle sign/verify)."""
+import hashlib
+
+import pytest
+
+from lightning_tpu.btc import keys as K
+from lightning_tpu.btc import script as SC
+from lightning_tpu.btc import tx as T
+from lightning_tpu.channel import commitment as C
+from lightning_tpu.crypto import ref_python as ref
+
+
+def mk_side(tag: bytes):
+    secrets = K.BaseSecrets.from_seed(hashlib.sha256(tag).digest())
+    return secrets, secrets.basepoints()
+
+
+@pytest.fixture
+def chan():
+    a_sec, a_base = mk_side(b"alice")
+    b_sec, b_base = mk_side(b"bob")
+    ser = ref.pubkey_serialize
+    params = C.CommitmentParams(
+        funding_txid=hashlib.sha256(b"funding").digest(),
+        funding_output_index=0,
+        funding_sat=1_000_000,
+        opener=C.Side.LOCAL,
+        opener_payment_basepoint=ser(a_base.payment),
+        accepter_payment_basepoint=ser(b_base.payment),
+        to_self_delay=144,
+        dust_limit_sat=546,
+        feerate_per_kw=2500,
+        anchors=True,
+        local_funding_pubkey=ser(a_base.funding_pubkey),
+        remote_funding_pubkey=ser(b_base.funding_pubkey),
+    )
+    pc_secret = K.shachain_derive_secret(hashlib.sha256(b"alice").digest(),
+                                         K.LARGEST_INDEX)
+    pc_point = K.per_commitment_point(pc_secret)
+    keys = C.CommitmentKeys.derive(a_base, b_base, pc_point)
+    return params, keys, (a_sec, a_base), (b_sec, b_base)
+
+
+def htlcs_sample():
+    return [
+        C.Htlc(True, 400_000_000, hashlib.sha256(b"h1").digest(), 500_100, id=0),
+        C.Htlc(False, 300_000_000, hashlib.sha256(b"h2").digest(), 500_050, id=1),
+        C.Htlc(True, 1_000, hashlib.sha256(b"h3").digest(), 500_000, id=2),  # dust
+    ]
+
+
+class TestCommitment:
+    def test_basic_structure(self, chan):
+        params, keys, _, _ = chan
+        tx, hmap = C.build_commitment_tx(
+            params, keys, commitment_number=42,
+            to_local_msat=600_000_000, to_remote_msat=399_300_000,
+            htlcs=[], holder_is_opener=True,
+        )
+        assert tx.version == 2
+        assert len(tx.inputs) == 1
+        # to_local + to_remote + 2 anchors
+        assert len(tx.outputs) == 4
+        assert (tx.locktime >> 24) == 0x20
+        assert (tx.inputs[0].sequence >> 24) == 0x80
+        assert all(h is None for h in hmap)
+        anchor_outs = [o for o in tx.outputs if o.amount_sat == C.ANCHOR_OUTPUT_SAT]
+        assert len(anchor_outs) == 2
+
+    def test_obscured_number_varies(self, chan):
+        params, keys, _, _ = chan
+        txs = set()
+        for n in (0, 1, 42):
+            tx, _ = C.build_commitment_tx(
+                params, keys, n, 600_000_000, 399_300_000, [], True)
+            txs.add((tx.locktime, tx.inputs[0].sequence))
+        assert len(txs) == 3
+
+    def test_htlc_outputs_and_trimming(self, chan):
+        params, keys, _, _ = chan
+        tx, hmap = C.build_commitment_tx(
+            params, keys, 7, 500_000_000, 498_600_000 - 400_000_000 - 300_000_000 + 400_000_000 + 300_000_000,
+            htlcs_sample(), True,
+        )
+        live = [h for h in hmap if h is not None]
+        assert len(live) == 2  # dust HTLC trimmed
+        assert {h.id for h in live} == {0, 1}
+
+    def test_fee_paid_by_opener(self, chan):
+        params, keys, _, _ = chan
+        tx_open, _ = C.build_commitment_tx(
+            params, keys, 7, 600_000_000, 399_300_000, [], True)
+        tx_noopen, _ = C.build_commitment_tx(
+            params, keys, 7, 600_000_000, 399_300_000, [], False)
+        local_open = max(o.amount_sat for o in tx_open.outputs
+                         if o.amount_sat != C.ANCHOR_OUTPUT_SAT and o.amount_sat < 600_000)
+        # when holder opens, its (to_local=600k sat) output pays fee+anchors
+        amounts_open = sorted(o.amount_sat for o in tx_open.outputs)
+        amounts_noopen = sorted(o.amount_sat for o in tx_noopen.outputs)
+        assert amounts_open != amounts_noopen
+        assert sum(amounts_open) < 1_000_000  # fee left the outputs
+
+    def test_bip69_ordering(self, chan):
+        params, keys, _, _ = chan
+        tx, _ = C.build_commitment_tx(
+            params, keys, 7, 500_000_000, 400_000_000, htlcs_sample(), True)
+        pairs = [(o.amount_sat, o.script_pubkey) for o in tx.outputs]
+        assert pairs == sorted(pairs)
+
+    def test_htlc_sighash_pipeline_sign_verify(self, chan):
+        """End-to-end: build commitment, derive per-HTLC sighashes, sign
+        with the oracle htlc key, verify — the exact batch the TPU signer
+        executes per commitment_signed."""
+        params, keys, (a_sec, a_base), _ = chan
+        tx, hmap = C.build_commitment_tx(
+            params, keys, 7, 500_000_000, 400_000_000, htlcs_sample(), True)
+        sighashes = C.htlc_sighashes(tx, hmap, keys, params.to_self_delay,
+                                     params.feerate_per_kw, params.anchors)
+        assert len(sighashes) == 2
+        pc_point = keys.per_commitment_point
+        htlc_priv = K.derive_privkey(a_sec.htlc, pc_point)
+        for idx, sh in sighashes:
+            r, s = ref.ecdsa_sign(sh, htlc_priv)
+            assert ref.ecdsa_verify(sh, r, s, ref.pubkey_create(htlc_priv))
+
+    def test_htlc_tx_locktime_rules(self, chan):
+        params, keys, _, _ = chan
+        offered = C.Htlc(True, 400_000_000, b"\x01" * 32, 500_100)
+        received = C.Htlc(False, 400_000_000, b"\x02" * 32, 500_100)
+        t1 = C.build_htlc_tx(b"\x00" * 32, 0, offered, keys, 144, 2500, True)
+        t2 = C.build_htlc_tx(b"\x00" * 32, 0, received, keys, 144, 2500, True)
+        assert t1.locktime == 500_100  # timeout tx locks until expiry
+        assert t2.locktime == 0  # success tx spends immediately
+        assert t1.inputs[0].sequence == 1  # anchors: CSV 1
+
+    def test_no_anchor_variant(self, chan):
+        params, keys, _, _ = chan
+        params.anchors = False
+        tx, _ = C.build_commitment_tx(
+            params, keys, 7, 600_000_000, 399_300_000, [], True)
+        assert len(tx.outputs) == 2
+        assert not any(o.amount_sat == C.ANCHOR_OUTPUT_SAT for o in tx.outputs)

@@ -1,0 +1,135 @@
+"""Randomized + boundary tests of the JAX limb engine against Python bigints."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightning_tpu.crypto import field as F
+
+RNG = np.random.default_rng(1234)
+
+
+def rand_ints(n, lo=0, hi=1 << 256):
+    return [int.from_bytes(RNG.bytes(32), "big") for _ in range(n)]
+
+
+BOUNDARY = [
+    0, 1, 2, 976, 977, 978,
+    F.P_INT - 1, F.P_INT, F.P_INT + 1,
+    F.N_INT - 1, F.N_INT, F.N_INT + 1,
+    (1 << 256) - 1, (1 << 256) - 2, (1 << 255), (1 << 255) + 1,
+    2**32 + 977, 2**128, 2**128 - 1,
+]
+
+
+def limbs(xs):
+    return jnp.asarray(F.from_int_array(xs))
+
+
+def ints(arr):
+    arr = np.asarray(arr)
+    return [F.limbs_to_int(arr[i]) for i in range(arr.shape[0])]
+
+
+@pytest.mark.parametrize("mod", [F.FP, F.FN], ids=["p", "n"])
+def test_roundtrip(mod):
+    xs = BOUNDARY + rand_ints(50)
+    assert ints(limbs(xs)) == xs
+
+
+@pytest.mark.parametrize("mod", [F.FP, F.FN], ids=["p", "n"])
+def test_add_sub_mul(mod):
+    xs = BOUNDARY + rand_ints(200)
+    ys = list(reversed(BOUNDARY)) + rand_ints(200)
+    a, b = limbs(xs), limbs(ys)
+    m = mod.m
+
+    got = ints(F.normalize(mod, F.add(mod, a, b)))
+    assert got == [(x + y) % m for x, y in zip(xs, ys)]
+
+    got = ints(F.normalize(mod, F.sub(mod, a, b)))
+    assert got == [(x - y) % m for x, y in zip(xs, ys)]
+
+    got = ints(F.normalize(mod, F.mul(mod, a, b)))
+    assert got == [(x * y) % m for x, y in zip(xs, ys)]
+
+
+@pytest.mark.parametrize("mod", [F.FP, F.FN], ids=["p", "n"])
+def test_mul_chain_stays_in_range(mod):
+    # Chained lazy ops must keep representatives < 2^256 (limbs ≤ 0xFFFF).
+    xs = rand_ints(64)
+    ys = rand_ints(64)
+    a, b = limbs(xs), limbs(ys)
+    acc = F.mul(mod, a, b)
+    vals = [(x * y) % mod.m for x, y in zip(xs, ys)]
+    for _ in range(5):
+        acc2 = F.mul(mod, acc, acc)
+        acc2 = F.add(mod, acc2, a)
+        acc2 = F.sub(mod, acc2, b)
+        vals = [(v * v + x - y) % mod.m for v, x, y in zip(vals, xs, ys)]
+        acc = acc2
+        assert np.asarray(acc).max() < F.LOOSE_BOUND
+    assert ints(F.normalize(mod, acc)) == vals
+
+
+@pytest.mark.parametrize("mod", [F.FP, F.FN], ids=["p", "n"])
+def test_mul_small(mod):
+    xs = BOUNDARY + rand_ints(20)
+    a = limbs(xs)
+    for k in [0, 1, 2, 3, 7, 21, 977, 6143]:
+        got = ints(F.normalize(mod, F.mul_small(mod, a, k)))
+        assert got == [(x * k) % mod.m for x in xs]
+
+
+@pytest.mark.parametrize("mod", [F.FP, F.FN], ids=["p", "n"])
+def test_inv(mod):
+    xs = [x for x in BOUNDARY if x % mod.m != 0][:8] + rand_ints(24)
+    a = limbs(xs)
+    got = ints(F.normalize(mod, jax.jit(lambda v: F.inv(mod, v))(a)))
+    assert got == [pow(x % mod.m, -1, mod.m) if x % mod.m else 0 for x in xs]
+
+
+def test_inv_zero_convention():
+    a = limbs([0, F.P_INT])
+    got = ints(F.normalize(F.FP, F.inv(F.FP, a)))
+    assert got == [0, 0]
+
+
+@pytest.mark.parametrize("mod", [F.FP, F.FN], ids=["p", "n"])
+def test_pow_const(mod):
+    xs = rand_ints(16)
+    a = limbs(xs)
+    for e in [1, 2, 3, (mod.m + 1) // 4, mod.m - 2]:
+        got = ints(F.normalize(mod, F.pow_const(mod, a, e)))
+        assert got == [pow(x, e, mod.m) for x in xs]
+
+
+def test_eq_is_zero():
+    mod = F.FP
+    xs = [0, F.P_INT, 5, F.P_INT + 5, 1 << 255]
+    ys = [F.P_INT, 0, F.P_INT + 5, 5, 1 << 255]
+    a, b = limbs(xs), limbs(ys)
+    assert list(np.asarray(F.eq(mod, a, b))) == [True, True, True, True, True]
+    assert list(np.asarray(F.is_zero(mod, a))) == [True, True, False, False, False]
+
+
+def test_bytes_roundtrip():
+    xs = BOUNDARY + rand_ints(20)
+    raw = np.stack([np.frombuffer(x.to_bytes(32, "big"), np.uint8) for x in xs])
+    l = F.from_bytes_be(raw)
+    assert [F.limbs_to_int(v) for v in l] == xs
+    assert np.array_equal(F.to_bytes_be(l), raw)
+
+
+def test_jit_and_vmap_compose():
+    mod = F.FP
+    xs = rand_ints(32)
+    ys = rand_ints(32)
+    a, b = limbs(xs), limbs(ys)
+    f = jax.jit(lambda u, v: F.normalize(mod, F.mul(mod, u, v)))
+    got = ints(f(a, b))
+    assert got == [(x * y) % mod.m for x, y in zip(xs, ys)]
+    g = jax.vmap(lambda u, v: F.mul(mod, u, v))
+    got2 = ints(F.normalize(mod, g(a, b)))
+    assert got2 == got

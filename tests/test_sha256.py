@@ -1,0 +1,48 @@
+"""Batched JAX SHA256 vs hashlib."""
+import hashlib
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from lightning_tpu.crypto import sha256 as H
+from lightning_tpu.crypto import field as F
+
+RNG = np.random.default_rng(7)
+
+
+def test_sha256_variable_lengths():
+    msgs = [b"", b"abc", b"a" * 55, b"a" * 56, b"a" * 64, b"x" * 100,
+            RNG.bytes(200), RNG.bytes(1), RNG.bytes(511), RNG.bytes(130)]
+    blocks, nb = H.pack_messages(msgs)
+    got = H.digest_to_bytes(np.asarray(H.sha256_blocks(jnp.asarray(blocks), jnp.asarray(nb))))
+    for i, m in enumerate(msgs):
+        assert bytes(got[i]) == hashlib.sha256(m).digest(), f"msg {i}"
+
+
+def test_sha256d():
+    msgs = [b"hello", RNG.bytes(80), b"", RNG.bytes(300)]
+    blocks, nb = H.pack_messages(msgs)
+    got = H.digest_to_bytes(np.asarray(H.sha256d_blocks(jnp.asarray(blocks), jnp.asarray(nb))))
+    for i, m in enumerate(msgs):
+        exp = hashlib.sha256(hashlib.sha256(m).digest()).digest()
+        assert bytes(got[i]) == exp
+
+
+def test_sha256_fixed():
+    msgs = [RNG.bytes(96) for _ in range(8)]  # 96+pad = 2 blocks exactly
+    blocks, nb = H.pack_messages(msgs)
+    assert blocks.shape[1] == 2 and all(nb == 2)
+    got = H.digest_to_bytes(np.asarray(H.sha256_fixed(jnp.asarray(blocks))))
+    for i, m in enumerate(msgs):
+        assert bytes(got[i]) == hashlib.sha256(m).digest()
+
+
+def test_digest_words_to_limbs():
+    msgs = [RNG.bytes(50) for _ in range(4)]
+    blocks, nb = H.pack_messages(msgs)
+    d = H.sha256_blocks(jnp.asarray(blocks), jnp.asarray(nb))
+    limbs = np.asarray(H.digest_words_to_limbs(d))
+    for i, m in enumerate(msgs):
+        expect = int.from_bytes(hashlib.sha256(m).digest(), "big")
+        assert F.limbs_to_int(limbs[i]) == expect

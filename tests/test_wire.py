@@ -1,0 +1,157 @@
+"""Wire codec framework + BOLT message roundtrips + BOLT#8 transport."""
+import pytest
+
+from lightning_tpu.wire import codec, messages as M
+from lightning_tpu.bolt import noise
+from lightning_tpu.crypto import ref_python as ref
+
+
+class TestBigsize:
+    # BOLT#1 bigsize canonical encodings
+    CASES = [
+        (0, b"\x00"), (252, b"\xfc"), (253, b"\xfd\x00\xfd"),
+        (65535, b"\xfd\xff\xff"), (65536, b"\xfe\x00\x01\x00\x00"),
+        (4294967295, b"\xfe\xff\xff\xff\xff"),
+        (4294967296, b"\xff\x00\x00\x00\x01\x00\x00\x00\x00"),
+    ]
+
+    def test_roundtrip(self):
+        for val, enc in self.CASES:
+            assert codec.write_bigsize(val) == enc
+            got, off = codec.read_bigsize(enc, 0)
+            assert got == val and off == len(enc)
+
+    def test_non_minimal_rejected(self):
+        for bad in [b"\xfd\x00\xfc", b"\xfe\x00\x00\xff\xff",
+                    b"\xff\x00\x00\x00\x00\xff\xff\xff\xff"]:
+            with pytest.raises(codec.WireError):
+                codec.read_bigsize(bad, 0)
+
+    def test_truncated(self):
+        with pytest.raises(codec.WireError):
+            codec.read_bigsize(b"\xfd\x01", 0)
+
+
+class TestTlv:
+    def test_roundtrip(self):
+        tlvs = {1: b"\xaa", 3: b"", 0xFFFF: b"hello"}
+        enc = codec.write_tlv_stream(tlvs)
+        assert codec.read_tlv_stream(enc) == tlvs
+
+    def test_ordering_enforced(self):
+        enc = codec.write_bigsize(3) + codec.write_bigsize(0) + \
+              codec.write_bigsize(1) + codec.write_bigsize(0)
+        with pytest.raises(codec.WireError):
+            codec.read_tlv_stream(enc)
+
+
+class TestMessages:
+    def test_init_roundtrip(self):
+        m = M.Init(features=b"\x80\x82", tlvs={1: b"\x01\x02"})
+        out = M.Init.parse(m.serialize())
+        assert out == m
+        assert codec.parse_message(m.serialize()) == m
+
+    def test_open_channel_roundtrip(self):
+        m = M.OpenChannel(
+            funding_satoshis=100000, push_msat=5, feerate_per_kw=253,
+            to_self_delay=144, max_accepted_htlcs=483,
+            funding_pubkey=b"\x02" + b"\x11" * 32,
+            channel_flags=1,
+        )
+        assert M.OpenChannel.parse(m.serialize()) == m
+
+    def test_commitment_signed_htlc_sigs(self):
+        sigs = [bytes([i]) * 64 for i in range(3)]
+        m = M.CommitmentSigned(channel_id=b"\x07" * 32,
+                               signature=b"\x01" * 64, htlc_signatures=sigs)
+        out = M.CommitmentSigned.parse(m.serialize())
+        assert out.htlc_signatures == sigs
+        assert out.channel_id == b"\x07" * 32
+
+    def test_update_add_htlc(self):
+        m = M.UpdateAddHtlc(id=7, amount_msat=123456, cltv_expiry=500000,
+                            payment_hash=b"\x09" * 32,
+                            onion_routing_packet=b"\x05" * M.ONION_PACKET_LEN)
+        assert M.UpdateAddHtlc.parse(m.serialize()) == m
+
+    def test_ping_pong(self):
+        p = M.Ping(num_pong_bytes=4, ignored=b"\x00" * 8)
+        assert M.Ping.parse(p.serialize()) == p
+        assert codec.msg_type(p.serialize()) == 18
+
+    def test_unknown_type(self):
+        with pytest.raises(codec.WireError):
+            codec.parse_message(b"\x99\x99payload")
+
+    def test_truncated_rejected(self):
+        m = M.RevokeAndAck(channel_id=b"\x01" * 32).serialize()
+        with pytest.raises(codec.WireError):
+            M.RevokeAndAck.parse(m[:-5])
+
+
+class TestNoise:
+    def _handshake(self):
+        rs = noise.Keypair(0x2121212121212121212121212121212121212121212121212121212121212121)
+        ls = noise.Keypair(0x1111111111111111111111111111111111111111111111111111111111111111)
+        ie = noise.Keypair(0x1212121212121212121212121212121212121212121212121212121212121212)
+        re = noise.Keypair(0x2222222222222222222222222222222222222222222222222222222222222222)
+        act1, cont_i = noise.initiator_handshake(ls, ie, rs.pub)
+        on_act1 = noise.responder_handshake(rs, re)
+        act2, cont_r = on_act1(act1)
+        act3, ikeys = cont_i(act2)
+        rkeys = cont_r(act3)
+        return ikeys, rkeys, ls, rs
+
+    def test_handshake_key_agreement(self):
+        ikeys, rkeys, ls, rs = self._handshake()
+        assert ikeys.sk == rkeys.rk
+        assert ikeys.rk == rkeys.sk
+        assert rkeys.remote_pub == ls.pub
+        assert ikeys.remote_pub == rs.pub
+
+    def test_act_sizes(self):
+        rs = noise.Keypair(42)
+        ls = noise.Keypair(43)
+        ie = noise.Keypair(44)
+        act1, _ = noise.initiator_handshake(ls, ie, rs.pub)
+        assert len(act1) == noise.ACT_ONE_SIZE
+
+    def test_bolt8_act1_vector(self):
+        """Official BOLT#8 initiator test vector (spec 08-transport.md)."""
+        rs = noise.Keypair(0x2121212121212121212121212121212121212121212121212121212121212121)
+        ls = noise.Keypair(0x1111111111111111111111111111111111111111111111111111111111111111)
+        ie = noise.Keypair(0x1212121212121212121212121212121212121212121212121212121212121212)
+        act1, _ = noise.initiator_handshake(ls, ie, rs.pub)
+        assert act1.hex() == (
+            "00036360e856310ce5d294e8be33fc807077dc56ac80d95d9cd4ddbd21325eff"
+            "73f70df6086551151f58b8afe6c195782c6a"
+        )
+
+    def test_transport_roundtrip_and_rotation(self):
+        ikeys, rkeys, _, _ = self._handshake()
+        a, b = noise.CryptoMsg(ikeys), noise.CryptoMsg(rkeys)
+        # cross 1000-message rekey boundary both directions
+        for i in range(1010):
+            msg = b"msg%04d" % i
+            assert b.decrypt(a.encrypt(msg)) == msg
+        for i in range(1010):
+            msg = b"rsp%04d" % i
+            assert a.decrypt(b.encrypt(msg)) == msg
+
+    def test_tampered_frame_rejected(self):
+        ikeys, rkeys, _, _ = self._handshake()
+        a, b = noise.CryptoMsg(ikeys), noise.CryptoMsg(rkeys)
+        frame = bytearray(a.encrypt(b"hello"))
+        frame[-1] ^= 1
+        with pytest.raises(Exception):
+            b.decrypt(bytes(frame))
+
+    def test_wrong_responder_key_fails(self):
+        rs = noise.Keypair(5)
+        wrong = noise.Keypair(6)
+        ls, ie, re = noise.Keypair(7), noise.Keypair(8), noise.Keypair(9)
+        act1, _ = noise.initiator_handshake(ls, ie, wrong.pub)
+        on_act1 = noise.responder_handshake(rs, re)
+        with pytest.raises(Exception):
+            on_act1(act1)
