@@ -7,55 +7,147 @@ Prints ONE JSON line:
 Workload (BASELINE.md configs 2-3): a synthetic gossip_store in the
 reference's on-disk format — channel_announcements (4 ECDSA sigs each,
 matching gossipd/sigcheck.c:45-113's cost model), channel_updates and
-node_announcements (1 sig each) — replay-verified end to end: mmap →
-native scan → field gathers → fused sha256d+ECDSA batched kernel.
+node_announcements (1 sig each) — replay-verified end to end: load →
+native scan → field gathers → chained sha256d+ECDSA batched kernels.
 
 vs_baseline divides by BASELINE_CPU_OPS = 50k verifies/sec, the upper end
 of single-core libsecp256k1 throughput cited in BASELINE.md (the library
 itself cannot be built here: vendored submodule is empty and the image has
 no network).  Using the upper end keeps the ratio conservative.
 
+Robustness (round-1 postmortem: the TPU backend failed to init and the
+whole run died with parsed=null): backend acquisition retries with
+backoff, falls back to the CPU backend with a smaller workload if the
+accelerator never comes up, and ANY error still emits the JSON line
+(value 0 + error detail) so the driver always has a parseable record.
+
 Env knobs: BENCH_CHANNELS (default 25000 → ~112k sigs), BENCH_BUCKET,
-BENCH_STORE (reuse an existing store file), BENCH_METRIC=replay|kernel.
+BENCH_STORE (reuse an existing store file), BENCH_CPU_CHANNELS (fallback
+workload size, default 200), BENCH_FORCE_CPU=1 (skip the accelerator
+probe entirely), BENCH_PROBE_TIMEOUT/RETRIES, BENCH_DEADLINE (watchdog
+seconds before a guaranteed JSON line + exit).
 """
 import json
 import os
 import sys
-import tempfile
 import time
+import tempfile
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_CPU_OPS = 50_000.0
+METRIC = "gossip_store_replay_sig_verify_throughput"
+UNIT = "sig_verifies_per_sec"
 
 
-def main():
-    from lightning_tpu.utils.jaxcfg import setup_cache
+def emit(value: float, vs_baseline: float, **extra):
+    line = {"metric": METRIC, "value": value, "unit": UNIT,
+            "vs_baseline": vs_baseline}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
 
-    setup_cache()
-    import numpy as np
 
+def acquire_backend() -> str:
+    """Initialize a usable jax backend, preferring the accelerator.
+
+    Returns the backend platform name.  The accelerator is probed in a
+    SUBPROCESS with a hard timeout first: the TPU here sits behind a
+    network tunnel and its init has been observed both to raise (round-1
+    BENCH failure) and to hang indefinitely — an in-process hang is
+    unrecoverable (the backend lock stays held), a dead subprocess is
+    trivially recoverable.  Only after the probe succeeds does the main
+    process touch jax; otherwise it forces the CPU platform so the
+    benchmark still produces an honest (labeled) number instead of
+    nothing.
+    """
+    import subprocess
+
+    from lightning_tpu.utils.jaxcfg import force_cpu
+
+    probed = None
+    if not os.environ.get("BENCH_FORCE_CPU"):
+        import subprocess
+
+        retries = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+        # PROBE_OK sentinel line: imports may print banners to stdout.
+        probe_src = ("import jax; print('PROBE_OK', jax.default_backend(), "
+                     "len(jax.devices()))")
+        for attempt in range(retries):
+            try:
+                p = subprocess.run(
+                    [sys.executable, "-c", probe_src],
+                    capture_output=True, text=True, timeout=probe_timeout,
+                )
+                lines = [l for l in p.stdout.splitlines()
+                         if l.startswith("PROBE_OK")]
+                if p.returncode == 0 and lines:
+                    _, platform, ndev = lines[-1].split()[:3]
+                    print(f"bench: backend probe ok: {platform} x{ndev}",
+                          file=sys.stderr, flush=True)
+                    probed = platform
+                    break
+                print(f"bench: backend probe attempt {attempt + 1}/{retries} "
+                      f"rc={p.returncode}: {p.stderr.strip()[-300:]}",
+                      file=sys.stderr, flush=True)
+            except subprocess.TimeoutExpired:
+                print(f"bench: backend probe attempt {attempt + 1}/{retries} "
+                      f"hung >{probe_timeout}s", file=sys.stderr, flush=True)
+            if attempt < retries - 1:
+                time.sleep(2.0 * (attempt + 1))
+    if probed is None:
+        print("bench: accelerator unavailable; falling back to CPU",
+              file=sys.stderr, flush=True)
+    if probed is None or probed == "cpu":
+        # Degraded mode either way: trade runtime for compile time (cold
+        # CPU compiles of the EC programs take ~4 min each at full opt).
+        force_cpu(cheap_compile=True)
+
+    import jax
+
+    jax.devices()  # raises if even CPU is broken — caught by main's guard
+    return jax.default_backend()
+
+
+def run_bench(platform: str) -> dict:
     from lightning_tpu.gossip import store as gstore
     from lightning_tpu.gossip import synth, verify
 
+    on_accel = platform not in ("cpu",)
     # Big fixed bucket on the real accelerator: amortizes per-dispatch
     # latency (the TPU sits behind a network tunnel here) and keeps one
-    # compiled program for any store size.
-    n_channels = int(os.environ.get("BENCH_CHANNELS", "25000"))
-    bucket = int(os.environ.get("BENCH_BUCKET", "16384"))
+    # compiled program for any store size.  The CPU fallback gets a small
+    # workload so the run finishes at all.
+    if on_accel:
+        n_channels = int(os.environ.get("BENCH_CHANNELS", "25000"))
+        bucket = int(os.environ.get("BENCH_BUCKET", "16384"))
+    else:
+        # bucket 64 = the unit-test bucket, warm in the persistent cache
+        n_channels = int(os.environ.get("BENCH_CPU_CHANNELS", "200"))
+        bucket = int(os.environ.get("BENCH_BUCKET", "64"))
 
     path = os.environ.get("BENCH_STORE")
-    if not path or not os.path.exists(path):
+    is_temp_store = not path or not os.path.exists(path)
+    if is_temp_store:
         path = os.path.join(tempfile.gettempdir(), f"bench_store_{n_channels}.gs")
         if not os.path.exists(path):
+            # write-then-rename: a run killed mid-synthesis must not leave
+            # a truncated store that poisons every later run
+            tmp = path + f".tmp.{os.getpid()}"
             synth.make_network_store(
-                path, n_channels=n_channels, n_nodes=max(2, n_channels // 8),
+                tmp, n_channels=n_channels, n_nodes=max(2, n_channels // 8),
                 updates_per_channel=2,
+                sign_bucket=(synth.SIGN_BUCKET if on_accel else 64),
             )
+            os.replace(tmp, path)
 
     idx = gstore.load_store(path)
     crc_ok = idx.check_crcs()
-    assert crc_ok.all(), "store CRC failure"
+    if not crc_ok.all():
+        if is_temp_store:
+            os.unlink(path)  # don't poison the next run
+        raise AssertionError("store CRC failure")
 
     # Warm-up: compiles the kernel (cached persistently) and pages data in.
     res = verify.verify_store(idx, bucket=bucket)
@@ -68,15 +160,72 @@ def main():
     idx2 = gstore.load_store(path)
     res2 = verify.verify_store(idx2, bucket=bucket)
     dt = time.perf_counter() - t0
-    n_sigs = res2.n_sigs
-    throughput = n_sigs / dt
+    return {"n_sigs": res2.n_sigs, "seconds": dt,
+            "throughput": res2.n_sigs / dt}
 
-    print(json.dumps({
-        "metric": "gossip_store_replay_sig_verify_throughput",
-        "value": round(throughput, 1),
-        "unit": "sig_verifies_per_sec",
-        "vs_baseline": round(throughput / BASELINE_CPU_OPS, 3),
-    }))
+
+def main():
+    # A hang is not an Exception: if the tunnel drops after the probe, the
+    # try/except below never fires.  The watchdog emits the JSON line and
+    # hard-exits before the driver deadline so `parsed` is never null.
+    import threading
+
+    t_start = time.monotonic()
+    deadline = float(os.environ.get("BENCH_DEADLINE", "2400"))
+
+    def _hang_guard():
+        emit(0.0, 0.0, error=f"watchdog: exceeded {deadline}s deadline")
+        os._exit(0)
+
+    guard = threading.Timer(deadline, _hang_guard)
+    guard.daemon = True
+    guard.start()
+
+    platform = None
+    try:
+        from lightning_tpu.utils.jaxcfg import setup_cache
+
+        setup_cache()
+        platform = acquire_backend()
+        r = run_bench(platform)
+        guard.cancel()
+        extra = {} if platform not in ("cpu",) else {"platform": "cpu-fallback"}
+        emit(round(r["throughput"], 1),
+             round(r["throughput"] / BASELINE_CPU_OPS, 3),
+             n_sigs=r["n_sigs"], seconds=round(r["seconds"], 3), **extra)
+    except Exception as e:
+        guard.cancel()
+        traceback.print_exc()
+        if (platform not in (None, "cpu")
+                and not os.environ.get("BENCH_FORCE_CPU")):
+            # Accelerator died AFTER a successful probe (tunnel drop
+            # mid-run).  The in-process backend is wedged; re-exec on CPU
+            # in a child so the run still yields a labeled number.
+            import subprocess
+
+            print("bench: accelerator failed mid-run; re-running on CPU",
+                  file=sys.stderr, flush=True)
+            # Child gets only the REMAINING budget so the total stays
+            # inside the driver deadline the watchdog promises.
+            remaining = deadline - (time.monotonic() - t_start) - 15
+            if remaining > 60:
+                try:
+                    child = subprocess.run(
+                        [sys.executable, os.path.abspath(__file__)],
+                        env=dict(os.environ, BENCH_FORCE_CPU="1",
+                                 BENCH_DEADLINE=str(int(remaining))),
+                        capture_output=True, text=True, timeout=remaining,
+                    )
+                    sys.stderr.write(child.stderr[-2000:])
+                    jl = [l for l in child.stdout.splitlines()
+                          if l.startswith("{")]
+                    if child.returncode == 0 and jl:
+                        print(jl[-1], flush=True)
+                        sys.exit(0)
+                except subprocess.TimeoutExpired:
+                    pass
+        emit(0.0, 0.0, error=f"{type(e).__name__}: {e}")
+        sys.exit(0)  # the JSON line IS the result; don't mask it with rc!=0
 
 
 if __name__ == "__main__":

@@ -7,11 +7,20 @@ which matters for the subdaemon architecture (each daemon process jits the
 same kernels) and for repeated bench/test runs.
 """
 import os
+import re
 
 import jax
 
 _DEFAULT_CACHE = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), ".jax_cache")
+
+# Compile-time-over-runtime XLA options: ~50 s instead of ~250 s per cold
+# EC-kernel compile on this host's CPU backend, at the cost of slower
+# generated code.  Right for dry-runs/tests/fallbacks, wrong for benches.
+CHEAP_COMPILE_OPTS = {
+    "xla_llvm_disable_expensive_passes": True,
+    "xla_backend_optimization_level": 0,
+}
 
 
 def setup_cache(path: str | None = None) -> None:
@@ -20,3 +29,37 @@ def setup_cache(path: str | None = None) -> None:
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def force_cpu(n_devices: int | None = None, cheap_compile: bool = False) -> None:
+    """Force the CPU platform, with >= n_devices virtual devices if given.
+
+    Must run BEFORE any jax backend initializes: the environment preloads
+    an `axon` TPU platform from sitecustomize, so both the env vars AND
+    jax.config must be overridden (env alone loses once jax is imported).
+    Used by tests/conftest.py, __graft_entry__.dryrun_multichip, and
+    bench.py's CPU fallback — keep the dance in this one place.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if n_devices:
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m is None:
+            flags = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+        elif int(m.group(1)) < n_devices:
+            flags = flags.replace(
+                m.group(0), f"--xla_force_host_platform_device_count={n_devices}"
+            )
+    if cheap_compile and "--xla_llvm_disable_expensive_passes" not in flags:
+        cheap = " ".join(
+            f"--{k}={str(v).lower() if isinstance(v, bool) else v}"
+            for k, v in CHEAP_COMPILE_OPTS.items()
+        )
+        flags = (flags + " " + cheap).strip()
+    os.environ["XLA_FLAGS"] = flags
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already up; callers assert on default_backend()

@@ -4,21 +4,20 @@ dry-runs the multichip path; see __graft_entry__.py).
 
 NOTE: the environment preloads jax with JAX_PLATFORMS=axon (real TPU via a
 network tunnel) from sitecustomize, so we must override the platform via
-jax.config, not just env vars, and before any backend is initialized."""
+jax.config, not just env vars, and before any backend is initialized —
+jaxcfg.force_cpu does both."""
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightning_tpu.utils.jaxcfg import force_cpu, setup_cache
+
+force_cpu(n_devices=8)
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-
 assert jax.default_backend() == "cpu", "tests must run on the CPU mesh"
-assert jax.device_count() == 8, "expected virtual 8-device CPU mesh"
-
-from lightning_tpu.utils.jaxcfg import setup_cache
+assert jax.device_count() >= 8, "expected virtual 8-device CPU mesh"
 
 setup_cache()
