@@ -10,6 +10,12 @@ import struct
 from dataclasses import dataclass, field
 
 SIGHASH_ALL = 1
+SIGHASH_NONE = 2
+SIGHASH_SINGLE = 3
+SIGHASH_ANYONECANPAY = 0x80
+# BOLT#3 option_anchors: counterparty HTLC-tx signatures commit to only
+# their own input/output so fees can be bumped later
+SIGHASH_SINGLE_ANYONECANPAY = SIGHASH_SINGLE | SIGHASH_ANYONECANPAY
 
 
 def sha256d(b: bytes) -> bytes:
@@ -158,12 +164,32 @@ class Tx:
 
     def sighash_segwit(self, input_index: int, script_code: bytes,
                       amount_sat: int, sighash: int = SIGHASH_ALL) -> bytes:
-        assert sighash == SIGHASH_ALL, "only SIGHASH_ALL needed for channels"
-        hash_prevouts = sha256d(b"".join(i.outpoint for i in self.inputs))
-        hash_sequence = sha256d(
-            b"".join(struct.pack("<I", i.sequence) for i in self.inputs)
-        )
-        hash_outputs = sha256d(b"".join(o.serialize() for o in self.outputs))
+        """BIP143 digest.  Channels use SIGHASH_ALL everywhere except the
+        counterparty's HTLC-tx signatures under option_anchors, which BOLT#3
+        requires to be SIGHASH_SINGLE|ANYONECANPAY (the holder may attach
+        extra fee inputs/outputs when broadcasting)."""
+        base = sighash & 0x1F
+        anyonecanpay = bool(sighash & SIGHASH_ANYONECANPAY)
+        zero32 = bytes(32)
+        if anyonecanpay:
+            hash_prevouts = zero32
+        else:
+            hash_prevouts = sha256d(b"".join(i.outpoint for i in self.inputs))
+        if anyonecanpay or base in (SIGHASH_SINGLE, SIGHASH_NONE):
+            hash_sequence = zero32
+        else:
+            hash_sequence = sha256d(
+                b"".join(struct.pack("<I", i.sequence) for i in self.inputs)
+            )
+        if base == SIGHASH_SINGLE:
+            hash_outputs = (
+                sha256d(self.outputs[input_index].serialize())
+                if input_index < len(self.outputs) else zero32
+            )
+        elif base == SIGHASH_NONE:
+            hash_outputs = zero32
+        else:
+            hash_outputs = sha256d(b"".join(o.serialize() for o in self.outputs))
         inp = self.inputs[input_index]
         pre = (
             struct.pack("<i", self.version)

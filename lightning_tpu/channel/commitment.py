@@ -269,6 +269,15 @@ def htlc_sighashes(
                 keys.revocation_pubkey, keys.remote_htlcpubkey,
                 keys.local_htlcpubkey, h.payment_hash, h.cltv_expiry, anchors,
             )
-        sighash = htx.sighash_segwit(0, ws, h.amount_msat // 1000)
+        # BOLT#3: with option_anchors the counterparty's HTLC signature
+        # (the one we produce here and ship in commitment_signed
+        # htlc_signatures) uses SIGHASH_SINGLE|ANYONECANPAY
+        sighash = htx.sighash_segwit(0, ws, h.amount_msat // 1000,
+                                     htlc_sighash_flags(anchors))
         out.append((idx, sighash))
     return out
+
+
+def htlc_sighash_flags(anchors: bool) -> int:
+    """The sighash byte that accompanies HTLC-tx signatures in witnesses."""
+    return T.SIGHASH_SINGLE_ANYONECANPAY if anchors else T.SIGHASH_ALL

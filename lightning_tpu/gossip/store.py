@@ -5,7 +5,7 @@ for interop, re-implemented here):
   byte 0: version (major in top 3 bits — must be 0; minor in low 5)
   then records: be16 flags | be16 len | be32 crc | be32 timestamp | msg
   crc = crc32c(timestamp, msg) (gossipd/gossip_store.c:67)
-  flag 0x8000 = deleted, 0x2000 = completed write, 0x0800 = dying.
+  flags: DELETED 0x8000 | PUSH 0x4000 | RATELIMIT 0x2000 | DYING 0x0800.
 
 The reader is built for the replay benchmark: one mmap + native scan into
 flat numpy arrays; no per-record Python objects anywhere.
@@ -21,9 +21,11 @@ import numpy as np
 from ..utils import native
 
 VERSION_BYTE = 0x10  # major 0, minor 16
+# flag bits per the reference's common/gossip_store.h
 FLAG_DELETED = 0x8000
-FLAG_COMPLETED = 0x2000
-FLAG_DYING = 0x0800
+FLAG_PUSH = 0x4000  # stream to peers even before timestamp filter
+FLAG_RATELIMIT = 0x2000  # spam-flagged: kept but not relayed
+FLAG_DYING = 0x0800  # funding spent; removed after 12 blocks
 
 
 @dataclass
@@ -63,11 +65,15 @@ class StoreIndex:
 
 
 def load_store(path: str) -> StoreIndex:
+    """mmap the store (zero-copy — at the 1M-record scale the file is
+    hundreds of MB) and scan it natively.  The mmap stays alive as long
+    as the returned StoreIndex's buf does."""
     with open(path, "rb") as f:
-        raw = f.read()
-    buf = np.frombuffer(raw, dtype=np.uint8)
-    if len(buf) < 1:
-        raise ValueError("empty gossip store")
+        size = os.fstat(f.fileno()).st_size
+        if size < 1:
+            raise ValueError("empty gossip store")
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    buf = np.frombuffer(mm, dtype=np.uint8)
     ver = int(buf[0])
     if ver >> 5 != 0:
         raise ValueError(f"incompatible gossip store major version {ver >> 5}")
@@ -86,7 +92,11 @@ class StoreWriter:
         if fresh:
             self.f.write(bytes([VERSION_BYTE]))
 
-    def append(self, msg: bytes, timestamp: int = 0, flags: int = 0):
+    def append(self, msg: bytes, timestamp: int = 0, flags: int = 0,
+               sync: bool = False):
+        """Append one record.  sync=True makes the record durable before
+        returning — the live ingest path uses this (the reference fsyncs
+        before gossip is acked/relayed); bulk synthesis leaves it off."""
         crc = native.crc32c(timestamp, msg)
         hdr = (
             int(flags).to_bytes(2, "big")
@@ -95,6 +105,12 @@ class StoreWriter:
             + int(timestamp).to_bytes(4, "big")
         )
         self.f.write(hdr + msg)
+        if sync:
+            self.sync()
+
+    def sync(self):
+        self.f.flush()
+        os.fsync(self.f.fileno())
 
     def append_many(self, msgs, timestamps=None):
         parts = []
@@ -122,17 +138,23 @@ def compact_store(src: str, dst: str) -> int:
     as a dedicated subdaemon, gossipd/compactd.c).  Returns record count."""
     idx = load_store(src)
     keep = idx.select(idx.alive())
-    with open(dst, "wb") as f:
-        f.write(bytes([VERSION_BYTE]))
-        out = []
-        for i in range(len(keep)):
-            o, l = int(keep.offsets[i]), int(keep.lengths[i])
-            hdr = (
-                int(keep.flags[i]).to_bytes(2, "big")
-                + l.to_bytes(2, "big")
-                + int(keep.crcs[i]).to_bytes(4, "big")
-                + int(keep.timestamps[i]).to_bytes(4, "big")
-            )
-            out.append(hdr + bytes(keep.buf[o : o + l]))
-        f.write(b"".join(out))
+    out = []
+    for i in range(len(keep)):
+        o, l = int(keep.offsets[i]), int(keep.lengths[i])
+        hdr = (
+            int(keep.flags[i]).to_bytes(2, "big")
+            + l.to_bytes(2, "big")
+            + int(keep.crcs[i]).to_bytes(4, "big")
+            + int(keep.timestamps[i]).to_bytes(4, "big")
+        )
+        out.append(hdr + bytes(keep.buf[o : o + l]))
+    # write-then-rename: never truncate dst in place — loaded StoreIndexes
+    # are live mmaps of it, and rewriting the mapped inode would SIGBUS
+    # them.  rename swaps the directory entry; old maps keep the old inode.
+    tmp = dst + f".compact.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(bytes([VERSION_BYTE]) + b"".join(out))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, dst)
     return len(keep)

@@ -59,11 +59,6 @@ def _jit_hash():
     return jax.jit(gossip_hash_kernel)
 
 
-@functools.lru_cache(maxsize=8)
-def _compiled_kernel(bucket: int, max_blocks: int):
-    return gossip_verify_kernel
-
-
 def _bytes_to_blocks(rows: np.ndarray, max_blocks: int) -> np.ndarray:
     """(B, max_blocks*64) uint8 → (B, max_blocks, 16) uint32 big-endian."""
     B = rows.shape[0]
@@ -76,23 +71,57 @@ class VerifyItems:
     """One flat signature-check workload (possibly many sigs per message)."""
 
     rows: np.ndarray  # (N, MAX_BLOCKS*64) uint8 pre-padded signed regions
-    n_blocks: np.ndarray  # (N,) uint32
+    n_blocks: np.ndarray  # (N,) uint32; 0 = oversized, hashed host-side
     sigs: np.ndarray  # (N, 64) uint8
     pubkeys: np.ndarray  # (N, 33) uint8
     msg_index: np.ndarray  # (N,) int64 — row in the originating batch
+    z_host: np.ndarray | None = None  # (N, 32) host sha256d where n_blocks==0
+
+    @property
+    def oversized(self) -> np.ndarray:
+        return self.n_blocks == 0
 
     @staticmethod
     def concat(items: list["VerifyItems"]) -> "VerifyItems":
+        if any(x.z_host is not None for x in items):
+            zh = np.concatenate([
+                x.z_host if x.z_host is not None
+                else np.zeros((len(x), 32), np.uint8)
+                for x in items
+            ])
+        else:
+            zh = None
         return VerifyItems(
             np.concatenate([x.rows for x in items]),
             np.concatenate([x.n_blocks for x in items]),
             np.concatenate([x.sigs for x in items]),
             np.concatenate([x.pubkeys for x in items]),
             np.concatenate([x.msg_index for x in items]),
+            zh,
         )
 
     def __len__(self):
         return len(self.sigs)
+
+
+def _host_hash_oversized(buf: np.ndarray, offsets: np.ndarray,
+                         lengths: np.ndarray, nb: np.ndarray):
+    """sha256d for rows the packer flagged oversized (n_blocks == 0).
+    Rare (long node_announcements) — returns None when there are none, so
+    the common-case 1M-record replay allocates nothing here."""
+    import hashlib
+
+    which = np.nonzero(nb == 0)[0]
+    if len(which) == 0:
+        return None
+    z = np.zeros((len(nb), 32), np.uint8)
+    for i in which:
+        o, l = int(offsets[i]), int(lengths[i])
+        d = hashlib.sha256(
+            hashlib.sha256(buf[o : o + l].tobytes()).digest()
+        ).digest()
+        z[i] = np.frombuffer(d, np.uint8)
+    return z
 
 
 def extract_channel_announcements(idx: StoreIndex) -> VerifyItems:
@@ -101,10 +130,10 @@ def extract_channel_announcements(idx: StoreIndex) -> VerifyItems:
     if n == 0:
         return _empty_items()
     off = idx.offsets
-    rows, nb = native.sha256_pack(
-        idx.buf, off + wire.CA_SIGNED_OFFSET,
-        idx.lengths - wire.CA_SIGNED_OFFSET, MAX_BLOCKS
-    )
+    sr_off = off + wire.CA_SIGNED_OFFSET
+    sr_len = idx.lengths - wire.CA_SIGNED_OFFSET
+    rows, nb = native.sha256_pack(idx.buf, sr_off, sr_len, MAX_BLOCKS)
+    z_host = _host_hash_oversized(idx.buf, sr_off, sr_len, nb)
     flen_raw = native.gather_fields(idx.buf, off, wire.CA_FLEN_OFFSET, 2)
     flen = (flen_raw[:, 0].astype(np.uint64) << 8) | flen_raw[:, 1]
     key_base = wire.CA_FLEN_OFFSET + 2 + flen + 32 + 8
@@ -118,6 +147,7 @@ def extract_channel_announcements(idx: StoreIndex) -> VerifyItems:
         np.concatenate(sigs),
         np.concatenate(keys),
         np.tile(np.arange(n, dtype=np.int64), 4),
+        np.tile(z_host, (4, 1)) if z_host is not None else None,
     )
 
 
@@ -126,15 +156,16 @@ def extract_node_announcements(idx: StoreIndex) -> VerifyItems:
     if n == 0:
         return _empty_items()
     off = idx.offsets
-    rows, nb = native.sha256_pack(
-        idx.buf, off + wire.NA_SIGNED_OFFSET,
-        idx.lengths - wire.NA_SIGNED_OFFSET, MAX_BLOCKS
-    )
+    sr_off = off + wire.NA_SIGNED_OFFSET
+    sr_len = idx.lengths - wire.NA_SIGNED_OFFSET
+    rows, nb = native.sha256_pack(idx.buf, sr_off, sr_len, MAX_BLOCKS)
+    z_host = _host_hash_oversized(idx.buf, sr_off, sr_len, nb)
     flen_raw = native.gather_fields(idx.buf, off, 66, 2)
     flen = (flen_raw[:, 0].astype(np.uint64) << 8) | flen_raw[:, 1]
     sigs = native.gather_fields(idx.buf, off, wire.NA_SIG_OFFSET, 64)
     keys = native.gather_fields(idx.buf, off + flen, 68 + 4, 33)
-    return VerifyItems(rows, nb, sigs, keys, np.arange(n, dtype=np.int64))
+    return VerifyItems(rows, nb, sigs, keys, np.arange(n, dtype=np.int64),
+                       z_host)
 
 
 def extract_channel_updates(idx: StoreIndex, scid_to_nodes) -> VerifyItems:
@@ -144,10 +175,10 @@ def extract_channel_updates(idx: StoreIndex, scid_to_nodes) -> VerifyItems:
     if n == 0:
         return _empty_items()
     off = idx.offsets
-    rows, nb = native.sha256_pack(
-        idx.buf, off + wire.CU_SIGNED_OFFSET,
-        idx.lengths - wire.CU_SIGNED_OFFSET, MAX_BLOCKS
-    )
+    sr_off = off + wire.CU_SIGNED_OFFSET
+    sr_len = idx.lengths - wire.CU_SIGNED_OFFSET
+    rows, nb = native.sha256_pack(idx.buf, sr_off, sr_len, MAX_BLOCKS)
+    z_host = _host_hash_oversized(idx.buf, sr_off, sr_len, nb)
     sigs = native.gather_fields(idx.buf, off, wire.CU_SIG_OFFSET, 64)
     scid_raw = native.gather_fields(idx.buf, off, wire.CU_SCID_OFFSET, 8)
     scids = scid_raw.astype(np.uint64)
@@ -157,7 +188,8 @@ def extract_channel_updates(idx: StoreIndex, scid_to_nodes) -> VerifyItems:
     chan_flags = native.gather_fields(idx.buf, off, wire.CU_FLAGS_OFFSET + 1, 1)[:, 0]
     direction = chan_flags & 1
     keys = scid_to_nodes(scid, direction)  # (n, 33) uint8
-    return VerifyItems(rows, nb, sigs, keys, np.arange(n, dtype=np.int64))
+    return VerifyItems(rows, nb, sigs, keys, np.arange(n, dtype=np.int64),
+                       z_host)
 
 
 def _empty_items() -> VerifyItems:
@@ -172,6 +204,10 @@ def make_scid_map(ca_idx: StoreIndex):
     """Vectorized scid → (node_id_1 | node_id_2) resolver built from the
     channel_announcement batch (sorted array + searchsorted)."""
     n = len(ca_idx)
+    if n == 0:
+        # no announcements: every update resolves to the zero key, which
+        # fails verification (as it must)
+        return lambda scids, direction: np.zeros((len(scids), 33), np.uint8)
     off = ca_idx.offsets
     flen_raw = native.gather_fields(ca_idx.buf, off, wire.CA_FLEN_OFFSET, 2)
     flen = (flen_raw[:, 0].astype(np.uint64) << 8) | flen_raw[:, 1]
@@ -203,10 +239,11 @@ def make_scid_map(ca_idx: StoreIndex):
 
 
 def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray:
-    """Run the fused kernel over fixed-size buckets. Returns bool (N,)."""
+    """Run the chained hash+verify kernels over fixed-size buckets.
+    Oversized rows (n_blocks == 0) ride the batched EC verify with their
+    host-computed hash instead of the device hash.  Returns bool (N,)."""
     N = len(items)
     out = np.zeros(N, bool)
-    kern = _compiled_kernel(bucket, MAX_BLOCKS)
     parity_all = (items.pubkeys[:, 0] & 1).astype(np.uint32)
     tag_ok = (items.pubkeys[:, 0] == 2) | (items.pubkeys[:, 0] == 3)
     for start in range(0, N, bucket):
@@ -220,7 +257,7 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
             return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
 
         blocks = _bytes_to_blocks(pad_to(items.rows[sl]), MAX_BLOCKS)
-        ok = kern(
+        ok = gossip_verify_kernel(
             jnp.asarray(blocks),
             jnp.asarray(pad_to(items.n_blocks[sl]).astype(np.int32)),
             jnp.asarray(F.from_bytes_be(pad_to(items.sigs[sl][:, :32]))),
@@ -229,6 +266,11 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
             jnp.asarray(pad_to(parity_all[sl])),
         )
         out[sl] = np.asarray(ok)[: end - start]
+    ovs = items.oversized
+    if ovs.any() and items.z_host is not None:
+        out[ovs] = S.ecdsa_verify_batch(
+            items.z_host[ovs], items.sigs[ovs], items.pubkeys[ovs]
+        )
     return out & tag_ok
 
 

@@ -53,15 +53,28 @@ int64_t gossip_store_scan(const uint8_t *buf, uint64_t size, uint64_t off,
  * For record i: copies buf[offsets[i] .. offsets[i]+lengths[i]) into
  * out + i*row_bytes, applies SHA256 padding (0x80, zeros, 64-bit bit
  * length), zero-fills the rest, and writes the number of 64-byte blocks
- * to n_blocks[i].  Returns -1 if any region needs more than row_bytes. */
+ * to n_blocks[i].
+ *
+ * A region that does not fit row_bytes is NOT an error: BOLT#7 messages
+ * are legal up to 64 KiB (long node_announcement address/feature vectors
+ * occur on the real network), and one oversized message must not abort a
+ * whole-store replay.  Such rows get n_blocks[i] = 0 (impossible for a
+ * real region — padding makes every region >= 1 block) and a zeroed row;
+ * the caller hashes them host-side.  Returns the oversized count. */
 int64_t sha256_pack(const uint8_t *buf, const uint64_t *offsets,
                     const uint32_t *lengths, size_t n, uint8_t *out,
                     uint64_t row_bytes, uint32_t *n_blocks) {
+    int64_t oversized = 0;
     for (size_t i = 0; i < n; i++) {
         uint32_t len = lengths[i];
         uint64_t padded = ((uint64_t)len + 1 + 8 + 63) & ~63ull;
-        if (padded > row_bytes) return -1;
         uint8_t *row = out + i * row_bytes;
+        if (padded > row_bytes) {
+            memset(row, 0, row_bytes);
+            n_blocks[i] = 0;
+            oversized++;
+            continue;
+        }
         memcpy(row, buf + offsets[i], len);
         row[len] = 0x80;
         memset(row + len + 1, 0, padded - len - 1 - 8);
@@ -72,7 +85,7 @@ int64_t sha256_pack(const uint8_t *buf, const uint64_t *offsets,
             memset(row + padded, 0, row_bytes - padded);
         n_blocks[i] = (uint32_t)(padded / 64);
     }
-    return 0;
+    return oversized;
 }
 
 /* Gather fixed-size fields at per-record offsets: out[i] = buf[offsets[i]

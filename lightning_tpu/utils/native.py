@@ -110,7 +110,9 @@ def gossip_store_scan(buf: np.ndarray, start_off: int = 1):
 def sha256_pack(buf: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
                 max_blocks: int):
     """Pack signed regions into pre-padded SHA256 rows.
-    Returns (rows (n, max_blocks*64) uint8, n_blocks (n,) uint32)."""
+    Returns (rows (n, max_blocks*64) uint8, n_blocks (n,) uint32).
+    Oversized regions (legal per BOLT#7, up to 64 KiB) get n_blocks == 0
+    and a zeroed row — callers route those to a host-side hash."""
     buf = np.ascontiguousarray(buf, dtype=np.uint8)
     offsets = np.ascontiguousarray(offsets, dtype=np.uint64)
     lengths = np.ascontiguousarray(lengths, dtype=np.uint32)
@@ -118,12 +120,10 @@ def sha256_pack(buf: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
     row_bytes = max_blocks * 64
     out = np.empty((n, row_bytes), np.uint8)
     n_blocks = np.empty(n, np.uint32)
-    rc = get_lib().sha256_pack(
+    get_lib().sha256_pack(
         buf.ctypes.data, offsets.ctypes.data, lengths.ctypes.data, n,
         out.ctypes.data, row_bytes, n_blocks.ctypes.data,
     )
-    if rc < 0:
-        raise ValueError("signed region exceeds max_blocks")
     return out, n_blocks
 
 

@@ -17,10 +17,15 @@ Field kinds:
   signature               64-byte compact sig
   chain_hash/sha256       32 raw bytes
   short_channel_id        u64
+  array:L:E               count-prefixed array: L in {u8,u16,bigsize} is
+                          the count encoding, E any fixed-size kind;
+                          value is a list (e.g. commitment_signed's
+                          htlc_signatures = array:u16:signature)
   tlvs                    trailing TLV stream (dict {type: raw bytes})
 """
 from __future__ import annotations
 
+import functools
 import struct
 from dataclasses import dataclass, field as dc_field
 from typing import Any
@@ -97,6 +102,21 @@ _INT_FMT = {"u8": ">B", "u16": ">H", "u32": ">I", "u64": ">Q"}
 _FIXED_LEN = {"point": 33, "signature": 64, "chain_hash": 32, "sha256": 32}
 
 
+def _write_count(kind: str, n: int) -> bytes:
+    if kind == "bigsize":
+        return write_bigsize(n)
+    return struct.pack(_INT_FMT[kind], n)
+
+
+def _read_count(kind: str, buf: bytes, off: int) -> tuple[int, int]:
+    if kind == "bigsize":
+        return read_bigsize(buf, off)
+    sz = struct.calcsize(_INT_FMT[kind])
+    if off + sz > len(buf):
+        raise WireError("truncated array count")
+    return struct.unpack_from(_INT_FMT[kind], buf, off)[0], off + sz
+
+
 @dataclass(frozen=True)
 class FieldSpec:
     name: str
@@ -114,6 +134,19 @@ class FieldSpec:
             return 8
         return None
 
+    @functools.cached_property
+    def array_parts(self) -> tuple[str, "FieldSpec"] | None:
+        """For array:L:E kinds: (count_kind, element FieldSpec)."""
+        if not self.kind.startswith("array:"):
+            return None
+        _, lk, ek = self.kind.split(":", 2)
+        if lk not in ("u8", "u16", "bigsize"):
+            raise TypeError(f"{self.name}: bad array count kind {lk}")
+        elem = FieldSpec(self.name + "[]", ek)
+        if elem.fixed_bytes is None:
+            raise TypeError(f"{self.name}: array element {ek} not fixed-size")
+        return lk, elem
+
 
 class MessageMeta(type):
     registry: dict[int, type] = {}
@@ -122,6 +155,15 @@ class MessageMeta(type):
         cls = super().__new__(mcls, name, bases, ns)
         if ns.get("TYPE") is not None and ns.get("FIELDS") is not None:
             cls.FIELDS = [FieldSpec(n, k) for n, k in ns["FIELDS"]]
+            # tu*/remainder/tlvs consume the rest of the message on parse,
+            # so they are only well-defined as the final field
+            for f in cls.FIELDS[:-1]:
+                if f.kind.startswith("tu") or f.kind in ("remainder", "tlvs"):
+                    raise TypeError(
+                        f"{name}.{f.name}: kind {f.kind} must be the last field"
+                    )
+            for f in cls.FIELDS:
+                f.array_parts  # validates (and caches) array:L:E specs now
             MessageMeta.registry[ns["TYPE"]] = cls
         return cls
 
@@ -144,6 +186,8 @@ class Message(metaclass=MessageMeta):
             return 0
         if f.kind == "tlvs":
             return {}
+        if f.kind.startswith("array:"):
+            return []
         n = f.fixed_bytes
         return b"\x00" * n if n is not None and f.kind not in _INT_FMT else b""
 
@@ -174,8 +218,25 @@ class Message(metaclass=MessageMeta):
                 out.append(v)
             elif k == "varbytes":
                 out.append(struct.pack(">H", len(v)) + v)
+            elif k.startswith("array:"):
+                lk, elem = f.array_parts
+                out.append(_write_count(lk, len(v)))
+                for item in v:
+                    if elem.kind in _INT_FMT:
+                        out.append(struct.pack(_INT_FMT[elem.kind], item))
+                    else:
+                        if len(item) != elem.fixed_bytes:
+                            raise WireError(
+                                f"{f.name}: element needs {elem.fixed_bytes}"
+                                f" bytes, got {len(item)}"
+                            )
+                        out.append(item)
             elif k == "remainder":
                 out.append(v)
+            elif k in ("tu16", "tu32", "tu64"):
+                # truncated int: minimal big-endian, must be last field
+                # (BOLT#1 TLV payloads)
+                out.append(write_tu(v, int(k[2:]) // 8))
             elif k == "tlvs":
                 out.append(write_tlv_stream(v))
             else:
@@ -217,8 +278,28 @@ class Message(metaclass=MessageMeta):
                     raise WireError(f"truncated at {f.name}")
                 vals[f.name] = msg[off : off + ln]
                 off += ln
+            elif k.startswith("array:"):
+                lk, elem = f.array_parts
+                cnt, off = _read_count(lk, msg, off)
+                esz = elem.fixed_bytes
+                if off + cnt * esz > len(msg):
+                    raise WireError(f"truncated at {f.name}")
+                items = []
+                for _ in range(cnt):
+                    raw = msg[off : off + esz]
+                    if elem.kind in _INT_FMT:
+                        items.append(
+                            struct.unpack(_INT_FMT[elem.kind], raw)[0]
+                        )
+                    else:
+                        items.append(raw)
+                    off += esz
+                vals[f.name] = items
             elif k == "remainder":
                 vals[f.name] = msg[off:]
+                off = len(msg)
+            elif k in ("tu16", "tu32", "tu64"):
+                vals[f.name] = read_tu(msg[off:], int(k[2:]) // 8)
                 off = len(msg)
             elif k == "tlvs":
                 vals[f.name] = read_tlv_stream(msg, off)

@@ -190,38 +190,9 @@ class CommitmentSigned(Message):
     FIELDS = [
         ("channel_id", "bytes:32"),
         ("signature", "signature"),
-        ("htlc_signatures", "remainder"),  # u16 count + 64B each (custom)
-        ("tlvs_unused", "tlvs"),  # placeholder so FIELDS stays declarative
+        ("htlc_signatures", "array:u16:signature"),
+        ("tlvs", "tlvs"),
     ]
-
-    def __init__(self, channel_id=b"\x00" * 32, signature=b"\x00" * 64,
-                 htlc_signatures=(), **kw):
-        self.channel_id = channel_id
-        self.signature = signature
-        self.htlc_signatures = list(htlc_signatures)
-        self.tlvs_unused = {}
-
-    def serialize(self) -> bytes:
-        out = struct.pack(">H", self.TYPE) + self.channel_id + self.signature
-        out += struct.pack(">H", len(self.htlc_signatures))
-        for s in self.htlc_signatures:
-            if len(s) != 64:
-                raise WireError("htlc signature must be 64 bytes")
-            out += s
-        return out
-
-    @classmethod
-    def parse(cls, msg: bytes):
-        if len(msg) < 2 + 32 + 64 + 2:
-            raise WireError("truncated commitment_signed")
-        channel_id = msg[2:34]
-        signature = msg[34:98]
-        (n,) = struct.unpack_from(">H", msg, 98)
-        off = 100
-        if off + 64 * n > len(msg):
-            raise WireError("truncated htlc sigs")
-        sigs = [msg[off + 64 * i : off + 64 * (i + 1)] for i in range(n)]
-        return cls(channel_id, signature, sigs)
 
 
 class RevokeAndAck(Message):

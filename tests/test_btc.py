@@ -165,3 +165,51 @@ class TestScripts:
         assert SC.push_num(17) == b"\x01\x11"
         assert SC.push_num(144) == b"\x02\x90\x00"  # needs 0x00 pad (0x90 has high bit)
         assert SC.push_num(500000) == b"\x03\x20\xa1\x07"
+
+
+class TestSighashSingleAnyonecanpay:
+    """BIP143 SIGHASH_SINGLE|ANYONECANPAY — the flags BOLT#3 requires for
+    counterparty HTLC-tx signatures under option_anchors."""
+
+    def _tx(self):
+        ins = [T.TxInput(bytes([i + 1]) * 32, i, sequence=0xFFFFFFFD + i)
+               for i in range(2)]
+        outs = [T.TxOutput(50_000, b"\x00\x14" + bytes([i]) * 20)
+                for i in range(2)]
+        return T.Tx(2, ins, outs, locktime=0)
+
+    def test_commits_to_own_output_only(self):
+        ws = b"\x51"
+        base = self._tx().sighash_segwit(1, ws, 50_000,
+                                         T.SIGHASH_SINGLE_ANYONECANPAY)
+        # mutating the OTHER output does not change the digest
+        tx = self._tx()
+        tx.outputs[0] = T.TxOutput(99_999, b"\x00\x14" + b"\xAA" * 20)
+        assert tx.sighash_segwit(1, ws, 50_000,
+                                 T.SIGHASH_SINGLE_ANYONECANPAY) == base
+        # mutating the SAME-index output does
+        tx = self._tx()
+        tx.outputs[1] = T.TxOutput(1, tx.outputs[1].script_pubkey)
+        assert tx.sighash_segwit(1, ws, 50_000,
+                                 T.SIGHASH_SINGLE_ANYONECANPAY) != base
+
+    def test_ignores_other_inputs(self):
+        ws = b"\x51"
+        base = self._tx().sighash_segwit(1, ws, 50_000,
+                                         T.SIGHASH_SINGLE_ANYONECANPAY)
+        # adding/mutating other inputs does not change the digest
+        tx = self._tx()
+        tx.inputs[0] = T.TxInput(b"\xEE" * 32, 7, sequence=123)
+        tx.inputs.append(T.TxInput(b"\xDD" * 32, 3))
+        assert tx.sighash_segwit(1, ws, 50_000,
+                                 T.SIGHASH_SINGLE_ANYONECANPAY) == base
+        # under SIGHASH_ALL the same mutation changes it
+        assert (self._tx().sighash_segwit(1, ws, 50_000)
+                != tx.sighash_segwit(1, ws, 50_000))
+
+    def test_differs_from_all(self):
+        ws = b"\x51"
+        tx = self._tx()
+        assert (tx.sighash_segwit(0, ws, 50_000)
+                != tx.sighash_segwit(0, ws, 50_000,
+                                     T.SIGHASH_SINGLE_ANYONECANPAY))

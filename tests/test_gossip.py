@@ -158,3 +158,45 @@ def test_deleted_records_skipped(small_net, tmp_path):
     assert idx2.alive().sum() == len(idx2) - 1
     res = verify.verify_store(idx2, bucket=64)
     assert len(res.ca_valid) == info["channels"] - 1
+
+
+def test_oversized_node_announcement_host_fallback(tmp_path):
+    """BOLT#7 allows messages up to 64KiB; signed regions beyond the device
+    packer's MAX_BLOCKS budget must be verified via the host-hash fallback
+    instead of aborting the replay (reference accepts these:
+    gossipd/sigcheck.c:118 has no length limit below the wire cap)."""
+    import hashlib as hl
+
+    p = str(tmp_path / "gs")
+    sk = 0xA1B2C3
+    pub = ref.pubkey_serialize(ref.point_mul(sk, ref.G))
+    # > MAX_BLOCKS*64 - 9 = 503 bytes of signed region → oversized
+    na = wire.NodeAnnouncement(node_id=pub, timestamp=9,
+                               addresses=b"\x01" * 600)
+    h = hl.sha256(hl.sha256(na.signed_region()).digest()).digest()
+    r, s = ref.ecdsa_sign(h, sk)
+    na.signature = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    # a second, tampered oversized NA must fail
+    bad = wire.NodeAnnouncement(node_id=pub, timestamp=10,
+                                addresses=b"\x02" * 600)
+    bad.signature = na.signature
+    # and a normal-sized valid one rides the device path in the same batch
+    small = wire.NodeAnnouncement(node_id=pub, timestamp=11)
+    hs = hl.sha256(hl.sha256(small.signed_region()).digest()).digest()
+    r2, s2 = ref.ecdsa_sign(hs, sk)
+    small.signature = r2.to_bytes(32, "big") + s2.to_bytes(32, "big")
+    with gstore.StoreWriter(p) as w:
+        w.append(na.serialize(), timestamp=1)
+        w.append(bad.serialize(), timestamp=2)
+        w.append(small.serialize(), timestamp=3, sync=True)
+    res = verify.verify_store(gstore.load_store(p), bucket=64)
+    assert list(res.na_valid) == [True, False, True]
+
+
+def test_scid_map_empty_announcements():
+    lookup = verify.make_scid_map(gstore.StoreIndex(
+        np.zeros(0, np.uint8), np.zeros(0, np.uint64), np.zeros(0, np.uint32),
+        np.zeros(0, np.uint16), np.zeros(0, np.uint32), np.zeros(0, np.uint32),
+        np.zeros(0, np.uint16)))
+    keys = lookup(np.array([42], np.uint64), np.array([0], np.uint8))
+    assert keys.shape == (1, 33) and (keys == 0).all()

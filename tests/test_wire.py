@@ -155,3 +155,30 @@ class TestNoise:
         on_act1 = noise.responder_handshake(rs, re)
         with pytest.raises(Exception):
             on_act1(act1)
+
+
+class TestTruncatedIntKinds:
+    def test_tu_roundtrip(self):
+        class TuMsg(codec.Message):
+            TYPE = 64999
+            FIELDS = [("flags", "u8"), ("amount", "tu64")]
+
+        for v in (0, 1, 0xFF, 0x100, 0xFFFF_FFFF, 0xFFFF_FFFF_FFFF_FFFF):
+            m = TuMsg(flags=7, amount=v)
+            got = TuMsg.parse(m.serialize())
+            assert got.amount == v and got.flags == 7
+
+    def test_tu_minimal_encoding(self):
+        class TuMsg32(codec.Message):
+            TYPE = 64998
+            FIELDS = [("val", "tu32")]
+
+        assert TuMsg32(val=0).serialize() == (64998).to_bytes(2, "big")
+        assert TuMsg32(val=0x1234).serialize().endswith(b"\x12\x34")
+        # leading-zero payload must be rejected on parse
+        import pytest
+
+        with pytest.raises(codec.WireError):
+            TuMsg32.parse((64998).to_bytes(2, "big") + b"\x00\x12")
+        with pytest.raises(codec.WireError):  # too long for tu32
+            TuMsg32.parse((64998).to_bytes(2, "big") + b"\x01\x02\x03\x04\x05")
