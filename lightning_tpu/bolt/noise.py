@@ -16,6 +16,7 @@ import hashlib
 import hmac as hmac_mod
 from dataclasses import dataclass, field
 
+from cryptography.exceptions import InvalidTag
 from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 
 from ..crypto import ref_python as ref
@@ -54,7 +55,12 @@ def encrypt_with_ad(key: bytes, nonce: int, ad: bytes, plaintext: bytes) -> byte
 
 
 def decrypt_with_ad(key: bytes, nonce: int, ad: bytes, ciphertext: bytes) -> bytes:
-    return ChaCha20Poly1305(key).decrypt(_nonce(nonce), ciphertext, ad)
+    try:
+        return ChaCha20Poly1305(key).decrypt(_nonce(nonce), ciphertext, ad)
+    except InvalidTag:
+        # normalize to our own error so transport/peer layers can handle
+        # "bad bytes from the network" without importing cryptography
+        raise HandshakeError("AEAD tag failure") from None
 
 
 @dataclass

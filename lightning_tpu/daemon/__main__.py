@@ -1,0 +1,81 @@
+"""Daemon entry point: run a node, optionally dial a peer and ping it.
+
+Minimal lightningd-equivalent main (lightningd/lightningd.c:1167) while
+the RPC surface grows; the JSON-RPC listener attaches here.
+
+Usage:
+  python -m lightning_tpu.daemon --listen 9735 [--privkey HEX]
+  python -m lightning_tpu.daemon --connect PUBKEY@HOST:PORT --ping
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+from .node import LightningNode
+
+
+async def amain(args) -> int:
+    privkey = int(args.privkey, 16) if args.privkey else None
+    node = LightningNode(privkey=privkey)
+    print(f"node_id {node.node_id.hex()}", flush=True)
+
+    if args.listen is not None:
+        port = await node.listen(args.bind, args.listen)
+        print(f"listening {args.bind}:{port}", flush=True)
+
+    if args.connect:
+        try:
+            target, hostport = args.connect.split("@")
+            host, port_s = hostport.rsplit(":", 1)
+            peer = await node.connect(host, int(port_s), bytes.fromhex(target))
+            print(f"connected {peer.node_id.hex()} "
+                  f"features {peer.remote_features.hex() or '(none)'}",
+                  flush=True)
+            if args.ping:
+                n = await peer.ping(num_pong_bytes=16)
+                print(f"pong {n} bytes", flush=True)
+        except Exception as e:
+            print(f"connect failed: {type(e).__name__}: {e}", file=sys.stderr)
+            await node.close()
+            return 1
+        if not args.stay:
+            await node.close()
+            return 0
+
+    # serve until interrupted
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    await node.close()
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(prog="lightning_tpu.daemon")
+    p.add_argument("--listen", type=int, default=None,
+                   help="TCP port to accept peers on (0 = ephemeral)")
+    p.add_argument("--bind", default="127.0.0.1")
+    p.add_argument("--privkey", default=None, help="node secret key (hex)")
+    p.add_argument("--connect", default=None, metavar="PUBKEY@HOST:PORT")
+    p.add_argument("--ping", action="store_true",
+                   help="ping the connected peer once")
+    p.add_argument("--stay", action="store_true",
+                   help="keep running after --connect actions")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args()
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    try:
+        return asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

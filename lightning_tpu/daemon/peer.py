@@ -1,0 +1,165 @@
+"""Per-peer message pump and BOLT#1 control handling.
+
+Functional parity targets: connectd's per-peer muxing
+(connectd/multiplex.c:1562 read loop), BOLT#1 ping/pong (the reference
+handles these in connectd so lightningd never sees them), and the
+"it's OK to be odd" unknown-message rule (BOLT#1; common/wire_error).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..bolt import noise
+from ..wire import codec
+from ..wire import messages as M
+from .transport import NoiseStream
+
+log = logging.getLogger("lightning_tpu.peer")
+
+ZERO_CHANNEL_ID = b"\x00" * 32
+MAX_PONG_REPLY = 65532  # BOLT#1: >= this means "don't reply"
+
+
+class PeerError(Exception):
+    pass
+
+
+class Peer:
+    """One connected, init-exchanged peer."""
+
+    def __init__(self, node, stream: NoiseStream, node_id: bytes,
+                 remote_features: bytes, incoming: bool):
+        self.node = node
+        self.stream = stream
+        self.node_id = node_id
+        self.remote_features = remote_features
+        self.incoming = incoming
+        self.inbox: asyncio.Queue = asyncio.Queue()
+        self.connected = True
+        self.connected_at = time.monotonic()
+        self._pong_waiters: list[asyncio.Future] = []
+        self._pump_task: asyncio.Task | None = None
+
+    # -- sending ---------------------------------------------------------
+
+    async def send(self, msg: codec.Message) -> None:
+        await self.stream.send_msg(msg.serialize())
+
+    async def send_error(self, data: bytes, channel_id: bytes = ZERO_CHANNEL_ID):
+        try:
+            await self.send(M.Error(channel_id=channel_id, data=data))
+        except (ConnectionError, OSError):
+            pass
+
+    async def ping(self, num_pong_bytes: int = 1, ignored_len: int = 0,
+                   timeout: float = 30.0) -> int:
+        """Send a ping, await the matching pong; returns the pong's
+        ignored-bytes length."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pong_waiters.append(fut)
+        try:
+            await self.send(M.Ping(num_pong_bytes=num_pong_bytes,
+                                   ignored=b"\x00" * ignored_len))
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            # a timed-out waiter must not swallow the next pong
+            if fut in self._pong_waiters:
+                self._pong_waiters.remove(fut)
+
+    # -- receiving -------------------------------------------------------
+
+    async def recv(self, *types: type, timeout: float = 30.0) -> codec.Message:
+        """Await the next non-control message (optionally of given types).
+        Protocol drivers (opening/closing/channel flows) consume this the
+        way reference subdaemons consume their peer fd."""
+        while True:
+            msg = await asyncio.wait_for(self.inbox.get(), timeout)
+            if not types or isinstance(msg, types):
+                return msg
+            log.warning("%s: ignoring unexpected %s while waiting for %s",
+                        self.node_id.hex()[:8], type(msg).__name__,
+                        [t.__name__ for t in types])
+
+    def start_pump(self) -> None:
+        self._pump_task = asyncio.get_running_loop().create_task(self._pump())
+
+    async def _pump(self) -> None:
+        try:
+            while True:
+                raw = await self.stream.read_msg()
+                await self._handle_raw(raw)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except noise.HandshakeError as e:
+            log.warning("%s: transport failure: %s", self.node_id.hex()[:8], e)
+        except Exception:
+            log.exception("%s: peer pump failed", self.node_id.hex()[:8])
+        finally:
+            await self._disconnected()
+
+    async def _handle_raw(self, raw: bytes) -> None:
+        try:
+            t = codec.msg_type(raw)
+        except codec.WireError:
+            return  # runt frame; BOLT#1 says ignore
+        cls = codec.MessageMeta.registry.get(t)
+        if cls is None:
+            if t % 2 == 0:
+                # unknown EVEN type: must fail the connection (BOLT#1)
+                await self.send_error(
+                    f"unknown message type {t}".encode()
+                )
+                await self.disconnect()
+            return  # unknown odd: ignore
+        try:
+            msg = cls.parse(raw)
+        except codec.WireError as e:
+            await self.send_error(f"bad {cls.__name__}: {e}".encode())
+            await self.disconnect()
+            return
+
+        if isinstance(msg, M.Ping):
+            if msg.num_pong_bytes < MAX_PONG_REPLY:
+                await self.send(M.Pong(ignored=b"\x00" * msg.num_pong_bytes))
+            return
+        if isinstance(msg, M.Pong):
+            if self._pong_waiters:
+                fut = self._pong_waiters.pop(0)
+                if not fut.done():
+                    fut.set_result(len(msg.ignored))
+            return
+        if isinstance(msg, M.Error):
+            log.warning("%s: peer error: %r", self.node_id.hex()[:8],
+                        msg.data[:128])
+            await self.disconnect()
+            return
+        if isinstance(msg, M.Warning_):
+            log.warning("%s: peer warning: %r", self.node_id.hex()[:8],
+                        msg.data[:128])
+            return
+
+        handler = self.node.handlers.get(type(msg))
+        if handler is not None:
+            await handler(self, msg)
+        else:
+            await self.inbox.put(msg)
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def disconnect(self) -> None:
+        self.connected = False
+        await self.stream.close()
+
+    async def _disconnected(self) -> None:
+        self.connected = False
+        for fut in self._pong_waiters:
+            if not fut.done():
+                fut.set_exception(ConnectionError("peer disconnected"))
+        self._pong_waiters.clear()
+        self.node._peer_gone(self)
+
+    async def wait_closed(self) -> None:
+        if self._pump_task is not None:
+            await asyncio.shield(self._pump_task)

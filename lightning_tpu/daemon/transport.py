@@ -1,0 +1,102 @@
+"""asyncio transport: TCP + BOLT#8 Noise_XK handshake + AEAD framing.
+
+Functional parity targets: connectd/connectd.c:648 (`connection_in`) /
+:793 (`connection_out`) for the dial/accept roles, and the read/write
+pump of connectd/multiplex.c:1214/1562 — re-shaped as one asyncio stream
+class instead of the reference's callback-chained ccan/io plan machinery
+(the host IO plane here is Python asyncio; the compute plane is the
+device, see daemon/hsmd.py).
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+
+from ..bolt import noise
+from ..crypto import ref_python as ref
+
+HANDSHAKE_TIMEOUT = 30.0
+
+
+def random_keypair() -> noise.Keypair:
+    return noise.Keypair(int.from_bytes(os.urandom(32), "big") % (ref.N - 1) + 1)
+
+
+class NoiseStream:
+    """An established BOLT#8 transport over an asyncio TCP stream."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, cm: noise.CryptoMsg):
+        self.reader = reader
+        self.writer = writer
+        self.cm = cm
+
+    @property
+    def remote_pub_bytes(self) -> bytes:
+        return ref.pubkey_serialize(self.cm.remote_pub)
+
+    async def read_msg(self) -> bytes:
+        hdr = await self.reader.readexactly(18)
+        ln = self.cm.decrypt_length(hdr)
+        body = await self.reader.readexactly(ln + 16)
+        return self.cm.decrypt_body(body)
+
+    async def send_msg(self, msg: bytes) -> None:
+        self.writer.write(self.cm.encrypt(msg))
+        await self.writer.drain()
+
+    async def close(self) -> None:
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+
+
+async def connect_noise(host: str, port: int, local: noise.Keypair,
+                        remote_pub: bytes,
+                        ephemeral: noise.Keypair | None = None) -> NoiseStream:
+    """Dial a peer and run the initiator side of the 3-act handshake
+    (connectd/connectd.c:793 connection_out)."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        e = ephemeral or random_keypair()
+        act1, on_act2 = noise.initiator_handshake(
+            local, e, ref.pubkey_parse(remote_pub)
+        )
+        writer.write(act1)
+        await writer.drain()
+        act2 = await asyncio.wait_for(
+            reader.readexactly(noise.ACT_TWO_SIZE), HANDSHAKE_TIMEOUT
+        )
+        act3, keys = on_act2(act2)
+        writer.write(act3)
+        await writer.drain()
+        return NoiseStream(reader, writer, noise.CryptoMsg(keys))
+    except BaseException:
+        writer.close()
+        raise
+
+
+async def accept_noise(reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter, local: noise.Keypair,
+                       ephemeral: noise.Keypair | None = None) -> NoiseStream:
+    """Run the responder side of the handshake on an accepted connection
+    (connectd/connectd.c:648 connection_in)."""
+    try:
+        e = ephemeral or random_keypair()
+        on_act1 = noise.responder_handshake(local, e)
+        act1 = await asyncio.wait_for(
+            reader.readexactly(noise.ACT_ONE_SIZE), HANDSHAKE_TIMEOUT
+        )
+        act2, on_act3 = on_act1(act1)
+        writer.write(act2)
+        await writer.drain()
+        act3 = await asyncio.wait_for(
+            reader.readexactly(noise.ACT_THREE_SIZE), HANDSHAKE_TIMEOUT
+        )
+        keys = on_act3(act3)
+        return NoiseStream(reader, writer, noise.CryptoMsg(keys))
+    except BaseException:
+        writer.close()
+        raise

@@ -1,0 +1,215 @@
+"""Node runtime tests: TCP + Noise_XK + BOLT#1 init/ping over localhost.
+
+Models the reference's connectd behaviors (tests/test_connection.py's
+connect/reconnect basics): two real nodes over real sockets, init feature
+exchange, ping/pong, unknown-message rules, feature incompatibility.
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lightning_tpu.daemon import features as feat
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.wire import messages as M
+from lightning_tpu.wire import codec
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60))
+
+
+async def _pair(features_a=None, features_b=None):
+    a = LightningNode(privkey=0x1111, features=features_a)
+    b = LightningNode(privkey=0x2222, features=features_b)
+    port = await a.listen()
+    peer_ab = await b.connect("127.0.0.1", port, a.node_id)
+    # wait for a's side to register the peer
+    for _ in range(100):
+        if b.node_id in a.peers:
+            break
+        await asyncio.sleep(0.01)
+    return a, b, peer_ab
+
+
+def test_connect_init_ping():
+    async def body():
+        a, b, peer = await _pair()
+        try:
+            assert b.node_id in a.peers and a.node_id in b.peers
+            # both sides saw each other's default features
+            ours = feat.from_bits(feat.DEFAULT_FEATURES)
+            assert peer.remote_features == ours
+            assert a.peers[b.node_id].remote_features == ours
+            assert feat.has_feature(peer.remote_features, feat.STATIC_REMOTEKEY)
+            # ping both directions
+            assert await peer.ping(num_pong_bytes=7) == 7
+            assert await a.peers[b.node_id].ping(num_pong_bytes=3) == 3
+            # oversized num_pong_bytes gets no reply (BOLT#1)
+            with pytest.raises(asyncio.TimeoutError):
+                await peer.ping(num_pong_bytes=65532, timeout=0.5)
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
+
+
+def test_incompatible_features_rejected():
+    async def body():
+        # b requires an even feature bit far beyond anything we know
+        weird = feat.combine(feat.from_bits(feat.DEFAULT_FEATURES),
+                             feat.from_bits([100]))
+        a = LightningNode(privkey=0x1111)
+        b = LightningNode(privkey=0x2222, features=weird)
+        port = await a.listen()
+        peer = await b.connect("127.0.0.1", port, a.node_id)
+        # a must reject us: wait for the disconnect
+        for _ in range(200):
+            if not peer.connected:
+                break
+            await asyncio.sleep(0.01)
+        assert not peer.connected
+        assert b.node_id not in a.peers
+        await a.close()
+        await b.close()
+
+    run(body())
+
+
+def test_unknown_even_message_disconnects():
+    async def body():
+        a, b, peer = await _pair()
+        try:
+            # craft an unknown EVEN message type (must trigger disconnect)
+            await peer.stream.send_msg((64000).to_bytes(2, "big") + b"junk")
+            for _ in range(200):
+                if not peer.connected:
+                    break
+                await asyncio.sleep(0.01)
+            assert not peer.connected
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
+
+
+def test_unknown_odd_message_ignored():
+    async def body():
+        a, b, peer = await _pair()
+        try:
+            await peer.stream.send_msg((64001).to_bytes(2, "big") + b"junk")
+            # connection survives: a ping still round-trips
+            assert await peer.ping(num_pong_bytes=5) == 5
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
+
+
+def test_non_control_message_reaches_inbox():
+    async def body():
+        a, b, peer = await _pair()
+        try:
+            err_cid = b"\x07" * 32
+            await peer.send(M.Shutdown(channel_id=err_cid,
+                                       scriptpubkey=b"\x00\x14" + b"\xAA" * 20))
+            got = await a.peers[b.node_id].recv(M.Shutdown, timeout=5)
+            assert got.channel_id == err_cid
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
+
+
+def test_handler_registration_routes_messages():
+    async def body():
+        a, b, peer = await _pair()
+        seen = []
+
+        async def on_shutdown(p, msg):
+            seen.append((p.node_id, msg.channel_id))
+
+        a.register(M.Shutdown, on_shutdown)
+        try:
+            await peer.send(M.Shutdown(channel_id=b"\x09" * 32,
+                                       scriptpubkey=b"\x00\x14" + b"\xBB" * 20))
+            for _ in range(200):
+                if seen:
+                    break
+                await asyncio.sleep(0.01)
+            assert seen == [(b.node_id, b"\x09" * 32)]
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
+
+
+def test_reconnect_replaces_old_peer():
+    async def body():
+        a, b, peer1 = await _pair()
+        try:
+            port = a._server.sockets[0].getsockname()[1]
+            peer2 = await b.connect("127.0.0.1", port, a.node_id)
+            assert await peer2.ping(num_pong_bytes=2) == 2
+            assert a.peers[b.node_id] is not None
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
+
+
+def test_feature_bit_encoding():
+    f = feat.from_bits([0, 5, 13])
+    assert feat.has_bit(f, 0) and feat.has_bit(f, 5) and feat.has_bit(f, 13)
+    assert not feat.has_bit(f, 1) and not feat.has_bit(f, 12)
+    assert feat.all_bits(f) == [0, 5, 13]
+    # odd/even pairing
+    assert feat.has_feature(f, 12)  # bit 13 set → feature 12 supported
+    assert feat.unsupported_features(feat.from_bits([13]), f) == [0]
+    assert feat.unsupported_features(f, feat.from_bits([101])) == []
+    assert feat.combine(b"\x01", b"\x02\x00") == b"\x02\x01"
+
+
+def test_ping_timeout_does_not_eat_next_pong():
+    async def body():
+        a, b, peer = await _pair()
+        try:
+            with pytest.raises(asyncio.TimeoutError):
+                await peer.ping(num_pong_bytes=65532, timeout=0.3)
+            # the stale waiter must not swallow this pong
+            assert await peer.ping(num_pong_bytes=4, timeout=5) == 4
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
+
+
+def test_post_handshake_garbage_handled():
+    async def body():
+        from lightning_tpu.daemon import transport
+        a = LightningNode(privkey=0x1111)
+        port = await a.listen()
+        # complete a real handshake, then send garbage instead of init
+        b_kp = transport.random_keypair()
+        stream = await transport.connect_noise("127.0.0.1", port, b_kp,
+                                               a.node_id)
+        stream.writer.write(b"\x00" * 18)  # not a valid AEAD frame
+        await stream.writer.drain()
+        await asyncio.sleep(0.3)
+        assert not a.peers  # rejected, no peer registered, no crash
+        # node still accepts real connections afterwards
+        c = LightningNode(privkey=0x3333)
+        peer = await c.connect("127.0.0.1", port, a.node_id)
+        assert await peer.ping(num_pong_bytes=2) == 2
+        await a.close()
+        await c.close()
+
+    run(body())
