@@ -13,7 +13,25 @@ from __future__ import annotations
 import enum
 from dataclasses import dataclass, field
 
-from .commitment import Htlc, Side
+from .commitment import (
+    ANCHOR_OUTPUT_SAT,
+    COMMITMENT_HTLC_WEIGHT,
+    COMMITMENT_TX_WEIGHT,
+    COMMITMENT_TX_WEIGHT_ANCHORS,
+    Htlc,
+    Side,
+)
+
+
+def commitment_fee_msat(n_untrimmed: int, feerate_per_kw: int,
+                        anchors: bool) -> int:
+    """The commitment-tx fee the opener pays (BOLT#3), in msat."""
+    weight = (COMMITMENT_TX_WEIGHT_ANCHORS if anchors else COMMITMENT_TX_WEIGHT)
+    weight += COMMITMENT_HTLC_WEIGHT * n_untrimmed
+    fee = feerate_per_kw * weight // 1000
+    if anchors:
+        fee += 2 * ANCHOR_OUTPUT_SAT
+    return fee * 1000
 
 
 class ChannelState(enum.Enum):
@@ -179,10 +197,31 @@ class ChannelCore:
     max_accepted_htlcs: int = 30
     max_htlc_value_in_flight_msat: int = 0xFFFFFFFFFFFFFFFF
     htlc_minimum_msat: int = 0
+    # each side imposes a reserve on the OTHER (BOLT#2): reserve_local is
+    # what WE must maintain (from their open/accept), reserve_remote what
+    # they must.  channel_reserve_msat sets both (symmetric default).
     channel_reserve_msat: int = 0
+    reserve_local_msat: int | None = None
+    reserve_remote_msat: int | None = None
+    # fee accounting (full_channel.c parity): the opener pays the
+    # commitment fee, so HTLC adds must keep the opener's balance above
+    # reserve + fee — with a 2x fee-spike buffer when the opener adds
+    # (BOLT#2 recommendation the reference enforces).
+    feerate_per_kw: int = 0
+    opener_is_local: bool = True
+    anchors: bool = True
     state: ChannelState = ChannelState.NORMAL
     htlcs: dict = field(default_factory=dict)  # (offered_by_us, id) -> LiveHtlc
     next_htlc_id: dict = field(default_factory=lambda: {True: 0, False: 0})
+
+    def __post_init__(self):
+        if self.reserve_local_msat is None:
+            self.reserve_local_msat = self.channel_reserve_msat
+        if self.reserve_remote_msat is None:
+            self.reserve_remote_msat = self.channel_reserve_msat
+
+    def _reserve_for(self, local_side: bool) -> int:
+        return self.reserve_local_msat if local_side else self.reserve_remote_msat
 
     # -- lifecycle --------------------------------------------------------
 
@@ -215,8 +254,24 @@ class ChannelCore:
         if sum(h.htlc.amount_msat for h in live) + amount_msat > \
                 self.max_htlc_value_in_flight_msat:
             raise ChannelError("max_htlc_value_in_flight exceeded")
-        if self._offered_balance_msat(by_us) - amount_msat < self.channel_reserve_msat:
+        if self._offered_balance_msat(by_us) - amount_msat < \
+                self._reserve_for(by_us):
             raise ChannelError("insufficient balance (reserve)")
+        # the opener must still afford the commitment fee with this HTLC
+        # on board; 2x feerate buffer when the opener itself is adding
+        # (fee-spike buffer, channeld/full_channel.c add_htlc)
+        if self.feerate_per_kw:
+            adder_is_opener = by_us == self.opener_is_local
+            feerate = self.feerate_per_kw * (2 if adder_is_opener else 1)
+            n_untrimmed = 1 + sum(
+                1 for h in self.htlcs.values() if not h.removed
+            )
+            fee = commitment_fee_msat(n_untrimmed, feerate, self.anchors)
+            opener_bal = self._offered_balance_msat(self.opener_is_local)
+            if by_us == self.opener_is_local:
+                opener_bal -= amount_msat
+            if opener_bal - fee < self._reserve_for(self.opener_is_local):
+                raise ChannelError("opener cannot afford commitment fee")
         hid = self.next_htlc_id[by_us]
         self.next_htlc_id[by_us] = hid + 1
         lh = LiveHtlc(
@@ -252,6 +307,18 @@ class ChannelCore:
         lh.fail_reason = reason or b"\x00"
         lh.state = HS.RCVD_REMOVE_HTLC if offered_by_us else HS.SENT_REMOVE_HTLC
 
+    def update_fee(self, feerate_per_kw: int, from_local: bool):
+        """BOLT#2 update_fee: only the opener may send it, and the opener
+        must afford the new fee on the current commitment."""
+        if from_local != self.opener_is_local:
+            raise ChannelError("only the opener may update_fee")
+        n_untrimmed = sum(1 for h in self.htlcs.values() if not h.removed)
+        fee = commitment_fee_msat(n_untrimmed, feerate_per_kw, self.anchors)
+        if self._offered_balance_msat(self.opener_is_local) - fee < \
+                self._reserve_for(self.opener_is_local):
+            raise ChannelError("opener cannot afford new feerate")
+        self.feerate_per_kw = feerate_per_kw
+
     # -- commitment flow events -------------------------------------------
 
     def _apply(self, table) -> list[LiveHtlc]:
@@ -262,6 +329,11 @@ class ChannelCore:
                 lh.state = new
                 changed.append(lh)
         return changed
+
+    def pending_for_commit(self) -> bool:
+        """True if a commitment_signed we send now would cover changes
+        (BOLT#2: MUST NOT send commitment_signed with no changes)."""
+        return any(lh.state in _ON_SEND_COMMIT for lh in self.htlcs.values())
 
     def send_commit(self) -> list[LiveHtlc]:
         changed = self._apply(_ON_SEND_COMMIT)

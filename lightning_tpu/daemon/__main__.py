@@ -1,11 +1,13 @@
-"""Daemon entry point: run a node, optionally dial a peer and ping it.
+"""Daemon entry point: run a node, optionally dial a peer and ping it,
+open a demo channel and pay over it.
 
 Minimal lightningd-equivalent main (lightningd/lightningd.c:1167) while
 the RPC surface grows; the JSON-RPC listener attaches here.
 
 Usage:
-  python -m lightning_tpu.daemon --listen 9735 [--privkey HEX]
+  python -m lightning_tpu.daemon --listen 9735 --accept-channels
   python -m lightning_tpu.daemon --connect PUBKEY@HOST:PORT --ping
+  python -m lightning_tpu.daemon --connect ... --fund 1000000 --pay 50000
 """
 from __future__ import annotations
 
@@ -22,9 +24,26 @@ async def amain(args) -> int:
     node = LightningNode(privkey=privkey)
     print(f"node_id {node.node_id.hex()}", flush=True)
 
+    hsm = None
+    if args.accept_channels or args.fund:
+        from .hsmd import CAP_MASTER, Hsm
+
+        hsm = Hsm((privkey or 7).to_bytes(32, "big"))
+
     if args.listen is not None:
         port = await node.listen(args.bind, args.listen)
         print(f"listening {args.bind}:{port}", flush=True)
+
+    if args.accept_channels:
+        from . import channeld as CD
+
+        async def serve_channels(peer):
+            client = hsm.client(CAP_MASTER, peer.node_id, dbid=1)
+            tx = await CD.channel_responder(peer, hsm, client)
+            print(f"channel closed, closing txid {tx.txid().hex()}",
+                  flush=True)
+
+        node.on_peer = serve_channels
 
     if args.connect:
         try:
@@ -37,6 +56,19 @@ async def amain(args) -> int:
             if args.ping:
                 n = await peer.ping(num_pong_bytes=16)
                 print(f"pong {n} bytes", flush=True)
+            if args.fund:
+                from . import channeld as CD
+
+                client = hsm.client(CAP_MASTER, peer.node_id, dbid=1)
+                ch = await CD.open_channel(peer, hsm, client, args.fund)
+                print(f"channel {ch.channel_id.hex()} open, "
+                      f"capacity {args.fund} sat", flush=True)
+                if args.pay:
+                    tx = await CD.demo_pay_and_close(ch, args.pay)
+                    print(f"paid {args.pay} msat; "
+                          f"final balance local {ch.core.to_local_msat} / "
+                          f"remote {ch.core.to_remote_msat} msat", flush=True)
+                    print(f"closing txid {tx.txid().hex()}", flush=True)
         except Exception as e:
             print(f"connect failed: {type(e).__name__}: {e}", file=sys.stderr)
             await node.close()
@@ -63,10 +95,25 @@ def main() -> int:
     p.add_argument("--connect", default=None, metavar="PUBKEY@HOST:PORT")
     p.add_argument("--ping", action="store_true",
                    help="ping the connected peer once")
+    p.add_argument("--accept-channels", action="store_true",
+                   help="serve inbound channel opens (fundee side)")
+    p.add_argument("--fund", type=int, default=None, metavar="SAT",
+                   help="open a channel to the connected peer")
+    p.add_argument("--pay", type=int, default=None, metavar="MSAT",
+                   help="demo-pay over the freshly opened channel and close")
     p.add_argument("--stay", action="store_true",
                    help="keep running after --connect actions")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU jax backend (the TPU tunnel may be "
+                        "unavailable; env vars alone cannot override the "
+                        "preloaded accelerator platform)")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args()
+    if args.cpu:
+        from ..utils.jaxcfg import force_cpu, setup_cache
+
+        force_cpu(cheap_compile=True)
+        setup_cache()
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",

@@ -40,7 +40,9 @@ class LightningNode:
                          else feat.from_bits(feat.DEFAULT_FEATURES))
         self.peers: dict[bytes, Peer] = {}
         self.handlers: dict[type, object] = {}
+        self.on_peer = None  # async callback(peer) run for each new peer
         self._server: asyncio.AbstractServer | None = None
+        self._peer_tasks: set[asyncio.Task] = set()
 
     @property
     def node_id(self) -> bytes:
@@ -114,6 +116,10 @@ class LightningNode:
         peer.start_pump()
         log.info("peer %s %s", node_id.hex()[:16],
                  "connected in" if incoming else "connected out")
+        if self.on_peer is not None and incoming:
+            task = asyncio.get_running_loop().create_task(self.on_peer(peer))
+            self._peer_tasks.add(task)
+            task.add_done_callback(self._peer_task_done)
         return peer
 
     async def _read_init(self, stream: NoiseStream) -> M.Init:
@@ -126,6 +132,11 @@ class LightningNode:
         return M.Init.parse(raw)
 
     # -- lifecycle --------------------------------------------------------
+
+    def _peer_task_done(self, task: asyncio.Task) -> None:
+        self._peer_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            log.error("peer service task failed", exc_info=task.exception())
 
     def _peer_gone(self, peer: Peer) -> None:
         if self.peers.get(peer.node_id) is peer:
