@@ -1,0 +1,120 @@
+"""BOLT#4 hop payload TLVs (the content inside each sphinx frame).
+
+Parity targets: common/onion_encode.c / onion_decode.c — the TLV fields
+every payment hop carries: amt_to_forward(2, tu64),
+outgoing_cltv_value(4, tu32), short_channel_id(6) for forwards,
+payment_data(8: 32-byte secret + tu64 total) for the final hop.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..wire.codec import (
+    WireError, read_tlv_stream, read_tu, write_tlv_stream, write_tu,
+)
+
+TLV_AMT_TO_FORWARD = 2
+TLV_OUTGOING_CLTV = 4
+TLV_SHORT_CHANNEL_ID = 6
+TLV_PAYMENT_DATA = 8
+# keysend (spontaneous payment): the preimage rides the final-hop onion
+# (plugins/keysend.c; de-facto standard record type)
+TLV_KEYSEND_PREIMAGE = 5482373484
+
+
+class PayloadError(Exception):
+    pass
+
+
+@dataclass
+class HopPayload:
+    amt_to_forward_msat: int
+    outgoing_cltv: int
+    short_channel_id: int | None = None  # present ⇔ forwarding hop
+    payment_secret: bytes | None = None  # final hop (payment_data)
+    total_msat: int | None = None
+    keysend_preimage: bytes | None = None
+
+    @property
+    def is_final(self) -> bool:
+        return self.short_channel_id is None
+
+    def serialize(self) -> bytes:
+        tlvs: dict[int, bytes] = {
+            TLV_AMT_TO_FORWARD: write_tu(self.amt_to_forward_msat, 8),
+            TLV_OUTGOING_CLTV: write_tu(self.outgoing_cltv, 4),
+        }
+        if self.short_channel_id is not None:
+            tlvs[TLV_SHORT_CHANNEL_ID] = self.short_channel_id.to_bytes(8, "big")
+        if self.payment_secret is not None:
+            tlvs[TLV_PAYMENT_DATA] = (
+                self.payment_secret + write_tu(self.total_msat or 0, 8)
+            )
+        if self.keysend_preimage is not None:
+            tlvs[TLV_KEYSEND_PREIMAGE] = self.keysend_preimage
+        return write_tlv_stream(tlvs)
+
+    @classmethod
+    def parse(cls, content: bytes) -> "HopPayload":
+        try:
+            tlvs = read_tlv_stream(content)
+        except WireError as e:
+            raise PayloadError(f"bad hop payload TLVs: {e}") from None
+        if TLV_AMT_TO_FORWARD not in tlvs or TLV_OUTGOING_CLTV not in tlvs:
+            raise PayloadError("hop payload missing amt/cltv")
+        scid = None
+        if TLV_SHORT_CHANNEL_ID in tlvs:
+            raw = tlvs[TLV_SHORT_CHANNEL_ID]
+            if len(raw) != 8:
+                raise PayloadError("bad short_channel_id length")
+            scid = int.from_bytes(raw, "big")
+        secret = total = None
+        if TLV_PAYMENT_DATA in tlvs:
+            raw = tlvs[TLV_PAYMENT_DATA]
+            if len(raw) < 32:
+                raise PayloadError("bad payment_data length")
+            secret = raw[:32]
+            total = read_tu(raw[32:], 8)
+        return cls(
+            amt_to_forward_msat=read_tu(tlvs[TLV_AMT_TO_FORWARD], 8),
+            outgoing_cltv=read_tu(tlvs[TLV_OUTGOING_CLTV], 4),
+            short_channel_id=scid,
+            payment_secret=secret,
+            total_msat=total,
+            keysend_preimage=tlvs.get(TLV_KEYSEND_PREIMAGE),
+        )
+
+
+def build_route_onion(hop_node_ids: list[bytes], payloads: list[HopPayload],
+                      payment_hash: bytes, session_key: int):
+    """Construct the payment onion for a route (xpay/pay's job in the
+    reference).  Returns (onion_bytes_1366, shared_secrets)."""
+    from . import sphinx
+
+    framed = [sphinx.tlv_payload(p.serialize()) for p in payloads]
+    pkt, secrets = sphinx.create_onion(
+        hop_node_ids, framed, payment_hash, session_key
+    )
+    return pkt.serialize(), secrets
+
+
+@dataclass
+class PeeledHop:
+    payload: HopPayload
+    next_onion: bytes | None  # 1366 bytes for forwards, None at the end
+    shared_secret: bytes
+
+
+def peel_payment_onion(onion_bytes: bytes, payment_hash: bytes,
+                       node_privkey: int) -> PeeledHop:
+    """One node's view of an incoming payment onion (the core of
+    lightningd/peer_htlcs.c:1451 peer_accepted_htlc)."""
+    from . import sphinx
+
+    pkt = sphinx.OnionPacket.parse(onion_bytes)
+    peeled = sphinx.peel_onion(pkt, payment_hash, node_privkey)
+    payload = HopPayload.parse(peeled.payload)
+    if peeled.is_final != payload.is_final:
+        raise PayloadError("hop position does not match payload shape")
+    nxt = peeled.next_packet.serialize() if peeled.next_packet else None
+    return PeeledHop(payload, nxt, peeled.shared_secret)

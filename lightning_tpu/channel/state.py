@@ -171,6 +171,7 @@ class LiveHtlc:
     state: HtlcState
     preimage: bytes | None = None
     fail_reason: bytes | None = None
+    onion: bytes | None = None  # the 1366-byte routing packet, for relay
 
     @property
     def in_local(self) -> bool:
@@ -242,7 +243,7 @@ class ChannelCore:
         return bal - in_flight
 
     def add_htlc(self, by_us: bool, amount_msat: int, payment_hash: bytes,
-                 cltv_expiry: int) -> LiveHtlc:
+                 cltv_expiry: int, onion: bytes | None = None) -> LiveHtlc:
         if self.state is not ChannelState.NORMAL:
             raise ChannelError(f"cannot add HTLC in {self.state}")
         if amount_msat < self.htlc_minimum_msat:
@@ -277,6 +278,7 @@ class ChannelCore:
         lh = LiveHtlc(
             Htlc(by_us, amount_msat, payment_hash, cltv_expiry, id=hid),
             HS.SENT_ADD_HTLC if by_us else HS.RCVD_ADD_HTLC,
+            onion=onion,
         )
         self.htlcs[(by_us, hid)] = lh
         return lh

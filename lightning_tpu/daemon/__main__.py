@@ -21,14 +21,19 @@ from .node import LightningNode
 
 async def amain(args) -> int:
     privkey = int(args.privkey, 16) if args.privkey else None
-    node = LightningNode(privkey=privkey)
-    print(f"node_id {node.node_id.hex()}", flush=True)
-
     hsm = None
     if args.accept_channels or args.fund:
         from .hsmd import CAP_MASTER, Hsm
 
-        hsm = Hsm((privkey or 7).to_bytes(32, "big"))
+        import os as _os
+
+        hsm = Hsm(privkey.to_bytes(32, "big") if privkey else _os.urandom(32))
+        # the node's network identity IS the hsm node key, so payment
+        # onions addressed to our node_id are peelable (hsmd ECDH parity)
+        node = LightningNode(privkey=hsm.node_key)
+    else:
+        node = LightningNode(privkey=privkey)
+    print(f"node_id {node.node_id.hex()}", flush=True)
 
     if args.listen is not None:
         port = await node.listen(args.bind, args.listen)
@@ -39,7 +44,7 @@ async def amain(args) -> int:
 
         async def serve_channels(peer):
             client = hsm.client(CAP_MASTER, peer.node_id, dbid=1)
-            tx = await CD.channel_responder(peer, hsm, client)
+            tx = await CD.channel_responder(peer, hsm, client, hsm.node_key)
             print(f"channel closed, closing txid {tx.txid().hex()}",
                   flush=True)
 
@@ -64,8 +69,9 @@ async def amain(args) -> int:
                 print(f"channel {ch.channel_id.hex()} open, "
                       f"capacity {args.fund} sat", flush=True)
                 if args.pay:
-                    tx = await CD.demo_pay_and_close(ch, args.pay)
-                    print(f"paid {args.pay} msat; "
+                    preimage, tx = await CD.keysend_pay_and_close(
+                        ch, args.pay, peer.node_id)
+                    print(f"keysend preimage {preimage.hex()[:16]}..; "
                           f"final balance local {ch.core.to_local_msat} / "
                           f"remote {ch.core.to_remote_msat} msat", flush=True)
                     print(f"closing txid {tx.txid().hex()}", flush=True)

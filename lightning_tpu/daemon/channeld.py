@@ -48,6 +48,10 @@ CLOSING_TX_WEIGHT = 672  # conservative 2-output p2wpkh/p2wsh closing tx
 RECV_TIMEOUT = 600.0
 
 
+class PaymentError(Exception):
+    pass
+
+
 @dataclass
 class ChannelConfig:
     """Our side's negotiable channel parameters (BOLT#2 open/accept)."""
@@ -313,6 +317,17 @@ class Channeld:
             channel_id=self.channel_id, id=hid, reason=reason,
         ))
 
+    async def fail_malformed_htlc(self, hid: int, onion: bytes,
+                                  failure_code: int) -> None:
+        """BOLT#2: unparseable onions are reported in the clear with the
+        onion's hash (no shared secret exists to encrypt an error)."""
+        self.core.fail_htlc(False, hid, failure_code.to_bytes(2, "big"))
+        await self.peer.send(M.UpdateFailMalformedHtlc(
+            channel_id=self.channel_id, id=hid,
+            sha256_of_onion=hashlib.sha256(onion or b"").digest(),
+            failure_code=failure_code,
+        ))
+
     async def send_update_fee(self, feerate_per_kw: int) -> None:
         self.core.update_fee(feerate_per_kw, from_local=True)
         await self.peer.send(M.UpdateFee(
@@ -322,7 +337,8 @@ class Channeld:
     async def recv_update(self):
         """Receive one update_* message and apply it to the state machine."""
         msg = await self.peer.recv(
-            M.UpdateAddHtlc, M.UpdateFulfillHtlc, M.UpdateFailHtlc, M.UpdateFee,
+            M.UpdateAddHtlc, M.UpdateFulfillHtlc, M.UpdateFailHtlc,
+            M.UpdateFailMalformedHtlc, M.UpdateFee,
             timeout=RECV_TIMEOUT,
         )
         self.apply_update(msg)
@@ -331,11 +347,15 @@ class Channeld:
     def apply_update(self, msg) -> None:
         if isinstance(msg, M.UpdateAddHtlc):
             self.core.add_htlc(False, msg.amount_msat, msg.payment_hash,
-                               msg.cltv_expiry)
+                               msg.cltv_expiry,
+                               onion=msg.onion_routing_packet)
         elif isinstance(msg, M.UpdateFulfillHtlc):
             self.core.fulfill_htlc(True, msg.id, msg.payment_preimage)
         elif isinstance(msg, M.UpdateFailHtlc):
             self.core.fail_htlc(True, msg.id, msg.reason)
+        elif isinstance(msg, M.UpdateFailMalformedHtlc):
+            self.core.fail_htlc(True, msg.id,
+                                msg.failure_code.to_bytes(2, "big"))
         elif isinstance(msg, M.UpdateFee):
             self.core.update_fee(msg.feerate_per_kw, from_local=False)
 
@@ -634,22 +654,62 @@ async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
 
 
 # ---------------------------------------------------------------------------
-# Channel responder service (the fundee-side daemon loop) + demo payment.
-# Until sphinx onions land, the demo payment uses a WELL-KNOWN preimage
-# (keysend carries the real one in the onion; see BOLT#4 task).
+# Channel responder service (the fundee-side daemon loop) + keysend pay.
 
-DEMO_PREIMAGE = hashlib.sha256(b"lightning-tpu-demo").digest()
-DEMO_PAYMENT_HASH = hashlib.sha256(DEMO_PREIMAGE).digest()
+
+# BOLT#4 failure codes
+BADONION, PERM = 0x8000, 0x4000
+INVALID_ONION_HMAC = BADONION | PERM | 5
+INCORRECT_OR_UNKNOWN_PAYMENT_DETAILS = PERM | 15
+
+
+def _classify_keysend(lh, node_privkey: int):
+    """Peel an incoming HTLC's onion and decide its fate
+    (plugins/keysend.c + lightningd/peer_htlcs.c semantics).
+
+    Returns one of:
+      ("fulfill", preimage)
+      ("fail", encrypted_error_onion)     — update_fail_htlc reason
+      ("malformed", failure_code)         — update_fail_malformed_htlc
+    """
+    from ..bolt import onion_payload as OP
+    from ..bolt import sphinx as SX
+
+    if lh.onion is None:
+        return ("malformed", INVALID_ONION_HMAC)
+    try:
+        peeled = OP.peel_payment_onion(lh.onion, lh.htlc.payment_hash,
+                                       node_privkey)
+    except (SX.SphinxError, OP.PayloadError):
+        # unparseable onion: we have no shared secret to encrypt with —
+        # BOLT#2 says report it as malformed with the onion's hash
+        return ("malformed", INVALID_ONION_HMAC)
+    p = peeled.payload
+    if (p.is_final and p.keysend_preimage is not None
+            and hashlib.sha256(p.keysend_preimage).digest()
+            == lh.htlc.payment_hash
+            and p.amt_to_forward_msat <= lh.htlc.amount_msat):
+        return ("fulfill", p.keysend_preimage)
+    # parseable but not a keysend for us: return a REAL encrypted error
+    # onion the origin can attribute (incorrect_or_unknown_payment_details
+    # carries htlc_msat + blockheight per BOLT#4)
+    failmsg = (
+        INCORRECT_OR_UNKNOWN_PAYMENT_DETAILS.to_bytes(2, "big")
+        + lh.htlc.amount_msat.to_bytes(8, "big") + (0).to_bytes(4, "big")
+    )
+    return ("fail", SX.create_error_onion(peeled.shared_secret, failmsg))
 
 
 async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
+                            node_privkey: int,
                             cfg: ChannelConfig | None = None) -> T.Tx:
     """Accept one inbound channel and serve it until cooperative close:
     apply updates, answer commitment dances (committing back our own
-    changes), fulfill demo-preimage HTLCs, negotiate shutdown.  Returns
-    the closing tx.  This is the daemon-side channel loop the CLI runs."""
+    changes), fulfill keysend HTLCs addressed to us, negotiate shutdown.
+    Returns the closing tx.  This is the daemon-side channel loop the CLI
+    runs."""
     ch = await accept_channel(peer, hsm, client, cfg)
-    pending_fulfill: list[int] = []
+    handled: set[int] = set()
     while True:
         msg = await ch.peer.recv(
             M.UpdateAddHtlc, M.UpdateFulfillHtlc, M.UpdateFailHtlc,
@@ -666,36 +726,55 @@ async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
             await ch.handle_commit_msg(msg)
             if ch.core.pending_for_commit():
                 await ch.commit()
-            # fulfill demo HTLCs that the completed dance locked in, and
-            # commit the removals in a fresh dance
-            fulfilled = False
+            # resolve HTLCs the completed dance locked in, then commit
+            # the removals in a fresh dance
+            resolved = False
             for (by_us, hid), lh in list(ch.core.htlcs.items()):
-                if (not by_us and lh.preimage is None
-                        and lh.fail_reason is None
-                        and lh.htlc.payment_hash == DEMO_PAYMENT_HASH
-                        and hid not in pending_fulfill):
-                    try:
-                        await ch.fulfill_htlc(hid, DEMO_PREIMAGE)
-                        pending_fulfill.append(hid)
-                        fulfilled = True
-                    except ChannelError:
-                        pass  # not yet irrevocably committed; next dance
-            if fulfilled:
+                if (by_us or lh.preimage is not None
+                        or lh.fail_reason is not None or hid in handled):
+                    continue
+                preimage = _keysend_preimage_for(lh, node_privkey)
+                try:
+                    if preimage is not None:
+                        await ch.fulfill_htlc(hid, preimage)
+                    else:
+                        # not ours / not keysend: no router attached yet
+                        await ch.fail_htlc(hid, b"@")  # incorrect_details
+                    handled.add(hid)
+                    resolved = True
+                except ChannelError:
+                    pass  # not yet irrevocably committed; next dance
+            if resolved:
                 await ch.commit()
         else:
             ch.apply_update(msg)
 
 
-async def demo_pay_and_close(ch: Channeld, amount_msat: int) -> T.Tx:
-    """Funder-side demo flow: pay one HTLC (demo preimage), settle it,
-    cooperatively close.  Returns the closing tx."""
-    await ch.offer_htlc(amount_msat, DEMO_PAYMENT_HASH, cltv_expiry=500_000)
+async def keysend_pay_and_close(ch: Channeld, amount_msat: int,
+                                dest_node_id: bytes) -> tuple[bytes, T.Tx]:
+    """Funder-side flow: keysend-pay over a REAL single-hop sphinx onion,
+    settle, cooperatively close.  Returns (preimage, closing tx)."""
+    from ..bolt import onion_payload as OP
+
+    preimage = os.urandom(32)
+    payment_hash = hashlib.sha256(preimage).digest()
+    onion, _ = OP.build_route_onion(
+        [dest_node_id],
+        [OP.HopPayload(amount_msat, 500_000, keysend_preimage=preimage)],
+        payment_hash,
+        session_key=int.from_bytes(os.urandom(32), "big") % (2**252) + 1,
+    )
+    await ch.offer_htlc(amount_msat, payment_hash, cltv_expiry=500_000,
+                        onion=onion)
     await ch.commit()           # lock it in; peer commits back with dance
     await ch.handle_commit()
-    upd = await ch.recv_update()  # their update_fulfill
-    assert isinstance(upd, M.UpdateFulfillHtlc)
+    upd = await ch.recv_update()  # their fulfill (or fail)
+    settled_ok = (isinstance(upd, M.UpdateFulfillHtlc)
+                  and upd.payment_preimage == preimage)
     await ch.handle_commit()    # they commit the removal
     await ch.commit()
+    if not settled_ok:
+        raise PaymentError(f"payment rejected: {type(upd).__name__}")
     await ch.shutdown()
     await ch.recv_shutdown()
-    return await ch.negotiate_close()
+    return preimage, await ch.negotiate_close()

@@ -1,0 +1,169 @@
+"""Three-node payment forwarding: a real sphinx onion rides UpdateAddHtlc
+across two channels (A→B→C), B peels and forwards, C fulfills, and the
+preimage settles back to A.
+
+This is the minimal forward_htlc relay of lightningd/peer_htlcs.c:812 —
+the full router/pay-engine service builds on exactly this path.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+from lightning_tpu.bolt import onion_payload as OP
+from lightning_tpu.daemon import channeld as CD
+from lightning_tpu.daemon.hsmd import CAP_MASTER, Hsm
+from lightning_tpu.daemon.node import LightningNode
+
+FUND = 1_000_000
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 300))
+
+
+async def _open(na, nb, hsm_a, hsm_b, dbid):
+    port = await na.listen() if na._server is None else \
+        na._server.sockets[0].getsockname()[1]
+    peer_b2a = await nb.connect("127.0.0.1", port, na.node_id)
+    while nb.node_id not in na.peers:
+        await asyncio.sleep(0.01)
+    peer_a2b = na.peers[nb.node_id]
+    cl_a = hsm_a.client(CAP_MASTER, nb.node_id, dbid=dbid)
+    cl_b = hsm_b.client(CAP_MASTER, na.node_id, dbid=dbid)
+    return await asyncio.gather(
+        CD.open_channel(peer_a2b, hsm_a, cl_a, FUND),
+        CD.accept_channel(peer_b2a, hsm_b, cl_b),
+    )
+
+
+def test_three_node_onion_forward():
+    async def body():
+        privs = {"a": 0xAAA1, "b": 0xBBB2, "c": 0xCCC3}
+        na, nb, nc = (LightningNode(privkey=p) for p in privs.values())
+        hsms = {k: Hsm(bytes([i + 1]) * 32) for i, k in enumerate("abc")}
+        try:
+            ch_ab, ch_ba = await _open(na, nb, hsms["a"], hsms["b"], 1)
+            ch_bc, ch_cb = await _open(nb, nc, hsms["b"], hsms["c"], 2)
+
+            preimage = b"\x42" * 32
+            payment_hash = hashlib.sha256(preimage).digest()
+            payment_secret = b"\x77" * 32
+            amount = 40_000_000
+            fee_b = 1_000_000  # B's routing fee
+            scid_bc = 0x0001_0000_0001
+
+            # A builds the route onion: hop B (forward), hop C (final)
+            onion, secrets = OP.build_route_onion(
+                [nb.node_id, nc.node_id],
+                [
+                    OP.HopPayload(amount, 500_040, short_channel_id=scid_bc),
+                    OP.HopPayload(amount, 500_020,
+                                  payment_secret=payment_secret,
+                                  total_msat=amount),
+                ],
+                payment_hash, session_key=0x535353,
+            )
+
+            # A → B: offer + lock in
+            hid_ab = await ch_ab.offer_htlc(amount + fee_b, payment_hash,
+                                            500_060, onion=onion)
+            await ch_ba.recv_update()
+            await asyncio.gather(ch_ab.commit(), ch_ba.handle_commit())
+            await asyncio.gather(ch_ba.commit(), ch_ab.handle_commit())
+
+            # B peels: must be a forward to scid_bc with A's stated amount
+            lh = ch_ba.core.htlcs[(False, hid_ab)]
+            peeled_b = OP.peel_payment_onion(lh.onion, payment_hash,
+                                             privs["b"])
+            assert not peeled_b.payload.is_final
+            assert peeled_b.payload.short_channel_id == scid_bc
+            assert peeled_b.payload.amt_to_forward_msat == amount
+            # B enforces its fee before forwarding
+            assert lh.htlc.amount_msat - peeled_b.payload.amt_to_forward_msat \
+                == fee_b
+
+            # B → C: forward with the peeled next onion
+            hid_bc = await ch_bc.offer_htlc(
+                peeled_b.payload.amt_to_forward_msat, payment_hash,
+                peeled_b.payload.outgoing_cltv, onion=peeled_b.next_onion,
+            )
+            await ch_cb.recv_update()
+            await asyncio.gather(ch_bc.commit(), ch_cb.handle_commit())
+            await asyncio.gather(ch_cb.commit(), ch_bc.handle_commit())
+
+            # C peels: final hop, payment_data intact → fulfill
+            lh_c = ch_cb.core.htlcs[(False, hid_bc)]
+            peeled_c = OP.peel_payment_onion(lh_c.onion, payment_hash,
+                                             privs["c"])
+            assert peeled_c.payload.is_final
+            assert peeled_c.next_onion is None
+            assert peeled_c.payload.payment_secret == payment_secret
+            assert peeled_c.payload.total_msat == amount
+
+            await ch_cb.fulfill_htlc(hid_bc, preimage)
+            await ch_bc.recv_update()
+            await asyncio.gather(ch_cb.commit(), ch_bc.handle_commit())
+            await asyncio.gather(ch_bc.commit(), ch_cb.handle_commit())
+
+            # preimage propagates back: B fulfills A's HTLC
+            await ch_ba.fulfill_htlc(hid_ab, preimage)
+            await ch_ab.recv_update()
+            await asyncio.gather(ch_ba.commit(), ch_ab.handle_commit())
+            await asyncio.gather(ch_ab.commit(), ch_ba.handle_commit())
+
+            # settlement: A paid amount+fee, B earned fee, C got amount
+            total = FUND * 1000
+            assert ch_ab.core.to_local_msat == total - amount - fee_b
+            assert ch_ba.core.to_local_msat == amount + fee_b
+            assert ch_bc.core.to_local_msat == total - amount
+            assert ch_cb.core.to_local_msat == amount
+        finally:
+            await na.close()
+            await nb.close()
+            await nc.close()
+
+    run(body())
+
+
+def test_non_keysend_htlc_fails_with_real_error_onion():
+    """A non-keysend payment hitting the keysend responder must come back
+    as an encrypted BOLT#4 error onion the ORIGIN can attribute and
+    decode (incorrect_or_unknown_payment_details with htlc_msat)."""
+    import hashlib as hl
+
+    from lightning_tpu.bolt import sphinx
+    from lightning_tpu.channel.state import LiveHtlc, HtlcState
+    from lightning_tpu.channel.commitment import Htlc
+
+    node_priv = 0x4242
+    node_pub = __import__(
+        "lightning_tpu.crypto.ref_python", fromlist=["x"]
+    ).pubkey_serialize(
+        __import__("lightning_tpu.crypto.ref_python",
+                   fromlist=["x"]).pubkey_create(node_priv)
+    )
+    payment_hash = hl.sha256(b"unknown-invoice").digest()
+    onion, secrets = OP.build_route_onion(
+        [node_pub],
+        [OP.HopPayload(5_000_000, 500_000, payment_secret=b"\x09" * 32,
+                       total_msat=5_000_000)],
+        payment_hash, session_key=0x1357,
+    )
+    lh = LiveHtlc(Htlc(False, 5_000_000, payment_hash, 500_000, id=0),
+                  HtlcState.RCVD_ADD_ACK_REVOCATION, onion=onion)
+    verdict, blob = CD._classify_keysend(lh, node_priv)
+    assert verdict == "fail"
+    idx, msg = sphinx.unwrap_error_onion(secrets, blob)
+    assert idx == 0
+    assert int.from_bytes(msg[:2], "big") == \
+        CD.INCORRECT_OR_UNKNOWN_PAYMENT_DETAILS
+    assert int.from_bytes(msg[2:10], "big") == 5_000_000
+
+    # garbage onion → malformed verdict with BADONION code
+    lh_bad = LiveHtlc(Htlc(False, 1, payment_hash, 1, id=1),
+                      HtlcState.RCVD_ADD_ACK_REVOCATION,
+                      onion=b"\x00" * 1366)
+    verdict, code = CD._classify_keysend(lh_bad, node_priv)
+    assert verdict == "malformed"
+    assert code & CD.BADONION
