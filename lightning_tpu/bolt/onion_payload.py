@@ -54,35 +54,44 @@ class HopPayload:
             tlvs[TLV_KEYSEND_PREIMAGE] = self.keysend_preimage
         return write_tlv_stream(tlvs)
 
+    KNOWN_TLVS = frozenset({TLV_AMT_TO_FORWARD, TLV_OUTGOING_CLTV,
+                            TLV_SHORT_CHANNEL_ID, TLV_PAYMENT_DATA,
+                            TLV_KEYSEND_PREIMAGE})
+
     @classmethod
     def parse(cls, content: bytes) -> "HopPayload":
         try:
             tlvs = read_tlv_stream(content)
+            if TLV_AMT_TO_FORWARD not in tlvs or TLV_OUTGOING_CLTV not in tlvs:
+                raise PayloadError("hop payload missing amt/cltv")
+            # BOLT#4 it's-OK-to-be-odd: an unknown EVEN type means the
+            # sender relies on semantics we don't implement — MUST fail
+            for t in tlvs:
+                if t % 2 == 0 and t not in cls.KNOWN_TLVS:
+                    raise PayloadError(f"unknown even TLV type {t}")
+            scid = None
+            if TLV_SHORT_CHANNEL_ID in tlvs:
+                raw = tlvs[TLV_SHORT_CHANNEL_ID]
+                if len(raw) != 8:
+                    raise PayloadError("bad short_channel_id length")
+                scid = int.from_bytes(raw, "big")
+            secret = total = None
+            if TLV_PAYMENT_DATA in tlvs:
+                raw = tlvs[TLV_PAYMENT_DATA]
+                if not 32 <= len(raw) <= 40:
+                    raise PayloadError("bad payment_data length")
+                secret = raw[:32]
+                total = read_tu(raw[32:], 8)
+            return cls(
+                amt_to_forward_msat=read_tu(tlvs[TLV_AMT_TO_FORWARD], 8),
+                outgoing_cltv=read_tu(tlvs[TLV_OUTGOING_CLTV], 4),
+                short_channel_id=scid,
+                payment_secret=secret,
+                total_msat=total,
+                keysend_preimage=tlvs.get(TLV_KEYSEND_PREIMAGE),
+            )
         except WireError as e:
-            raise PayloadError(f"bad hop payload TLVs: {e}") from None
-        if TLV_AMT_TO_FORWARD not in tlvs or TLV_OUTGOING_CLTV not in tlvs:
-            raise PayloadError("hop payload missing amt/cltv")
-        scid = None
-        if TLV_SHORT_CHANNEL_ID in tlvs:
-            raw = tlvs[TLV_SHORT_CHANNEL_ID]
-            if len(raw) != 8:
-                raise PayloadError("bad short_channel_id length")
-            scid = int.from_bytes(raw, "big")
-        secret = total = None
-        if TLV_PAYMENT_DATA in tlvs:
-            raw = tlvs[TLV_PAYMENT_DATA]
-            if len(raw) < 32:
-                raise PayloadError("bad payment_data length")
-            secret = raw[:32]
-            total = read_tu(raw[32:], 8)
-        return cls(
-            amt_to_forward_msat=read_tu(tlvs[TLV_AMT_TO_FORWARD], 8),
-            outgoing_cltv=read_tu(tlvs[TLV_OUTGOING_CLTV], 4),
-            short_channel_id=scid,
-            payment_secret=secret,
-            total_msat=total,
-            keysend_preimage=tlvs.get(TLV_KEYSEND_PREIMAGE),
-        )
+            raise PayloadError(f"bad hop payload: {e}") from None
 
 
 def build_route_onion(hop_node_ids: list[bytes], payloads: list[HopPayload],

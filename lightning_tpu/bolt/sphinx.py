@@ -214,7 +214,12 @@ def peel_onion(packet: OnionPacket, assoc_data: bytes,
         payload = clear[1:33]
         consumed = 33
     else:
-        ln, off = read_bigsize(clear, 0)
+        try:
+            ln, off = read_bigsize(clear, 0)
+        except Exception as e:
+            raise SphinxError(f"bad frame length: {e}") from None
+        if off + ln + HMAC_SIZE > ROUTING_INFO_SIZE:
+            raise SphinxError("hop frame exceeds routing info")
         payload = clear[off : off + ln]
         consumed = off + ln
     next_hmac = clear[consumed : consumed + HMAC_SIZE]

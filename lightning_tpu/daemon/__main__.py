@@ -20,14 +20,44 @@ from .node import LightningNode
 
 
 async def amain(args) -> int:
+    import os as _os
+
     privkey = int(args.privkey, 16) if args.privkey else None
     hsm = None
-    if args.accept_channels or args.fund:
-        from .hsmd import CAP_MASTER, Hsm
+    wallet = None
+    if args.data_dir:
+        # persistent node: hsm_secret + sqlite wallet live here
+        # (the reference's lightning-dir layout)
+        from .hsmd import Hsm
+        from ..wallet.db import Db
+        from ..wallet.wallet import Wallet
 
-        import os as _os
+        _os.makedirs(args.data_dir, exist_ok=True)
+        secret_path = _os.path.join(args.data_dir, "hsm_secret")
+        if _os.path.exists(secret_path):
+            with open(secret_path, "rb") as f:
+                secret = f.read()
+        else:
+            secret = (privkey.to_bytes(32, "big") if privkey
+                      else _os.urandom(32))
+            fd = _os.open(secret_path, _os.O_WRONLY | _os.O_CREAT, 0o600)
+            _os.write(fd, secret)
+            _os.close(fd)
+        hsm = Hsm(secret)
+        wallet = Wallet(Db(_os.path.join(args.data_dir, "lightningd.sqlite3")))
+        rows = wallet.list_channels()
+        live = [r for r in rows if r["state"] not in
+                ("closingd_complete", "onchain", "closed")]
+        if rows:
+            # records are loaded, not yet re-attached to peers: the
+            # channel-manager service will reestablish live ones
+            print(f"wallet has {len(rows)} channel record(s), "
+                  f"{len(live)} live", flush=True)
+    elif args.accept_channels or args.fund:
+        from .hsmd import Hsm
 
         hsm = Hsm(privkey.to_bytes(32, "big") if privkey else _os.urandom(32))
+    if hsm is not None:
         # the node's network identity IS the hsm node key, so payment
         # onions addressed to our node_id are peelable (hsmd ECDH parity)
         node = LightningNode(privkey=hsm.node_key)
@@ -43,8 +73,11 @@ async def amain(args) -> int:
         from . import channeld as CD
 
         async def serve_channels(peer):
+            from .hsmd import CAP_MASTER
+
             client = hsm.client(CAP_MASTER, peer.node_id, dbid=1)
-            tx = await CD.channel_responder(peer, hsm, client, hsm.node_key)
+            tx = await CD.channel_responder(peer, hsm, client, hsm.node_key,
+                                            wallet=wallet)
             print(f"channel closed, closing txid {tx.txid().hex()}",
                   flush=True)
 
@@ -63,9 +96,11 @@ async def amain(args) -> int:
                 print(f"pong {n} bytes", flush=True)
             if args.fund:
                 from . import channeld as CD
+                from .hsmd import CAP_MASTER
 
                 client = hsm.client(CAP_MASTER, peer.node_id, dbid=1)
-                ch = await CD.open_channel(peer, hsm, client, args.fund)
+                ch = await CD.open_channel(peer, hsm, client, args.fund,
+                                           wallet=wallet, hsm_dbid=1)
                 print(f"channel {ch.channel_id.hex()} open, "
                       f"capacity {args.fund} sat", flush=True)
                 if args.pay:
@@ -98,6 +133,8 @@ def main() -> int:
                    help="TCP port to accept peers on (0 = ephemeral)")
     p.add_argument("--bind", default="127.0.0.1")
     p.add_argument("--privkey", default=None, help="node secret key (hex)")
+    p.add_argument("--data-dir", default=None,
+                   help="persistent node dir (hsm_secret + sqlite wallet)")
     p.add_argument("--connect", default=None, metavar="PUBKEY@HOST:PORT")
     p.add_argument("--ping", action="store_true",
                    help="ping the connected peer once")

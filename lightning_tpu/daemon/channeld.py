@@ -121,6 +121,20 @@ class Channeld:
         self.their_last_secret = b"\x00" * 32
         self.our_shutdown_script: bytes = b""
         self.their_shutdown_script: bytes = b""
+        # persistence (wallet/wallet.c parity): when attached, _persist()
+        # checkpoints the FULL channel state; callers invoke it before
+        # every wire ack (write-ahead, SURVEY §5)
+        self.wallet = None
+        self.wallet_id: int | None = None
+        self.hsm_dbid = 0
+
+    def attach_wallet(self, wallet, hsm_dbid: int) -> None:
+        self.wallet = wallet
+        self.hsm_dbid = hsm_dbid
+
+    def _persist(self) -> None:
+        if self.wallet is not None:
+            self.wallet.save_channel(self, self.peer.node_id, self.hsm_dbid)
 
     # ------------------------------------------------------------------
     # key/commitment helpers
@@ -246,12 +260,14 @@ class Channeld:
         self.core.send_commit()
         n = self.next_remote_commit
         fsig, hsigs = await asyncio.to_thread(self._sign_remote, n)
+        self.next_remote_commit = n + 1
+        self._persist()  # checkpoint BEFORE the signature leaves us
         await self.peer.send(M.CommitmentSigned(
             channel_id=self.channel_id, signature=fsig, htlc_signatures=hsigs,
         ))
-        self.next_remote_commit = n + 1
         raa = await self.peer.recv(M.RevokeAndAck, timeout=RECV_TIMEOUT)
         self._process_revoke(raa, revoked_n=n - 1)
+        self._persist()  # their revocation secret must survive a crash
 
     async def handle_commit(self) -> None:
         """await commitment_signed → verify (batched) → send revoke_and_ack
@@ -265,14 +281,17 @@ class Channeld:
         await asyncio.to_thread(self._verify_local, n, cs.signature,
                                 cs.htlc_signatures)
         self.next_local_commit = n + 1
-        # revoke commitment n-1: reveal its secret, announce point n+1
+        # revoke commitment n-1: reveal its secret, announce point n+1.
+        # The state advance + checkpoint happen BEFORE the revocation
+        # leaves us — releasing a secret we could forget is unforgivable
         secret = self.hsm.per_commitment_secret(self.client, n - 1)
+        self.core.send_revoke()
+        self._persist()
         await self.peer.send(M.RevokeAndAck(
             channel_id=self.channel_id,
             per_commitment_secret=secret,
             next_per_commitment_point=ref.pubkey_serialize(self.our_point(n + 1)),
         ))
-        self.core.send_revoke()
 
     def _process_revoke(self, raa: M.RevokeAndAck, revoked_n: int) -> None:
         point = K.per_commitment_point(raa.per_commitment_secret)
@@ -296,7 +315,9 @@ class Channeld:
     async def offer_htlc(self, amount_msat: int, payment_hash: bytes,
                          cltv_expiry: int,
                          onion: bytes = b"\x00" * M.ONION_PACKET_LEN) -> int:
-        lh = self.core.add_htlc(True, amount_msat, payment_hash, cltv_expiry)
+        lh = self.core.add_htlc(True, amount_msat, payment_hash, cltv_expiry,
+                                onion=onion)
+        self._persist()
         await self.peer.send(M.UpdateAddHtlc(
             channel_id=self.channel_id, id=lh.htlc.id,
             amount_msat=amount_msat, payment_hash=payment_hash,
@@ -307,12 +328,14 @@ class Channeld:
     async def fulfill_htlc(self, hid: int, preimage: bytes) -> None:
         """Fulfill an HTLC the peer offered us."""
         self.core.fulfill_htlc(False, hid, preimage)
+        self._persist()
         await self.peer.send(M.UpdateFulfillHtlc(
             channel_id=self.channel_id, id=hid, payment_preimage=preimage,
         ))
 
     async def fail_htlc(self, hid: int, reason: bytes = b"") -> None:
         self.core.fail_htlc(False, hid, reason)
+        self._persist()
         await self.peer.send(M.UpdateFailHtlc(
             channel_id=self.channel_id, id=hid, reason=reason,
         ))
@@ -322,6 +345,7 @@ class Channeld:
         """BOLT#2: unparseable onions are reported in the clear with the
         onion's hash (no shared secret exists to encrypt an error)."""
         self.core.fail_htlc(False, hid, failure_code.to_bytes(2, "big"))
+        self._persist()
         await self.peer.send(M.UpdateFailMalformedHtlc(
             channel_id=self.channel_id, id=hid,
             sha256_of_onion=hashlib.sha256(onion or b"").digest(),
@@ -330,6 +354,7 @@ class Channeld:
 
     async def send_update_fee(self, feerate_per_kw: int) -> None:
         self.core.update_fee(feerate_per_kw, from_local=True)
+        self._persist()
         await self.peer.send(M.UpdateFee(
             channel_id=self.channel_id, feerate_per_kw=feerate_per_kw,
         ))
@@ -358,6 +383,7 @@ class Channeld:
                                 msg.failure_code.to_bytes(2, "big"))
         elif isinstance(msg, M.UpdateFee):
             self.core.update_fee(msg.feerate_per_kw, from_local=False)
+        self._persist()
 
     # ------------------------------------------------------------------
     # cooperative close (closingd/closingd.c:809 + simpleclosed)
@@ -389,6 +415,7 @@ class Channeld:
         )
         if self.core.state is ChannelState.NORMAL:
             self.core.transition(ChannelState.SHUTTING_DOWN)
+        self._persist()
         await self.peer.send(M.Shutdown(
             channel_id=self.channel_id, scriptpubkey=self.our_shutdown_script,
         ))
@@ -428,6 +455,7 @@ class Channeld:
             await asyncio.to_thread(self._check_closing_sig, their)
             await self._send_closing_signed(fee)
         self.core.transition(ChannelState.CLOSINGD_COMPLETE)
+        self._persist()
         tx = self._closing_tx(fee)
         log.info("channel %s closed cooperatively, fee %d sat, txid %s",
                  self.channel_id.hex()[:16], fee, tx.txid().hex()[:16])
@@ -485,6 +513,21 @@ class Channeld:
         return K.LARGEST_INDEX - self.their_secrets.max_index + 1
 
 
+def restore_channeld(wallet, row: dict, peer: Peer, hsm: Hsm,
+                     cfg: ChannelConfig | None = None) -> Channeld:
+    """Rebuild a channel's driver from its db row after a restart
+    (load_channels_from_wallet, lightningd/lightningd.c:1363)."""
+    from .hsmd import CAP_MASTER
+
+    client = hsm.client(CAP_MASTER, row["peer_node_id"], dbid=row["hsm_dbid"])
+    ch = Channeld(peer, hsm, client, funder=bool(row["funder"]),
+                  cfg=cfg or ChannelConfig())
+    wallet.restore_into(ch, row)
+    ch.attach_wallet(wallet, row["hsm_dbid"])
+    ch.cfg.feerate_per_kw = ch.core.feerate_per_kw
+    return ch
+
+
 # ---------------------------------------------------------------------------
 # v1 channel establishment (openingd/openingd.c + opening_control.c)
 
@@ -511,7 +554,8 @@ def _open_core(funding_sat: int, push_msat: int, local_is_funder: bool,
 
 async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
                        funding_sat: int, push_msat: int = 0,
-                       cfg: ChannelConfig | None = None) -> Channeld:
+                       cfg: ChannelConfig | None = None,
+                       wallet=None, hsm_dbid: int = 0) -> Channeld:
     """Funder-side v1 open: open_channel → accept_channel →
     funding_created → funding_signed → channel_ready (both ways)."""
     cfg = cfg or ChannelConfig()
@@ -585,13 +629,17 @@ async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
     cr = await peer.recv(M.ChannelReady, timeout=RECV_TIMEOUT)
     ch.their_points[1] = ref.pubkey_parse(cr.second_per_commitment_point)
     ch.core.transition(ChannelState.NORMAL)
+    if wallet is not None:
+        ch.attach_wallet(wallet, hsm_dbid)
+        ch._persist()
     log.info("channel %s open (funder), capacity %d sat",
              ch.channel_id.hex()[:16], funding_sat)
     return ch
 
 
 async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
-                         cfg: ChannelConfig | None = None) -> Channeld:
+                         cfg: ChannelConfig | None = None,
+                         wallet=None, hsm_dbid: int = 0) -> Channeld:
     """Fundee-side v1 open."""
     cfg = cfg or ChannelConfig()
     oc = await peer.recv(M.OpenChannel, timeout=RECV_TIMEOUT)
@@ -648,6 +696,9 @@ async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
         second_per_commitment_point=ref.pubkey_serialize(ch.our_point(1)),
     ))
     ch.core.transition(ChannelState.NORMAL)
+    if wallet is not None:
+        ch.attach_wallet(wallet, hsm_dbid)
+        ch._persist()
     log.info("channel %s open (fundee), capacity %d sat",
              ch.channel_id.hex()[:16], oc.funding_satoshis)
     return ch
@@ -660,6 +711,7 @@ async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
 # BOLT#4 failure codes
 BADONION, PERM = 0x8000, 0x4000
 INVALID_ONION_HMAC = BADONION | PERM | 5
+INVALID_ONION_PAYLOAD = PERM | 22
 INCORRECT_OR_UNKNOWN_PAYMENT_DETAILS = PERM | 15
 
 
@@ -678,18 +730,28 @@ def _classify_keysend(lh, node_privkey: int):
     if lh.onion is None:
         return ("malformed", INVALID_ONION_HMAC)
     try:
-        peeled = OP.peel_payment_onion(lh.onion, lh.htlc.payment_hash,
-                                       node_privkey)
-    except (SX.SphinxError, OP.PayloadError):
-        # unparseable onion: we have no shared secret to encrypt with —
+        pkt = SX.OnionPacket.parse(lh.onion)
+        peeled_raw = SX.peel_onion(pkt, lh.htlc.payment_hash, node_privkey)
+    except SX.SphinxError:
+        # sphinx-level failure: no shared secret exists to encrypt with —
         # BOLT#2 says report it as malformed with the onion's hash
         return ("malformed", INVALID_ONION_HMAC)
-    p = peeled.payload
-    if (p.is_final and p.keysend_preimage is not None
-            and hashlib.sha256(p.keysend_preimage).digest()
+    try:
+        payload = OP.HopPayload.parse(peeled_raw.payload)
+        if peeled_raw.is_final != payload.is_final:
+            raise OP.PayloadError("hop position/payload shape mismatch")
+    except OP.PayloadError:
+        # the HMAC was valid, so we DO have a shared secret: per BOLT#4
+        # this is an encrypted invalid_onion_payload error, not malformed
+        failmsg = INVALID_ONION_PAYLOAD.to_bytes(2, "big")
+        return ("fail", SX.create_error_onion(peeled_raw.shared_secret,
+                                              failmsg))
+
+    if (payload.is_final and payload.keysend_preimage is not None
+            and hashlib.sha256(payload.keysend_preimage).digest()
             == lh.htlc.payment_hash
-            and p.amt_to_forward_msat <= lh.htlc.amount_msat):
-        return ("fulfill", p.keysend_preimage)
+            and payload.amt_to_forward_msat <= lh.htlc.amount_msat):
+        return ("fulfill", payload.keysend_preimage)
     # parseable but not a keysend for us: return a REAL encrypted error
     # onion the origin can attribute (incorrect_or_unknown_payment_details
     # carries htlc_msat + blockheight per BOLT#4)
@@ -697,18 +759,20 @@ def _classify_keysend(lh, node_privkey: int):
         INCORRECT_OR_UNKNOWN_PAYMENT_DETAILS.to_bytes(2, "big")
         + lh.htlc.amount_msat.to_bytes(8, "big") + (0).to_bytes(4, "big")
     )
-    return ("fail", SX.create_error_onion(peeled.shared_secret, failmsg))
+    return ("fail", SX.create_error_onion(peeled_raw.shared_secret, failmsg))
 
 
 async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
                             node_privkey: int,
-                            cfg: ChannelConfig | None = None) -> T.Tx:
+                            cfg: ChannelConfig | None = None,
+                            wallet=None, hsm_dbid: int = 1) -> T.Tx:
     """Accept one inbound channel and serve it until cooperative close:
     apply updates, answer commitment dances (committing back our own
     changes), fulfill keysend HTLCs addressed to us, negotiate shutdown.
     Returns the closing tx.  This is the daemon-side channel loop the CLI
     runs."""
-    ch = await accept_channel(peer, hsm, client, cfg)
+    ch = await accept_channel(peer, hsm, client, cfg, wallet=wallet,
+                              hsm_dbid=hsm_dbid)
     handled: set[int] = set()
     while True:
         msg = await ch.peer.recv(
@@ -733,13 +797,14 @@ async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
                 if (by_us or lh.preimage is not None
                         or lh.fail_reason is not None or hid in handled):
                     continue
-                preimage = _keysend_preimage_for(lh, node_privkey)
+                verdict, data = _classify_keysend(lh, node_privkey)
                 try:
-                    if preimage is not None:
-                        await ch.fulfill_htlc(hid, preimage)
+                    if verdict == "fulfill":
+                        await ch.fulfill_htlc(hid, data)
+                    elif verdict == "fail":
+                        await ch.fail_htlc(hid, data)
                     else:
-                        # not ours / not keysend: no router attached yet
-                        await ch.fail_htlc(hid, b"@")  # incorrect_details
+                        await ch.fail_malformed_htlc(hid, lh.onion, data)
                     handled.add(hid)
                     resolved = True
                 except ChannelError:

@@ -1,0 +1,173 @@
+"""Channel persistence: save/load the full protocol state of a channel.
+
+Parity target: wallet/wallet.c's channels + channel_htlcs + shachains
+tables.  The save path is called by channeld BEFORE every wire ack
+(write-ahead semantics, SURVEY §5); the load path reconstructs a
+Channeld after restart, ready for channel_reestablish.
+"""
+from __future__ import annotations
+
+import json
+
+from ..btc import keys as K
+from ..channel.commitment import Htlc
+from ..channel.state import ChannelCore, ChannelState, HtlcState, LiveHtlc
+from ..crypto import ref_python as ref
+from .db import Db
+
+
+def _pack_basepoints(bp: K.Basepoints) -> bytes:
+    ser = ref.pubkey_serialize
+    return b"".join([ser(bp.funding_pubkey), ser(bp.revocation),
+                     ser(bp.payment), ser(bp.delayed_payment), ser(bp.htlc)])
+
+
+def _unpack_basepoints(raw: bytes) -> K.Basepoints:
+    ks = [ref.pubkey_parse(raw[i * 33:(i + 1) * 33]) for i in range(5)]
+    return K.Basepoints(*ks)
+
+
+class Wallet:
+    def __init__(self, db: Db):
+        self.db = db
+
+    # -- channels ---------------------------------------------------------
+
+    def save_channel(self, ch, peer_node_id: bytes, hsm_dbid: int) -> int:
+        """Insert-or-update the complete state of a Channeld.  Returns the
+        channel's db id (stable across saves via ch.wallet_id)."""
+        core = ch.core
+        points = json.dumps(
+            {str(n): ref.pubkey_serialize(p).hex()
+             for n, p in ch.their_points.items()}
+        )
+        fields = dict(
+            peer_node_id=peer_node_id, hsm_dbid=hsm_dbid,
+            funder=int(ch.funder), channel_id=ch.channel_id,
+            funding_txid=ch.funding_txid, funding_outidx=ch.funding_outidx,
+            funding_sat=ch.funding_sat, state=core.state.value,
+            to_local_msat=core.to_local_msat,
+            to_remote_msat=core.to_remote_msat,
+            feerate_per_kw=core.feerate_per_kw,
+            opener_is_local=int(core.opener_is_local),
+            anchors=int(core.anchors),
+            reserve_local_msat=core.reserve_local_msat,
+            reserve_remote_msat=core.reserve_remote_msat,
+            next_local_commit=ch.next_local_commit,
+            next_remote_commit=ch.next_remote_commit,
+            next_htlc_id_ours=core.next_htlc_id[True],
+            next_htlc_id_theirs=core.next_htlc_id[False],
+            delay_on_local=ch.delay_on_local,
+            delay_on_remote=ch.delay_on_remote,
+            their_dust_limit=ch.their_dust_limit,
+            their_funding_pub=ch.their_funding_pub,
+            their_basepoints=_pack_basepoints(ch.their_base),
+            their_points=points,
+            their_last_secret=ch.their_last_secret,
+            our_shutdown_script=ch.our_shutdown_script,
+            their_shutdown_script=ch.their_shutdown_script,
+        )
+        with self.db.transaction() as c:
+            if getattr(ch, "wallet_id", None) is None:
+                cols = ", ".join(fields)
+                ph = ", ".join("?" * len(fields))
+                cur = c.execute(
+                    f"INSERT INTO channels ({cols}) VALUES ({ph})",
+                    tuple(fields.values()),
+                )
+                ch.wallet_id = cur.lastrowid
+            else:
+                sets = ", ".join(f"{k}=?" for k in fields)
+                c.execute(
+                    f"UPDATE channels SET {sets} WHERE id=?",
+                    (*fields.values(), ch.wallet_id),
+                )
+            # htlcs + shachain are replaced wholesale inside the SAME
+            # transaction — the commit point makes the snapshot atomic
+            c.execute("DELETE FROM htlcs WHERE channel_ref=?", (ch.wallet_id,))
+            for (by_us, hid), lh in core.htlcs.items():
+                c.execute(
+                    "INSERT INTO htlcs VALUES (?,?,?,?,?,?,?,?,?,?)",
+                    (ch.wallet_id, int(by_us), hid, lh.htlc.amount_msat,
+                     lh.htlc.payment_hash, lh.htlc.cltv_expiry,
+                     lh.state.name, lh.preimage, lh.fail_reason, lh.onion),
+                )
+            c.execute("DELETE FROM shachain_slots WHERE channel_ref=?",
+                      (ch.wallet_id,))
+            for slot, entry in enumerate(ch.their_secrets.known):
+                if entry is not None:
+                    c.execute(
+                        "INSERT INTO shachain_slots VALUES (?,?,?,?)",
+                        (ch.wallet_id, slot, entry[0], entry[1]),
+                    )
+        return ch.wallet_id
+
+    def list_channels(self) -> list[dict]:
+        cur = self.db.conn.execute("SELECT * FROM channels")
+        names = [d[0] for d in cur.description]
+        return [dict(zip(names, row)) for row in cur.fetchall()]
+
+    def load_channel_state(self, wallet_id: int) -> dict:
+        cur = self.db.conn.execute("SELECT * FROM channels WHERE id=?",
+                                   (wallet_id,))
+        row = cur.fetchone()
+        if row is None:
+            raise KeyError(f"no channel {wallet_id}")
+        names = [d[0] for d in cur.description]
+        return dict(zip(names, row))
+
+    def restore_into(self, ch, row: dict) -> None:
+        """Rebuild a Channeld's protocol state from a channels row (the
+        inverse of save_channel; caller provides a fresh Channeld with
+        hsm/client/peer wired)."""
+        ch.wallet_id = row["id"]
+        ch.channel_id = row["channel_id"]
+        ch.funding_txid = row["funding_txid"]
+        ch.funding_outidx = row["funding_outidx"]
+        ch.funding_sat = row["funding_sat"]
+        ch.funder = bool(row["funder"])
+        ch.delay_on_local = row["delay_on_local"]
+        ch.delay_on_remote = row["delay_on_remote"]
+        ch.their_dust_limit = row["their_dust_limit"]
+        ch.their_funding_pub = row["their_funding_pub"]
+        ch.their_base = _unpack_basepoints(row["their_basepoints"])
+        ch.their_points = {
+            int(n): ref.pubkey_parse(bytes.fromhex(h))
+            for n, h in json.loads(row["their_points"]).items()
+        }
+        ch.their_last_secret = row["their_last_secret"]
+        ch.next_local_commit = row["next_local_commit"]
+        ch.next_remote_commit = row["next_remote_commit"]
+        ch.our_shutdown_script = row["our_shutdown_script"]
+        ch.their_shutdown_script = row["their_shutdown_script"]
+        ch.core = ChannelCore(
+            funding_sat=row["funding_sat"],
+            to_local_msat=row["to_local_msat"],
+            to_remote_msat=row["to_remote_msat"],
+            reserve_local_msat=row["reserve_local_msat"],
+            reserve_remote_msat=row["reserve_remote_msat"],
+            feerate_per_kw=row["feerate_per_kw"],
+            opener_is_local=bool(row["opener_is_local"]),
+            anchors=bool(row["anchors"]),
+            state=ChannelState(row["state"]),
+        )
+        ch.core.next_htlc_id = {True: row["next_htlc_id_ours"],
+                                False: row["next_htlc_id_theirs"]}
+        for h in self.db.conn.execute(
+            "SELECT offered_by_us, htlc_id, amount_msat, payment_hash, "
+            "cltv_expiry, hstate, preimage, fail_reason, onion FROM htlcs "
+            "WHERE channel_ref=?", (ch.wallet_id,)
+        ):
+            by_us = bool(h[0])
+            ch.core.htlcs[(by_us, h[1])] = LiveHtlc(
+                Htlc(by_us, h[2], h[3], h[4], id=h[1]),
+                HtlcState[h[5]], preimage=h[6], fail_reason=h[7], onion=h[8],
+            )
+        ch.their_secrets = K.ShachainReceiver()
+        for slot, idx, secret in self.db.conn.execute(
+            "SELECT slot, idx, secret FROM shachain_slots WHERE channel_ref=?",
+            (ch.wallet_id,)
+        ):
+            ch.their_secrets.known[slot] = (idx, secret)
+            m = ch.their_secrets.max_index
+            ch.their_secrets.max_index = idx if m is None else min(m, idx)

@@ -205,3 +205,31 @@ def test_closing_rejects_inflight_htlcs():
             await nb.close()
 
     run(body())
+
+
+def test_responder_keysend_roundtrip():
+    """The daemon-side responder loop end-to-end in-process: accept an
+    inbound channel, fulfill a keysend, negotiate close (covers the path
+    the CLI --accept-channels runs)."""
+    async def body():
+        na = LightningNode(privkey=0xD00D)
+        nb = LightningNode(privkey=0xFEED)
+        port = await na.listen()
+        hsm_a, hsm_b = Hsm(b"\x0c" * 32), Hsm(b"\x0d" * 32)
+
+        async def responder(peer):
+            client = hsm_a.client(CAP_MASTER, peer.node_id, dbid=1)
+            return await CD.channel_responder(peer, hsm_a, client, 0xD00D)
+
+        na.on_peer = responder
+        peer = await nb.connect("127.0.0.1", port, na.node_id)
+        cl_b = hsm_b.client(CAP_MASTER, na.node_id, dbid=1)
+        ch = await CD.open_channel(peer, hsm_b, cl_b, FUNDING_SAT)
+        preimage, tx = await CD.keysend_pay_and_close(
+            ch, 5_000_000, na.node_id)
+        assert ch.core.to_remote_msat == 5_000_000
+        assert tx.inputs[0].txid == ch.funding_txid
+        await na.close()
+        await nb.close()
+
+    run(body())

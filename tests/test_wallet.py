@@ -1,0 +1,171 @@
+"""Persistence tests: write-ahead channel state survives a crash.
+
+Models the reference's checkpoint/resume design (SURVEY §5): the db is
+the only state — kill the node objects mid-HTLC flow, rebuild BOTH sides
+purely from their sqlite files, reconnect, channel_reestablish, and
+complete the payment.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+from lightning_tpu.channel.state import ChannelState, HtlcState
+from lightning_tpu.daemon import channeld as CD
+from lightning_tpu.daemon.hsmd import CAP_MASTER, Hsm
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.wallet.db import Db
+from lightning_tpu.wallet.wallet import Wallet
+
+FUND = 1_000_000
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 300))
+
+
+def test_migrations_idempotent(tmp_path):
+    p = str(tmp_path / "n.sqlite3")
+    db = Db(p)
+    db.set_var("gossip_high_water", b"\x00\x01")
+    db.close()
+    db2 = Db(p)  # re-open runs migrations again: must be a no-op
+    assert db2.get_var("gossip_high_water") == b"\x00\x01"
+    version = db2.conn.execute("SELECT version FROM db_version").fetchone()[0]
+    from lightning_tpu.wallet.db import MIGRATIONS
+
+    assert version == len(MIGRATIONS)
+    db2.close()
+
+
+def test_crash_restart_mid_htlc(tmp_path):
+    """Open a channel (persisted both sides), lock in an HTLC, then
+    'crash': drop every in-memory object and TCP session.  Restart from
+    the sqlite files alone, reestablish, fulfill, and close."""
+
+    async def phase1():
+        na = LightningNode(privkey=0xA11CE)
+        nb = LightningNode(privkey=0xB0B)
+        port = await na.listen()
+        peer_b2a = await nb.connect("127.0.0.1", port, na.node_id)
+        while nb.node_id not in na.peers:
+            await asyncio.sleep(0.01)
+        peer_a2b = na.peers[nb.node_id]
+        hsm_a, hsm_b = Hsm(b"\x0a" * 32), Hsm(b"\x0b" * 32)
+        wa = Wallet(Db(str(tmp_path / "a.sqlite3")))
+        wb = Wallet(Db(str(tmp_path / "b.sqlite3")))
+        cl_a = hsm_a.client(CAP_MASTER, nb.node_id, dbid=1)
+        cl_b = hsm_b.client(CAP_MASTER, na.node_id, dbid=1)
+        ch_a, ch_b = await asyncio.gather(
+            CD.open_channel(peer_a2b, hsm_a, cl_a, FUND, wallet=wa,
+                            hsm_dbid=1),
+            CD.accept_channel(peer_b2a, hsm_b, cl_b, wallet=wb, hsm_dbid=1),
+        )
+        # lock in an HTLC with two full dances, then CRASH before fulfill
+        preimage = b"\x33" * 32
+        h = hashlib.sha256(preimage).digest()
+        hid = await ch_a.offer_htlc(25_000_000, h, 500_000)
+        await ch_b.recv_update()
+        await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        # simulate kill -9: close sockets without any graceful teardown
+        await na.close()
+        await nb.close()
+        wa.db.close()
+        wb.db.close()
+        return hid, preimage
+
+    hid, preimage = run(phase1())
+
+    async def phase2():
+        # restart: everything reconstructed from disk
+        wa = Wallet(Db(str(tmp_path / "a.sqlite3")))
+        wb = Wallet(Db(str(tmp_path / "b.sqlite3")))
+        rows_a, rows_b = wa.list_channels(), wb.list_channels()
+        assert len(rows_a) == 1 and len(rows_b) == 1
+
+        na = LightningNode(privkey=0xA11CE)
+        nb = LightningNode(privkey=0xB0B)
+        port = await na.listen()
+        peer_b2a = await nb.connect("127.0.0.1", port, na.node_id)
+        while nb.node_id not in na.peers:
+            await asyncio.sleep(0.01)
+        hsm_a, hsm_b = Hsm(b"\x0a" * 32), Hsm(b"\x0b" * 32)
+        ch_a = CD.restore_channeld(wa, rows_a[0], na.peers[nb.node_id], hsm_a)
+        ch_b = CD.restore_channeld(wb, rows_b[0], peer_b2a, hsm_b)
+
+        # the HTLC and balances survived
+        assert ch_a.core.state is ChannelState.NORMAL
+        lh_a = ch_a.core.htlcs[(True, hid)]
+        lh_b = ch_b.core.htlcs[(False, hid)]
+        assert lh_a.state is HtlcState.SENT_ADD_ACK_REVOCATION
+        assert lh_b.state is HtlcState.RCVD_ADD_ACK_REVOCATION
+        assert ch_a.next_local_commit == ch_b.next_remote_commit == 2
+        assert ch_a._their_revoked_count() == 1
+
+        # reestablish and complete the payment end-to-end
+        await asyncio.gather(ch_a.reestablish(), ch_b.reestablish())
+        await ch_b.fulfill_htlc(hid, preimage)
+        await ch_a.recv_update()
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+        assert ch_a.core.to_local_msat == FUND * 1000 - 25_000_000
+        assert ch_b.core.to_local_msat == 25_000_000
+
+        # and close cooperatively
+        await asyncio.gather(ch_a.shutdown(), ch_b.shutdown())
+        await asyncio.gather(ch_a.recv_shutdown(), ch_b.recv_shutdown())
+        tx_a, tx_b = await asyncio.gather(
+            ch_a.negotiate_close(), ch_b.negotiate_close()
+        )
+        assert tx_a.txid() == tx_b.txid()
+        await na.close()
+        await nb.close()
+        wa.db.close()
+        wb.db.close()
+
+    run(phase2())
+
+
+def test_revocation_secrets_persisted(tmp_path):
+    """The peer's revealed secrets must survive restart — losing them
+    would forfeit the penalty option (shachains table, migrations.c:76)."""
+
+    async def body():
+        na = LightningNode(privkey=0x111)
+        nb = LightningNode(privkey=0x222)
+        port = await na.listen()
+        peer_b2a = await nb.connect("127.0.0.1", port, na.node_id)
+        while nb.node_id not in na.peers:
+            await asyncio.sleep(0.01)
+        hsm_a, hsm_b = Hsm(b"\x01" * 32), Hsm(b"\x02" * 32)
+        wa = Wallet(Db(str(tmp_path / "a.sqlite3")))
+        cl_a = hsm_a.client(CAP_MASTER, nb.node_id, dbid=1)
+        cl_b = hsm_b.client(CAP_MASTER, na.node_id, dbid=1)
+        ch_a, ch_b = await asyncio.gather(
+            CD.open_channel(na.peers[nb.node_id], hsm_a, cl_a, FUND,
+                            wallet=wa, hsm_dbid=1),
+            CD.accept_channel(peer_b2a, hsm_b, cl_b),
+        )
+        for i in range(3):
+            await ch_a.offer_htlc(1_000_000, hashlib.sha256(bytes([i])).digest(),
+                                  500_000)
+            await ch_b.recv_update()
+            await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+            await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        before = ch_a._their_revoked_count()
+        assert before == 3
+        row = wa.list_channels()[0]
+        ch_a2 = CD.restore_channeld(wa, row, na.peers[nb.node_id], hsm_a)
+        assert ch_a2._their_revoked_count() == before
+        # restored receiver still derives old secrets (penalty capability)
+        import lightning_tpu.btc.keys as K
+
+        idx = K.LARGEST_INDEX  # commitment 0's index
+        assert ch_a2.their_secrets.lookup(idx) == \
+            ch_a.their_secrets.lookup(idx)
+        await na.close()
+        await nb.close()
+        wa.db.close()
+
+    run(body())
