@@ -1,0 +1,68 @@
+"""lightning-cli equivalent: one-shot JSON-RPC over the unix socket.
+
+Usage:
+  python -m lightning_tpu.cli --rpc-file /path/lightning-rpc getinfo
+  python -m lightning_tpu.cli ... getroute id=<hex> amount_msat=1000
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import sys
+
+
+def call(rpc_path: str, method: str, params: dict, timeout: float = 60.0):
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout)
+    s.connect(rpc_path)
+    req = {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+    s.sendall(json.dumps(req).encode())
+    buf = b""
+    decoder = json.JSONDecoder()
+    while True:
+        chunk = s.recv(65536)
+        if not chunk:
+            raise ConnectionError("rpc socket closed without a response")
+        buf += chunk
+        try:
+            resp, _ = decoder.raw_decode(buf.decode("utf8").lstrip())
+            s.close()
+            return resp
+        except json.JSONDecodeError:
+            continue
+
+
+def _coerce(v: str):
+    if v and (v[0] in "{[" or v in ("true", "false", "null")):
+        return json.loads(v)
+    # only short all-digit strings become ints: a 66-char hex pubkey that
+    # happens to be all digits must stay a string
+    if v.isdigit() and len(v) <= 18:
+        return int(v)
+    return v
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(prog="lightning_tpu.cli")
+    p.add_argument("--rpc-file", required=True)
+    p.add_argument("method")
+    p.add_argument("params", nargs="*", metavar="key=value")
+    args = p.parse_args()
+    params = {}
+    for kv in args.params:
+        if "=" not in kv:
+            print(f"bad param {kv!r}: want key=value", file=sys.stderr)
+            return 2
+        k, v = kv.split("=", 1)
+        params[k] = _coerce(v)
+    resp = call(args.rpc_file, args.method, params)
+    if "error" in resp:
+        print(json.dumps(resp["error"], indent=1), file=sys.stderr)
+        return 1
+    print(json.dumps(resp["result"], indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

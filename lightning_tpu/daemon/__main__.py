@@ -69,6 +69,32 @@ async def amain(args) -> int:
         port = await node.listen(args.bind, args.listen)
         print(f"listening {args.bind}:{port}", flush=True)
 
+    gossmap_ref = {"map": None}
+    if args.gossip_store:
+        from ..gossip import gossmap as GM
+        from ..gossip import store as gstore
+
+        gossmap_ref["map"] = GM.from_store(
+            gstore.load_store(args.gossip_store))
+        g = gossmap_ref["map"]
+        print(f"gossmap: {g.n_channels} channels, {g.n_nodes} nodes",
+              flush=True)
+
+    rpc = None
+    stop_event = asyncio.Event()
+    rpc_path = args.rpc_file or (
+        _os.path.join(args.data_dir, "lightning-rpc") if args.data_dir
+        else None
+    )
+    if rpc_path:
+        from . import jsonrpc as RPC
+
+        rpc = RPC.JsonRpcServer(rpc_path)
+        RPC.attach_core_commands(rpc, node, gossmap_ref,
+                                 stop_event=stop_event)
+        await rpc.start()
+        print(f"rpc ready {rpc_path}", flush=True)
+
     if args.accept_channels:
         from . import channeld as CD
 
@@ -112,17 +138,23 @@ async def amain(args) -> int:
                     print(f"closing txid {tx.txid().hex()}", flush=True)
         except Exception as e:
             print(f"connect failed: {type(e).__name__}: {e}", file=sys.stderr)
+            if rpc is not None:
+                await rpc.close()
             await node.close()
             return 1
         if not args.stay:
+            if rpc is not None:
+                await rpc.close()
             await node.close()
             return 0
 
-    # serve until interrupted
+    # serve until interrupted or `stop` RPC
     try:
-        await asyncio.Event().wait()
+        await stop_event.wait()
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
+    if rpc is not None:
+        await rpc.close()
     await node.close()
     return 0
 
@@ -135,6 +167,11 @@ def main() -> int:
     p.add_argument("--privkey", default=None, help="node secret key (hex)")
     p.add_argument("--data-dir", default=None,
                    help="persistent node dir (hsm_secret + sqlite wallet)")
+    p.add_argument("--rpc-file", default=None,
+                   help="unix socket path for JSON-RPC (default: "
+                        "<data-dir>/lightning-rpc)")
+    p.add_argument("--gossip-store", default=None,
+                   help="gossip_store file to build the routing graph from")
     p.add_argument("--connect", default=None, metavar="PUBKEY@HOST:PORT")
     p.add_argument("--ping", action="store_true",
                    help="ping the connected peer once")

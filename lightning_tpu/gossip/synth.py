@@ -65,16 +65,26 @@ def make_network_store(
     node_announcements: bool = True,
     seed: int = 7,
     sign_bucket: int = SIGN_BUCKET,
+    sign: bool = True,
 ):
-    """Generate and write a synthetic, fully-signed gossip store.
-    Returns a dict of counts."""
+    """Generate and write a synthetic gossip store; returns counts.
+
+    sign=False writes zero signatures and derives pubkeys host-side —
+    right for graph/routing tests and topology benches that never verify
+    (no device kernels touched at all)."""
+    from ..crypto import ref_python as ref
+
     rng = np.random.default_rng(seed)
     n_nodes = n_nodes or max(2, n_channels // 8)
     seckeys = _rand_scalars(rng, n_nodes)
-    pubs = S.derive_pubkeys(
-        np.stack([F.int_to_limbs(k) for k in seckeys]).astype(np.uint32)
-    )
-    pub_bytes = [bytes(p) for p in pubs]
+    if sign:
+        pubs = S.derive_pubkeys(
+            np.stack([F.int_to_limbs(k) for k in seckeys]).astype(np.uint32)
+        )
+        pub_bytes = [bytes(p) for p in pubs]
+    else:
+        pub_bytes = [ref.pubkey_serialize(ref.pubkey_create(k))
+                     for k in seckeys]
 
     # channel endpoints; BOLT7: node_id_1 is the lexically lesser key
     a = rng.integers(0, n_nodes, n_channels)
@@ -95,16 +105,18 @@ def make_network_store(
             bitcoin_key_2=pub_bytes[n2[i]],
         )
         ca_msgs.append(bytearray(ca.serialize()))
-    ca_hashes = [_sha256d(bytes(m[wire.CA_SIGNED_OFFSET:])) for m in ca_msgs]
-    sig_jobs_h, sig_jobs_k, patch = [], [], []
-    for i in range(n_channels):
-        for j, signer in enumerate((n1[i], n2[i], n1[i], n2[i])):
-            sig_jobs_h.append(ca_hashes[i])
-            sig_jobs_k.append(seckeys[signer])
-            patch.append((i, wire.CA_SIG_OFFSETS[j]))
-    sigs = _sign_bulk(sig_jobs_h, sig_jobs_k, rng, sign_bucket)
-    for (i, off), sig in zip(patch, sigs):
-        ca_msgs[i][off : off + 64] = bytes(sig)
+    if sign:
+        ca_hashes = [_sha256d(bytes(m[wire.CA_SIGNED_OFFSET:]))
+                     for m in ca_msgs]
+        sig_jobs_h, sig_jobs_k, patch = [], [], []
+        for i in range(n_channels):
+            for j, signer in enumerate((n1[i], n2[i], n1[i], n2[i])):
+                sig_jobs_h.append(ca_hashes[i])
+                sig_jobs_k.append(seckeys[signer])
+                patch.append((i, wire.CA_SIG_OFFSETS[j]))
+        sigs = _sign_bulk(sig_jobs_h, sig_jobs_k, rng, sign_bucket)
+        for (i, off), sig in zip(patch, sigs):
+            ca_msgs[i][off : off + 64] = bytes(sig)
 
     # --- channel_updates
     cu_msgs, cu_hashes, cu_keys = [], [], []
@@ -123,7 +135,7 @@ def make_network_store(
             cu_msgs.append(m)
             cu_hashes.append(_sha256d(bytes(m[wire.CU_SIGNED_OFFSET:])))
             cu_keys.append(seckeys[(n1 if direction == 0 else n2)[i]])
-    if cu_msgs:
+    if cu_msgs and sign:
         sigs = _sign_bulk(cu_hashes, cu_keys, rng, sign_bucket)
         for m, sig in zip(cu_msgs, sigs):
             m[wire.CU_SIG_OFFSET : wire.CU_SIG_OFFSET + 64] = bytes(sig)
@@ -142,9 +154,10 @@ def make_network_store(
             na_msgs.append(m)
             na_hashes.append(_sha256d(bytes(m[wire.NA_SIGNED_OFFSET:])))
             na_keys.append(seckeys[i])
-        sigs = _sign_bulk(na_hashes, na_keys, rng, sign_bucket)
-        for m, sig in zip(na_msgs, sigs):
-            m[wire.NA_SIG_OFFSET : wire.NA_SIG_OFFSET + 64] = bytes(sig)
+        if sign:
+            sigs = _sign_bulk(na_hashes, na_keys, rng, sign_bucket)
+            for m, sig in zip(na_msgs, sigs):
+                m[wire.NA_SIG_OFFSET : wire.NA_SIG_OFFSET + 64] = bytes(sig)
 
     with StoreWriter(path) as w:
         w.append_many([bytes(m) for m in ca_msgs],

@@ -1,0 +1,127 @@
+"""JSON-RPC server tests: raw unix-socket requests against a live node
+(lightningd/jsonrpc.c parity — getinfo/listpeers/connect/getroute etc.).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+
+from lightning_tpu.daemon.jsonrpc import JsonRpcServer, attach_core_commands
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.gossip import gossmap, store as gstore, synth
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 120))
+
+
+async def _rpc_call(path, method, params=None, rid=1):
+    reader, writer = await asyncio.open_unix_connection(path)
+    req = {"jsonrpc": "2.0", "id": rid, "method": method,
+           "params": params or {}}
+    writer.write(json.dumps(req).encode())
+    await writer.drain()
+    buf = b""
+    while b"\n\n" not in buf:
+        chunk = await reader.read(65536)
+        if not chunk:
+            break
+        buf += chunk
+    writer.close()
+    return json.loads(buf.decode().strip())
+
+
+async def _setup(tmp_path, with_gossip=True):
+    node = LightningNode(privkey=0x9999)
+    rpc = JsonRpcServer(str(tmp_path / "lightning-rpc"))
+    ref = {"map": None}
+    if with_gossip:
+        p = str(tmp_path / "g.gs")
+        synth.make_network_store(p, n_channels=50, n_nodes=10, sign=False)
+        ref["map"] = gossmap.from_store(gstore.load_store(p))
+    attach_core_commands(rpc, node, ref)
+    await rpc.start()
+    return node, rpc, ref
+
+
+def test_getinfo_and_graph_queries(tmp_path):
+    async def body():
+        node, rpc, ref = await _setup(tmp_path)
+        path = rpc.rpc_path
+        try:
+            info = (await _rpc_call(path, "getinfo"))["result"]
+            assert info["id"] == node.node_id.hex()
+            assert info["num_known_channels"] == 50
+            nodes = (await _rpc_call(path, "listnodes"))["result"]["nodes"]
+            assert len(nodes) == ref["map"].n_nodes
+            chans = (await _rpc_call(path, "listchannels"))["result"]["channels"]
+            assert len(chans) == 100  # 2 directions
+            r = await _rpc_call(path, "getroute", {
+                "id": nodes[-1]["nodeid"], "fromid": nodes[0]["nodeid"],
+                "amount_msat": 10_000,
+            })
+            hops = r["result"]["route"]
+            assert hops[-1]["amount_msat"] == 10_000
+            assert all("x" in h["channel"] for h in hops)
+        finally:
+            await rpc.close()
+            await node.close()
+
+    run(body())
+
+
+def test_connect_and_listpeers_via_rpc(tmp_path):
+    async def body():
+        node, rpc, _ = await _setup(tmp_path, with_gossip=False)
+        other = LightningNode(privkey=0x8888)
+        port = await other.listen()
+        try:
+            r = await _rpc_call(path := rpc.rpc_path, "connect", {
+                "id": f"{other.node_id.hex()}@127.0.0.1:{port}",
+            })
+            assert r["result"]["id"] == other.node_id.hex()
+            peers = (await _rpc_call(path, "listpeers"))["result"]["peers"]
+            assert len(peers) == 1 and peers[0]["connected"]
+            pong = await _rpc_call(path, "ping", {"id": other.node_id.hex()})
+            assert pong["result"]["totlen"] == 128
+        finally:
+            await rpc.close()
+            await node.close()
+            await other.close()
+
+    run(body())
+
+
+def test_rpc_error_shapes(tmp_path):
+    async def body():
+        node, rpc, _ = await _setup(tmp_path, with_gossip=False)
+        path = rpc.rpc_path
+        try:
+            r = await _rpc_call(path, "nosuchmethod")
+            assert r["error"]["code"] == -32601
+            r = await _rpc_call(path, "getroute", {"id": "ab"})
+            assert r["error"]["code"] == -32602
+            r = await _rpc_call(path, "listchannels")
+            assert r["error"]["code"] == -1  # no gossip loaded
+            # positional params work (lightning-cli style)
+            r = await _rpc_call(path, "getinfo", [])
+            assert r["result"]["id"] == node.node_id.hex()
+            # two concatenated requests on one connection
+            reader, writer = await asyncio.open_unix_connection(path)
+            for rid in (7, 8):
+                writer.write(json.dumps({
+                    "jsonrpc": "2.0", "id": rid, "method": "getinfo",
+                    "params": {},
+                }).encode())
+            await writer.drain()
+            buf = b""
+            while buf.count(b"\n\n") < 2:
+                buf += await reader.read(65536)
+            writer.close()
+            parts = [json.loads(x) for x in buf.split(b"\n\n") if x.strip()]
+            assert [p["id"] for p in parts] == [7, 8]
+        finally:
+            await rpc.close()
+            await node.close()
+
+    run(body())
