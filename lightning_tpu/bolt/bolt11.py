@@ -1,0 +1,425 @@
+"""BOLT#11 invoice encoding/decoding/signing.
+
+Parity target: common/bolt11.c (decode :1003, encode/sign :1299 region)
+and common/bech32.c — rewritten from the BOLT#11 spec text.  Invoices are
+bech32 (no length limit, original non-m variant) over HRP
+``ln{currency}{amount}{multiplier}`` plus a 5-bit data part:
+timestamp(35 bits) | tagged fields | 65-byte recoverable signature.
+
+The signature is ECDSA over sha256(hrp_utf8 || data_part_packed_to_bytes)
+with a recovery id, so the payee node id can be omitted from the invoice
+and recovered at decode time (common/bolt11.c uses
+secp256k1_ecdsa_recoverable; here `recover_pubkey`).
+"""
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+
+from ..crypto import ref_python as ref
+
+CHARSET = "qpzry9x8gf2tvdw0s3jn54khce6mua7l"
+_REV = {c: i for i, c in enumerate(CHARSET)}
+
+# currency prefixes (chainparams.c: bip173_name per network)
+CURRENCIES = ("lnbcrt", "lntbs", "lntb", "lnbc", "lnsb")
+# msat per unit for each multiplier: amounts are `number × multiplier`
+# BTC, 1 BTC = 10^11 msat; `p` (pico) is 0.1 msat so the digit string must
+# end in 0 (BOLT#11: "the last decimal MUST be 0")
+MULTIPLIERS = {"m": 10 ** 8, "u": 10 ** 5, "n": 10 ** 2}
+DEFAULT_EXPIRY = 3600
+DEFAULT_MIN_FINAL_CLTV = 18
+
+
+class Bolt11Error(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# bech32 (BIP173 charset/checksum; BOLT#11 drops the 90-char length cap)
+
+def _polymod(values):
+    gen = (0x3B6A57B2, 0x26508E6D, 0x1EA119FA, 0x3D4233DD, 0x2A1462B3)
+    chk = 1
+    for v in values:
+        top = chk >> 25
+        chk = (chk & 0x1FFFFFF) << 5 ^ v
+        for i in range(5):
+            chk ^= gen[i] if ((top >> i) & 1) else 0
+    return chk
+
+
+def _hrp_expand(hrp: str):
+    return [ord(x) >> 5 for x in hrp] + [0] + [ord(x) & 31 for x in hrp]
+
+
+def bech32_encode(hrp: str, data: list[int]) -> str:
+    values = _hrp_expand(hrp) + data
+    polymod = _polymod(values + [0, 0, 0, 0, 0, 0]) ^ 1
+    checksum = [(polymod >> 5 * (5 - i)) & 31 for i in range(6)]
+    return hrp + "1" + "".join(CHARSET[d] for d in data + checksum)
+
+
+def bech32_decode(s: str) -> tuple[str, list[int]]:
+    if s.lower() != s and s.upper() != s:
+        raise Bolt11Error("mixed case")
+    s = s.lower()
+    pos = s.rfind("1")
+    if pos < 1 or pos + 7 > len(s):
+        raise Bolt11Error("bad separator position")
+    hrp, rest = s[:pos], s[pos + 1:]
+    try:
+        data = [_REV[c] for c in rest]
+    except KeyError as e:
+        raise Bolt11Error(f"invalid character {e.args[0]!r}")
+    if _polymod(_hrp_expand(hrp) + data) != 1:
+        raise Bolt11Error("bad checksum")
+    return hrp, data[:-6]
+
+
+def _to5(data: bytes, pad: bool = True) -> list[int]:
+    out, acc, bits = [], 0, 0
+    for b in data:
+        acc = (acc << 8) | b
+        bits += 8
+        while bits >= 5:
+            bits -= 5
+            out.append((acc >> bits) & 31)
+    if pad and bits:
+        out.append((acc << (5 - bits)) & 31)
+    return out
+
+
+def _to8(data: list[int]) -> bytes:
+    acc, bits, out = 0, 0, bytearray()
+    for v in data:
+        acc = (acc << 5) | v
+        bits += 5
+        while bits >= 8:
+            bits -= 8
+            out.append((acc >> bits) & 0xFF)
+    # leftover bits must be zero padding
+    if bits and (acc & ((1 << bits) - 1)):
+        raise Bolt11Error("non-zero bech32 padding")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# recoverable ECDSA (common/bolt11.c sign_invoice / pubkey recovery)
+
+def sign_recoverable(msg_hash: bytes, seckey: int) -> tuple[bytes, int]:
+    """Returns (64-byte compact sig, recovery id 0-3)."""
+    r, s = ref.ecdsa_sign(msg_hash, seckey, grind_low_r=False)
+    pub = ref.pubkey_create(seckey)
+    sig = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    for recid in range(4):
+        try:
+            if recover_pubkey(msg_hash, sig, recid) == ref.pubkey_serialize(pub):
+                return sig, recid
+        except Bolt11Error:
+            continue
+    raise Bolt11Error("could not determine recovery id")
+
+
+def recover_pubkey(msg_hash: bytes, sig64: bytes, recid: int) -> bytes:
+    """SEC1 4.1.6 public-key recovery; returns compressed pubkey."""
+    r = int.from_bytes(sig64[:32], "big")
+    s = int.from_bytes(sig64[32:], "big")
+    if not (1 <= r < ref.N and 1 <= s < ref.N and 0 <= recid <= 3):
+        raise Bolt11Error("bad signature")
+    x = r + (ref.N if recid & 2 else 0)
+    if x >= ref.P:
+        raise Bolt11Error("r+n overflows field")
+    ysq = (pow(x, 3, ref.P) + ref.B) % ref.P
+    y = pow(ysq, (ref.P + 1) // 4, ref.P)
+    if (y * y) % ref.P != ysq:
+        raise Bolt11Error("point not on curve")
+    if (y & 1) != (recid & 1):
+        y = ref.P - y
+    R = ref.Point(x, y)
+    z = int.from_bytes(msg_hash, "big") % ref.N
+    rinv = pow(r, -1, ref.N)
+    # Q = r^-1 (s*R - z*G)
+    q = ref.point_add(ref.point_mul((s * rinv) % ref.N, R),
+                      ref.point_mul((-z * rinv) % ref.N, ref.G))
+    if q.inf:
+        raise Bolt11Error("recovered infinity")
+    return ref.pubkey_serialize(q)
+
+
+# ---------------------------------------------------------------------------
+# invoice model
+
+@dataclass
+class RouteHint:
+    pubkey: bytes          # 33
+    scid: int
+    fee_base_msat: int
+    fee_ppm: int
+    cltv_delta: int
+
+
+@dataclass
+class Invoice:
+    currency: str = "bcrt"
+    amount_msat: int | None = None
+    timestamp: int = 0
+    payment_hash: bytes = b""
+    payment_secret: bytes | None = None
+    description: str | None = None
+    description_hash: bytes | None = None
+    payee: bytes | None = None           # compressed pubkey (recovered)
+    expiry: int = DEFAULT_EXPIRY
+    min_final_cltv: int = DEFAULT_MIN_FINAL_CLTV
+    features: bytes = b""
+    route_hints: list[list[RouteHint]] = field(default_factory=list)
+    signature: bytes = b""               # 64-byte compact
+    metadata: bytes | None = None
+
+    @property
+    def expires_at(self) -> int:
+        return self.timestamp + self.expiry
+
+
+_PREFIX_FOR = {"bc": "lnbc", "tb": "lntb", "bcrt": "lnbcrt", "sb": "lnsb",
+               "tbs": "lntbs"}
+
+
+def _encode_amount(msat: int) -> str:
+    # pick the largest multiplier that represents msat exactly
+    if msat % (10 ** 11) == 0:
+        return str(msat // (10 ** 11))
+    for letter in "mun":
+        scale = MULTIPLIERS[letter]
+        if msat % scale == 0:
+            return f"{msat // scale}{letter}"
+    return f"{msat * 10}p"
+
+
+def _decode_amount(s: str) -> int | None:
+    if not s:
+        return None
+    if s[-1] == "p":
+        num = s[:-1]
+        _check_digits(num, s)
+        if int(num) % 10:
+            raise Bolt11Error("pico amount must end in 0 (sub-msat)")
+        return int(num) // 10
+    if s[-1] in MULTIPLIERS:
+        num, scale = s[:-1], MULTIPLIERS[s[-1]]
+    else:
+        num, scale = s, 10 ** 11
+    _check_digits(num, s)
+    return int(num) * scale
+
+
+def _check_digits(num: str, s: str) -> None:
+    if not num.isdigit() or (len(num) > 1 and num[0] == "0"):
+        raise Bolt11Error(f"bad amount {s!r}")
+
+
+def _tagged(tag: str, data5: list[int]) -> list[int]:
+    if len(data5) > 1023:
+        raise Bolt11Error(f"field {tag} too long")
+    return [_REV[tag], len(data5) >> 5, len(data5) & 31] + data5
+
+
+def _int_to5(x: int, n: int | None = None) -> list[int]:
+    out = []
+    while x:
+        out.append(x & 31)
+        x >>= 5
+    out.reverse()
+    if n is not None:
+        out = [0] * (n - len(out)) + out
+    return out or ([0] * (n or 1))
+
+
+def _sig_msg(hrp: str, data: list[int]) -> bytes:
+    """The signed message: hrp utf8 bytes + data part (sans signature)
+    packed 5→8 with zero bits padding the final partial byte."""
+    acc, bits, out = 0, 0, bytearray()
+    for v in data:
+        acc = (acc << 5) | v
+        bits += 5
+        while bits >= 8:
+            bits -= 8
+            out.append((acc >> bits) & 0xFF)
+    if bits:
+        out.append((acc << (8 - bits)) & 0xFF)
+    return hrp.encode("utf8") + bytes(out)
+
+
+def _5_to_int(data5: list[int]) -> int:
+    x = 0
+    for v in data5:
+        x = (x << 5) | v
+    return x
+
+
+def encode(inv: Invoice, seckey: int) -> str:
+    """Serialize + sign an invoice with the node key."""
+    prefix = _PREFIX_FOR.get(inv.currency)
+    if prefix is None:
+        raise Bolt11Error(f"unknown currency {inv.currency!r}")
+    hrp = prefix + ("" if inv.amount_msat is None
+                    else _encode_amount(inv.amount_msat))
+    data: list[int] = _int_to5(inv.timestamp, 7)
+    if len(data) > 7:
+        raise Bolt11Error("timestamp overflow")
+    if len(inv.payment_hash) != 32:
+        raise Bolt11Error("payment_hash must be 32 bytes")
+    data += _tagged("p", _to5(inv.payment_hash))
+    if inv.payment_secret is not None:
+        data += _tagged("s", _to5(inv.payment_secret))
+    if inv.description is not None:
+        data += _tagged("d", _to5(inv.description.encode("utf8")))
+    elif inv.description_hash is not None:
+        data += _tagged("h", _to5(inv.description_hash))
+    else:
+        raise Bolt11Error("need description or description_hash")
+    if inv.metadata is not None:
+        data += _tagged("m", _to5(inv.metadata))
+    if inv.payee is not None:
+        data += _tagged("n", _to5(inv.payee))
+    if inv.expiry != DEFAULT_EXPIRY:
+        data += _tagged("x", _int_to5(inv.expiry))
+    if inv.min_final_cltv != DEFAULT_MIN_FINAL_CLTV:
+        data += _tagged("c", _int_to5(inv.min_final_cltv))
+    for hint in inv.route_hints:
+        raw = b"".join(
+            h.pubkey + h.scid.to_bytes(8, "big")
+            + h.fee_base_msat.to_bytes(4, "big") + h.fee_ppm.to_bytes(4, "big")
+            + h.cltv_delta.to_bytes(2, "big")
+            for h in hint)
+        data += _tagged("r", _to5(raw))
+    if inv.features:
+        feats = int.from_bytes(inv.features, "big")
+        data += _tagged("9", _int_to5(feats) if feats else [0])
+    sig, recid = sign_recoverable(
+        hashlib.sha256(_sig_msg(hrp, data)).digest(), seckey)
+    inv.signature = sig
+    data += _to5(sig + bytes([recid]))
+    return bech32_encode(hrp, data)
+
+
+def decode(invstring: str, check_sig: bool = True) -> Invoice:
+    invstring = invstring.strip()
+    hrp, data = bech32_decode(invstring)
+    prefix = next((p for p in CURRENCIES if hrp.startswith(p)), None)
+    if prefix is None:
+        raise Bolt11Error(f"bad prefix {hrp!r}")
+    currency = prefix[2:]
+    amount = _decode_amount(hrp[len(prefix):])
+    if len(data) < 7 + 104:
+        raise Bolt11Error("too short")
+    sig5 = data[-104:]
+    data = data[:-104]
+    sigbytes = _to8(sig5)
+    sig64, recid = sigbytes[:64], sigbytes[64]
+    inv = Invoice(currency=currency, amount_msat=amount,
+                  timestamp=_5_to_int(data[:7]), signature=sig64)
+    i = 7
+    while i < len(data):
+        if i + 3 > len(data):
+            raise Bolt11Error("truncated tagged field")
+        tag = CHARSET[data[i]]
+        ln = (data[i + 1] << 5) | data[i + 2]
+        body = data[i + 3: i + 3 + ln]
+        if len(body) != ln:
+            raise Bolt11Error(f"truncated field {tag!r}")
+        i += 3 + ln
+        try:
+            _parse_field(inv, tag, body)
+        except Bolt11Error:
+            raise
+        except Exception:
+            pass  # unknown/odd fields are ignored per spec
+    if not inv.payment_hash:
+        raise Bolt11Error("missing payment_hash")
+    h = hashlib.sha256(_sig_msg(hrp, data)).digest()
+    recovered = recover_pubkey(h, sig64, recid)
+    if inv.payee is not None:
+        if check_sig and recovered != inv.payee:
+            # spec: if n field present, must validate sig against it
+            r = int.from_bytes(sig64[:32], "big")
+            s = int.from_bytes(sig64[32:], "big")
+            if not ref.ecdsa_verify(h, r, s, ref.pubkey_parse(inv.payee)):
+                raise Bolt11Error("signature does not match payee")
+    else:
+        inv.payee = recovered
+    return inv
+
+
+def _parse_field(inv: Invoice, tag: str, body: list[int]) -> None:
+    if tag == "p":
+        if len(body) != 52:
+            return  # skip malformed-length p per spec
+        inv.payment_hash = _field_bytes(body, 32)
+    elif tag == "s":
+        if len(body) == 52:
+            inv.payment_secret = _field_bytes(body, 32)
+    elif tag == "d":
+        inv.description = _to8(body).decode("utf8")
+    elif tag == "h":
+        if len(body) == 52:
+            inv.description_hash = _field_bytes(body, 32)
+    elif tag == "n":
+        if len(body) == 53:
+            inv.payee = _field_bytes(body, 33)
+    elif tag == "x":
+        inv.expiry = _5_to_int(body)
+    elif tag == "c":
+        inv.min_final_cltv = _5_to_int(body)
+    elif tag == "m":
+        inv.metadata = _to8(body)
+    elif tag == "9":
+        bits = _5_to_int(body)
+        inv.features = bits.to_bytes((bits.bit_length() + 7) // 8 or 1, "big")
+    elif tag == "r":
+        raw = _to8(body)
+        hops = []
+        while len(raw) >= 51:
+            hops.append(RouteHint(
+                pubkey=raw[:33],
+                scid=int.from_bytes(raw[33:41], "big"),
+                fee_base_msat=int.from_bytes(raw[41:45], "big"),
+                fee_ppm=int.from_bytes(raw[45:49], "big"),
+                cltv_delta=int.from_bytes(raw[49:51], "big"),
+            ))
+            raw = raw[51:]
+        if hops:
+            inv.route_hints.append(hops)
+
+
+def _field_bytes(body: list[int], n: int) -> bytes:
+    """Exact-size field: 5-bit data whose last partial bits are padding."""
+    acc, bits, out = 0, 0, bytearray()
+    for v in body:
+        acc = (acc << 5) | v
+        bits += 5
+        while bits >= 8 and len(out) < n:
+            bits -= 8
+            out.append((acc >> bits) & 0xFF)
+    if len(out) != n:
+        raise Bolt11Error("short field")
+    return bytes(out)
+
+
+def new_invoice(seckey: int, payment_hash: bytes, amount_msat: int | None,
+                description: str, currency: str = "bcrt",
+                payment_secret: bytes | None = None,
+                expiry: int = DEFAULT_EXPIRY,
+                min_final_cltv: int = DEFAULT_MIN_FINAL_CLTV,
+                features: bytes = b"\x02\x02\x41\x00",
+                timestamp: int | None = None) -> tuple[str, Invoice]:
+    """Convenience: build + sign, returning (bolt11 string, Invoice)."""
+    inv = Invoice(
+        currency=currency, amount_msat=amount_msat,
+        timestamp=int(time.time()) if timestamp is None else timestamp,
+        payment_hash=payment_hash, payment_secret=payment_secret,
+        description=description, expiry=expiry,
+        min_final_cltv=min_final_cltv, features=features,
+    )
+    return encode(inv, seckey), inv

@@ -133,13 +133,12 @@ def pubkey_create(seckey: int) -> Point:
 def ecdsa_verify(msg_hash: bytes, r: int, s: int, pubkey: Point) -> bool:
     """Verify an ECDSA signature over a 32-byte hash.
 
-    Like libsecp256k1's secp256k1_ecdsa_verify as called from the
-    reference's check_signed_hash (bitcoin/signature.c:174): the (r,s) is
-    already normalized (we reject s > n/2 like the low-S rule upstream
-    enforces at parse time is NOT done here; reference parses compact sigs
-    without low-S enforcement on verify, so neither do we).
+    Matches libsecp256k1's secp256k1_ecdsa_verify as called from the
+    reference's check_signed_hash (bitcoin/signature.c:174): upstream
+    returns 0 for non-normalized (high-S) signatures, so s > n/2 is
+    rejected here too.
     """
-    if not (0 < r < N and 0 < s < N):
+    if not (0 < r < N and 0 < s <= N // 2):
         return False
     if pubkey.inf or not is_on_curve(pubkey):
         return False

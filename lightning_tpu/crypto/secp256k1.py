@@ -292,7 +292,9 @@ def ecdsa_verify_kernel(z, r, s, qx, q_parity):
     Returns bool (B,).  Fully branchless; invalid encodings yield False.
     """
     r_ok = F.lt_const(r, N_INT) & _nonzero(r)
-    s_ok = F.lt_const(s, N_INT) & _nonzero(s)
+    # libsecp256k1's secp256k1_ecdsa_verify (bitcoin/signature.c:174 path)
+    # rejects high-S outright: accept only s ≤ (n-1)/2
+    s_ok = F.lt_const(s, (N_INT + 1) // 2) & _nonzero(s)
     q_ok = F.lt_const(qx, P_INT)
     qy, on_curve = decompress(qx, q_parity)
 

@@ -16,6 +16,8 @@ import logging
 import os
 import time
 
+from ..gossip.gossmap import scid_str
+
 log = logging.getLogger("lightning_tpu.jsonrpc")
 
 # JSON-RPC error codes (common/jsonrpc_errors.h)
@@ -87,6 +89,14 @@ class JsonRpcServer:
                     try:
                         req, end = decoder.raw_decode(buf)
                     except json.JSONDecodeError:
+                        # a token that can never become valid JSON gets an
+                        # immediate PARSE_ERROR (jsonrpc.c parse loop
+                        # behavior) instead of stalling the client
+                        if buf[0] not in "{[\"-0123456789tfn":
+                            writer.write(_err_bytes(None, PARSE_ERROR,
+                                                    "invalid JSON"))
+                            await writer.drain()
+                            return
                         if len(buf) > 4 * 1024 * 1024:
                             writer.write(_err_bytes(None, PARSE_ERROR,
                                                     "request too large"))
@@ -142,6 +152,13 @@ def _err_bytes(rid, code: int, message: str) -> bytes:
     return json.dumps(_err(rid, code, message)).encode() + b"\n\n"
 
 
+def _hex(s: str, what: str = "pubkey") -> bytes:
+    try:
+        return bytes.fromhex(s)
+    except ValueError:
+        raise RpcError(INVALID_PARAMS, f"{what} must be hex, got {s!r}")
+
+
 # ---------------------------------------------------------------------------
 # The core command set (doc/schemas shapes)
 
@@ -183,17 +200,18 @@ def attach_core_commands(rpc: JsonRpcServer, node, gossmap_ref: dict,
     async def connect(id: str) -> dict:
         try:
             target, hostport = id.split("@")
-            host, port = hostport.rsplit(":", 1)
+            host, port_s = hostport.rsplit(":", 1)
+            port = int(port_s)
         except ValueError:
             raise RpcError(INVALID_PARAMS, "id must be pubkey@host:port")
-        peer = await node.connect(host, int(port), bytes.fromhex(target))
+        peer = await node.connect(host, port, _hex(target))
         return {"id": peer.node_id.hex(),
                 "features": peer.remote_features.hex(),
                 "direction": "out"}
 
     async def ping(id: str, len: int = 128) -> dict:  # noqa: A002
         # parameter is named `len` to match doc/schemas/lightning-ping
-        peer = node.peers.get(bytes.fromhex(id))
+        peer = node.peers.get(_hex(id))
         if peer is None:
             raise RpcError(RPC_ERROR, f"peer {id} not connected")
         n = await peer.ping(num_pong_bytes=len)
@@ -216,7 +234,7 @@ def attach_core_commands(rpc: JsonRpcServer, node, gossmap_ref: dict,
         from ..routing import dijkstra as DJ
 
         g = _need_map()
-        src = bytes.fromhex(fromid) if fromid else node.node_id
+        src = _hex(fromid, "fromid") if fromid else node.node_id
         if fromid is None:
             try:
                 g.node_index(src)
@@ -227,14 +245,14 @@ def attach_core_commands(rpc: JsonRpcServer, node, gossmap_ref: dict,
                     "pass fromid to route between known nodes",
                 )
         try:
-            hops = DJ.getroute(g, src, bytes.fromhex(id), amount_msat,
+            hops = DJ.getroute(g, src, _hex(id), amount_msat,
                                final_cltv=cltv, riskfactor=riskfactor)
         except (DJ.NoRoute, KeyError) as e:
             raise RpcError(ROUTE_NOT_FOUND, e.args[0] if e.args else str(e))
         return {"route": [
             {
                 "id": h.node_id.hex(),
-                "channel": _scid_str(h.scid),
+                "channel": scid_str(h.scid),
                 "direction": h.direction,
                 "amount_msat": h.amount_msat,
                 "delay": h.delay,
@@ -267,9 +285,3 @@ def attach_core_commands(rpc: JsonRpcServer, node, gossmap_ref: dict,
         ("loadgossip", loadgossip), ("stop", stop),
     ]:
         rpc.register(name, fn)
-
-
-def _scid_str(scid: int) -> str:
-    from ..gossip.gossmap import scid_str
-
-    return scid_str(scid)

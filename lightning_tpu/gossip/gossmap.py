@@ -197,7 +197,7 @@ def from_store(idx: StoreIndex, default_capacity_sat: int = 0) -> Gossmap:
         mflags, cflags = fl[:, 0], fl[:, 1]
         direction = (cflags & 1).astype(np.int8)
         disabled = (cflags & 2) != 0
-        body = native.gather_fields(cu.buf, offu, wire.CU_FLAGS_OFFSET + 2, 26)
+        body = native.gather_fields(cu.buf, offu, wire.CU_FLAGS_OFFSET + 2, 18)
 
         def be(a, o, w):
             v = np.zeros(len(a), np.uint64)
@@ -209,7 +209,17 @@ def from_store(idx: StoreIndex, default_capacity_sat: int = 0) -> Gossmap:
         u_hmin = be(body, 2, 8)
         u_base = be(body, 10, 4)
         u_ppm = be(body, 14, 4)
-        u_hmax = be(body, 18, 8)
+        # htlc_maximum_msat is optional (message_flags bit 0 + length);
+        # gathering it unconditionally would read past short legacy
+        # records (gather_fields is an unchecked memcpy)
+        u_hmax = np.zeros(m, np.uint64)
+        has_max = ((mflags & 1) != 0) & (
+            cu.lengths >= wire.CU_FLAGS_OFFSET + 2 + 26)
+        li = np.nonzero(has_max)[0]
+        if len(li):
+            maxb = native.gather_fields(
+                cu.buf, offu[li], wire.CU_FLAGS_OFFSET + 2 + 18, 8)
+            u_hmax[li] = be(maxb, 0, 8)
 
         pos = np.searchsorted(scids, u_scid)
         pos_c = np.clip(pos, 0, max(0, n - 1))

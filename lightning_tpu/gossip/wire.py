@@ -210,6 +210,11 @@ CU_SCID_OFFSET = 98
 CU_FLAGS_OFFSET = 110  # message_flags, channel_flags
 
 
+def msg_type(msg: bytes) -> int:
+    (t,) = struct.unpack_from(">H", msg, 0)
+    return t
+
+
 def parse_gossip(msg: bytes):
     (t,) = struct.unpack_from(">H", msg, 0)
     if t == MSG_CHANNEL_ANNOUNCEMENT:

@@ -98,10 +98,13 @@ def getroute(g: Gossmap, source: bytes, destination: bytes,
             fee = hop_fee_msat(int(g.fee_base_msat[d, c]),
                                int(g.fee_ppm[d, c]), amt_v)
             amt_u = amt_v + fee
-            if amt_u < int(g.htlc_min_msat[d, c]):
+            # the HTLC carried over u→v is amt_v (what v receives) —
+            # channel_update limits apply to it, not to amt_u
+            # (common/route.c amount semantics)
+            if amt_v < int(g.htlc_min_msat[d, c]):
                 continue
             hmax = int(g.htlc_max_msat[d, c])
-            if hmax and amt_u > hmax:
+            if hmax and amt_v > hmax:
                 continue
             cd = int(g.cltv_delta[d, c])
             cost = dist[v] + fee + _risk_msat(amt_v, cd, riskfactor)

@@ -1,0 +1,246 @@
+"""External validation of the crypto stack against independent oracles.
+
+Round-1 verdict: everything was validated only against our own Python
+oracle (`crypto/ref_python.py`) — a shared misunderstanding would pass
+both sides.  This suite pins the kernels against:
+
+1. OpenSSL (via the `cryptography` package), a fully independent
+   secp256k1 ECDSA implementation: cross-sign/cross-verify in both
+   directions, public-key derivation, and ECDH x-coordinates.
+2. The canonical public RFC6979 secp256k1 deterministic-nonce vectors
+   (the "Satoshi Nakamoto"/"Alan Turing" set reproduced across bitcoin
+   libraries), pinning nonce derivation + sign exactly.
+3. BIP340 reference test vectors (index 0-1 of the spec CSV) for
+   Schnorr verification, plus a must-reject case.
+
+Reference parity: bitcoin/signature.c:174 `check_signed_hash` /
+:97 `sign_hash` are thin wrappers over libsecp256k1, which these same
+public vectors pin upstream.
+"""
+import hashlib
+
+import numpy as np
+import pytest
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+from lightning_tpu.crypto import ref_python as ref
+from lightning_tpu.crypto import secp256k1 as S
+
+RNG = np.random.default_rng(1234)
+CURVE = ec.SECP256K1()
+SHA256 = hashes.SHA256()
+
+
+def rand_seckey() -> int:
+    return int.from_bytes(RNG.bytes(32), "big") % ref.N or 1
+
+
+def openssl_priv(seckey: int) -> ec.EllipticCurvePrivateKey:
+    return ec.derive_private_key(seckey, CURVE)
+
+
+def openssl_pub(pt: ref.Point) -> ec.EllipticCurvePublicKey:
+    return ec.EllipticCurvePublicNumbers(pt.x, pt.y, CURVE).public_key()
+
+
+def low_s(r: int, s: int) -> tuple[int, int]:
+    return (r, ref.N - s) if s > ref.N // 2 else (r, s)
+
+
+class TestOpenSSLCross:
+    B = 32
+
+    def _keys_msgs(self):
+        seckeys = [rand_seckey() for _ in range(self.B)]
+        msgs = [RNG.bytes(32) for _ in range(self.B)]
+        return seckeys, msgs
+
+    def test_pubkey_derivation_matches_openssl(self):
+        from lightning_tpu.crypto import field as F
+
+        seckeys = [rand_seckey() for _ in range(self.B)]
+        ours = S.derive_pubkeys(
+            np.stack([F.int_to_limbs(k) for k in seckeys]).astype(np.uint32))
+        for i, k in enumerate(seckeys):
+            nums = openssl_priv(k).public_key().public_numbers()
+            assert bytes(ours[i])[1:] == nums.x.to_bytes(32, "big")
+            assert bytes(ours[i])[0] == 2 + (nums.y & 1)
+
+    def test_our_signatures_verify_under_openssl(self):
+        seckeys, msgs = self._keys_msgs()
+        hashes32 = np.array(
+            [np.frombuffer(m, np.uint8) for m in msgs])
+        sigs = S.ecdsa_sign_batch(hashes32, seckeys)
+        for i, k in enumerate(seckeys):
+            r = int.from_bytes(bytes(sigs[i, :32]), "big")
+            s = int.from_bytes(bytes(sigs[i, 32:]), "big")
+            pub = openssl_priv(k).public_key()
+            # raises InvalidSignature on failure
+            pub.verify(encode_dss_signature(r, s), msgs[i],
+                       ec.ECDSA(Prehashed(SHA256)))
+
+    def test_openssl_signatures_verify_under_kernel(self):
+        seckeys, msgs = self._keys_msgs()
+        sigs64 = np.zeros((self.B, 64), np.uint8)
+        pubs33 = np.zeros((self.B, 33), np.uint8)
+        for i, k in enumerate(seckeys):
+            priv = openssl_priv(k)
+            der = priv.sign(msgs[i], ec.ECDSA(Prehashed(SHA256)))
+            # libsecp256k1 (bitcoin/signature.c) rejects high-S; normalize
+            r, s = low_s(*decode_dss_signature(der))
+            sigs64[i, :32] = np.frombuffer(r.to_bytes(32, "big"), np.uint8)
+            sigs64[i, 32:] = np.frombuffer(s.to_bytes(32, "big"), np.uint8)
+            nums = priv.public_key().public_numbers()
+            pubs33[i, 0] = 2 + (nums.y & 1)
+            pubs33[i, 1:] = np.frombuffer(nums.x.to_bytes(32, "big"), np.uint8)
+        hashes32 = np.array([np.frombuffer(m, np.uint8) for m in msgs])
+        ok = S.ecdsa_verify_batch(hashes32, sigs64, pubs33)
+        assert ok.all()
+        # flip one byte of each message: all must reject
+        bad = hashes32.copy()
+        bad[:, 0] ^= 0xFF
+        assert not S.ecdsa_verify_batch(bad, sigs64, pubs33).any()
+
+    def test_high_s_rejected_like_libsecp256k1(self):
+        seckeys, msgs = self._keys_msgs()
+        k = seckeys[0]
+        der = openssl_priv(k).sign(msgs[0], ec.ECDSA(Prehashed(SHA256)))
+        r, s = decode_dss_signature(der)
+        hi = (r, ref.N - s) if s <= ref.N // 2 else (r, s)
+        lo = low_s(r, s)
+        pub = ref.pubkey_create(k)
+        pub33 = np.frombuffer(ref.pubkey_serialize(pub), np.uint8)
+        h = np.frombuffer(msgs[0], np.uint8)
+
+        def check(rs):
+            sig = np.concatenate([
+                np.frombuffer(rs[0].to_bytes(32, "big"), np.uint8),
+                np.frombuffer(rs[1].to_bytes(32, "big"), np.uint8)])
+            return bool(S.ecdsa_verify_batch(
+                h[None], sig[None], pub33[None])[0])
+
+        assert check(lo)
+        assert not check(hi)
+
+    def test_ecdh_matches_openssl(self):
+        for _ in range(8):
+            a, b = rand_seckey(), rand_seckey()
+            pub_b = ref.pubkey_create(b)
+            shared = openssl_priv(a).exchange(ec.ECDH(), openssl_pub(pub_b))
+            ours = ref.point_mul(a, pub_b)
+            assert shared == ours.x.to_bytes(32, "big")
+            # sphinx-style ECDH = sha256(compressed shared point)
+            from lightning_tpu.bolt.sphinx import ecdh
+            expect = hashlib.sha256(
+                (b"\x02" if ours.y % 2 == 0 else b"\x03")
+                + shared).digest()
+            assert ecdh(a, pub_b) == expect
+
+
+# The canonical public RFC6979/secp256k1 vectors (reproduced in
+# python-ecdsa, haskoin, pybitcointools, trezor-crypto test suites).
+# Fields: seckey, message (sha256-hashed), k, compact sig (r||s, low-S,
+# NOT low-R-ground).
+RFC6979_VECTORS = [
+    (0x1, b"Satoshi Nakamoto",
+     0x8F8A276C19F4149656B280621E358CCE24F5F52542772691EE69063B74F15D15,
+     "934b1ea10a4b3c1757e2b0c017d0b6143ce3c9a7e6a4a49860d7a6ab210ee3d8"
+     "2442ce9d2b916064108014783e923ec36b49743e2ffa1c4496f01a512aafd9e5"),
+    (0x1, b"All those moments will be lost in time, like tears in rain. "
+          b"Time to die...",
+     0x38AA22D72376B4DBC472E06C3BA403EE0A394DA63FC58D88686C611ABA98D6B3,
+     "8600dbd41e348fe5c9465ab92d23e3db8b98b873beecd930736488696438cb6b"
+     "547fe64427496db33bf66019dacbf0039c04199abb0122918601db38a72cfc21"),
+    (ref.N - 1, b"Satoshi Nakamoto",
+     0x33A19B60E25FB6F4435AF53A3D42D493644827367E6453928554F43E49AA6F90,
+     "fd567d121db66e382991534ada77a6bd3106f0a1098c231e47993447cd6af2d0"
+     "6b39cd0eb1bc8603e159ef5c20a5c8ad685a45b06ce9bebed3f153d10d93bed5"),
+    (0xf8b8af8ce3c7cca5e300d33939540c10d45ce001b8f252bfbc57ba0342904181,
+     b"Alan Turing",
+     0x525A82B70E67874398067543FD84C83D30C175FDC45FDEEE082FE13B1D7CFDF1,
+     "7063ae83e7f62bbb171798131b4a0564b956930092b33b07b395615d9ec7e15c"
+     "58dfcc1e00a35e1572f366ffe34ba0fc47db1e7189759b9fb233c5b05ab388ea"),
+    (0xe91671c46231f833a6406ccbea0e3e392c76c167bac1cb013f6f1013980455c2,
+     b"There is a computer disease that anybody who works with computers "
+     b"knows about. It's a very serious disease and it interferes "
+     b"completely with the work. The trouble with computers is that you "
+     b"'play' with them!",
+     0x1F4B84C23A86A221D233F2521BE018D9318639D5B8BBD6374A8A59232D16AD3D,
+     "b552edd27580141f3b2a5463048cb7cd3e047b97c9f98076c32dbdf85a68718b"
+     "279fa72dd19bfae05577e06c7c0c1900c371fcd5893f7e1d56a37d30174671f6"),
+]
+
+
+class TestRFC6979Vectors:
+    @pytest.mark.parametrize("seckey,msg,k,sig_hex", RFC6979_VECTORS)
+    def test_nonce(self, seckey, msg, k, sig_hex):
+        h = hashlib.sha256(msg).digest()
+        assert ref.rfc6979_nonce(h, seckey) == k
+
+    @pytest.mark.parametrize("seckey,msg,k,sig_hex", RFC6979_VECTORS)
+    def test_sign(self, seckey, msg, k, sig_hex):
+        h = hashlib.sha256(msg).digest()
+        r, s = ref.ecdsa_sign(h, seckey, grind_low_r=False)
+        assert f"{r:064x}{s:064x}" == sig_hex
+
+    @pytest.mark.parametrize("seckey,msg,k,sig_hex", RFC6979_VECTORS)
+    def test_kernel_verifies_vector_sigs(self, seckey, msg, k, sig_hex):
+        h = np.frombuffer(hashlib.sha256(msg).digest(), np.uint8)
+        sig = np.frombuffer(bytes.fromhex(sig_hex), np.uint8)
+        pub = np.frombuffer(
+            ref.pubkey_serialize(ref.pubkey_create(seckey)), np.uint8)
+        assert S.ecdsa_verify_batch(h[None], sig[None], pub[None])[0]
+
+
+# BIP340 reference vectors (test-vectors.csv of the BIP, index 0 and 1)
+# plus one must-fail mutation.
+BIP340_VECTORS = [
+    # (seckey or None, pubkey_x, msg, sig, should_verify)
+    (3,
+     "F9308A019258C31049344F85F89D5229B531C845836F99B08601F113BCE036F9",
+     "00" * 32,
+     "E907831F80848D1069A5371B402410364BDF1C5F8307B0084C55F1CE2DCA8215"
+     "25F66A4A85EA8B71E482A74F382D2CE5EBEEE8FDB2172F477DF4900D310536C0",
+     True),
+    (0xB7E151628AED2A6ABF7158809CF4F3C762E7160F38B4DA56A784D9045190CFEF,
+     "DFF1D77F2A671C5F36183726DB2341BE58FEAE1DA2DECED843240F7B502BA659",
+     "243F6A8885A308D313198A2E03707344A4093822299F31D0082EFA98EC4E6C89",
+     "6896BD60EEAE296DB48A229FF71DFE071BDE413E6D43F917DC8DCF8C78DE3341"
+     "8906D11AC976ABCCB20B091292BFF4EA897EFCB639EA871CFA95F6DE339E4B0A",
+     True),
+]
+
+
+class TestBIP340Vectors:
+    @pytest.mark.parametrize("seckey,px,msg,sig,ok", BIP340_VECTORS)
+    def test_vector(self, seckey, px, msg, sig, ok):
+        msgs = np.frombuffer(bytes.fromhex(msg), np.uint8)[None]
+        sigs = np.frombuffer(bytes.fromhex(sig), np.uint8)[None]
+        pubs = np.frombuffer(bytes.fromhex(px), np.uint8)[None]
+        assert bool(S.schnorr_verify_batch(msgs, sigs, pubs)[0]) == ok
+        # sanity: the x-only pubkey matches the stated secret key
+        if seckey is not None:
+            pt = ref.pubkey_create(seckey)
+            x = pt.x if pt.y % 2 == 0 else pt.x
+            assert f"{x:064X}" == px
+
+    def test_mutated_sig_rejected(self):
+        _, px, msg, sig, _ = BIP340_VECTORS[0]
+        bad = bytearray(bytes.fromhex(sig))
+        bad[63] ^= 1
+        msgs = np.frombuffer(bytes.fromhex(msg), np.uint8)[None]
+        sigs = np.frombuffer(bytes(bad), np.uint8)[None]
+        pubs = np.frombuffer(bytes.fromhex(px), np.uint8)[None]
+        assert not S.schnorr_verify_batch(msgs, sigs, pubs)[0]
+
+    def test_own_schnorr_sign_matches_bip340(self):
+        # ref_python BIP340 signer must reproduce vector 0 exactly
+        sig = ref.schnorr_sign(bytes(32), 3, aux=bytes(32))
+        assert sig.hex().upper() == BIP340_VECTORS[0][3]

@@ -1,0 +1,215 @@
+"""Live gossip ingest: dedup, pending, ratelimit, batched verify, store.
+
+Parity: gossipd/gossmap_manage.c pending/dedup semantics driven through
+the batched-kernel flush path (SURVEY §3.4 / §7.3).
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from lightning_tpu.crypto import ref_python as ref
+from lightning_tpu.gossip import gossmap as GM
+from lightning_tpu.gossip import ingest as gi
+from lightning_tpu.gossip import store as gstore
+from lightning_tpu.gossip import wire
+
+K1, K2, K3 = 11111, 22222, 33333
+
+
+def pub(k: int) -> bytes:
+    return ref.pubkey_serialize(ref.pubkey_create(k))
+
+
+def _ordered(ka, kb):
+    return (ka, kb) if pub(ka) < pub(kb) else (kb, ka)
+
+
+def make_ca(ka: int, kb: int, scid: int) -> bytes:
+    ka, kb = _ordered(ka, kb)
+    ca = wire.ChannelAnnouncement(
+        short_channel_id=scid,
+        node_id_1=pub(ka), node_id_2=pub(kb),
+        bitcoin_key_1=pub(ka), bitcoin_key_2=pub(kb))
+    m = bytearray(ca.serialize())
+    h = ref.sha256d(bytes(m[wire.CA_SIGNED_OFFSET:]))
+    for off, k in zip(wire.CA_SIG_OFFSETS, (ka, kb, ka, kb)):
+        r, s = ref.ecdsa_sign(h, k)
+        m[off:off + 64] = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    return bytes(m)
+
+
+def make_cu(ka: int, kb: int, scid: int, direction: int, ts: int,
+            signer: int | None = None, fee_base: int = 1000) -> bytes:
+    ka, kb = _ordered(ka, kb)
+    cu = wire.ChannelUpdate(
+        short_channel_id=scid, timestamp=ts, channel_flags=direction,
+        htlc_maximum_msat=10 ** 9, fee_base_msat=fee_base,
+        fee_proportional_millionths=10)
+    m = bytearray(cu.serialize())
+    h = ref.sha256d(bytes(m[wire.CU_SIGNED_OFFSET:]))
+    k = signer if signer is not None else (ka if direction == 0 else kb)
+    r, s = ref.ecdsa_sign(h, k)
+    m[wire.CU_SIG_OFFSET:wire.CU_SIG_OFFSET + 64] = (
+        r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+    return bytes(m)
+
+
+def make_na(k: int, ts: int) -> bytes:
+    na = wire.NodeAnnouncement(
+        timestamp=ts, node_id=pub(k), alias=b"ingest-test".ljust(32, b"\0"))
+    m = bytearray(na.serialize())
+    h = ref.sha256d(bytes(m[wire.NA_SIGNED_OFFSET:]))
+    r, s = ref.ecdsa_sign(h, k)
+    m[wire.NA_SIG_OFFSET:wire.NA_SIG_OFFSET + 64] = (
+        r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+    return bytes(m)
+
+
+SCID = (600000 << 40) | (1 << 16) | 0
+SCID2 = (600000 << 40) | (2 << 16) | 0
+
+
+def run_ingest(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def store_path(tmp_path):
+    return str(tmp_path / "ingest.gs")
+
+
+def test_basic_accept_and_graph(store_path):
+    async def main():
+        ing = gi.GossipIngest(store_path, flush_size=64, flush_ms=1.0,
+                              bucket=64)
+        streamed = []
+        ing.on_accept = lambda raw, src: streamed.append(raw)
+        ing.start()
+        await ing.submit(make_ca(K1, K2, SCID), source="peerA")
+        await ing.submit(make_cu(K1, K2, SCID, 0, ts=100))
+        await ing.submit(make_cu(K1, K2, SCID, 1, ts=100))
+        await ing.submit(make_na(K1, ts=100))
+        await ing.drain()
+        await ing.close()
+        assert ing.stats.accepted == 4, ing.stats
+        assert len(streamed) == 4
+        return ing
+
+    ing = run_ingest(main())
+    idx = gstore.load_store(store_path)
+    assert len(idx) == 4
+    g = GM.from_store(idx)
+    assert g.n_channels == 1 and g.n_nodes == 2
+
+
+def test_bad_sig_and_wrong_signer_dropped(store_path):
+    async def main():
+        ing = gi.GossipIngest(store_path, flush_ms=1.0, bucket=64)
+        ing.start()
+        await ing.submit(make_ca(K1, K2, SCID))
+        # valid-looking update signed by the WRONG node for direction 0
+        ka, kb = _ordered(K1, K2)
+        await ing.submit(make_cu(K1, K2, SCID, 0, ts=50, signer=kb))
+        # outright corrupt signature
+        bad = bytearray(make_cu(K1, K2, SCID, 1, ts=50))
+        bad[wire.CU_SIG_OFFSET] ^= 0xFF
+        await ing.submit(bytes(bad))
+        await ing.drain()
+        await ing.close()
+        assert ing.stats.accepted == 1
+        assert ing.stats.dropped.get(gi.R_BADSIG) == 2, ing.stats
+
+    run_ingest(main())
+
+
+def test_dedup_and_stale(store_path):
+    async def main():
+        ing = gi.GossipIngest(store_path, flush_ms=1.0, bucket=64)
+        ing.start()
+        ca = make_ca(K1, K2, SCID)
+        await ing.submit(ca)
+        await ing.drain()
+        await ing.submit(ca)  # duplicate after accept
+        await ing.submit(make_cu(K1, K2, SCID, 0, ts=100))
+        await ing.drain()
+        await ing.submit(make_cu(K1, K2, SCID, 0, ts=90))   # stale
+        await ing.submit(make_cu(K1, K2, SCID, 0, ts=100))  # equal = stale
+        await ing.drain()
+        await ing.close()
+        assert ing.stats.accepted == 2
+        assert ing.stats.dropped.get(gi.R_DUP) == 1
+        assert ing.stats.dropped.get(gi.R_STALE) == 2
+
+    run_ingest(main())
+
+
+def test_update_before_announcement_held_then_applied(store_path):
+    async def main():
+        ing = gi.GossipIngest(store_path, flush_ms=1.0, bucket=64)
+        ing.start()
+        await ing.submit(make_cu(K1, K2, SCID, 0, ts=10))
+        await ing.submit(make_na(K3, ts=10))   # node with no channel
+        await ing.drain()
+        assert ing.stats.accepted == 0
+        assert SCID in ing.pending_updates
+        await ing.submit(make_ca(K1, K2, SCID))
+        await ing.drain()
+        await ing.close()
+        # CA + resubmitted CU accepted; NA for K3 still pending
+        assert ing.stats.accepted == 2, ing.stats
+        assert not ing.pending_updates
+        assert pub(K3) in ing.pending_nodes
+
+    run_ingest(main())
+
+
+def test_ratelimit(store_path):
+    async def main():
+        ing = gi.GossipIngest(store_path, flush_ms=1.0, bucket=64)
+        ing.start()
+        await ing.submit(make_ca(K1, K2, SCID))
+        await ing.drain()
+        for i in range(gi.RATELIMIT_BURST + 3):
+            await ing.submit(make_cu(K1, K2, SCID, 0, ts=100 + i))
+            await asyncio.sleep(0.05)
+        await ing.close()
+        assert ing.stats.dropped.get(gi.R_RATELIMIT, 0) == 3, ing.stats
+
+    run_ingest(main())
+
+
+def test_utxo_check_gate(store_path):
+    async def main():
+        async def utxo_check(scid):
+            return 10_000 if scid == SCID else None
+
+        ing = gi.GossipIngest(store_path, flush_ms=1.0, bucket=64,
+                              utxo_check=utxo_check)
+        ing.start()
+        await ing.submit(make_ca(K1, K2, SCID))
+        await ing.submit(make_ca(K1, K3, SCID2))  # fails utxo check
+        await ing.drain()
+        await ing.close()
+        assert ing.stats.accepted == 1
+        assert ing.stats.dropped.get(gi.R_NO_UTXO) == 1
+
+    run_ingest(main())
+
+
+def test_batching_observable(store_path):
+    async def main():
+        ing = gi.GossipIngest(store_path, flush_size=4096, flush_ms=50.0,
+                              bucket=64)
+        ing.start()
+        # queue many before the deadline: they must flush as ONE batch
+        for i in range(8):
+            await ing.submit(make_ca(K1 + i * 2, K2 + i * 2,
+                                     SCID + (i << 16)))
+        await ing.drain()
+        await ing.close()
+        assert ing.stats.accepted == 8
+        assert ing.stats.flushes == 1
+        assert ing.stats.max_batch == 32  # 8 CAs x 4 sigs
+
+    run_ingest(main())

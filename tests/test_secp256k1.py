@@ -75,6 +75,40 @@ class TestPointOps:
         assert jac_to_affine_int(S.point_add(P, inf)) == [(p1.x, p1.y)]
         assert bool(np.asarray(S.point_is_inf(S.point_add(inf, inf)))[0])
 
+    def test_mixed_add(self):
+        """Mixed-representative addition: one operand at Z != 1 (e.g. the
+        running accumulator mid-ladder), the other affine (Z == 1, as the
+        precomputed window entries are).  Also Z1 != 1 both sides, and the
+        P == Q doubling collision with non-trivial Z."""
+        k1, k2 = rand_scalar(), rand_scalar()
+        p1, p2 = ref.point_mul(k1, ref.G), ref.point_mul(k2, ref.G)
+        lam = 0x1234567894545
+        lam_l = limbs([lam])
+
+        def scaled(p):
+            return (F.mul(F.FP, limbs([p.x]), lam_l),
+                    F.mul(F.FP, limbs([p.y]), lam_l),
+                    F.mul(F.FP, F.one((1,)), lam_l))
+
+        affine = lambda p: (limbs([p.x]), limbs([p.y]), F.one((1,)))
+        exp = ref.point_add(p1, p2)
+        # Z!=1 + Z=1 (both orders)
+        assert jac_to_affine_int(S.point_add(scaled(p1), affine(p2))) == \
+            [(exp.x, exp.y)]
+        assert jac_to_affine_int(S.point_add(affine(p1), scaled(p2))) == \
+            [(exp.x, exp.y)]
+        # Z!=1 + Z!=1 with different lambdas
+        lam2_l = limbs([0xC0FFEE])
+        Q2 = (F.mul(F.FP, limbs([p2.x]), lam2_l),
+              F.mul(F.FP, limbs([p2.y]), lam2_l),
+              F.mul(F.FP, F.one((1,)), lam2_l))
+        assert jac_to_affine_int(S.point_add(scaled(p1), Q2)) == \
+            [(exp.x, exp.y)]
+        # P == Q collision through different representatives → doubling
+        dbl = ref.point_double(p1)
+        assert jac_to_affine_int(S.point_add(scaled(p1), affine(p1))) == \
+            [(dbl.x, dbl.y)]
+
     def test_projective_scaling_invariance(self):
         """Complete formulas must accept any projective representative:
         (λX : λY : λZ) gives the same affine result."""
