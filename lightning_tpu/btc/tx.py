@@ -121,7 +121,12 @@ class Tx:
 
     @classmethod
     def parse(cls, raw: bytes) -> "Tx":
-        off = 0
+        return cls.parse_from(raw, 0)[0]
+
+    @classmethod
+    def parse_from(cls, raw: bytes, off: int) -> tuple["Tx", int]:
+        """Parse one tx starting at `off`; returns (tx, next_offset) —
+        used for block bodies (chain/backend.py)."""
         (version,) = struct.unpack_from("<i", raw, off)
         off += 4
         has_wit = raw[off] == 0 and raw[off + 1] == 1
@@ -158,7 +163,8 @@ class Tx:
                     off += ilen
                 i.witness = items
         (locktime,) = struct.unpack_from("<I", raw, off)
-        return cls(version, inputs, outputs, locktime)
+        off += 4
+        return cls(version, inputs, outputs, locktime), off
 
     # -- BIP143 (segwit v0) sighash --------------------------------------
 

@@ -1,0 +1,224 @@
+"""Chain backend interface + in-memory regtest implementation.
+
+Parity target: lightningd/bitcoind.c:19's required plugin methods —
+`getchaininfo, getrawblockbyheight, estimatefees, sendrawtransaction,
+getutxout` — the complete surface lightningd needs from a chain
+provider (default provider: plugins/bcli.c shelling out to
+bitcoin-cli).  Here the same five calls are an async interface; the
+production backend speaks to a bitcoind, the `FakeBitcoind` below is
+the regtest-in-a-box used by tests (pyln-testing's BitcoinD/
+BitcoinRpcProxy role, utils.py:481 / btcproxy.py:25).
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import struct
+from dataclasses import dataclass, field
+
+from ..btc.tx import Tx, sha256d
+
+
+@dataclass
+class ChainInfo:
+    chain: str
+    headercount: int
+    blockcount: int
+    ibd: bool = False
+
+
+@dataclass
+class FeeEstimates:
+    """sat/kVB estimates by blocks-to-confirm (bcli estimatefees shape)."""
+    floor: int = 1000
+    estimates: dict[int, int] = field(default_factory=dict)
+
+    def feerate(self, blocks: int, default: int = 5000) -> int:
+        best = default
+        for b in sorted(self.estimates):
+            if b <= blocks:
+                best = self.estimates[b]
+        return max(best, self.floor)
+
+
+class ChainBackend:
+    """The five required methods (lightningd/bitcoind.c:19)."""
+
+    async def getchaininfo(self) -> ChainInfo:
+        raise NotImplementedError
+
+    async def getrawblockbyheight(self, height: int) \
+            -> tuple[bytes, bytes] | None:
+        """Returns (blockhash, raw block bytes) or None past the tip."""
+        raise NotImplementedError
+
+    async def estimatefees(self) -> FeeEstimates:
+        raise NotImplementedError
+
+    async def sendrawtransaction(self, rawtx: bytes) -> tuple[bool, str]:
+        raise NotImplementedError
+
+    async def getutxout(self, txid: bytes, vout: int) \
+            -> tuple[int, bytes] | None:
+        """(amount_sat, scriptpubkey) if unspent, else None."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Minimal block format: 80-byte header || varint count || txs.  Real
+# header rules (PoW) don't matter off-chain; hashes chain properly so
+# reorg logic is exercised for real.
+
+def _header(prev_hash: bytes, merkle: bytes, nonce: int = 0) -> bytes:
+    return struct.pack("<I", 2) + prev_hash + merkle + \
+        struct.pack("<III", 0, 0x207FFFFF, nonce)
+
+
+def block_hash(header80: bytes) -> bytes:
+    return sha256d(header80)
+
+
+@dataclass
+class Block:
+    header: bytes
+    txs: list[Tx]
+
+    @property
+    def hash(self) -> bytes:
+        return block_hash(self.header)
+
+    def serialize(self) -> bytes:
+        from ..btc.tx import write_varint
+
+        out = bytearray(self.header)
+        out += write_varint(len(self.txs))
+        for tx in self.txs:
+            out += tx.serialize()
+        return bytes(out)
+
+    @classmethod
+    def parse(cls, raw: bytes) -> "Block":
+        from ..btc.tx import read_varint
+
+        header, off = raw[:80], 80
+        n, off = read_varint(raw, off)
+        txs = []
+        for _ in range(n):
+            tx, off = Tx.parse_from(raw, off)
+            txs.append(tx)
+        return cls(bytes(header), txs)
+
+
+class FakeBitcoind(ChainBackend):
+    """Deterministic in-memory regtest chain.
+
+    Supports generate (N empty or mempool-draining blocks), direct tx
+    confirmation, reorgs (invalidate + regenerate), per-method failure
+    injection (BitcoinRpcProxy's mock_rpc role), and UTXO tracking for
+    getutxout.
+    """
+
+    def __init__(self, chain: str = "regtest"):
+        self.chain = chain
+        genesis = _header(b"\x00" * 32, b"\x00" * 32)
+        self.blocks: list[Block] = [Block(genesis, [])]
+        self.mempool: dict[bytes, Tx] = {}
+        self.utxos: dict[tuple[bytes, int], tuple[int, bytes]] = {}
+        self.spent: set[tuple[bytes, int]] = set()
+        self.fees = FeeEstimates(floor=253,
+                                 estimates={2: 7500, 6: 5000, 12: 3000,
+                                            100: 1000})
+        self.fail_method: dict[str, Exception] = {}
+        self._new_block_evt = asyncio.Event()
+
+    # -- test controls ----------------------------------------------------
+
+    def fund_utxo(self, txid: bytes, vout: int, amount_sat: int,
+                  scriptpubkey: bytes) -> None:
+        self.utxos[(txid, vout)] = (amount_sat, scriptpubkey)
+
+    def generate(self, n: int = 1, with_mempool: bool = True) -> None:
+        for _ in range(n):
+            txs = list(self.mempool.values()) if with_mempool else []
+            if with_mempool:
+                self.mempool.clear()
+            merkle = sha256d(b"".join(t.txid() for t in txs)) if txs \
+                else b"\x00" * 32
+            hdr = _header(self.blocks[-1].hash, merkle,
+                          nonce=len(self.blocks))
+            self.blocks.append(Block(hdr, txs))
+            for tx in txs:
+                self._apply_tx(tx)
+        self._new_block_evt.set()
+        self._new_block_evt = asyncio.Event()
+
+    def reorg(self, depth: int, new_blocks: int | None = None) -> None:
+        """Drop `depth` tip blocks; their txs fall back into the mempool;
+        then mine a LONGER replacement chain (chaintopology only switches
+        when the replacement is higher)."""
+        dropped = self.blocks[-depth:]
+        del self.blocks[-depth:]
+        for blk in dropped:
+            for tx in blk.txs:
+                self._unapply_tx(tx)
+                self.mempool[tx.txid()] = tx
+        self.generate(new_blocks if new_blocks is not None else depth + 1,
+                      with_mempool=False)
+
+    def _apply_tx(self, tx: Tx) -> None:
+        txid = tx.txid()
+        for vin in tx.inputs:
+            key = (vin.txid, vin.vout)
+            self.utxos.pop(key, None)
+            self.spent.add(key)
+        for i, out in enumerate(tx.outputs):
+            self.utxos[(txid, i)] = (out.amount_sat, out.script_pubkey)
+
+    def _unapply_tx(self, tx: Tx) -> None:
+        txid = tx.txid()
+        for i in range(len(tx.outputs)):
+            self.utxos.pop((txid, i), None)
+
+    def _maybe_fail(self, method: str) -> None:
+        exc = self.fail_method.get(method)
+        if exc is not None:
+            raise exc
+
+    # -- ChainBackend -----------------------------------------------------
+
+    async def getchaininfo(self) -> ChainInfo:
+        self._maybe_fail("getchaininfo")
+        h = len(self.blocks) - 1
+        return ChainInfo(self.chain, h, h)
+
+    async def getrawblockbyheight(self, height: int):
+        self._maybe_fail("getrawblockbyheight")
+        if height < 0 or height >= len(self.blocks):
+            return None
+        blk = self.blocks[height]
+        return blk.hash, blk.serialize()
+
+    async def estimatefees(self) -> FeeEstimates:
+        self._maybe_fail("estimatefees")
+        return self.fees
+
+    async def sendrawtransaction(self, rawtx: bytes) -> tuple[bool, str]:
+        self._maybe_fail("sendrawtransaction")
+        try:
+            tx = Tx.parse(rawtx)
+        except Exception as e:
+            return False, f"decode failed: {e}"
+        for vin in tx.inputs:
+            key = (vin.txid, vin.vout)
+            if key in self.spent:
+                return False, "bad-txns-inputs-missingorspent"
+        self.mempool[tx.txid()] = tx
+        return True, ""
+
+    async def getutxout(self, txid: bytes, vout: int):
+        self._maybe_fail("getutxout")
+        return self.utxos.get((txid, vout))
+
+    async def wait_new_block(self, timeout: float | None = None) -> None:
+        evt = self._new_block_evt
+        await asyncio.wait_for(evt.wait(), timeout)

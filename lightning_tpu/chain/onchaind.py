@@ -1,0 +1,250 @@
+"""Unilateral-close resolution: classify funding spends, claim outputs.
+
+Parity target: onchaind/onchaind.c:3389 (output classification + claim
+tx construction) and lightningd/onchain_control.c (arming from the
+funding-outpoint watch).  Signing goes through the Hsm's onchain entry
+points, the analogue of hsmd_wire.csv:289-327's
+sign_penalty_to_us / sign_any_delayed_payment_to_us family.
+
+Spend classes (onchaind.c's commitment classification):
+  MUTUAL   — a negotiated closing tx (known txid)
+  OURS     — our latest commitment: claim to_local after CSV delay
+  THEIRS   — their latest commitment: claim to_remote (+ HTLCs)
+  REVOKED  — an OLD commitment of theirs: penalty-sweep everything
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from enum import Enum
+
+from ..btc import script as SC
+from ..btc import tx as T
+from ..btc.keys import Basepoints, per_commitment_point
+from ..channel.commitment import CommitmentKeys, obscured_commitment_number
+from ..crypto import ref_python as ref
+
+log = logging.getLogger("lightning_tpu.onchaind")
+
+# claim tx weights (approximate, used for fee deduction)
+SWEEP_WEIGHT = 600
+
+
+class SpendClass(Enum):
+    MUTUAL = "mutual_close"
+    OURS = "our_unilateral"
+    THEIRS = "their_unilateral"
+    REVOKED = "revoked_counterparty"
+    UNKNOWN = "unknown_spend"
+
+
+@dataclass
+class ChannelOnchainState:
+    """Everything onchaind needs, snapshot at arming time (the reference
+    serializes the equivalent across the onchaind wire at spawn)."""
+
+    funding_txid: bytes
+    funding_output_index: int
+    our_basepoints: Basepoints
+    their_basepoints: Basepoints
+    opener_payment_basepoint: bytes
+    accepter_payment_basepoint: bytes
+    to_self_delay: int            # delay THEY must wait on our commitment
+    their_to_self_delay: int      # delay WE must wait... (their commitment)
+    our_commitment_number: int
+    their_commitment_number: int
+    our_commitment_txid: bytes | None
+    mutual_close_txids: set[bytes] = field(default_factory=set)
+    # their revealed per-commitment secrets by commitment number
+    their_secrets: dict[int, int] = field(default_factory=dict)
+    # preimages we know (payment_hash -> preimage)
+    preimages: dict[bytes, bytes] = field(default_factory=dict)
+    anchors: bool = True
+    dust_limit_sat: int = 546
+
+
+def recover_commitment_number(tx: T.Tx, opener_bp: bytes,
+                              accepter_bp: bytes) -> int | None:
+    """BOLT#3: locktime/sequence hide the obscured commitment number."""
+    if not tx.inputs:
+        return None
+    lock, seq = tx.locktime, tx.inputs[0].sequence
+    if (lock >> 24) != 0x20 or (seq >> 24) != 0x80:
+        return None
+    obscured = ((seq & 0xFFFFFF) << 24) | (lock & 0xFFFFFF)
+    return obscured ^ (obscured_commitment_number(0, opener_bp, accepter_bp))
+
+
+def classify_spend(tx: T.Tx, st: ChannelOnchainState) \
+        -> tuple[SpendClass, int | None]:
+    txid = tx.txid()
+    if txid in st.mutual_close_txids:
+        return SpendClass.MUTUAL, None
+    if st.our_commitment_txid is not None and txid == st.our_commitment_txid:
+        return SpendClass.OURS, st.our_commitment_number
+    n = recover_commitment_number(tx, st.opener_payment_basepoint,
+                                  st.accepter_payment_basepoint)
+    if n is None:
+        return SpendClass.UNKNOWN, None
+    if n < st.their_commitment_number and n in st.their_secrets:
+        return SpendClass.REVOKED, n
+    return SpendClass.THEIRS, n
+
+
+# ---------------------------------------------------------------------------
+# sweep construction (unsigned tx + witness plan)
+
+def _sweep_tx(prev_txid: bytes, vout: int, amount_sat: int,
+              dest_spk: bytes, feerate_per_kw: int,
+              sequence: int = 0xFFFFFFFD, locktime: int = 0) -> T.Tx:
+    fee = max(feerate_per_kw * SWEEP_WEIGHT // 1000, 110)
+    out_amt = amount_sat - fee
+    if out_amt <= 294:
+        raise ValueError(f"output {amount_sat} sat not worth sweeping")
+    return T.Tx(version=2,
+                inputs=[T.TxInput(prev_txid, vout, sequence=sequence)],
+                outputs=[T.TxOutput(out_amt, dest_spk)],
+                locktime=locktime)
+
+
+@dataclass
+class Claim:
+    """One claimable output + how to spend it."""
+    kind: str                 # to_local/to_remote/penalty/htlc_success/...
+    tx: T.Tx
+    witness_script: bytes
+    amount_sat: int
+    # witness stack shape: [sig] + extra + [script]; sig filled by sign()
+    extra: list[bytes] = field(default_factory=list)
+    signer: str = ""          # hsm method name
+    signer_arg: object = None
+
+    def sighash(self) -> bytes:
+        return self.tx.sighash_segwit(0, self.witness_script,
+                                      self.amount_sat)
+
+    def finalize(self, sig64: bytes) -> T.Tx:
+        der = T.sig_to_der(int.from_bytes(sig64[:32], "big"),
+                           int.from_bytes(sig64[32:], "big"))
+        self.tx.inputs[0].witness = [der] + self.extra + \
+            [self.witness_script]
+        return self.tx
+
+
+def plan_claims(spend_class: SpendClass, commitment_tx: T.Tx, n: int,
+                st: ChannelOnchainState, dest_spk: bytes,
+                feerate_per_kw: int, our_pcp: ref.Point | None = None) \
+        -> list[Claim]:
+    """Walk the commitment outputs and plan every claim we can make.
+    Mirrors onchaind.c's output classification loop."""
+    claims: list[Claim] = []
+    ctxid = commitment_tx.txid()
+
+    if spend_class == SpendClass.OURS:
+        # our commitment: keys derived at OUR per-commitment point
+        keys = CommitmentKeys.derive(st.our_basepoints, st.their_basepoints,
+                                     our_pcp)
+        tl_script = SC.to_local_script(keys.revocation_pubkey,
+                                       st.to_self_delay,
+                                       keys.local_delayedpubkey)
+        tl_spk = SC.p2wsh(tl_script)
+        for i, out in enumerate(commitment_tx.outputs):
+            if out.script_pubkey == tl_spk:
+                claims.append(Claim(
+                    "to_local_delayed",
+                    _sweep_tx(ctxid, i, out.amount_sat, dest_spk,
+                              feerate_per_kw, sequence=st.to_self_delay),
+                    tl_script, out.amount_sat, extra=[b""],
+                    signer="sign_delayed_payment_to_us", signer_arg=our_pcp))
+        return claims
+
+    if spend_class in (SpendClass.THEIRS, SpendClass.REVOKED):
+        secret = st.their_secrets.get(n)
+        # their per-commitment point is recoverable only from a revealed
+        # secret (REVOKED case); for their CURRENT commitment we can
+        # still claim the static to_remote, which needs no point
+        their_pcp = per_commitment_point(
+            secret.to_bytes(32, "big")) if secret is not None else None
+        our_payment_pub = ref.pubkey_serialize(st.our_basepoints.payment)
+        tr_script = SC.to_remote_anchor_script(our_payment_pub)
+        tr_spk = SC.p2wsh(tr_script) if st.anchors else \
+            SC.p2wpkh(our_payment_pub)
+        for i, out in enumerate(commitment_tx.outputs):
+            if out.script_pubkey == tr_spk and st.anchors:
+                claims.append(Claim(
+                    "to_remote",
+                    _sweep_tx(ctxid, i, out.amount_sat, dest_spk,
+                              feerate_per_kw, sequence=1),
+                    tr_script, out.amount_sat,
+                    signer="sign_to_remote_to_us"))
+        if spend_class == SpendClass.REVOKED and their_pcp is not None:
+            # penalty: their to_local is OURS via the revocation key
+            keys = CommitmentKeys.derive(st.their_basepoints,
+                                         st.our_basepoints, their_pcp)
+            tl_script = SC.to_local_script(keys.revocation_pubkey,
+                                           st.their_to_self_delay,
+                                           keys.local_delayedpubkey)
+            tl_spk = SC.p2wsh(tl_script)
+            for i, out in enumerate(commitment_tx.outputs):
+                if out.script_pubkey == tl_spk:
+                    claims.append(Claim(
+                        "penalty_to_local",
+                        _sweep_tx(ctxid, i, out.amount_sat, dest_spk,
+                                  feerate_per_kw),
+                        tl_script, out.amount_sat, extra=[b"\x01"],
+                        signer="sign_penalty_to_us", signer_arg=secret))
+        return claims
+
+    return claims
+
+
+class Onchaind:
+    """Per-channel resolution engine, armed on the funding outpoint."""
+
+    def __init__(self, state: ChannelOnchainState, hsm, hsm_client,
+                 topology, backend, dest_spk: bytes,
+                 our_pcp: ref.Point | None = None):
+        self.st = state
+        self.hsm = hsm
+        self.client = hsm_client
+        self.topo = topology
+        self.backend = backend
+        self.dest_spk = dest_spk
+        self.our_pcp = our_pcp
+        self.events: list[tuple[str, object]] = []
+        self.claims: list[Claim] = []
+        self.resolved = False
+
+    def arm(self) -> None:
+        self.topo.watch_outpoint(self.st.funding_txid,
+                                 self.st.funding_output_index,
+                                 self._on_funding_spent)
+
+    async def _on_funding_spent(self, tx: T.Tx, height: int) -> None:
+        kind, n = classify_spend(tx, self.st)
+        self.events.append(("spend_classified", kind))
+        log.info("funding %s spent at %d: %s (n=%s)",
+                 self.st.funding_txid.hex()[:16], height, kind.value, n)
+        if kind == SpendClass.MUTUAL:
+            self.resolved = True
+            return
+        feerate = self.topo.feerate(6)
+        self.claims = plan_claims(kind, tx, n if n is not None else 0,
+                                  self.st, self.dest_spk, feerate,
+                                  self.our_pcp)
+        for c in self.claims:
+            sig = getattr(self.hsm, c.signer)(
+                self.client, c.sighash(), *(
+                    [c.signer_arg] if c.signer_arg is not None else []))
+            claim_tx = c.finalize(sig)
+            ok, err = await self.backend.sendrawtransaction(
+                claim_tx.serialize())
+            self.events.append(("claim_broadcast", (c.kind, ok, err)))
+            if ok:
+                self.topo.watch_txid(
+                    claim_tx.txid(),
+                    lambda t, h, d, k=c.kind: self._claim_confirmed(k, d))
+
+    def _claim_confirmed(self, kind: str, depth: int) -> None:
+        if depth >= 1:
+            self.events.append(("claim_confirmed", kind))

@@ -1,0 +1,198 @@
+"""Chain topology: tip tracking, reorg handling, tx/outpoint watches.
+
+Parity target: lightningd/chaintopology.c (`get_new_block` :1095 poll →
+`add_tip` / `remove_tip` :1050 reorg), lightningd/watch.c (txwatch
+:124 / txowatch :179 / `txwatch_fire` :237), and feerate smoothing
+(lightningd/feerate.c).  The watch layer is what arms onchaind: a
+funding-output spend firing a txowatch is how unilateral closes are
+detected.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+
+from ..btc.tx import Tx
+from .backend import Block, ChainBackend, FeeEstimates
+
+log = logging.getLogger("lightning_tpu.topology")
+
+
+@dataclass
+class BlockRecord:
+    height: int
+    hash: bytes
+    prev: bytes
+    txids: set[bytes] = field(default_factory=set)
+
+
+class ChainTopology:
+    """Single poller owning the node's view of the chain.
+
+    Callbacks (all may be sync or async):
+      watch_txid(txid, cb)          -> cb(tx, height, depth) per new depth
+      watch_outpoint(txid,vout,cb)  -> cb(spending_tx, height) on spend
+      on_block(cb)                  -> cb(height, block) per connected block
+      on_reorg(cb)                  -> cb(new_tip_height) after rewind
+    """
+
+    def __init__(self, backend: ChainBackend, poll_interval: float = 0.2,
+                 smoothing_alpha: float = 0.9):
+        self.backend = backend
+        self.poll_interval = poll_interval
+        self.chain: list[BlockRecord] = []
+        self.txs_seen: dict[bytes, tuple[Tx, int]] = {}  # txid -> (tx, height)
+        self._tx_watches: dict[bytes, list] = {}
+        self._txo_watches: dict[tuple[bytes, int], list] = {}
+        self._block_cbs: list = []
+        self._reorg_cbs: list = []
+        self.feerates = FeeEstimates()
+        self._smoothed: dict[int, float] = {}
+        self.alpha = smoothing_alpha
+        self._task: asyncio.Task | None = None
+        self._stopped = False
+        self._from_height = 0
+        self.synced = asyncio.Event()
+
+    @property
+    def height(self) -> int:
+        return self.chain[-1].height if self.chain else -1
+
+    # -- watches ----------------------------------------------------------
+
+    def watch_txid(self, txid: bytes, cb) -> None:
+        self._tx_watches.setdefault(txid, []).append(cb)
+        # already confirmed? fire immediately at current depth
+        seen = self.txs_seen.get(txid)
+        if seen is not None:
+            tx, h = seen
+            self._call_soon(cb, tx, h, self.height - h + 1)
+
+    def watch_outpoint(self, txid: bytes, vout: int, cb) -> None:
+        self._txo_watches.setdefault((txid, vout), []).append(cb)
+
+    def on_block(self, cb) -> None:
+        self._block_cbs.append(cb)
+
+    def on_reorg(self, cb) -> None:
+        self._reorg_cbs.append(cb)
+
+    def depth(self, txid: bytes) -> int:
+        seen = self.txs_seen.get(txid)
+        return 0 if seen is None else self.height - seen[1] + 1
+
+    def feerate(self, blocks: int = 6) -> int:
+        """Smoothed estimate (feerate.c keeps an EMA so fee spikes don't
+        whipsaw channel feerates)."""
+        sm = self._smoothed.get(blocks)
+        return int(sm) if sm else self.feerates.feerate(blocks)
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self, from_height: int = 0) -> None:
+        self._from_height = from_height
+        self._task = asyncio.get_running_loop().create_task(self._poll())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def sync_once(self) -> None:
+        """Pull every block the backend has right now (tests drive this
+        directly instead of sleeping through the poll loop)."""
+        await self._catch_up()
+
+    async def _poll(self) -> None:
+        while not self._stopped:
+            try:
+                await self._catch_up()
+                self.synced.set()
+            except Exception:
+                log.exception("chain poll failed; retrying")
+            try:
+                wait = getattr(self.backend, "wait_new_block", None)
+                if wait is not None:
+                    await wait(timeout=self.poll_interval)
+                else:
+                    await asyncio.sleep(self.poll_interval)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _catch_up(self) -> None:
+        info = await self.backend.getchaininfo()
+        fees = await self.backend.estimatefees()
+        self.feerates = fees
+        for blocks, rate in fees.estimates.items():
+            prev = self._smoothed.get(blocks, float(rate))
+            self._smoothed[blocks] = self.alpha * prev + \
+                (1 - self.alpha) * rate
+        while True:
+            if self.chain:
+                # same-height hash check catches equal-length reorgs;
+                # the prev-hash check below catches the rest
+                tip = await self.backend.getrawblockbyheight(self.height)
+                if tip is not None and tip[0] != self.chain[-1].hash:
+                    await self._remove_tip()
+                    continue
+            if self.height >= info.blockcount:
+                break
+            nxt = (self.chain[-1].height + 1) if self.chain \
+                else self._from_height
+            got = await self.backend.getrawblockbyheight(nxt)
+            if got is None:
+                break
+            bhash, raw = got
+            block = Block.parse(raw)
+            if self.chain and block.header[4:36] != self.chain[-1].hash:
+                await self._remove_tip()
+                continue
+            await self._add_tip(nxt, bhash, block)
+
+    async def _add_tip(self, height: int, bhash: bytes,
+                       block: Block) -> None:
+        rec = BlockRecord(height, bhash, block.header[4:36])
+        self.chain.append(rec)
+        for tx in block.txs:
+            txid = tx.txid()
+            rec.txids.add(txid)
+            self.txs_seen[txid] = (tx, height)
+            for vin in tx.inputs:
+                for cb in self._txo_watches.get((vin.txid, vin.vout), []):
+                    await self._call(cb, tx, height)
+        # depth change fires every tx watch whose tx is confirmed
+        for txid, cbs in list(self._tx_watches.items()):
+            seen = self.txs_seen.get(txid)
+            if seen is None:
+                continue
+            tx, h = seen
+            for cb in cbs:
+                await self._call(cb, tx, h, height - h + 1)
+        for cb in self._block_cbs:
+            await self._call(cb, height, block)
+
+    async def _remove_tip(self) -> None:
+        """chaintopology.c:1050 remove_tip: rewind one block."""
+        rec = self.chain.pop()
+        for txid in rec.txids:
+            self.txs_seen.pop(txid, None)
+        log.info("reorg: removed tip %d (%s)", rec.height,
+                 rec.hash.hex()[:16])
+        for cb in self._reorg_cbs:
+            await self._call(cb, self.height)
+
+    async def _call(self, cb, *args) -> None:
+        r = cb(*args)
+        if asyncio.iscoroutine(r):
+            await r
+
+    def _call_soon(self, cb, *args) -> None:
+        async def run():
+            await self._call(cb, *args)
+
+        asyncio.get_running_loop().create_task(run())

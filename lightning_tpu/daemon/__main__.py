@@ -64,6 +64,8 @@ async def amain(args) -> int:
     else:
         node = LightningNode(privkey=privkey)
     print(f"node_id {node.node_id.hex()}", flush=True)
+    logging.getLogger("lightning_tpu.lightningd").info(
+        "server started, node_id %s", node.node_id.hex())
 
     if args.listen is not None:
         port = await node.listen(args.bind, args.listen)
@@ -92,6 +94,7 @@ async def amain(args) -> int:
         rpc = RPC.JsonRpcServer(rpc_path)
         RPC.attach_core_commands(rpc, node, gossmap_ref,
                                  stop_event=stop_event)
+        RPC.attach_admin_commands(rpc, args.cfg, args.logring)
         await rpc.start()
         print(f"rpc ready {rpc_path}", flush=True)
 
@@ -188,7 +191,10 @@ def main() -> int:
                         "unavailable; env vars alone cannot override the "
                         "preloaded accelerator platform)")
     p.add_argument("-v", "--verbose", action="store_true")
-    args = p.parse_args()
+    p.add_argument("--conf", default=None,
+                   help="config file (reference name=value syntax); "
+                        "cmdline --opts after --conf are layered on top")
+    args, extra = p.parse_known_args()
     if args.cpu:
         from ..utils.jaxcfg import force_cpu, setup_cache
 
@@ -198,6 +204,24 @@ def main() -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    # layered config (common/configvar.c): file < remaining cmdline opts;
+    # serves listconfigs/setconfig; the ring serves getlog
+    from ..utils.config import ConfigError, node_options
+    from ..utils.logring import LogRing, install
+
+    cfg = node_options()
+    ring = LogRing()
+    try:
+        if args.conf:
+            cfg.load_file(args.conf, missing_ok=False)
+        cfg.parse_argv(extra)
+        ring.set_level(cfg["log-level"])   # validates debug:subsys syntax
+    except (ConfigError, ValueError) as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 1
+    install(ring)
+    cfg.on_change["log-level"] = ring.set_level
+    args.cfg, args.logring = cfg, ring
     try:
         return asyncio.run(amain(args))
     except KeyboardInterrupt:

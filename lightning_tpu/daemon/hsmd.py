@@ -145,6 +145,48 @@ class Hsm:
         r, s = ref.ecdsa_sign(sighash, secs.funding)
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
+    # -- onchain resolution signing (hsmd_wire.csv:289-327 equivalents) ----
+
+    def sign_delayed_payment_to_us(self, client: HsmClient, sighash: bytes,
+                                   per_commitment_point: ref.Point) -> bytes:
+        """hsmd_sign_any_delayed_payment_to_us: our to_local claim after
+        the CSV delay on OUR unilateral close."""
+        client._need(CAP_SIGN_ONCHAIN)
+        secs = self.channel_secrets(client)
+        k = K.derive_privkey(secs.delayed_payment, per_commitment_point)
+        r, s = ref.ecdsa_sign(sighash, k)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def sign_penalty_to_us(self, client: HsmClient, sighash: bytes,
+                           their_per_commitment_secret: int) -> bytes:
+        """hsmd_sign_penalty_to_us: revocation-key spend of a REVOKED
+        remote commitment's outputs."""
+        client._need(CAP_SIGN_ONCHAIN)
+        secs = self.channel_secrets(client)
+        k = K.derive_revocation_privkey(secs.revocation,
+                                        their_per_commitment_secret)
+        r, s = ref.ecdsa_sign(sighash, k)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def sign_to_remote_to_us(self, client: HsmClient,
+                             sighash: bytes) -> bytes:
+        """Claim our to_remote output on THEIR commitment (static
+        remotekey: the plain payment basepoint)."""
+        client._need(CAP_SIGN_ONCHAIN)
+        secs = self.channel_secrets(client)
+        r, s = ref.ecdsa_sign(sighash, secs.payment)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def sign_remote_htlc_to_us(self, client: HsmClient, sighash: bytes,
+                               per_commitment_point: ref.Point) -> bytes:
+        """Claim an HTLC output on THEIR commitment (success w/ preimage
+        or timeout), keyed by our htlc basepoint at their point."""
+        client._need(CAP_SIGN_ONCHAIN)
+        secs = self.channel_secrets(client)
+        k = K.derive_privkey(secs.htlc, per_commitment_point)
+        r, s = ref.ecdsa_sign(sighash, k)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
     def check_sigs_batch(self, msg_hashes: np.ndarray, sigs: np.ndarray,
                          pubkeys: np.ndarray) -> np.ndarray:
         """Batched verify (the self-check the reference does per-HTLC with

@@ -285,3 +285,34 @@ def attach_core_commands(rpc: JsonRpcServer, node, gossmap_ref: dict,
         ("loadgossip", loadgossip), ("stop", stop),
     ]:
         rpc.register(name, fn)
+
+
+def attach_admin_commands(rpc: JsonRpcServer, cfg, ring) -> None:
+    """listconfigs/setconfig (common/configvar.c surface) and getlog
+    (lightningd/log.c surface)."""
+    from ..utils.config import ConfigError
+
+    async def listconfigs(config: str | None = None) -> dict:
+        out = cfg.listconfigs()
+        if config is not None:
+            if config not in out["configs"]:
+                raise RpcError(RPC_ERROR, f"unknown config {config!r}")
+            out["configs"] = {config: out["configs"][config]}
+        return out
+
+    async def setconfig(config: str, val=None) -> dict:
+        try:
+            return cfg.setconfig(config,
+                                 None if val is None else str(val))
+        except ConfigError as e:
+            raise RpcError(RPC_ERROR, str(e))
+
+    async def getlog(level: str = "info") -> dict:
+        try:
+            return ring.getlog(level)
+        except ValueError as e:
+            raise RpcError(INVALID_PARAMS, str(e))
+
+    rpc.register("listconfigs", listconfigs)
+    rpc.register("setconfig", setconfig)
+    rpc.register("getlog", getlog)

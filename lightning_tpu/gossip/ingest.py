@@ -86,6 +86,8 @@ class GossipIngest:
         self.channels: dict[int, tuple[bytes, bytes]] = {}  # scid -> nodes
         self.updates: dict[tuple[int, int], int] = {}   # (scid,dir) -> ts
         self.nodes: dict[bytes, int] = {}               # node_id -> ts
+        self._channeled_nodes: set[bytes] = set()       # O(1) NA gate
+        self._accepted: list[_QItem] = []               # staged this flush
         # pending (messages that arrived before their channel)
         self.pending_updates: dict[int, dict[int, _QItem]] = {}
         self.pending_nodes: dict[bytes, _QItem] = {}
@@ -239,11 +241,25 @@ class GossipIngest:
         for it in batch:
             sig_ok.append(bool(ok[pos: pos + it.n_sigs].all()))
             pos += it.n_sigs
+        self._accepted = []
         for it, good in zip(batch, sig_ok):
             if not good:
                 self.stats.drop(R_BADSIG)
                 continue
             await self._apply(it)
+        if self._accepted:
+            # write-ahead: ONE append_many + fsync for the whole batch,
+            # then stream — nothing reaches peers before it is durable
+            self.writer.append_many(
+                [it.raw for it in self._accepted],
+                [getattr(it.parsed, "timestamp", 0)
+                 for it in self._accepted])
+            self.writer.sync()
+            self.stats.accepted += len(self._accepted)
+            if self.on_accept is not None:
+                for it in self._accepted:
+                    self.on_accept(it.raw, it.source)
+            self._accepted = []
 
     async def _apply(self, it: _QItem) -> None:
         """Post-signature acceptance: state tables + store + streaming."""
@@ -259,6 +275,7 @@ class GossipIngest:
                     self.stats.drop(R_NO_UTXO)
                     return
             self.channels[scid] = (p.node_id_1, p.node_id_2)
+            self._channeled_nodes.update((p.node_id_1, p.node_id_2))
             self._accept(it)
             # drain pendings now satisfiable
             for q in self.pending_updates.pop(scid, {}).values():
@@ -276,8 +293,10 @@ class GossipIngest:
             self._accept(it)
         elif kind == wire.MSG_NODE_ANNOUNCEMENT:
             nid = p.node_id
-            if not self._node_has_channel(nid):
-                self.pending_nodes[nid] = it
+            if nid not in self._channeled_nodes:
+                prev = self.pending_nodes.get(nid)
+                if prev is None or prev.parsed.timestamp < p.timestamp:
+                    self.pending_nodes[nid] = it
                 self.stats.drop(R_NO_CHANNEL)
                 return
             if self.nodes.get(nid, -1) >= p.timestamp:
@@ -286,16 +305,10 @@ class GossipIngest:
             self.nodes[nid] = p.timestamp
             self._accept(it)
 
-    def _node_has_channel(self, nid: bytes) -> bool:
-        return any(nid in ns for ns in self.channels.values())
-
     def _accept(self, it: _QItem) -> None:
-        ts = getattr(it.parsed, "timestamp", 0)
-        self.writer.append(it.raw, timestamp=ts)
-        self.writer.sync()              # write-ahead before streaming
-        self.stats.accepted += 1
-        if self.on_accept is not None:
-            self.on_accept(it.raw, it.source)
+        """Stage for the per-flush store write (one append_many + fsync
+        per batch, not per message)."""
+        self._accepted.append(it)
 
 
     def _build_items(self, batch: list[_QItem]) -> gverify.VerifyItems:

@@ -1,0 +1,257 @@
+"""Layered configuration engine with provenance tracking.
+
+Parity target: common/configvar.c (layered sources + provenance),
+lightningd/options.c (typed option registry, `clnopt_*` sites) and the
+`listconfigs`/`setconfig` RPC surface (lightningd/configs.c).
+
+Sources layer in increasing precedence:
+    default < config file < network config file < cmdline < setconfig
+Each value remembers where it came from (`listconfigs` shows it), and
+only options registered `dynamic=True` may be changed at runtime
+(setconfig), matching the reference's dynamic-option gating.
+
+Config file format is the reference's: one `name=value` per line,
+`name` alone for flags, `#` comments, and `include <file>`.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+from dataclasses import dataclass, field
+
+SOURCES = ("default", "file", "network_file", "cmdline", "setconfig")
+
+
+class ConfigError(Exception):
+    pass
+
+
+def _parse_bool(v: str) -> bool:
+    if v in ("true", "True", "1", "yes"):
+        return True
+    if v in ("false", "False", "0", "no"):
+        return False
+    raise ConfigError(f"not a boolean: {v!r}")
+
+
+_PARSERS = {
+    "string": str,
+    "int": int,
+    "bool": _parse_bool,
+    "flag": lambda v: True,
+    "msat": lambda v: int(v[:-4]) if v.endswith("msat") else int(v),
+    "sat": lambda v: int(v[:-3]) if v.endswith("sat") else int(v),
+    "float": float,
+}
+
+
+@dataclass
+class OptSpec:
+    name: str
+    type: str = "string"          # key into _PARSERS
+    default: object = None
+    description: str = ""
+    dynamic: bool = False         # settable via setconfig at runtime
+    multi: bool = False           # repeatable (collects a list)
+    dev_only: bool = False
+
+    def parse(self, value: str | None):
+        if self.type == "flag":
+            return True
+        if value is None:
+            raise ConfigError(f"--{self.name} requires a value")
+        try:
+            return _PARSERS[self.type](value)
+        except (ValueError, KeyError) as e:
+            raise ConfigError(f"--{self.name}: {e}")
+
+
+@dataclass
+class _Entry:
+    value: object
+    source: str
+    file: str | None = None
+    line: int | None = None
+
+
+class Config:
+    """Option registry + layered values."""
+
+    def __init__(self, developer: bool = False):
+        self.specs: dict[str, OptSpec] = {}
+        self.values: dict[str, _Entry] = {}
+        self.multi_values: dict[str, list[_Entry]] = {}
+        self.developer = developer
+        self.on_change: dict[str, object] = {}   # name -> callback(value)
+
+    # -- registry ---------------------------------------------------------
+
+    def register(self, *specs: OptSpec) -> None:
+        for s in specs:
+            if s.name in self.specs:
+                raise ConfigError(f"option {s.name} registered twice")
+            self.specs[s.name] = s
+
+    def _spec(self, name: str) -> OptSpec:
+        s = self.specs.get(name)
+        if s is None:
+            raise ConfigError(f"unknown option {name!r}")
+        if s.dev_only and not self.developer:
+            raise ConfigError(f"{name} requires --developer")
+        return s
+
+    # -- setting ----------------------------------------------------------
+
+    def _set(self, name: str, raw: str | None, source: str,
+             file: str | None = None, line: int | None = None) -> None:
+        s = self._spec(name)
+        val = s.parse(raw)
+        e = _Entry(val, source, file, line)
+        if s.multi:
+            self.multi_values.setdefault(name, []).append(e)
+        else:
+            prev = self.values.get(name)
+            # higher- or equal-precedence sources win (later file lines
+            # override earlier ones; cmdline overrides files)
+            if prev is None or SOURCES.index(source) >= SOURCES.index(
+                    prev.source):
+                self.values[name] = e
+
+    def load_file(self, path: str, source: str = "file",
+                  missing_ok: bool = True, _depth: int = 0) -> None:
+        """Reference config-file syntax (common/configdir.c)."""
+        if _depth > 10:
+            raise ConfigError("include depth exceeded")
+        if not os.path.exists(path):
+            if missing_ok:
+                return
+            raise ConfigError(f"config file {path} not found")
+        with open(path) as f:
+            for ln, rawline in enumerate(f, 1):
+                s = rawline.strip()
+                if not s or s.startswith("#"):
+                    continue
+                if s.startswith("include "):
+                    inc = shlex.split(s[len("include "):])[0]
+                    if not os.path.isabs(inc):
+                        inc = os.path.join(os.path.dirname(path), inc)
+                    self.load_file(inc, source, missing_ok=False,
+                                   _depth=_depth + 1)
+                    continue
+                name, sep, value = s.partition("=")
+                self._set(name.strip(),
+                          value.strip() if sep else None,
+                          source, file=path, line=ln)
+
+    def parse_argv(self, argv: list[str]) -> list[str]:
+        """Consume --name[=value] style args; returns non-option rest."""
+        rest, i = [], 0
+        while i < len(argv):
+            a = argv[i]
+            if not a.startswith("--"):
+                rest.append(a)
+                i += 1
+                continue
+            name, sep, value = a[2:].partition("=")
+            spec = self._spec(name)
+            if not sep and spec.type != "flag":
+                i += 1
+                if i >= len(argv):
+                    raise ConfigError(f"--{name} requires a value")
+                value = argv[i]
+            self._set(name, value if (sep or spec.type != "flag") else None,
+                      "cmdline")
+            i += 1
+        return rest
+
+    def setconfig(self, name: str, value: str | None) -> dict:
+        """Runtime change (RPC `setconfig`); dynamic options only."""
+        s = self._spec(name)
+        if not s.dynamic:
+            raise ConfigError(f"{name} is not a dynamic option")
+        self._set(name, value, "setconfig")
+        cb = self.on_change.get(name)
+        if cb is not None:
+            cb(self.get(name))
+        return {"config": self._describe(name)}
+
+    # -- reading ----------------------------------------------------------
+
+    def get(self, name: str):
+        s = self.specs[name]
+        if s.multi:
+            entries = self.multi_values.get(name)
+            return [e.value for e in entries] if entries else (s.default or [])
+        e = self.values.get(name)
+        return e.value if e is not None else s.default
+
+    def __getitem__(self, name: str):
+        return self.get(name)
+
+    def _describe(self, name: str) -> dict:
+        s = self.specs[name]
+        out = {"value_" + ("int" if s.type in ("int", "msat", "sat")
+                           else "bool" if s.type in ("bool", "flag")
+                           else "str"): self.get(name),
+               "source": "default"}
+        e = self.values.get(name)
+        if e is not None:
+            out["source"] = e.source if e.file is None else \
+                f"{e.file}:{e.line}"
+        if s.dynamic:
+            out["dynamic"] = True
+        return out
+
+    def listconfigs(self) -> dict:
+        """RPC `listconfigs` shape: {configs: {name: {value_*, source}}}"""
+        return {"configs": {
+            name: self._describe(name)
+            for name, s in sorted(self.specs.items())
+            if not (s.dev_only and not self.developer)
+        }}
+
+
+# ---------------------------------------------------------------------------
+# The node's option registry (subset of lightningd/options.c's 80 clnopt_*
+# registrations, growing as subsystems land).
+
+def node_options() -> Config:
+    cfg = Config()
+    cfg.register(
+        OptSpec("network", "string", "regtest", "chain network name"),
+        OptSpec("alias", "string", None, "node alias (up to 32 bytes)",
+                dynamic=True),
+        OptSpec("rgb", "string", "0377ff", "node color"),
+        OptSpec("bind-addr", "string", "127.0.0.1", "listen address"),
+        OptSpec("addr", "string", None, "public address", multi=True),
+        OptSpec("port", "int", 19846, "listen port"),
+        OptSpec("rpc-file", "string", None, "JSON-RPC unix socket path"),
+        OptSpec("lightning-dir", "string", None, "data directory"),
+        OptSpec("log-level", "string", "info", "minimum log level",
+                dynamic=True),
+        OptSpec("log-file", "string", None, "log to this file", multi=True),
+        OptSpec("fee-base", "int", 1000, "routing base fee msat",
+                dynamic=True),
+        OptSpec("fee-per-satoshi", "int", 10, "routing ppm fee",
+                dynamic=True),
+        OptSpec("cltv-delta", "int", 34, "forwarding cltv delta",
+                dynamic=True),
+        OptSpec("cltv-final", "int", 18, "final hop cltv"),
+        OptSpec("max-concurrent-htlcs", "int", 30,
+                "HTLC slots offered per channel (options.c:979)"),
+        OptSpec("min-capacity-sat", "int", 10000,
+                "reject channels smaller than this", dynamic=True),
+        OptSpec("funding-confirms", "int", 3, "depth before channel_ready"),
+        OptSpec("watchtime-blocks", "int", 144, "to_self_delay we demand"),
+        OptSpec("gossip-store-file", "string", None, "gossip store path"),
+        OptSpec("offline", "flag", False, "do not listen or reconnect"),
+        OptSpec("developer", "flag", False, "enable dev options"),
+        OptSpec("dev-fast-gossip", "flag", False, "short gossip timers",
+                dev_only=True),
+        OptSpec("verify-batch-size", "int", 256,
+                "signature batch flush threshold (TPU occupancy)",
+                dynamic=True),
+        OptSpec("verify-batch-ms", "float", 2.0,
+                "signature batch flush deadline ms", dynamic=True),
+    )
+    return cfg

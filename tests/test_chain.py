@@ -1,0 +1,172 @@
+"""Chain backend + topology: blocks, watches, reorgs, feerate smoothing.
+
+Parity: lightningd/chaintopology.c add_tip/remove_tip, watch.c
+txwatch/txowatch firing, bcli's five required methods.
+"""
+import asyncio
+
+import pytest
+
+from lightning_tpu.btc.tx import Tx, TxInput, TxOutput
+from lightning_tpu.chain.backend import Block, FakeBitcoind
+from lightning_tpu.chain.topology import ChainTopology
+
+
+def mktx(prev_txid: bytes, vout: int = 0, amount: int = 50_000,
+         script: bytes = b"\x00\x14" + b"\xab" * 20) -> Tx:
+    return Tx(inputs=[TxInput(prev_txid, vout)],
+              outputs=[TxOutput(amount, script)])
+
+
+COINBASE = bytes(31) + b"\x01"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_block_roundtrip():
+    tx = mktx(COINBASE)
+    bd = FakeBitcoind()
+    bd.mempool[tx.txid()] = tx
+    bd.generate()
+    _, raw = run(bd.getrawblockbyheight(1))
+    blk = Block.parse(raw)
+    assert [t.txid() for t in blk.txs] == [tx.txid()]
+
+
+def test_chaininfo_and_utxo():
+    async def main():
+        bd = FakeBitcoind()
+        tx = mktx(COINBASE)
+        ok, err = await bd.sendrawtransaction(tx.serialize())
+        assert ok, err
+        bd.generate()
+        info = await bd.getchaininfo()
+        assert info.blockcount == 1
+        got = await bd.getutxout(tx.txid(), 0)
+        assert got == (50_000, b"\x00\x14" + b"\xab" * 20)
+        # spend it
+        tx2 = mktx(tx.txid(), 0, 40_000)
+        await bd.sendrawtransaction(tx2.serialize())
+        bd.generate()
+        assert await bd.getutxout(tx.txid(), 0) is None
+        # double spend rejected
+        tx3 = mktx(tx.txid(), 0, 30_000)
+        ok, err = await bd.sendrawtransaction(tx3.serialize())
+        assert not ok and "missingorspent" in err
+
+    run(main())
+
+
+def test_topology_sync_and_watches():
+    async def main():
+        bd = FakeBitcoind()
+        topo = ChainTopology(bd)
+        blocks, fired, spends = [], [], []
+        topo.on_block(lambda h, b: blocks.append(h))
+
+        tx = mktx(COINBASE)
+        txid = tx.txid()
+        topo.watch_txid(txid, lambda t, h, d: fired.append((h, d)))
+        topo.watch_outpoint(txid, 0,
+                            lambda t, h: spends.append((t.txid(), h)))
+
+        await bd.sendrawtransaction(tx.serialize())
+        bd.generate()          # height 1: tx confirms
+        await topo.sync_once()
+        assert blocks == [0, 1]   # syncs from genesis
+        assert fired == [(1, 1)]
+        assert topo.depth(txid) == 1
+
+        bd.generate(2)         # depth grows
+        await topo.sync_once()
+        assert fired[-1] == (1, 3) and topo.depth(txid) == 3
+
+        spend = mktx(txid, 0, 45_000)
+        await bd.sendrawtransaction(spend.serialize())
+        bd.generate()
+        await topo.sync_once()
+        assert spends == [(spend.txid(), 4)]
+
+    run(main())
+
+
+def test_watch_already_confirmed_fires():
+    async def main():
+        bd = FakeBitcoind()
+        topo = ChainTopology(bd)
+        tx = mktx(COINBASE)
+        await bd.sendrawtransaction(tx.serialize())
+        bd.generate()
+        await topo.sync_once()
+        fired = []
+        topo.watch_txid(tx.txid(), lambda t, h, d: fired.append(d))
+        await asyncio.sleep(0)   # let the call_soon task run
+        await asyncio.sleep(0)
+        assert fired == [1]
+
+    run(main())
+
+
+def test_reorg_rewinds_and_refires():
+    async def main():
+        bd = FakeBitcoind()
+        topo = ChainTopology(bd)
+        reorgs, fired = [], []
+        topo.on_reorg(lambda h: reorgs.append(h))
+        tx = mktx(COINBASE)
+        topo.watch_txid(tx.txid(), lambda t, h, d: fired.append((h, d)))
+        bd.generate(2)
+        await bd.sendrawtransaction(tx.serialize())
+        bd.generate()          # tx at height 3
+        await topo.sync_once()
+        assert fired[-1] == (3, 1)
+        assert topo.height == 3
+
+        bd.reorg(depth=2)      # drops 2..3, mines 2..4; tx back in mempool
+        await topo.sync_once()
+        assert reorgs, "reorg callback must fire"
+        assert topo.height == 4
+        assert topo.depth(tx.txid()) == 0   # unconfirmed again
+
+        bd.generate()          # remine mempool (tx confirms at 5)
+        await topo.sync_once()
+        assert topo.depth(tx.txid()) == 1
+        assert fired[-1] == (5, 1)
+
+    run(main())
+
+
+def test_feerate_smoothing():
+    async def main():
+        bd = FakeBitcoind()
+        topo = ChainTopology(bd, smoothing_alpha=0.5)
+        await topo.sync_once()
+        base = topo.feerate(6)
+        assert base == 5000
+        bd.fees.estimates[6] = 20000   # spike
+        await topo.sync_once()
+        smoothed = topo.feerate(6)
+        assert 5000 < smoothed < 20000   # EMA, not the raw spike
+
+    run(main())
+
+
+def test_failure_injection_does_not_kill_poller():
+    async def main():
+        bd = FakeBitcoind()
+        topo = ChainTopology(bd, poll_interval=0.01)
+        await topo.start()
+        bd.generate()
+        await asyncio.sleep(0.1)
+        assert topo.height == 1
+        bd.fail_method["getchaininfo"] = RuntimeError("rpc down")
+        bd.generate()
+        await asyncio.sleep(0.05)
+        del bd.fail_method["getchaininfo"]
+        await asyncio.sleep(0.2)
+        assert topo.height == 2      # recovered after transient failure
+        await topo.stop()
+
+    run(main())

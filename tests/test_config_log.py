@@ -1,0 +1,122 @@
+"""Config engine (configvar parity) and ring-buffer log (log.c parity)."""
+import logging
+
+import pytest
+
+from lightning_tpu.utils.config import (Config, ConfigError, OptSpec,
+                                        node_options)
+from lightning_tpu.utils.logring import LogRing, install
+
+
+class TestConfig:
+    def test_defaults_and_types(self):
+        cfg = node_options()
+        assert cfg["port"] == 19846
+        assert cfg["log-level"] == "info"
+        assert cfg["offline"] is False
+
+    def test_layering_precedence(self, tmp_path):
+        cfg = node_options()
+        conf = tmp_path / "config"
+        conf.write_text("port=1000\nalias=filealias\n# comment\noffline\n")
+        cfg.load_file(str(conf))
+        assert cfg["port"] == 1000 and cfg["offline"] is True
+        cfg.parse_argv(["--port", "2000"])
+        assert cfg["port"] == 2000          # cmdline beats file
+        assert cfg["alias"] == "filealias"  # untouched
+        desc = cfg.listconfigs()["configs"]
+        assert desc["port"]["source"] == "cmdline"
+        assert desc["alias"]["source"].endswith("config:2")
+        assert desc["rgb"]["source"] == "default"
+
+    def test_include_and_missing(self, tmp_path):
+        inc = tmp_path / "extra.conf"
+        inc.write_text("fee-base=777\n")
+        conf = tmp_path / "config"
+        conf.write_text(f"include {inc.name}\n")
+        cfg = node_options()
+        cfg.load_file(str(conf))
+        assert cfg["fee-base"] == 777
+        with pytest.raises(ConfigError):
+            cfg.load_file(str(tmp_path / "nope"), missing_ok=False)
+
+    def test_multi_option(self):
+        cfg = node_options()
+        cfg.parse_argv(["--addr", "a:1", "--addr", "b:2"])
+        assert cfg["addr"] == ["a:1", "b:2"]
+
+    def test_unknown_and_dev_gating(self):
+        cfg = node_options()
+        with pytest.raises(ConfigError):
+            cfg.parse_argv(["--no-such-option", "x"])
+        with pytest.raises(ConfigError):
+            cfg.parse_argv(["--dev-fast-gossip"])
+        cfg.developer = True
+        cfg.parse_argv(["--dev-fast-gossip"])
+        assert cfg["dev-fast-gossip"] is True
+
+    def test_setconfig_dynamic_gate(self):
+        cfg = node_options()
+        out = cfg.setconfig("fee-base", "50")
+        assert cfg["fee-base"] == 50
+        assert out["config"]["source"] == "setconfig"
+        with pytest.raises(ConfigError):
+            cfg.setconfig("port", "9")   # not dynamic
+        seen = []
+        cfg.on_change["alias"] = seen.append
+        cfg.setconfig("alias", "newname")
+        assert seen == ["newname"]
+
+    def test_bad_values(self):
+        cfg = node_options()
+        with pytest.raises(ConfigError):
+            cfg.parse_argv(["--port", "notanint"])
+        with pytest.raises(ConfigError):
+            cfg.parse_argv(["--port"])
+
+
+class TestLogRing:
+    def _fresh(self, **kw):
+        ring = LogRing(**kw)
+        name = f"lightning_tpu.test{id(ring)}"
+        lg = logging.getLogger(name)
+        lg.addHandler(ring)
+        lg.setLevel(1)
+        return ring, lg
+
+    def test_capture_and_getlog(self):
+        ring, lg = self._fresh()
+        lg.info("hello %s", "world")
+        lg.debug("too quiet")       # below default info
+        lg.error("broken thing")
+        out = ring.getlog("info")
+        msgs = [e["log"] for e in out["log"]]
+        assert "hello world" in msgs and "broken thing" in msgs
+        assert "too quiet" not in msgs
+        types = {e["log"]: e["type"] for e in out["log"]}
+        assert types["broken thing"] == "BROKEN"
+
+    def test_subsystem_override(self):
+        ring, lg = self._fresh()
+        sub = lg.name.removeprefix("lightning_tpu.")
+        ring.set_level(f"debug:{sub}")
+        lg.debug("now visible")
+        assert any(e["log"] == "now visible"
+                   for e in ring.getlog("debug")["log"])
+
+    def test_ring_bound(self):
+        ring, lg = self._fresh(max_entries=10)
+        for i in range(25):
+            lg.info("m%d", i)
+        out = ring.getlog("info")["log"]
+        assert len(out) == 10
+        assert out[0]["log"] == "m15" and out[-1]["log"] == "m24"
+
+    def test_level_filter_in_getlog(self):
+        ring, lg = self._fresh(default_level="debug")
+        lg.debug("fine detail")
+        lg.warning("odd")
+        assert all(e["type"] in ("UNUSUAL", "BROKEN")
+                   for e in ring.getlog("unusual")["log"])
+        with pytest.raises(ValueError):
+            ring.getlog("nope")

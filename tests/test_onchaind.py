@@ -1,0 +1,196 @@
+"""Onchain resolution: spend classification, penalty + delayed claims.
+
+Parity: onchaind/onchaind.c classification loop, watch.c arming,
+hsmd sign_penalty_to_us / sign_any_delayed_payment_to_us.
+"""
+import asyncio
+
+import pytest
+
+from lightning_tpu.btc import keys as K
+from lightning_tpu.btc import script as SC
+from lightning_tpu.btc import tx as T
+from lightning_tpu.chain.backend import FakeBitcoind
+from lightning_tpu.chain.onchaind import (ChannelOnchainState, Onchaind,
+                                          SpendClass, classify_spend,
+                                          plan_claims,
+                                          recover_commitment_number)
+from lightning_tpu.chain.topology import ChainTopology
+from lightning_tpu.channel.commitment import (CommitmentKeys,
+                                              CommitmentParams, Side,
+                                              build_commitment_tx)
+from lightning_tpu.crypto import ref_python as ref
+from lightning_tpu.daemon.hsmd import CAP_MASTER, Hsm
+
+DEST_SPK = b"\x00\x14" + b"\xd0" * 20
+FUNDING_SAT = 1_000_000
+
+
+class Harness:
+    def __init__(self):
+        self.hsm = Hsm(b"\x51" * 32)
+        self.client = self.hsm.client(CAP_MASTER, b"\x02" * 33, dbid=1)
+        self.ours = self.hsm.channel_secrets(self.client)
+        self.our_bp = self.hsm.channel_basepoints(self.client)
+        self.theirs = K.BaseSecrets.from_seed(b"their-seed")
+        self.their_bp = self.theirs.basepoints()
+        self.funding_tx = T.Tx(
+            inputs=[T.TxInput(bytes(31) + b"\x07", 0)],
+            outputs=[T.TxOutput(FUNDING_SAT, SC.p2wsh(SC.funding_script(
+                ref.pubkey_serialize(self.our_bp.funding_pubkey),
+                ref.pubkey_serialize(self.their_bp.funding_pubkey))))])
+        self.opener_bp = ref.pubkey_serialize(self.our_bp.payment)
+        self.accepter_bp = ref.pubkey_serialize(self.their_bp.payment)
+
+    def params(self, holder_their_side: bool) -> CommitmentParams:
+        return CommitmentParams(
+            funding_txid=self.funding_tx.txid(),
+            funding_output_index=0,
+            funding_sat=FUNDING_SAT,
+            opener=Side.LOCAL if not holder_their_side else Side.REMOTE,
+            opener_payment_basepoint=self.opener_bp,
+            accepter_payment_basepoint=self.accepter_bp,
+            to_self_delay=6,
+            dust_limit_sat=546,
+            feerate_per_kw=2500,
+        )
+
+    def their_secret(self, n: int) -> int:
+        shaseed = b"\x99" * 32
+        return int.from_bytes(
+            K.shachain_derive_secret(shaseed, K.LARGEST_INDEX - n), "big")
+
+    def their_commitment(self, n: int):
+        """Their commitment tx (they are holder) at commitment number n."""
+        secret = self.their_secret(n)
+        pcp = K.per_commitment_point(secret.to_bytes(32, "big"))
+        keys = CommitmentKeys.derive(self.their_bp, self.our_bp, pcp)
+        tx, _ = build_commitment_tx(
+            self.params(holder_their_side=True), keys, n,
+            to_local_msat=600_000_000, to_remote_msat=400_000_000,
+            htlcs=[], holder_is_opener=False)
+        return tx, secret, pcp
+
+    def our_commitment(self, n: int):
+        pcp = self.hsm.per_commitment_point(self.client, n)
+        keys = CommitmentKeys.derive(self.our_bp, self.their_bp, pcp)
+        tx, _ = build_commitment_tx(
+            self.params(holder_their_side=False), keys, n,
+            to_local_msat=600_000_000, to_remote_msat=400_000_000,
+            htlcs=[], holder_is_opener=True)
+        return tx, pcp
+
+    def state(self, our_txid=None, their_n=7, secrets=None) \
+            -> ChannelOnchainState:
+        return ChannelOnchainState(
+            funding_txid=self.funding_tx.txid(),
+            funding_output_index=0,
+            our_basepoints=self.our_bp,
+            their_basepoints=self.their_bp,
+            opener_payment_basepoint=self.opener_bp,
+            accepter_payment_basepoint=self.accepter_bp,
+            to_self_delay=6, their_to_self_delay=6,
+            our_commitment_number=3, their_commitment_number=their_n,
+            our_commitment_txid=our_txid,
+            their_secrets=secrets or {},
+        )
+
+
+def test_commitment_number_recovery():
+    h = Harness()
+    tx, _, _ = h.their_commitment(5)
+    assert recover_commitment_number(
+        tx, h.opener_bp, h.accepter_bp) == 5
+
+
+def test_classification():
+    h = Harness()
+    rev_tx, secret, _ = h.their_commitment(5)
+    cur_tx, _, _ = h.their_commitment(7)
+    our_tx, _ = h.our_commitment(3)
+    st = h.state(our_txid=our_tx.txid(), their_n=7, secrets={5: secret})
+    assert classify_spend(rev_tx, st)[0] == SpendClass.REVOKED
+    assert classify_spend(cur_tx, st)[0] == SpendClass.THEIRS
+    assert classify_spend(our_tx, st)[0] == SpendClass.OURS
+    mutual = T.Tx(inputs=[T.TxInput(h.funding_tx.txid(), 0)],
+                  outputs=[T.TxOutput(999_000, DEST_SPK)])
+    st.mutual_close_txids.add(mutual.txid())
+    assert classify_spend(mutual, st)[0] == SpendClass.MUTUAL
+    random_spend = T.Tx(inputs=[T.TxInput(h.funding_tx.txid(), 0)],
+                        outputs=[T.TxOutput(1000, DEST_SPK)])
+    assert classify_spend(random_spend, st)[0] == SpendClass.UNKNOWN
+
+
+def test_penalty_claims_on_revoked():
+    h = Harness()
+    rev_tx, secret, pcp = h.their_commitment(5)
+    st = h.state(their_n=7, secrets={5: secret})
+    claims = plan_claims(SpendClass.REVOKED, rev_tx, 5, st, DEST_SPK, 2500)
+    kinds = sorted(c.kind for c in claims)
+    assert kinds == ["penalty_to_local", "to_remote"]
+    # penalty claim signature verifies under the revocation pubkey
+    pen = next(c for c in claims if c.kind == "penalty_to_local")
+    sig = h.hsm.sign_penalty_to_us(h.client, pen.sighash(), secret)
+    keys = CommitmentKeys.derive(h.their_bp, h.our_bp, pcp)
+    r, s = int.from_bytes(sig[:32], "big"), int.from_bytes(sig[32:], "big")
+    assert ref.ecdsa_verify(pen.sighash(), r, s,
+                            ref.pubkey_parse(keys.revocation_pubkey))
+
+
+def test_end_to_end_revoked_sweep():
+    async def main():
+        h = Harness()
+        bd = FakeBitcoind()
+        topo = ChainTopology(bd)
+        rev_tx, secret, _ = h.their_commitment(5)
+        st = h.state(their_n=7, secrets={5: secret})
+        oc = Onchaind(st, h.hsm, h.client, topo, bd, DEST_SPK)
+        oc.arm()
+        await bd.sendrawtransaction(h.funding_tx.serialize())
+        bd.generate()
+        await topo.sync_once()
+
+        await bd.sendrawtransaction(rev_tx.serialize())
+        bd.generate()
+        await topo.sync_once()
+        assert ("spend_classified", SpendClass.REVOKED) in oc.events
+        bcast = [e for e in oc.events if e[0] == "claim_broadcast"]
+        assert {e[1][0] for e in bcast} == {"penalty_to_local", "to_remote"}
+        assert all(e[1][1] for e in bcast), bcast
+
+        bd.generate()
+        await topo.sync_once()
+        confirmed = {e[1] for e in oc.events if e[0] == "claim_confirmed"}
+        assert confirmed == {"penalty_to_local", "to_remote"}
+        # swept outputs pay our destination
+        dest_utxos = [v for k, v in bd.utxos.items() if v[1] == DEST_SPK]
+        assert len(dest_utxos) == 2
+        total = sum(v[0] for v in dest_utxos)
+        assert total > 990_000   # capacity minus commitment+sweep fees
+
+    asyncio.run(main())
+
+
+def test_end_to_end_our_unilateral():
+    async def main():
+        h = Harness()
+        bd = FakeBitcoind()
+        topo = ChainTopology(bd)
+        our_tx, pcp = h.our_commitment(3)
+        st = h.state(our_txid=our_tx.txid())
+        oc = Onchaind(st, h.hsm, h.client, topo, bd, DEST_SPK, our_pcp=pcp)
+        oc.arm()
+        await bd.sendrawtransaction(h.funding_tx.serialize())
+        bd.generate()
+        await topo.sync_once()
+
+        await bd.sendrawtransaction(our_tx.serialize())
+        bd.generate()
+        await topo.sync_once()
+        assert ("spend_classified", SpendClass.OURS) in oc.events
+        bcast = [e for e in oc.events if e[0] == "claim_broadcast"]
+        assert [e[1][0] for e in bcast] == ["to_local_delayed"]
+        # the sweep carries the CSV delay in its input sequence
+        assert oc.claims[0].tx.inputs[0].sequence == 6
+
+    asyncio.run(main())
