@@ -715,9 +715,14 @@ INVALID_ONION_PAYLOAD = PERM | 22
 INCORRECT_OR_UNKNOWN_PAYMENT_DETAILS = PERM | 15
 
 
-def _classify_keysend(lh, node_privkey: int):
+def classify_incoming(lh, node_privkey: int, invoices=None):
     """Peel an incoming HTLC's onion and decide its fate
-    (plugins/keysend.c + lightningd/peer_htlcs.c semantics).
+    (plugins/keysend.c + lightningd/invoice.c `invoice_payment` +
+    lightningd/peer_htlcs.c semantics).
+
+    invoices: optional pay.invoices.InvoiceRegistry — a final-hop HTLC
+    whose payment_hash/secret/amount match one of our invoices is
+    fulfilled with the invoice preimage.
 
     Returns one of:
       ("fulfill", preimage)
@@ -752,6 +757,13 @@ def _classify_keysend(lh, node_privkey: int):
             == lh.htlc.payment_hash
             and payload.amt_to_forward_msat <= lh.htlc.amount_msat):
         return ("fulfill", payload.keysend_preimage)
+    if (payload.is_final and invoices is not None
+            and payload.amt_to_forward_msat <= lh.htlc.amount_msat):
+        preimage = invoices.resolve_htlc(
+            lh.htlc.payment_hash, lh.htlc.amount_msat,
+            payload.payment_secret, payload.total_msat)
+        if preimage is not None:
+            return ("fulfill", preimage)
     # parseable but not a keysend for us: return a REAL encrypted error
     # onion the origin can attribute (incorrect_or_unknown_payment_details
     # carries htlc_msat + blockheight per BOLT#4)
@@ -765,7 +777,8 @@ def _classify_keysend(lh, node_privkey: int):
 async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
                             node_privkey: int,
                             cfg: ChannelConfig | None = None,
-                            wallet=None, hsm_dbid: int = 1) -> T.Tx:
+                            wallet=None, hsm_dbid: int = 1,
+                            invoices=None) -> T.Tx:
     """Accept one inbound channel and serve it until cooperative close:
     apply updates, answer commitment dances (committing back our own
     changes), fulfill keysend HTLCs addressed to us, negotiate shutdown.
@@ -797,10 +810,14 @@ async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
                 if (by_us or lh.preimage is not None
                         or lh.fail_reason is not None or hid in handled):
                     continue
-                verdict, data = _classify_keysend(lh, node_privkey)
+                verdict, data = classify_incoming(lh, node_privkey,
+                                                  invoices)
                 try:
                     if verdict == "fulfill":
                         await ch.fulfill_htlc(hid, data)
+                        if invoices is not None:
+                            invoices.settle(lh.htlc.payment_hash,
+                                            lh.htlc.amount_msat)
                     elif verdict == "fail":
                         await ch.fail_htlc(hid, data)
                     else:

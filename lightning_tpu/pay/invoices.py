@@ -1,0 +1,199 @@
+"""Invoice registry: create/lookup/settle/expire BOLT#11 invoices.
+
+Parity target: lightningd/invoice.c + wallet/invoices.c (the invoices
+table, pay_index monotone counter for waitanyinvoice, expiry handling)
+with our bolt11 codec doing the encoding/signing.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+from ..bolt import bolt11
+
+
+class InvoiceError(Exception):
+    pass
+
+
+@dataclass
+class InvoiceRecord:
+    label: str
+    payment_hash: bytes
+    preimage: bytes
+    amount_msat: int | None
+    bolt11: str
+    description: str
+    status: str               # unpaid | paid | expired
+    expires_at: int
+    payment_secret: bytes
+    pay_index: int | None = None
+    paid_at: int | None = None
+    received_msat: int | None = None
+
+    def to_rpc(self) -> dict:
+        out = {
+            "label": self.label,
+            "payment_hash": self.payment_hash.hex(),
+            "bolt11": self.bolt11,
+            "status": self.status,
+            "description": self.description,
+            "expires_at": self.expires_at,
+        }
+        if self.amount_msat is not None:
+            out["amount_msat"] = self.amount_msat
+        if self.status == "paid":
+            out.update(pay_index=self.pay_index, paid_at=self.paid_at,
+                       amount_received_msat=self.received_msat,
+                       payment_preimage=self.preimage.hex())
+        return out
+
+
+class InvoiceRegistry:
+    """In-memory registry with write-through to the wallet db (if any)."""
+
+    def __init__(self, node_seckey: int, db=None, currency: str = "bcrt",
+                 min_final_cltv: int = 18):
+        self.node_seckey = node_seckey
+        self.db = db
+        self.currency = currency
+        self.min_final_cltv = min_final_cltv
+        self.by_hash: dict[bytes, InvoiceRecord] = {}
+        self.by_label: dict[str, InvoiceRecord] = {}
+        self._next_pay_index = 1
+        if db is not None:
+            self._load()
+
+    # -- persistence ------------------------------------------------------
+
+    def _load(self) -> None:
+        rows = self.db.conn.execute(
+            "SELECT label, payment_hash, preimage, amount_msat, bolt11,"
+            " description, status, expires_at, pay_index, paid_at,"
+            " received_msat FROM invoices").fetchall()
+        for r in rows:
+            inv = bolt11.decode(r[4], check_sig=False)
+            rec = InvoiceRecord(
+                label=r[0], payment_hash=bytes(r[1]), preimage=bytes(r[2]),
+                amount_msat=r[3], bolt11=r[4], description=r[5] or "",
+                status=r[6], expires_at=r[7],
+                payment_secret=inv.payment_secret or b"",
+                pay_index=r[8], paid_at=r[9], received_msat=r[10])
+            self.by_hash[rec.payment_hash] = rec
+            self.by_label[rec.label] = rec
+            if rec.pay_index is not None:
+                self._next_pay_index = max(self._next_pay_index,
+                                           rec.pay_index + 1)
+
+    def _save(self, rec: InvoiceRecord) -> None:
+        if self.db is None:
+            return
+        with self.db.transaction():
+            self.db.conn.execute(
+                "INSERT INTO invoices (label, payment_hash, preimage,"
+                " amount_msat, bolt11, description, status, expires_at,"
+                " pay_index, paid_at, received_msat)"
+                " VALUES (?,?,?,?,?,?,?,?,?,?,?)"
+                " ON CONFLICT(label) DO UPDATE SET status=excluded.status,"
+                " pay_index=excluded.pay_index, paid_at=excluded.paid_at,"
+                " received_msat=excluded.received_msat",
+                (rec.label, rec.payment_hash, rec.preimage, rec.amount_msat,
+                 rec.bolt11, rec.description, rec.status, rec.expires_at,
+                 rec.pay_index, rec.paid_at, rec.received_msat))
+
+    # -- creation ---------------------------------------------------------
+
+    def create(self, label: str, amount_msat: int | None, description: str,
+               expiry: int = 3600) -> InvoiceRecord:
+        if label in self.by_label:
+            raise InvoiceError(f"duplicate label {label!r}")
+        preimage = os.urandom(32)
+        import hashlib
+
+        payment_hash = hashlib.sha256(preimage).digest()
+        payment_secret = os.urandom(32)
+        s, inv = bolt11.new_invoice(
+            self.node_seckey, payment_hash, amount_msat, description,
+            currency=self.currency, payment_secret=payment_secret,
+            expiry=expiry, min_final_cltv=self.min_final_cltv)
+        rec = InvoiceRecord(
+            label=label, payment_hash=payment_hash, preimage=preimage,
+            amount_msat=amount_msat, bolt11=s, description=description,
+            status="unpaid", expires_at=inv.expires_at,
+            payment_secret=payment_secret)
+        self.by_hash[payment_hash] = rec
+        self.by_label[label] = rec
+        self._save(rec)
+        return rec
+
+    # -- resolution (the htlc_accepted / invoice_payment path) ------------
+
+    def resolve_htlc(self, payment_hash: bytes, amount_msat: int,
+                     payment_secret: bytes | None,
+                     total_msat: int | None = None,
+                     now: float | None = None) -> bytes | None:
+        """Decide whether an incoming final-hop HTLC pays one of our
+        invoices.  Returns the preimage to fulfill with, or None
+        (caller fails the HTLC).  Mirrors invoice.c's checks: known
+        hash, not expired, secret matches, delivered amount in
+        [amount, 2*amount] (BOLT#4 overpayment rule).
+
+        READ-ONLY w.r.t. payment state: classification can run more
+        than once for the same HTLC (the fulfill may not be committable
+        yet); callers mark the invoice paid via `settle()` only after
+        the fulfill is actually sent.  Until MPP sets land, a single
+        HTLC must deliver the whole amount: a payload claiming
+        total_msat beyond what this HTLC carries is rejected (the
+        reference holds such HTLCs in an htlc_set; paying out the
+        preimage for a partial delivery would forfeit the invoice)."""
+        rec = self.by_hash.get(payment_hash)
+        if rec is None:
+            return None
+        t = int(now if now is not None else time.time())
+        if rec.status == "paid":
+            # idempotent re-classification of the same fulfill
+            return rec.preimage if amount_msat == rec.received_msat \
+                else None
+        if t > rec.expires_at:
+            rec.status = "expired"
+            self._save(rec)
+            return None
+        if rec.payment_secret and payment_secret != rec.payment_secret:
+            return None
+        if total_msat is not None and total_msat > amount_msat:
+            return None   # partial HTLC of a multi-part payment
+        if rec.amount_msat is not None and not (
+                rec.amount_msat <= amount_msat <= 2 * rec.amount_msat):
+            return None
+        return rec.preimage
+
+    def settle(self, payment_hash: bytes, amount_msat: int,
+               now: float | None = None) -> None:
+        """Mark paid — called once the fulfill_htlc was actually sent.
+        Idempotent."""
+        rec = self.by_hash.get(payment_hash)
+        if rec is None or rec.status == "paid":
+            return
+        rec.status = "paid"
+        rec.paid_at = int(now if now is not None else time.time())
+        rec.received_msat = amount_msat
+        rec.pay_index = self._next_pay_index
+        self._next_pay_index += 1
+        self._save(rec)
+
+    # -- queries ----------------------------------------------------------
+
+    def listinvoices(self, label: str | None = None) -> list[dict]:
+        self._expire_now()
+        if label is not None:
+            rec = self.by_label.get(label)
+            return [rec.to_rpc()] if rec else []
+        return [r.to_rpc() for r in self.by_label.values()]
+
+    def _expire_now(self) -> None:
+        t = time.time()
+        for rec in self.by_label.values():
+            if rec.status == "unpaid" and t > rec.expires_at:
+                rec.status = "expired"
+                self._save(rec)

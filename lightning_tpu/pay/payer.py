@@ -1,0 +1,239 @@
+"""The payment engine: decode → route → onion → HTLC → settle.
+
+Parity target: the modern xpay path (plugins/xpay/xpay.c: route query →
+onion build → injectpaymentonion, lightningd/pay.c:1074
+send_payment_core) plus error-onion attribution
+(common/onion_message parsing of BOLT#4 failure messages) and the
+payments table (wallet_payment records, listpays surface).
+
+The route source is pluggable: direct channel (single hop), an explicit
+hop list, or a Gossmap+dijkstra query.  Failures unwrap the returned
+error onion with the per-hop shared secrets so the erring node is
+attributed (pay.c's payment_result path).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+from ..bolt import bolt11 as B11
+from ..bolt import onion_payload as OP
+from ..bolt import sphinx as SX
+from ..wire import messages as M
+
+log = logging.getLogger("lightning_tpu.pay")
+
+# BOLT#4 failure codes we name in errors (subset; PERM=0x4000,
+# NODE=0x2000, UPDATE=0x1000, BADONION=0x8000)
+FAILURE_NAMES = {
+    0x400F: "incorrect_or_unknown_payment_details",   # PERM|15
+    0x1007: "temporary_channel_failure",              # UPDATE|7
+    0x400A: "unknown_next_peer",                      # PERM|10
+    0x4016: "invalid_onion_payload",                  # PERM|22
+    0x2002: "temporary_node_failure",                 # NODE|2
+}
+
+
+class PayError(Exception):
+    def __init__(self, message: str, code: int | None = None,
+                 erring_index: int | None = None):
+        super().__init__(message)
+        self.code = code
+        self.erring_index = erring_index
+
+
+@dataclass
+class PayResult:
+    payment_hash: bytes
+    preimage: bytes
+    amount_msat: int
+    amount_sent_msat: int
+    parts: int = 1
+    status: str = "complete"
+
+    def to_rpc(self) -> dict:
+        return {
+            "payment_hash": self.payment_hash.hex(),
+            "payment_preimage": self.preimage.hex(),
+            "amount_msat": self.amount_msat,
+            "amount_sent_msat": self.amount_sent_msat,
+            "parts": self.parts,
+            "status": self.status,
+        }
+
+
+@dataclass
+class RouteStep:
+    """One hop of a payment route: forward over `scid` to `node_id`,
+    delivering amount_msat with cltv `delay` at that hop."""
+    node_id: bytes
+    scid: int
+    amount_msat: int
+    delay: int
+
+
+def route_from_gossmap(g, source: bytes, dest: bytes, amount_msat: int,
+                       final_cltv: int, blockheight: int = 0) \
+        -> tuple[list[RouteStep], int, int]:
+    """Route from `source` (our channel peer) to dest; also returns what
+    we must deliver TO source (amount, cltv) so its own fee and delta
+    are funded."""
+    from ..routing import dijkstra as DJ
+
+    hops, (src_amount, src_delay) = DJ.getroute(
+        g, source, dest, amount_msat, final_cltv=final_cltv,
+        with_source=True)
+    steps = [RouteStep(h.node_id, h.scid, h.amount_msat,
+                       blockheight + h.delay) for h in hops]
+    return steps, src_amount, blockheight + src_delay
+
+
+def build_payment_onion(route: list[RouteStep], payment_hash: bytes,
+                        payment_secret: bytes | None, total_msat: int,
+                        session_key: int):
+    """Per-hop payloads: forwards carry the NEXT hop's amount/cltv/scid;
+    the final hop carries payment_data (BOLT#4 payload semantics)."""
+    payloads = []
+    for i, step in enumerate(route):
+        if i + 1 < len(route):
+            nxt = route[i + 1]
+            payloads.append(OP.HopPayload(
+                nxt.amount_msat, nxt.delay,
+                short_channel_id=nxt.scid))
+        else:
+            payloads.append(OP.HopPayload(
+                step.amount_msat, step.delay,
+                payment_secret=payment_secret,
+                total_msat=total_msat))
+    return OP.build_route_onion(
+        [s.node_id for s in route], payloads, payment_hash,
+        session_key=session_key)
+
+
+async def pay_over_channel(ch, invoice_str: str, *,
+                           amount_msat: int | None = None,
+                           gossmap=None, source_node_id: bytes | None = None,
+                           blockheight: int = 0, wallet=None,
+                           session_key: int | None = None) -> PayResult:
+    """Pay a BOLT#11 invoice whose first hop is the given Channeld.
+
+    Route selection: direct if the channel peer IS the payee, else a
+    gossmap query from the channel peer to the payee (we prepend the
+    first hop ourselves since our own channel is not in the public map).
+    """
+    inv = B11.decode(invoice_str)
+    if inv.amount_msat is None and amount_msat is None:
+        raise PayError("invoice has no amount; amount_msat required")
+    if inv.amount_msat is not None and amount_msat is not None \
+            and amount_msat != inv.amount_msat:
+        raise PayError("amount_msat conflicts with invoice amount")
+    amount = inv.amount_msat or amount_msat
+    if time.time() > inv.expires_at:
+        raise PayError("invoice expired")
+
+    final_cltv = blockheight + inv.min_final_cltv
+    if ch.peer.node_id == inv.payee:
+        route = [RouteStep(inv.payee, 0, amount, final_cltv)]
+        amount_sent, first_cltv = amount, final_cltv
+    else:
+        if gossmap is None:
+            raise PayError(f"no route: payee {inv.payee.hex()[:16]} is not "
+                           "a direct peer and no gossip graph is loaded",
+                           code=205)
+        tail, src_amount, src_cltv = route_from_gossmap(
+            gossmap, ch.peer.node_id, inv.payee, amount,
+            inv.min_final_cltv, blockheight)
+        # hop 0 of the onion is ch.peer itself (our unannounced channel
+        # feeds the public route); we must deliver src_amount/src_cltv to
+        # it so its forwarding fee and cltv_delta are funded
+        route = [RouteStep(ch.peer.node_id, 0, src_amount, src_cltv)] + tail
+        amount_sent, first_cltv = src_amount, src_cltv
+
+    if session_key is None:
+        import os
+
+        session_key = int.from_bytes(os.urandom(32), "big") % (2**252) + 1
+    onion, secrets = build_payment_onion(
+        route, inv.payment_hash, inv.payment_secret, amount, session_key)
+
+    created = int(time.time())
+    pay_id = _record_payment(wallet, inv, invoice_str, amount, amount_sent,
+                             created)
+
+    hid = await ch.offer_htlc(amount_sent, inv.payment_hash, first_cltv,
+                              onion=onion)
+    await ch.commit()
+    await ch.handle_commit()
+    upd = await ch.recv_update()
+    await ch.handle_commit()
+    await ch.commit()
+
+    if isinstance(upd, M.UpdateFulfillHtlc):
+        _settle_payment(wallet, pay_id, upd.payment_preimage)
+        return PayResult(inv.payment_hash, upd.payment_preimage,
+                         amount, amount_sent)
+    if isinstance(upd, M.UpdateFailHtlc):
+        idx, failmsg = SX.unwrap_error_onion(secrets, upd.reason)
+        code = int.from_bytes(failmsg[:2], "big") if len(failmsg) >= 2 \
+            else None
+        name = FAILURE_NAMES.get(code, f"code {code:#x}" if code else "?")
+        _fail_payment(wallet, pay_id, name)
+        raise PayError(f"payment failed at hop {idx}: {name}",
+                       code=code, erring_index=idx)
+    _fail_payment(wallet, pay_id, f"unexpected {type(upd).__name__}")
+    raise PayError(f"unexpected update {type(upd).__name__}")
+
+
+def _record_payment(wallet, inv, bolt11_str, amount, amount_sent,
+                    created) -> int | None:
+    if wallet is None:
+        return None
+    with wallet.db.transaction():
+        cur = wallet.db.conn.execute(
+            "INSERT INTO payments (payment_hash, destination, amount_msat,"
+            " amount_sent_msat, bolt11, status, created_at)"
+            " VALUES (?,?,?,?,?,'pending',?)",
+            (inv.payment_hash, inv.payee, amount, amount_sent,
+             bolt11_str, created))
+    return cur.lastrowid
+
+
+def _settle_payment(wallet, pay_id, preimage: bytes) -> None:
+    if wallet is None or pay_id is None:
+        return
+    with wallet.db.transaction():
+        wallet.db.conn.execute(
+            "UPDATE payments SET status='complete', preimage=?,"
+            " completed_at=? WHERE id=?",
+            (preimage, int(time.time()), pay_id))
+
+
+def _fail_payment(wallet, pay_id, why: str) -> None:
+    if wallet is None or pay_id is None:
+        return
+    with wallet.db.transaction():
+        wallet.db.conn.execute(
+            "UPDATE payments SET status='failed', failure=?,"
+            " completed_at=? WHERE id=?",
+            (why, int(time.time()), pay_id))
+
+
+def listpays(wallet) -> list[dict]:
+    rows = wallet.db.conn.execute(
+        "SELECT payment_hash, destination, amount_msat, amount_sent_msat,"
+        " status, preimage, created_at, failure FROM payments"
+        " ORDER BY id").fetchall()
+    out = []
+    for r in rows:
+        d = {"payment_hash": bytes(r[0]).hex(),
+             "amount_msat": r[2], "amount_sent_msat": r[3],
+             "status": r[4], "created_at": r[6]}
+        if r[1] is not None:
+            d["destination"] = bytes(r[1]).hex()
+        if r[5] is not None:
+            d["preimage"] = bytes(r[5]).hex()
+        if r[7] is not None:
+            d["failure"] = r[7]
+        out.append(d)
+    return out

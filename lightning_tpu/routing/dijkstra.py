@@ -52,9 +52,14 @@ def getroute(g: Gossmap, source: bytes, destination: bytes,
              amount_msat: int, final_cltv: int = 18,
              riskfactor: int = DEFAULT_RISKFACTOR,
              max_hops: int = 20,
-             excluded_scids: set | None = None) -> list[RouteHop]:
+             excluded_scids: set | None = None,
+             with_source: bool = False):
     """Cheapest route source → destination delivering amount_msat.
-    Returns hops in forward order, ready for onion construction."""
+    Returns hops in forward order, ready for onion construction.
+
+    with_source=True additionally returns (amount_msat, delay) AT the
+    source — what a payer one hop before `source` must deliver to it
+    (used when our own unannounced channel feeds the public route)."""
     src = g.node_index(source)
     dst = g.node_index(destination)
     if src == dst:
@@ -136,6 +141,8 @@ def getroute(g: Gossmap, source: bytes, destination: bytes,
             delay=int(delay[v]),
         ))
         u = v
+    if with_source:
+        return route, (int(amount[src]), int(delay[src]))
     return route
 
 

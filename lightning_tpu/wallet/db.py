@@ -74,6 +74,38 @@ MIGRATIONS: list[str] = [
         PRIMARY KEY (channel_ref, slot)
     )""",
     # 5: gossip store high-water mark + misc node state live in vars
+    # (placeholder entry: the migration loop skips falsy entries, keeping
+    # comment numbers == db_version values)
+    "",
+    # 6: invoices (wallet/invoices.c table equivalent)
+    """CREATE TABLE invoices (
+        id INTEGER PRIMARY KEY,
+        label TEXT NOT NULL UNIQUE,
+        payment_hash BLOB NOT NULL UNIQUE,
+        preimage BLOB NOT NULL,
+        amount_msat INTEGER,
+        bolt11 TEXT NOT NULL,
+        description TEXT,
+        status TEXT NOT NULL DEFAULT 'unpaid',
+        expires_at INTEGER NOT NULL,
+        pay_index INTEGER,
+        paid_at INTEGER,
+        received_msat INTEGER
+    )""",
+    # 7: outgoing payments (wallet_payment / listpays store)
+    """CREATE TABLE payments (
+        id INTEGER PRIMARY KEY,
+        payment_hash BLOB NOT NULL,
+        destination BLOB,
+        amount_msat INTEGER NOT NULL,
+        amount_sent_msat INTEGER NOT NULL,
+        bolt11 TEXT,
+        status TEXT NOT NULL DEFAULT 'pending',
+        preimage BLOB,
+        created_at INTEGER NOT NULL,
+        completed_at INTEGER,
+        failure TEXT
+    )""",
 ]
 
 

@@ -152,7 +152,7 @@ def test_non_keysend_htlc_fails_with_real_error_onion():
     )
     lh = LiveHtlc(Htlc(False, 5_000_000, payment_hash, 500_000, id=0),
                   HtlcState.RCVD_ADD_ACK_REVOCATION, onion=onion)
-    verdict, blob = CD._classify_keysend(lh, node_priv)
+    verdict, blob = CD.classify_incoming(lh, node_priv)
     assert verdict == "fail"
     idx, msg = sphinx.unwrap_error_onion(secrets, blob)
     assert idx == 0
@@ -164,6 +164,6 @@ def test_non_keysend_htlc_fails_with_real_error_onion():
     lh_bad = LiveHtlc(Htlc(False, 1, payment_hash, 1, id=1),
                       HtlcState.RCVD_ADD_ACK_REVOCATION,
                       onion=b"\x00" * 1366)
-    verdict, code = CD._classify_keysend(lh_bad, node_priv)
+    verdict, code = CD.classify_incoming(lh_bad, node_priv)
     assert verdict == "malformed"
     assert code & CD.BADONION
