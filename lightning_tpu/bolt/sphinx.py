@@ -47,6 +47,13 @@ def generate_key(key_type: bytes, secret: bytes) -> bytes:
     return _hmac(key_type, secret)
 
 
+def random_session_key() -> int:
+    """A fresh sphinx session scalar (shared by every onion builder)."""
+    import os
+
+    return int.from_bytes(os.urandom(32), "big") % (2 ** 252) + 1
+
+
 def cipher_stream(key: bytes, length: int) -> bytes:
     """ChaCha20 keystream with a zero 96-bit nonce from counter 0."""
     c = Cipher(algorithms.ChaCha20(key, b"\x00" * 16), mode=None)

@@ -713,9 +713,11 @@ BADONION, PERM = 0x8000, 0x4000
 INVALID_ONION_HMAC = BADONION | PERM | 5
 INVALID_ONION_PAYLOAD = PERM | 22
 INCORRECT_OR_UNKNOWN_PAYMENT_DETAILS = PERM | 15
+FINAL_INCORRECT_CLTV_EXPIRY = 18
 
 
-def classify_incoming(lh, node_privkey: int, invoices=None):
+def classify_incoming(lh, node_privkey: int, invoices=None,
+                      blockheight: int = 0):
     """Peel an incoming HTLC's onion and decide its fate
     (plugins/keysend.c + lightningd/invoice.c `invoice_payment` +
     lightningd/peer_htlcs.c semantics).
@@ -759,6 +761,14 @@ def classify_incoming(lh, node_privkey: int, invoices=None):
         return ("fulfill", payload.keysend_preimage)
     if (payload.is_final and invoices is not None
             and payload.amt_to_forward_msat <= lh.htlc.amount_msat):
+        # BOLT#4 final_incorrect_cltv_expiry: an HTLC that can expire
+        # too soon must not release the preimage (invoice.c rejects it)
+        min_cltv = blockheight + getattr(invoices, "min_final_cltv", 18)
+        if lh.htlc.cltv_expiry < min_cltv:
+            failmsg = (FINAL_INCORRECT_CLTV_EXPIRY.to_bytes(2, "big")
+                       + lh.htlc.cltv_expiry.to_bytes(4, "big"))
+            return ("fail", SX.create_error_onion(peeled_raw.shared_secret,
+                                                  failmsg))
         preimage = invoices.resolve_htlc(
             lh.htlc.payment_hash, lh.htlc.amount_msat,
             payload.payment_secret, payload.total_msat)
@@ -838,13 +848,15 @@ async def keysend_pay_and_close(ch: Channeld, amount_msat: int,
     settle, cooperatively close.  Returns (preimage, closing tx)."""
     from ..bolt import onion_payload as OP
 
+    from ..bolt import sphinx as SX
+
     preimage = os.urandom(32)
     payment_hash = hashlib.sha256(preimage).digest()
     onion, _ = OP.build_route_onion(
         [dest_node_id],
         [OP.HopPayload(amount_msat, 500_000, keysend_preimage=preimage)],
         payment_hash,
-        session_key=int.from_bytes(os.urandom(32), "big") % (2**252) + 1,
+        session_key=SX.random_session_key(),
     )
     await ch.offer_htlc(amount_msat, payment_hash, cltv_expiry=500_000,
                         onion=onion)

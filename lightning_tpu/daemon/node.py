@@ -40,6 +40,7 @@ class LightningNode:
                          else feat.from_bits(feat.DEFAULT_FEATURES))
         self.peers: dict[bytes, Peer] = {}
         self.handlers: dict[type, object] = {}
+        self.raw_handlers: dict[int, object] = {}  # msg type -> fn(peer, raw)
         self.on_peer = None  # async callback(peer) run for each new peer
         self._server: asyncio.AbstractServer | None = None
         self._peer_tasks: set[asyncio.Task] = set()

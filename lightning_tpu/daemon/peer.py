@@ -99,11 +99,20 @@ class Peer:
         finally:
             await self._disconnected()
 
+    async def send_raw(self, raw: bytes) -> None:
+        """Forward pre-serialized bytes (gossip fan-out path: connectd
+        streams store records without re-encoding)."""
+        await self.stream.send_msg(raw)
+
     async def _handle_raw(self, raw: bytes) -> None:
         try:
             t = codec.msg_type(raw)
         except codec.WireError:
             return  # runt frame; BOLT#1 says ignore
+        raw_handler = self.node.raw_handlers.get(t)
+        if raw_handler is not None:
+            await raw_handler(self, raw)
+            return
         cls = codec.MessageMeta.registry.get(t)
         if cls is None:
             if t % 2 == 0:

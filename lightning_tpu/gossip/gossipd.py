@@ -1,0 +1,262 @@
+"""The gossipd service: live peer gossip in/out around the batched
+verifier.
+
+Parity targets:
+ - connectd/multiplex.c:829 `handle_gossip_in` (peer bytes → gossipd)
+   and :599 `wake_gossip` (store → every peer, filtered) — here the
+   ingest's on_accept fan-out plus gossip_timestamp_filter state.
+ - gossipd/queries.c + connectd/queries.c: query_channel_range /
+   query_short_channel_ids / reply handling (BOLT#7 encoding type 0).
+ - gossipd/seeker.c:28: the catch-up state machine a fresh node runs
+   against its first peer (filter → range query → scid query → ingest).
+
+The crypto-heavy part stays in GossipIngest (batched TPU kernels);
+this module is the host-side shell that makes it a daemon.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import time
+
+from ..wire import messages as M
+from . import wire as gwire
+from .ingest import GossipIngest
+
+log = logging.getLogger("lightning_tpu.gossipd")
+
+ENC_UNCOMPRESSED = 0
+
+
+def encode_scids(scids: list[int]) -> bytes:
+    return bytes([ENC_UNCOMPRESSED]) + b"".join(
+        s.to_bytes(8, "big") for s in sorted(scids))
+
+
+def decode_scids(blob: bytes) -> list[int]:
+    if not blob:
+        return []
+    if blob[0] != ENC_UNCOMPRESSED:
+        raise ValueError(f"unsupported scid encoding {blob[0]}")
+    body = blob[1:]
+    if len(body) % 8:
+        raise ValueError("ragged encoded_short_ids")
+    return [int.from_bytes(body[i:i + 8], "big")
+            for i in range(0, len(body), 8)]
+
+
+def scid_block(scid: int) -> int:
+    return scid >> 40
+
+
+class Gossipd:
+    """Attach to a LightningNode: ingest, answer queries, stream out."""
+
+    def __init__(self, node, store_path: str,
+                 chain_hash: bytes = gwire.MAINNET_CHAIN_HASH,
+                 utxo_check=None, flush_ms: float = 2.0,
+                 flush_size: int = 256, bucket: int = 64):
+        self.node = node
+        self.chain_hash = chain_hash
+        self.ingest = GossipIngest(
+            store_path, utxo_check=utxo_check, flush_ms=flush_ms,
+            flush_size=flush_size, bucket=bucket,
+            on_accept=self._on_accept)
+        # raw message cache for query replies (the store is the durable
+        # copy; this is the reference's gossmap offset index role)
+        self.msgs: dict[int, dict] = {}       # scid -> {ca, cu0, cu1}
+        self.node_msgs: dict[bytes, bytes] = {}  # node_id -> na raw
+        self.filters: dict[bytes, tuple[int, int]] = {}  # peer -> (t0, dt)
+        self._synced: dict[bytes, asyncio.Event] = {}
+
+        for t in (gwire.MSG_CHANNEL_ANNOUNCEMENT,
+                  gwire.MSG_NODE_ANNOUNCEMENT, gwire.MSG_CHANNEL_UPDATE):
+            node.raw_handlers[t] = self._on_gossip
+        node.register(M.QueryChannelRange, self._on_query_range)
+        node.register(M.ReplyChannelRange, self._on_reply_range)
+        node.register(M.QueryShortChannelIds, self._on_query_scids)
+        node.register(M.ReplyShortChannelIdsEnd, self._on_scids_end)
+        node.register(M.GossipTimestampFilter, self._on_filter)
+
+    def load_existing(self, store_path: str, verify: bool = False) -> int:
+        """Rebuild the in-memory view from an existing store (restart
+        path; common/gossmap.c:749's load role).  verify=True replays
+        every signature through the batched kernels first
+        (tools/bench-gossipd.sh's store_load workload)."""
+        import os
+
+        from . import store as gstore
+
+        if not os.path.exists(store_path):
+            return 0
+        idx = gstore.load_store(store_path)
+        alive = idx.select(idx.alive())
+        if verify:
+            from . import verify as gverify
+
+            res = gverify.verify_store(alive)
+            if not (res.ca_valid.all() and res.cu_valid.all()
+                    and res.na_valid.all()):
+                raise ValueError("store failed replay verification")
+        n = 0
+        for i in range(len(alive)):
+            raw = alive.message(i)
+            try:
+                p = gwire.parse_gossip(raw)
+            except Exception:
+                continue
+            t = gwire.msg_type(raw)
+            ing = self.ingest
+            if t == gwire.MSG_CHANNEL_ANNOUNCEMENT:
+                ing.channels[p.short_channel_id] = (p.node_id_1, p.node_id_2)
+                ing._channeled_nodes.update((p.node_id_1, p.node_id_2))
+                self.msgs.setdefault(p.short_channel_id, {})["ca"] = raw
+            elif t == gwire.MSG_CHANNEL_UPDATE:
+                key = (p.short_channel_id, p.direction)
+                if ing.updates.get(key, -1) < p.timestamp:
+                    ing.updates[key] = p.timestamp
+                    self.msgs.setdefault(p.short_channel_id, {})[
+                        f"cu{p.direction}"] = raw
+            else:
+                if ing.nodes.get(p.node_id, -1) < p.timestamp:
+                    ing.nodes[p.node_id] = p.timestamp
+                    self.node_msgs[p.node_id] = raw
+            n += 1
+        return n
+
+    def start(self) -> None:
+        self.ingest.start()
+
+    async def close(self) -> None:
+        await self.ingest.close()
+
+    # -- ingest + fan-out -------------------------------------------------
+
+    async def _on_gossip(self, peer, raw: bytes) -> None:
+        await self.ingest.submit(raw, source=peer.node_id)
+
+    def _on_accept(self, raw: bytes, source) -> None:
+        t = gwire.msg_type(raw)
+        p = gwire.parse_gossip(raw)
+        if t == gwire.MSG_CHANNEL_ANNOUNCEMENT:
+            self.msgs.setdefault(p.short_channel_id, {})["ca"] = raw
+        elif t == gwire.MSG_CHANNEL_UPDATE:
+            self.msgs.setdefault(p.short_channel_id, {})[
+                f"cu{p.direction}"] = raw
+        else:
+            self.node_msgs[p.node_id] = raw
+        ts = getattr(p, "timestamp", int(time.time()))
+        loop = asyncio.get_event_loop()
+        for peer in list(self.node.peers.values()):
+            if peer.node_id == source or not peer.connected:
+                continue
+            flt = self.filters.get(peer.node_id)
+            if flt is None:
+                continue      # peer never asked for gossip
+            t0, dt = flt
+            if t == gwire.MSG_CHANNEL_ANNOUNCEMENT or t0 <= ts < t0 + dt:
+                loop.create_task(peer.send_raw(raw))
+
+    # -- query answering (gossipd/queries.c) ------------------------------
+
+    async def _on_query_range(self, peer, msg: M.QueryChannelRange) -> None:
+        lo = msg.first_blocknum
+        hi = lo + msg.number_of_blocks
+        scids = [s for s in self.ingest.channels
+                 if lo <= scid_block(s) < hi]
+        await peer.send(M.ReplyChannelRange(
+            chain_hash=msg.chain_hash, first_blocknum=lo,
+            number_of_blocks=msg.number_of_blocks, sync_complete=1,
+            encoded_short_ids=encode_scids(scids)))
+
+    async def _on_query_scids(self, peer,
+                              msg: M.QueryShortChannelIds) -> None:
+        try:
+            scids = decode_scids(msg.encoded_short_ids)
+        except ValueError:
+            await peer.send(M.ReplyShortChannelIdsEnd(
+                chain_hash=msg.chain_hash, full_information=0))
+            return
+        full = 1
+        sent_nodes: set[bytes] = set()
+        for s in scids:
+            entry = self.msgs.get(s)
+            if entry is None or "ca" not in entry:
+                full = 0
+                continue
+            await peer.send_raw(entry["ca"])
+            for k in ("cu0", "cu1"):
+                if k in entry:
+                    await peer.send_raw(entry[k])
+            for nid in self.ingest.channels.get(s, ()):
+                na = self.node_msgs.get(nid)
+                if na is not None and nid not in sent_nodes:
+                    sent_nodes.add(nid)
+                    await peer.send_raw(na)
+        await peer.send(M.ReplyShortChannelIdsEnd(
+            chain_hash=msg.chain_hash, full_information=full))
+
+    async def _on_filter(self, peer, msg: M.GossipTimestampFilter) -> None:
+        self.filters[peer.node_id] = (msg.first_timestamp,
+                                      msg.timestamp_range)
+        # backfill everything already accepted that matches (connectd's
+        # store-streaming role, simplified to the in-memory index)
+        t0, dt = msg.first_timestamp, msg.timestamp_range
+        for entry in list(self.msgs.values()):
+            ca = entry.get("ca")
+            if ca is not None:
+                await peer.send_raw(ca)
+            for k in ("cu0", "cu1"):
+                raw = entry.get(k)
+                if raw is None:
+                    continue
+                ts = gwire.parse_gossip(raw).timestamp
+                if t0 <= ts < t0 + dt:
+                    await peer.send_raw(raw)
+        for raw in list(self.node_msgs.values()):
+            ts = gwire.parse_gossip(raw).timestamp
+            if t0 <= ts < t0 + dt:
+                await peer.send_raw(raw)
+
+    # -- seeker (gossipd/seeker.c) ----------------------------------------
+
+    async def sync_with(self, peer, first_blocknum: int = 0,
+                        number_of_blocks: int = 0xFFFFFFFF,
+                        backfill_from: int = 0,
+                        timeout: float = 30.0) -> int:
+        """Catch up from one peer: set a timestamp filter, learn its scid
+        set, fetch the ones we don't know.  Returns #scids requested."""
+        evt = asyncio.Event()
+        self._synced[peer.node_id] = evt
+        self._requested = 0
+        await peer.send(M.GossipTimestampFilter(
+            chain_hash=self.chain_hash, first_timestamp=backfill_from,
+            timestamp_range=0xFFFFFFFF))
+        await peer.send(M.QueryChannelRange(
+            chain_hash=self.chain_hash, first_blocknum=first_blocknum,
+            number_of_blocks=number_of_blocks))
+        await asyncio.wait_for(evt.wait(), timeout)
+        return self._requested
+
+    async def _on_reply_range(self, peer, msg: M.ReplyChannelRange) -> None:
+        try:
+            theirs = decode_scids(msg.encoded_short_ids)
+        except ValueError:
+            return
+        missing = [s for s in theirs if s not in self.ingest.channels]
+        self._requested = len(missing)
+        if missing:
+            await peer.send(M.QueryShortChannelIds(
+                chain_hash=msg.chain_hash,
+                encoded_short_ids=encode_scids(missing)))
+        elif msg.sync_complete:
+            evt = self._synced.get(peer.node_id)
+            if evt is not None:
+                evt.set()
+
+    async def _on_scids_end(self, peer,
+                            msg: M.ReplyShortChannelIdsEnd) -> None:
+        evt = self._synced.get(peer.node_id)
+        if evt is not None:
+            evt.set()

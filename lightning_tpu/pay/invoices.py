@@ -71,14 +71,19 @@ class InvoiceRegistry:
         rows = self.db.conn.execute(
             "SELECT label, payment_hash, preimage, amount_msat, bolt11,"
             " description, status, expires_at, pay_index, paid_at,"
-            " received_msat FROM invoices").fetchall()
+            " received_msat, payment_secret FROM invoices").fetchall()
         for r in rows:
-            inv = bolt11.decode(r[4], check_sig=False)
+            if r[11] is not None:
+                secret = bytes(r[11])
+            else:
+                # pre-migration-8 row: fall back to decoding the invoice
+                inv = bolt11.decode(r[4], check_sig=False)
+                secret = inv.payment_secret or b""
             rec = InvoiceRecord(
                 label=r[0], payment_hash=bytes(r[1]), preimage=bytes(r[2]),
                 amount_msat=r[3], bolt11=r[4], description=r[5] or "",
                 status=r[6], expires_at=r[7],
-                payment_secret=inv.payment_secret or b"",
+                payment_secret=secret,
                 pay_index=r[8], paid_at=r[9], received_msat=r[10])
             self.by_hash[rec.payment_hash] = rec
             self.by_label[rec.label] = rec
@@ -93,14 +98,15 @@ class InvoiceRegistry:
             self.db.conn.execute(
                 "INSERT INTO invoices (label, payment_hash, preimage,"
                 " amount_msat, bolt11, description, status, expires_at,"
-                " pay_index, paid_at, received_msat)"
-                " VALUES (?,?,?,?,?,?,?,?,?,?,?)"
+                " pay_index, paid_at, received_msat, payment_secret)"
+                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?)"
                 " ON CONFLICT(label) DO UPDATE SET status=excluded.status,"
                 " pay_index=excluded.pay_index, paid_at=excluded.paid_at,"
                 " received_msat=excluded.received_msat",
                 (rec.label, rec.payment_hash, rec.preimage, rec.amount_msat,
                  rec.bolt11, rec.description, rec.status, rec.expires_at,
-                 rec.pay_index, rec.paid_at, rec.received_msat))
+                 rec.pay_index, rec.paid_at, rec.received_msat,
+                 rec.payment_secret))
 
     # -- creation ---------------------------------------------------------
 

@@ -141,9 +141,19 @@ async def pay_over_channel(ch, invoice_str: str, *,
             raise PayError(f"no route: payee {inv.payee.hex()[:16]} is not "
                            "a direct peer and no gossip graph is loaded",
                            code=205)
-        tail, src_amount, src_cltv = route_from_gossmap(
-            gossmap, ch.peer.node_id, inv.payee, amount,
-            inv.min_final_cltv, blockheight)
+        try:
+            tail, src_amount, src_cltv = route_from_gossmap(
+                gossmap, ch.peer.node_id, inv.payee, amount,
+                inv.min_final_cltv, blockheight)
+        except KeyError as e:
+            raise PayError(f"no route: {e.args[0] if e.args else e}",
+                           code=205) from e
+        except Exception as e:
+            from ..routing.dijkstra import NoRoute
+
+            if isinstance(e, NoRoute):
+                raise PayError(f"no route: {e}", code=205) from e
+            raise
         # hop 0 of the onion is ch.peer itself (our unannounced channel
         # feeds the public route); we must deliver src_amount/src_cltv to
         # it so its forwarding fee and cltv_delta are funded
@@ -151,30 +161,39 @@ async def pay_over_channel(ch, invoice_str: str, *,
         amount_sent, first_cltv = src_amount, src_cltv
 
     if session_key is None:
-        import os
-
-        session_key = int.from_bytes(os.urandom(32), "big") % (2**252) + 1
+        session_key = SX.random_session_key()
     onion, secrets = build_payment_onion(
         route, inv.payment_hash, inv.payment_secret, amount, session_key)
 
     created = int(time.time())
     pay_id = _record_payment(wallet, inv, invoice_str, amount, amount_sent,
                              created)
-
-    hid = await ch.offer_htlc(amount_sent, inv.payment_hash, first_cltv,
-                              onion=onion)
-    await ch.commit()
-    await ch.handle_commit()
-    upd = await ch.recv_update()
-    await ch.handle_commit()
-    await ch.commit()
+    # ANY exit below must resolve the payments row — a row stuck at
+    # 'pending' is the reference's cardinal sin (wallet_payment states
+    # are the restart-recovery source of truth)
+    try:
+        await ch.offer_htlc(amount_sent, inv.payment_hash, first_cltv,
+                            onion=onion)
+        await ch.commit()
+        await ch.handle_commit()
+        upd = await ch.recv_update()
+        await ch.handle_commit()
+        await ch.commit()
+    except Exception as e:
+        _fail_payment(wallet, pay_id, f"{type(e).__name__}: {e}")
+        raise PayError(f"payment dance failed: {e}") from e
 
     if isinstance(upd, M.UpdateFulfillHtlc):
         _settle_payment(wallet, pay_id, upd.payment_preimage)
         return PayResult(inv.payment_hash, upd.payment_preimage,
                          amount, amount_sent)
     if isinstance(upd, M.UpdateFailHtlc):
-        idx, failmsg = SX.unwrap_error_onion(secrets, upd.reason)
+        try:
+            idx, failmsg = SX.unwrap_error_onion(secrets, upd.reason)
+        except SX.SphinxError as e:
+            _fail_payment(wallet, pay_id, "unparseable error onion")
+            raise PayError(f"failed with unparseable error onion: {e}") \
+                from e
         code = int.from_bytes(failmsg[:2], "big") if len(failmsg) >= 2 \
             else None
         name = FAILURE_NAMES.get(code, f"code {code:#x}" if code else "?")

@@ -1,0 +1,140 @@
+"""Plugin-side framework: write a plugin as decorated Python functions.
+
+Parity target: plugins/libplugin.c (the C framework all in-tree plugins
+link against) / contrib/pyln-client's Plugin class — manifest
+generation, the getmanifest/init dance, method/hook/subscription
+dispatch over the stdin/stdout `\\n\\n`-separated JSON-RPC transport.
+
+Usage (an executable python file):
+
+    from lightning_tpu.plugins.libplugin import Plugin
+    p = Plugin()
+
+    @p.method("hello")
+    def hello(name="world"):
+        return {"greeting": f"hello {name}"}
+
+    @p.hook("htlc_accepted")
+    def on_htlc(onion, htlc, **kw):
+        return {"result": "continue"}
+
+    @p.subscribe("block_added")
+    def on_block(block_added):
+        ...
+
+    p.run()
+"""
+from __future__ import annotations
+
+import inspect
+import json
+import sys
+
+
+class Plugin:
+    def __init__(self, dynamic: bool = True):
+        self.methods: dict[str, object] = {}
+        self.method_descs: list[dict] = []
+        self.hooks: dict[str, object] = {}
+        self.subs: dict[str, object] = {}
+        self.options: list[dict] = []
+        self.option_values: dict[str, object] = {}
+        self.dynamic = dynamic
+        self.configuration: dict = {}
+        self.on_init = None
+
+    # -- registration decorators -----------------------------------------
+
+    def method(self, name: str, description: str = ""):
+        def deco(fn):
+            self.methods[name] = fn
+            self.method_descs.append(
+                {"name": name, "usage": " ".join(
+                    inspect.signature(fn).parameters),
+                 "description": description or (fn.__doc__ or "")})
+            return fn
+
+        return deco
+
+    def hook(self, name: str):
+        def deco(fn):
+            self.hooks[name] = fn
+            return fn
+
+        return deco
+
+    def subscribe(self, topic: str):
+        def deco(fn):
+            self.subs[topic] = fn
+            return fn
+
+        return deco
+
+    def add_option(self, name: str, default=None, description: str = "",
+                   opt_type: str = "string") -> None:
+        self.options.append({"name": name, "type": opt_type,
+                             "default": default,
+                             "description": description})
+
+    # -- the stdio loop ---------------------------------------------------
+
+    def _manifest(self) -> dict:
+        return {
+            "options": self.options,
+            "rpcmethods": self.method_descs,
+            "hooks": [{"name": h} for h in self.hooks],
+            "subscriptions": list(self.subs),
+            "dynamic": self.dynamic,
+        }
+
+    def _dispatch(self, req: dict):
+        method = req["method"]
+        params = req.get("params") or {}
+        if method == "getmanifest":
+            return self._manifest()
+        if method == "init":
+            self.option_values = params.get("options", {})
+            self.configuration = params.get("configuration", {})
+            if self.on_init is not None:
+                self.on_init(self)
+            return {}
+        fn = self.methods.get(method) or self.hooks.get(method)
+        if fn is None:
+            raise ValueError(f"unknown method {method!r}")
+        if isinstance(params, list):
+            return fn(*params)
+        return fn(**params)
+
+    def run(self, infile=None, outfile=None) -> None:
+        fin = infile or sys.stdin.buffer
+        fout = outfile or sys.stdout.buffer
+        buf = b""
+        while True:
+            chunk = fin.read1(65536) if hasattr(fin, "read1") \
+                else fin.read(65536)
+            if not chunk:
+                return
+            buf += chunk
+            while b"\n\n" in buf:
+                raw, buf = buf.split(b"\n\n", 1)
+                if not raw.strip():
+                    continue
+                req = json.loads(raw)
+                rid = req.get("id")
+                if rid is None:
+                    # notification
+                    fn = self.subs.get(req["method"])
+                    if fn is not None:
+                        try:
+                            fn(**(req.get("params") or {}))
+                        except Exception:
+                            pass
+                    continue
+                try:
+                    result = self._dispatch(req)
+                    resp = {"jsonrpc": "2.0", "id": rid, "result": result}
+                except Exception as e:
+                    resp = {"jsonrpc": "2.0", "id": rid,
+                            "error": {"code": -32603, "message": str(e)}}
+                fout.write(json.dumps(resp).encode() + b"\n\n")
+                fout.flush()

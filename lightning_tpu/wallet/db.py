@@ -106,6 +106,9 @@ MIGRATIONS: list[str] = [
         completed_at INTEGER,
         failure TEXT
     )""",
+    # 8: store the payment_secret directly (re-deriving it by decoding
+    # the bolt11 string on load was costly and fragile)
+    "ALTER TABLE invoices ADD COLUMN payment_secret BLOB",
 ]
 
 

@@ -1,0 +1,19 @@
+#!/usr/bin/env python3
+"""Plugin that dies right after init (crash-handling test)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from lightning_tpu.plugins.libplugin import Plugin  # noqa: E402
+
+p = Plugin()
+
+
+@p.method("abouttodie")
+def abouttodie():
+    os._exit(7)
+
+
+if __name__ == "__main__":
+    p.run()

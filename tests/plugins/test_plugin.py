@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""Test plugin: one method, one hook, one subscription, one option.
+(The role of the reference's tests/plugins/*.py helper plugins.)"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from lightning_tpu.plugins.libplugin import Plugin  # noqa: E402
+
+p = Plugin()
+p.add_option("greeting-word", default="hello", description="what to say")
+SEEN = {"blocks": []}
+
+
+@p.method("testgreet", description="greet someone")
+def testgreet(name="world"):
+    word = p.option_values.get("greeting-word", "hello")
+    return {"greeting": f"{word} {name}"}
+
+
+@p.method("testseen")
+def testseen():
+    return {"blocks": SEEN["blocks"]}
+
+
+@p.hook("htlc_accepted")
+def on_htlc(htlc=None, onion=None, **kw):
+    if htlc and htlc.get("payment_hash", "").startswith("ff"):
+        return {"result": "fail", "failure_message": "400f"}
+    return {"result": "continue"}
+
+
+@p.subscribe("block_added")
+def on_block(block_added=None, **kw):
+    SEEN["blocks"].append(block_added.get("height"))
+
+
+if __name__ == "__main__":
+    p.run()

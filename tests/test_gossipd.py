@@ -1,0 +1,140 @@
+"""Gossipd service: BOLT#7 queries, seeker sync, live fan-out between
+real nodes over TCP+Noise.
+
+Parity: gossipd/queries.c + seeker.c + connectd's gossip streaming.
+"""
+import asyncio
+
+import pytest
+
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.gossip import gossipd as GD
+from lightning_tpu.gossip import store as gstore
+from lightning_tpu.gossip import wire as gwire
+from tests.test_ingest import K1, K2, K3, make_ca, make_cu, make_na, pub
+
+SCID_A = (500_000 << 40) | (1 << 16)
+SCID_B = (600_000 << 40) | (2 << 16)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 120))
+
+
+def seed_store(path: str) -> list[bytes]:
+    msgs = [
+        make_ca(K1, K2, SCID_A),
+        make_cu(K1, K2, SCID_A, 0, ts=100),
+        make_cu(K1, K2, SCID_A, 1, ts=101),
+        make_ca(K2, K3, SCID_B),
+        make_cu(K2, K3, SCID_B, 0, ts=102),
+        make_na(K2, ts=103),
+    ]
+    w = gstore.StoreWriter(path)
+    for m in msgs:
+        w.append(m, timestamp=100)
+    w.close()
+    return msgs
+
+
+def test_scid_codec():
+    scids = [SCID_A, SCID_B, 42]
+    assert GD.decode_scids(GD.encode_scids(scids)) == sorted(scids)
+    with pytest.raises(ValueError):
+        GD.decode_scids(b"\x01\x00")
+    assert GD.decode_scids(b"") == []
+
+
+def test_load_existing_and_verify(tmp_path):
+    async def body():
+        src = str(tmp_path / "seed.gs")
+        seed_store(src)
+        node = LightningNode(privkey=0x9101)
+        gd = GD.Gossipd(node, str(tmp_path / "live.gs"))
+        n = gd.load_existing(src, verify=True)
+        assert n == 6
+        assert set(gd.ingest.channels) == {SCID_A, SCID_B}
+        assert pub(K2) in gd.node_msgs
+
+    run(body())
+
+
+def test_seeker_sync_and_live_fanout(tmp_path):
+    async def body():
+        # node A: seeded gossipd; node B: empty, syncs from A
+        na, nb = LightningNode(privkey=0xA111), LightningNode(privkey=0xB222)
+        seed = str(tmp_path / "seed.gs")
+        seed_store(seed)
+        ga = GD.Gossipd(na, str(tmp_path / "a.gs"), flush_ms=1.0)
+        ga.load_existing(seed)
+        gb = GD.Gossipd(nb, str(tmp_path / "b.gs"), flush_ms=1.0)
+        ga.start()
+        gb.start()
+        try:
+            port = await na.listen()
+            peer_ba = await nb.connect("127.0.0.1", port, na.node_id)
+
+            requested = await gb.sync_with(peer_ba, timeout=60)
+            assert requested == 2          # both channels unknown to B
+            # B's ingest must verify + accept everything A served
+            # (channel_updates drain from pending after their CA lands)
+            def caught_up():
+                return (len(gb.ingest.channels) == 2 and gb.node_msgs
+                        and gb.ingest.updates.get((SCID_A, 0)) == 100
+                        and (SCID_B, 0) in gb.ingest.updates)
+
+            for _ in range(400):
+                if caught_up():
+                    break
+                await asyncio.sleep(0.05)
+            assert set(gb.ingest.channels) == {SCID_A, SCID_B}
+            assert gb.ingest.updates[(SCID_A, 0)] == 100
+            assert pub(K2) in gb.node_msgs
+            # B's OWN store now has the records (durable resync source)
+            await gb.ingest.drain()
+            idx = gstore.load_store(str(tmp_path / "b.gs"))
+            assert len(idx) == 6
+
+            # live fan-out: new update ingested at A streams to B
+            # (B's timestamp filter was set by sync_with)
+            newer = make_cu(K1, K2, SCID_A, 0, ts=200)
+            await ga.ingest.submit(newer)
+            for _ in range(200):
+                if gb.ingest.updates.get((SCID_A, 0)) == 200:
+                    break
+                await asyncio.sleep(0.05)
+            assert gb.ingest.updates[(SCID_A, 0)] == 200
+        finally:
+            await ga.close()
+            await gb.close()
+            await na.close()
+            await nb.close()
+
+    run(body())
+
+
+def test_query_range_block_filter(tmp_path):
+    async def body():
+        na, nb = LightningNode(privkey=0xA333), LightningNode(privkey=0xB444)
+        seed = str(tmp_path / "seed.gs")
+        seed_store(seed)
+        ga = GD.Gossipd(na, str(tmp_path / "a.gs"))
+        ga.load_existing(seed)
+        # nb stays a PLAIN node so replies land in the peer inbox
+        try:
+            port = await na.listen()
+            peer = await nb.connect("127.0.0.1", port, na.node_id)
+            from lightning_tpu.wire import messages as M
+
+            # only blocks [500000, 500001): SCID_A alone
+            await peer.send(M.QueryChannelRange(
+                chain_hash=gwire.MAINNET_CHAIN_HASH,
+                first_blocknum=500_000, number_of_blocks=1))
+            reply = await peer.recv(M.ReplyChannelRange, timeout=10)
+            assert GD.decode_scids(reply.encoded_short_ids) == [SCID_A]
+            assert reply.sync_complete == 1
+        finally:
+            await na.close()
+            await nb.close()
+
+    run(body())

@@ -1,0 +1,97 @@
+"""Plugin host: manifest lifecycle, method proxy, hooks, notifications,
+crash handling.  Parity: lightningd/plugin.c + plugin_hook.c.
+"""
+import asyncio
+import os
+import stat
+
+import pytest
+
+from lightning_tpu.daemon.jsonrpc import JsonRpcServer
+from lightning_tpu.plugins.host import PluginError, PluginHost
+
+HERE = os.path.dirname(__file__)
+TEST_PLUGIN = os.path.join(HERE, "plugins", "test_plugin.py")
+CRASH_PLUGIN = os.path.join(HERE, "plugins", "crash_plugin.py")
+
+
+def setup_module(mod):
+    for p in (TEST_PLUGIN, CRASH_PLUGIN):
+        os.chmod(p, os.stat(p).st_mode | stat.S_IEXEC)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60))
+
+
+def test_manifest_and_method_proxy(tmp_path):
+    async def body():
+        rpc = JsonRpcServer(str(tmp_path / "rpc.sock"))
+        host = PluginHost(rpc, init_options={"greeting-word": "hoi"})
+        p = await host.start_plugin(TEST_PLUGIN)
+        assert "htlc_accepted" in p.manifest.hooks
+        assert p.manifest.dynamic
+        # method registered into the rpc table and proxied
+        assert "testgreet" in rpc.methods
+        out = await rpc.methods["testgreet"](name="ln")
+        assert out == {"greeting": "hoi ln"}
+        await host.close()
+
+    run(body())
+
+
+def test_hook_chain_and_short_circuit(tmp_path):
+    async def body():
+        host = PluginHost()
+        await host.start_plugin(TEST_PLUGIN)
+        res = await host.call_hook(
+            "htlc_accepted", {"htlc": {"payment_hash": "aa" * 32}})
+        assert res == {"result": "continue"}
+        res = await host.call_hook(
+            "htlc_accepted", {"htlc": {"payment_hash": "ff" + "0" * 62}})
+        assert res["result"] == "fail"
+        # unsubscribed hook: continue by default
+        res = await host.call_hook("peer_connected", {})
+        assert res == {"result": "continue"}
+        await host.close()
+
+    run(body())
+
+
+def test_notifications(tmp_path):
+    async def body():
+        host = PluginHost()
+        p = await host.start_plugin(TEST_PLUGIN)
+        host.notify("block_added", {"height": 101})
+        host.notify("block_added", {"height": 102})
+        for _ in range(50):
+            seen = await p.call("testseen")
+            if len(seen["blocks"]) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert seen["blocks"] == [101, 102]
+        await host.close()
+
+    run(body())
+
+
+def test_crash_detected_and_deregistered(tmp_path):
+    async def body():
+        rpc = JsonRpcServer(str(tmp_path / "rpc.sock"))
+        host = PluginHost(rpc)
+        crashes = []
+        host.on_crash = crashes.append
+        p = await host.start_plugin(CRASH_PLUGIN)
+        assert "abouttodie" in rpc.methods
+        with pytest.raises(PluginError):
+            await p.call("abouttodie")
+        for _ in range(50):
+            if crashes:
+                break
+            await asyncio.sleep(0.05)
+        assert crashes and crashes[0].name == "crash_plugin.py"
+        assert "abouttodie" not in rpc.methods
+        assert "crash_plugin.py" not in host.plugins
+        await host.close()
+
+    run(body())
