@@ -1,0 +1,230 @@
+"""BOLT#4 route blinding: blinded paths for onion messages and payments.
+
+Functional parity target: the reference's common/blindedpath.c (path
+construction + unblinding) and common/blindedpay.c — re-implemented from
+the BOLT#4 "Route Blinding" spec text.
+
+Construction: the builder picks a path-key scalar e0 and, walking the
+route, derives per-hop shared secrets ss_i = H(e_i * P_i).  Each hop's
+real node id P_i is tweaked into a blinded id
+B_i = HMAC("blinded_node_id", ss_i) * P_i, its per-hop routing payload is
+sealed with ChaCha20-Poly1305 under rho_i = HMAC("rho", ss_i), and the
+path key evolves as e_{i+1} = H(E_i || ss_i) * e_i.  A relaying node,
+handed E_i alongside the onion, recovers ss_i with its own node key,
+decrypts its payload, tweaks its privkey by the blinded_node_id factor to
+peel the onion addressed to B_i, and forwards E_{i+1}.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+from dataclasses import dataclass, field
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+from ..crypto import ref_python as ref
+from ..wire.codec import read_tlv_stream, write_bigsize, write_tlv_stream
+
+
+class BlindedPathError(Exception):
+    pass
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def _hmac(key: bytes, msg: bytes) -> bytes:
+    return hmac_mod.new(key, msg, hashlib.sha256).digest()
+
+
+def _ecdh(scalar: int, point: ref.Point) -> bytes:
+    return _sha256(ref.pubkey_serialize(ref.point_mul(scalar, point)))
+
+
+# ---------------------------------------------------------------------------
+# encrypted_data TLV (BOLT#4 tlv_encrypted_data_tlv)
+
+PADDING = 1
+SHORT_CHANNEL_ID = 2
+NEXT_NODE_ID = 4
+PATH_ID = 6
+NEXT_PATH_KEY_OVERRIDE = 8
+PAYMENT_RELAY = 10
+PAYMENT_CONSTRAINTS = 12
+ALLOWED_FEATURES = 14
+
+
+@dataclass
+class EncryptedData:
+    """One hop's recipient data inside a blinded path."""
+    short_channel_id: int | None = None
+    next_node_id: bytes | None = None     # 33-byte compressed pubkey
+    path_id: bytes | None = None          # recipient-only secret cookie
+    next_path_key_override: bytes | None = None
+    payment_relay: tuple[int, int, int] | None = None  # (cltv_delta, ppm, base)
+    payment_constraints: tuple[int, int] | None = None  # (max_cltv, htlc_min)
+    allowed_features: bytes | None = None
+    padding: int = 0
+
+    def serialize(self) -> bytes:
+        tlvs: dict[int, bytes] = {}
+        if self.padding:
+            tlvs[PADDING] = b"\x00" * self.padding
+        if self.short_channel_id is not None:
+            tlvs[SHORT_CHANNEL_ID] = self.short_channel_id.to_bytes(8, "big")
+        if self.next_node_id is not None:
+            tlvs[NEXT_NODE_ID] = self.next_node_id
+        if self.path_id is not None:
+            tlvs[PATH_ID] = self.path_id
+        if self.next_path_key_override is not None:
+            tlvs[NEXT_PATH_KEY_OVERRIDE] = self.next_path_key_override
+        if self.payment_relay is not None:
+            cltv, ppm, base = self.payment_relay
+            v = cltv.to_bytes(2, "big") + ppm.to_bytes(4, "big")
+            v += _tu(base)
+            tlvs[PAYMENT_RELAY] = v
+        if self.payment_constraints is not None:
+            max_cltv, htlc_min = self.payment_constraints
+            tlvs[PAYMENT_CONSTRAINTS] = max_cltv.to_bytes(4, "big") + _tu(htlc_min)
+        if self.allowed_features is not None:
+            tlvs[ALLOWED_FEATURES] = self.allowed_features
+        return write_tlv_stream(tlvs)
+
+    @classmethod
+    def parse(cls, data: bytes) -> "EncryptedData":
+        tlvs = read_tlv_stream(data)
+        ed = cls()
+        if SHORT_CHANNEL_ID in tlvs:
+            ed.short_channel_id = int.from_bytes(tlvs[SHORT_CHANNEL_ID], "big")
+        if NEXT_NODE_ID in tlvs:
+            ed.next_node_id = tlvs[NEXT_NODE_ID]
+        if PATH_ID in tlvs:
+            ed.path_id = tlvs[PATH_ID]
+        if NEXT_PATH_KEY_OVERRIDE in tlvs:
+            ed.next_path_key_override = tlvs[NEXT_PATH_KEY_OVERRIDE]
+        if PAYMENT_RELAY in tlvs:
+            v = tlvs[PAYMENT_RELAY]
+            ed.payment_relay = (int.from_bytes(v[:2], "big"),
+                                int.from_bytes(v[2:6], "big"),
+                                int.from_bytes(v[6:], "big"))
+        if PAYMENT_CONSTRAINTS in tlvs:
+            v = tlvs[PAYMENT_CONSTRAINTS]
+            ed.payment_constraints = (int.from_bytes(v[:4], "big"),
+                                      int.from_bytes(v[4:], "big"))
+        if ALLOWED_FEATURES in tlvs:
+            ed.allowed_features = tlvs[ALLOWED_FEATURES]
+        return ed
+
+
+def _tu(n: int) -> bytes:
+    """Truncated big-endian uint (no leading zero bytes)."""
+    out = n.to_bytes(8, "big").lstrip(b"\x00")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the path object (BOLT#4 blinded_path subtype)
+
+
+@dataclass
+class BlindedHop:
+    blinded_node_id: bytes     # 33
+    encrypted_recipient_data: bytes
+
+
+@dataclass
+class BlindedPath:
+    first_node_id: bytes       # 33 — real id of the introduction point
+    first_path_key: bytes      # 33 — E_0
+    hops: list[BlindedHop] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        out = [self.first_node_id, self.first_path_key,
+               bytes([len(self.hops)])]
+        for h in self.hops:
+            out.append(h.blinded_node_id)
+            out.append(len(h.encrypted_recipient_data).to_bytes(2, "big"))
+            out.append(h.encrypted_recipient_data)
+        return b"".join(out)
+
+    @classmethod
+    def parse(cls, data: bytes, off: int = 0) -> tuple["BlindedPath", int]:
+        if len(data) - off < 67:
+            raise BlindedPathError("short blinded path")
+        first = data[off:off + 33]
+        pk = data[off + 33:off + 66]
+        n = data[off + 66]
+        off += 67
+        hops = []
+        for _ in range(n):
+            bid = data[off:off + 33]
+            ln = int.from_bytes(data[off + 33:off + 35], "big")
+            enc = data[off + 35:off + 35 + ln]
+            if len(bid) != 33 or len(enc) != ln:
+                raise BlindedPathError("truncated blinded hop")
+            off += 35 + ln
+            hops.append(BlindedHop(bid, enc))
+        return cls(first, pk, hops), off
+
+
+def blind_factor(ss: bytes) -> int:
+    return int.from_bytes(_hmac(b"blinded_node_id", ss), "big") % ref.N
+
+
+def encrypt_data(rho: bytes, plaintext: bytes) -> bytes:
+    return ChaCha20Poly1305(rho).encrypt(b"\x00" * 12, plaintext, b"")
+
+
+def decrypt_data(rho: bytes, ciphertext: bytes) -> bytes:
+    try:
+        return ChaCha20Poly1305(rho).decrypt(b"\x00" * 12, ciphertext, b"")
+    except InvalidTag:
+        raise BlindedPathError("encrypted_data AEAD failure") from None
+
+
+def create_path(node_ids: list[bytes], data: list[EncryptedData],
+                session_key: int | None = None) -> BlindedPath:
+    """Blind a route: node_ids[i] gets data[i]; the last entry is the
+    recipient (usually carrying only a path_id)."""
+    assert len(node_ids) == len(data) > 0
+    e = session_key or (int.from_bytes(os.urandom(32), "big") % ref.N or 1)
+    first_key = ref.pubkey_serialize(ref.pubkey_create(e))
+    hops = []
+    for pk, d in zip(node_ids, data):
+        point = ref.pubkey_parse(pk)
+        eph_pub = ref.pubkey_create(e)
+        ss = _ecdh(e, point)
+        blinded = ref.point_mul(blind_factor(ss), point)
+        rho = _hmac(b"rho", ss)
+        hops.append(BlindedHop(ref.pubkey_serialize(blinded),
+                               encrypt_data(rho, d.serialize())))
+        bf = int.from_bytes(
+            _sha256(ref.pubkey_serialize(eph_pub) + ss), "big") % ref.N
+        e = (e * bf) % ref.N
+    return BlindedPath(node_ids[0], first_key, hops)
+
+
+@dataclass
+class UnblindedHop:
+    data: EncryptedData        # this hop's decrypted recipient data
+    onion_privkey: int         # tweaked key that peels the onion for B_i
+    next_path_key: bytes       # E_{i+1} to hand to the next hop
+
+
+def unblind_hop(node_privkey: int, path_key: bytes,
+                encrypted_recipient_data: bytes) -> UnblindedHop:
+    """A relaying/receiving node's processing of one blinded hop."""
+    E = ref.pubkey_parse(path_key)
+    ss = _ecdh(node_privkey, E)
+    rho = _hmac(b"rho", ss)
+    data = EncryptedData.parse(decrypt_data(rho, encrypted_recipient_data))
+    tweaked = (node_privkey * blind_factor(ss)) % ref.N
+    if data.next_path_key_override is not None:
+        next_key = data.next_path_key_override
+    else:
+        bf = int.from_bytes(_sha256(path_key + ss), "big") % ref.N
+        next_key = ref.pubkey_serialize(ref.point_mul(bf, E))
+    return UnblindedHop(data, tweaked, next_key)

@@ -1,0 +1,504 @@
+"""BOLT#12 offers: TLV models, merkle signatures, and bech32 strings.
+
+Functional parity target: the reference's common/bolt12.c (decode/encode
+:?), common/bolt12_merkle.c (signature merkle tree), and the lno1/lnr1/
+lni1 string forms — re-implemented from the BOLT#12 spec text.
+
+Strings are bech32-charset *without a checksum* (BOLT#12: the signature
+already authenticates content), case-insensitive, and may contain `+`
+(with optional whitespace) joining parts split for transport.
+
+Signatures cover a tagged merkle root over the non-signature TLV fields:
+each field leaf H("LnLeaf", tlv) is paired with a per-field nonce leaf
+H("LnNonce"||first_tlv, bigsize(type)); pairs combine upward with
+H("LnBranch", lesser||greater), an unpaired node promoting to the next
+level.  The BIP340 signature is over
+H("lightning" || messagename || fieldname, merkle_root).
+"""
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass, field
+
+from ..crypto import ref_python as ref
+from ..wire.codec import read_tlv_stream, write_bigsize, write_tlv_stream
+from .bolt11 import CHARSET, _REV
+from .blindedpath import BlindedPath, _tu as _tu_shared
+
+SIGNATURE = 240
+
+# offer fields (also embedded in invoice_request / invoice)
+OFFER_CHAINS = 2
+OFFER_METADATA = 4
+OFFER_CURRENCY = 6
+OFFER_AMOUNT = 8
+OFFER_DESCRIPTION = 10
+OFFER_FEATURES = 12
+OFFER_ABSOLUTE_EXPIRY = 14
+OFFER_PATHS = 16
+OFFER_ISSUER = 18
+OFFER_QUANTITY_MAX = 20
+OFFER_ISSUER_ID = 22
+
+INVREQ_METADATA = 0
+INVREQ_CHAIN = 80
+INVREQ_AMOUNT = 82
+INVREQ_FEATURES = 84
+INVREQ_QUANTITY = 86
+INVREQ_PAYER_ID = 88
+INVREQ_PAYER_NOTE = 89
+
+INVOICE_PATHS = 160
+INVOICE_BLINDEDPAY = 162
+INVOICE_CREATED_AT = 164
+INVOICE_RELATIVE_EXPIRY = 166
+INVOICE_PAYMENT_HASH = 168
+INVOICE_AMOUNT = 170
+INVOICE_FALLBACKS = 172
+INVOICE_FEATURES = 174
+INVOICE_NODE_ID = 176
+
+DEFAULT_INVOICE_EXPIRY = 7200
+
+
+class Bolt12Error(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# string form
+
+def encode_string(hrp: str, tlv_bytes: bytes) -> str:
+    acc, bits, data = 0, 0, []
+    for b in tlv_bytes:
+        acc = (acc << 8) | b
+        bits += 8
+        while bits >= 5:
+            bits -= 5
+            data.append((acc >> bits) & 31)
+    if bits:
+        data.append((acc << (5 - bits)) & 31)
+    return hrp + "1" + "".join(CHARSET[d] for d in data)
+
+
+def decode_string(s: str) -> tuple[str, bytes]:
+    s = re.sub(r"\+\s*", "", s.strip())   # transport continuations
+    if s.lower() != s and s.upper() != s:
+        raise Bolt12Error("mixed case")
+    s = s.lower()
+    pos = s.rfind("1")
+    if pos < 1:
+        raise Bolt12Error("no hrp separator")
+    hrp, rest = s[:pos], s[pos + 1:]
+    try:
+        data = [_REV[c] for c in rest]
+    except KeyError as e:
+        raise Bolt12Error(f"invalid character {e.args[0]!r}")
+    acc, bits, out = 0, 0, bytearray()
+    for v in data:
+        acc = (acc << 5) | v
+        bits += 5
+        while bits >= 8:
+            bits -= 8
+            out.append((acc >> bits) & 0xFF)
+    if bits and (acc & ((1 << bits) - 1)):
+        raise Bolt12Error("non-zero padding")
+    return hrp, bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# merkle signature scheme (common/bolt12_merkle.c semantics, from spec)
+
+def _H(tag: bytes, msg: bytes) -> bytes:
+    import hashlib
+
+    th = hashlib.sha256(tag).digest()
+    return hashlib.sha256(th + th + msg).digest()
+
+
+def _branch(a: bytes, b: bytes) -> bytes:
+    lesser, greater = (a, b) if a < b else (b, a)
+    return _H(b"LnBranch", lesser + greater)
+
+
+def _tlv_entries(tlvs: dict[int, bytes]) -> list[tuple[int, bytes]]:
+    return [(t, write_bigsize(t) + write_bigsize(len(v)) + v)
+            for t, v in sorted(tlvs.items())]
+
+
+def merkle_root(tlvs: dict[int, bytes]) -> bytes:
+    entries = [(t, w) for t, w in _tlv_entries(tlvs)
+               if not (SIGNATURE <= t <= 1000)]
+    if not entries:
+        raise Bolt12Error("no fields to sign")
+    first_tlv = entries[0][1]
+    level = []
+    for t, wire in entries:
+        leaf = _H(b"LnLeaf", wire)
+        nonce = _H(b"LnNonce" + first_tlv, write_bigsize(t))
+        level.append(_branch(leaf, nonce))
+    while len(level) > 1:
+        nxt = [_branch(level[i], level[i + 1])
+               for i in range(0, len(level) - 1, 2)]
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    return level[0]
+
+
+def sig_hash(messagename: str, fieldname: str, tlvs: dict[int, bytes]) -> bytes:
+    tag = b"lightning" + messagename.encode() + fieldname.encode()
+    return _H(tag, merkle_root(tlvs))
+
+
+def sign(messagename: str, tlvs: dict[int, bytes], seckey: int) -> bytes:
+    """BIP340 signature over the merkle sig-hash; stored as TLV 240."""
+    return ref.schnorr_sign(sig_hash(messagename, "signature", tlvs), seckey)
+
+
+def check_signature(messagename: str, tlvs: dict[int, bytes],
+                    pubkey33_or_x: bytes) -> bool:
+    sig = tlvs.get(SIGNATURE)
+    if sig is None or len(sig) != 64:
+        return False
+    unsigned = {t: v for t, v in tlvs.items() if not (SIGNATURE <= t <= 1000)}
+    h = sig_hash(messagename, "signature", unsigned)
+    x = pubkey33_or_x[-32:] if len(pubkey33_or_x) == 33 else pubkey33_or_x
+    return ref.schnorr_verify(h, int.from_bytes(x, "big"), sig)
+
+
+# ---------------------------------------------------------------------------
+# helpers shared by the three models
+
+_tu = _tu_shared   # BOLT truncated uint; one impl (blindedpath.py)
+
+
+def _tu_read(v: bytes) -> int:
+    return int.from_bytes(v, "big")
+
+
+def _paths_bytes(paths: list[BlindedPath]) -> bytes:
+    return b"".join(p.serialize() for p in paths)
+
+
+def _paths_parse(v: bytes) -> list[BlindedPath]:
+    out, off = [], 0
+    while off < len(v):
+        p, off = BlindedPath.parse(v, off)
+        out.append(p)
+    return out
+
+
+@dataclass
+class Offer:
+    """lno1... — a reusable invitation to request invoices."""
+    description: str | None = None
+    amount_msat: int | None = None
+    currency: str | None = None
+    issuer: str | None = None
+    issuer_id: bytes | None = None        # 33-byte pubkey
+    chains: list[bytes] = field(default_factory=list)
+    metadata: bytes | None = None
+    features: bytes = b""
+    absolute_expiry: int | None = None
+    quantity_max: int | None = None
+    paths: list[BlindedPath] = field(default_factory=list)
+
+    def tlvs(self) -> dict[int, bytes]:
+        t: dict[int, bytes] = {}
+        if self.chains:
+            t[OFFER_CHAINS] = b"".join(self.chains)
+        if self.metadata is not None:
+            t[OFFER_METADATA] = self.metadata
+        if self.currency is not None:
+            t[OFFER_CURRENCY] = self.currency.encode()
+            if self.amount_msat is None:
+                raise Bolt12Error("currency requires amount")
+        if self.amount_msat is not None:
+            t[OFFER_AMOUNT] = _tu(self.amount_msat)
+        if self.description is not None:
+            t[OFFER_DESCRIPTION] = self.description.encode()
+        if self.features:
+            t[OFFER_FEATURES] = self.features
+        if self.absolute_expiry is not None:
+            t[OFFER_ABSOLUTE_EXPIRY] = _tu(self.absolute_expiry)
+        if self.paths:
+            t[OFFER_PATHS] = _paths_bytes(self.paths)
+        if self.issuer is not None:
+            t[OFFER_ISSUER] = self.issuer.encode()
+        if self.quantity_max is not None:
+            t[OFFER_QUANTITY_MAX] = _tu(self.quantity_max)
+        if self.issuer_id is not None:
+            t[OFFER_ISSUER_ID] = self.issuer_id
+        return t
+
+    @classmethod
+    def from_tlvs(cls, t: dict[int, bytes]) -> "Offer":
+        o = cls()
+        if OFFER_CHAINS in t:
+            v = t[OFFER_CHAINS]
+            o.chains = [v[i:i + 32] for i in range(0, len(v), 32)]
+        o.metadata = t.get(OFFER_METADATA)
+        if OFFER_CURRENCY in t:
+            o.currency = t[OFFER_CURRENCY].decode()
+        if OFFER_AMOUNT in t:
+            o.amount_msat = _tu_read(t[OFFER_AMOUNT])
+        if OFFER_DESCRIPTION in t:
+            o.description = t[OFFER_DESCRIPTION].decode()
+        o.features = t.get(OFFER_FEATURES, b"")
+        if OFFER_ABSOLUTE_EXPIRY in t:
+            o.absolute_expiry = _tu_read(t[OFFER_ABSOLUTE_EXPIRY])
+        if OFFER_PATHS in t:
+            o.paths = _paths_parse(t[OFFER_PATHS])
+        if OFFER_ISSUER in t:
+            o.issuer = t[OFFER_ISSUER].decode()
+        if OFFER_QUANTITY_MAX in t:
+            o.quantity_max = _tu_read(t[OFFER_QUANTITY_MAX])
+        o.issuer_id = t.get(OFFER_ISSUER_ID)
+        return o
+
+    def offer_id(self) -> bytes:
+        """Merkle root of the offer fields — the stable dedup id."""
+        return merkle_root(self.tlvs())
+
+    def encode(self) -> str:
+        t = self.tlvs()
+        if self.issuer_id is None and not self.paths:
+            raise Bolt12Error("offer needs issuer_id or paths")
+        if self.description is None and self.amount_msat is not None:
+            raise Bolt12Error("offer with amount needs description")
+        return encode_string("lno", write_tlv_stream(t))
+
+    @classmethod
+    def decode(cls, s: str) -> "Offer":
+        hrp, raw = decode_string(s)
+        if hrp != "lno":
+            raise Bolt12Error(f"not an offer: {hrp!r}")
+        return cls.from_tlvs(read_tlv_stream(raw))
+
+
+@dataclass
+class InvoiceRequest:
+    """lnr1... — a (signed) request for an invoice against an offer."""
+    offer: Offer
+    metadata: bytes = b""                 # payer-chosen key-binding blob
+    payer_id: bytes = b""                 # 33-byte pubkey (signing key)
+    chain: bytes | None = None
+    amount_msat: int | None = None
+    quantity: int | None = None
+    payer_note: str | None = None
+    features: bytes = b""
+    signature: bytes | None = None
+
+    def tlvs(self, with_sig: bool = True) -> dict[int, bytes]:
+        t = self.offer.tlvs()
+        t[INVREQ_METADATA] = self.metadata
+        if self.chain is not None:
+            t[INVREQ_CHAIN] = self.chain
+        if self.amount_msat is not None:
+            t[INVREQ_AMOUNT] = _tu(self.amount_msat)
+        if self.features:
+            t[INVREQ_FEATURES] = self.features
+        if self.quantity is not None:
+            t[INVREQ_QUANTITY] = _tu(self.quantity)
+        t[INVREQ_PAYER_ID] = self.payer_id
+        if self.payer_note is not None:
+            t[INVREQ_PAYER_NOTE] = self.payer_note.encode()
+        if with_sig and self.signature is not None:
+            t[SIGNATURE] = self.signature
+        return t
+
+    def sign(self, payer_seckey: int) -> None:
+        self.signature = sign("invoice_request", self.tlvs(with_sig=False),
+                              payer_seckey)
+
+    def check_signature(self) -> bool:
+        return check_signature("invoice_request", self.tlvs(), self.payer_id)
+
+    def serialize(self) -> bytes:
+        if self.signature is None:
+            raise Bolt12Error("invoice_request must be signed")
+        return write_tlv_stream(self.tlvs())
+
+    def encode(self) -> str:
+        return encode_string("lnr", self.serialize())
+
+    @classmethod
+    def from_tlvs(cls, t: dict[int, bytes]) -> "InvoiceRequest":
+        offer = Offer.from_tlvs(
+            {k: v for k, v in t.items() if 1 <= k <= 79})
+        r = cls(offer=offer,
+                metadata=t.get(INVREQ_METADATA, b""),
+                payer_id=t.get(INVREQ_PAYER_ID, b""))
+        r.chain = t.get(INVREQ_CHAIN)
+        if INVREQ_AMOUNT in t:
+            r.amount_msat = _tu_read(t[INVREQ_AMOUNT])
+        r.features = t.get(INVREQ_FEATURES, b"")
+        if INVREQ_QUANTITY in t:
+            r.quantity = _tu_read(t[INVREQ_QUANTITY])
+        if INVREQ_PAYER_NOTE in t:
+            r.payer_note = t[INVREQ_PAYER_NOTE].decode()
+        r.signature = t.get(SIGNATURE)
+        return r
+
+    @classmethod
+    def parse(cls, raw: bytes) -> "InvoiceRequest":
+        return cls.from_tlvs(read_tlv_stream(raw))
+
+    def validate_against(self, offer: Offer) -> None:
+        """Recipient-side checks (reference: invoice_request handling in
+        plugins/offers_invreq_hook.c semantics)."""
+        if not self.payer_id or len(self.payer_id) != 33:
+            raise Bolt12Error("missing invreq_payer_id")
+        if not self.metadata:
+            raise Bolt12Error("missing invreq_metadata")
+        if not self.check_signature():
+            raise Bolt12Error("bad invoice_request signature")
+        if merkle_root(offer.tlvs()) != merkle_root(self.offer.tlvs()):
+            raise Bolt12Error("invoice_request does not match offer")
+        amt = self.amount_msat
+        if offer.amount_msat is not None:
+            expect = offer.amount_msat * (self.quantity or 1)
+            if amt is not None and amt < expect:
+                raise Bolt12Error("invreq_amount below offer amount")
+        elif amt is None:
+            raise Bolt12Error("offer has no amount; invreq must set one")
+        if offer.quantity_max is not None:
+            q = self.quantity or 0
+            if not (1 <= q <= (offer.quantity_max or 2 ** 64)):
+                raise Bolt12Error("bad quantity")
+        elif self.quantity is not None:
+            raise Bolt12Error("quantity not allowed")
+        if (offer.absolute_expiry is not None
+                and time.time() > offer.absolute_expiry):
+            raise Bolt12Error("offer expired")
+
+
+@dataclass
+class Invoice12:
+    """lni1... — a BOLT#12 invoice answering an invoice_request."""
+    invreq: InvoiceRequest
+    payment_hash: bytes = b""
+    amount_msat: int = 0
+    node_id: bytes = b""                  # 33-byte signing key
+    created_at: int = 0
+    relative_expiry: int | None = None
+    paths: list[BlindedPath] = field(default_factory=list)
+    blindedpay: list[tuple[int, int, int, int, int, bytes]] = field(
+        default_factory=list)  # (fee_base, ppm, cltv, htlc_min, htlc_max, feat)
+    features: bytes = b""
+    fallbacks: bytes | None = None
+    signature: bytes | None = None
+
+    def tlvs(self, with_sig: bool = True) -> dict[int, bytes]:
+        t = self.invreq.tlvs()             # includes invreq signature (240)?
+        t.pop(SIGNATURE, None)             # no: sig is ours to add
+        if self.paths:
+            t[INVOICE_PATHS] = _paths_bytes(self.paths)
+        if self.blindedpay:
+            out = b""
+            for base, ppm, cltv, hmin, hmax, feat in self.blindedpay:
+                out += (base.to_bytes(4, "big") + ppm.to_bytes(4, "big")
+                        + cltv.to_bytes(2, "big") + hmin.to_bytes(8, "big")
+                        + hmax.to_bytes(8, "big")
+                        + len(feat).to_bytes(2, "big") + feat)
+            t[INVOICE_BLINDEDPAY] = out
+        t[INVOICE_CREATED_AT] = _tu(self.created_at)
+        if self.relative_expiry is not None:
+            t[INVOICE_RELATIVE_EXPIRY] = _tu(self.relative_expiry)
+        t[INVOICE_PAYMENT_HASH] = self.payment_hash
+        t[INVOICE_AMOUNT] = _tu(self.amount_msat)
+        if self.fallbacks is not None:
+            t[INVOICE_FALLBACKS] = self.fallbacks
+        if self.features:
+            t[INVOICE_FEATURES] = self.features
+        t[INVOICE_NODE_ID] = self.node_id
+        if with_sig and self.signature is not None:
+            t[SIGNATURE] = self.signature
+        return t
+
+    def sign(self, node_seckey: int) -> None:
+        self.signature = sign("invoice", self.tlvs(with_sig=False),
+                              node_seckey)
+
+    def check_signature(self) -> bool:
+        return check_signature("invoice", self.tlvs(), self.node_id)
+
+    def serialize(self) -> bytes:
+        if self.signature is None:
+            raise Bolt12Error("invoice must be signed")
+        return write_tlv_stream(self.tlvs())
+
+    def encode(self) -> str:
+        return encode_string("lni", self.serialize())
+
+    @property
+    def expires_at(self) -> int:
+        return self.created_at + (self.relative_expiry
+                                  or DEFAULT_INVOICE_EXPIRY)
+
+    @classmethod
+    def from_tlvs(cls, t: dict[int, bytes]) -> "Invoice12":
+        invreq = InvoiceRequest.from_tlvs(
+            {k: v for k, v in t.items() if k < 160})
+        inv = cls(invreq=invreq,
+                  payment_hash=t.get(INVOICE_PAYMENT_HASH, b""),
+                  amount_msat=_tu_read(t.get(INVOICE_AMOUNT, b"")),
+                  node_id=t.get(INVOICE_NODE_ID, b""),
+                  created_at=_tu_read(t.get(INVOICE_CREATED_AT, b"")))
+        if INVOICE_RELATIVE_EXPIRY in t:
+            inv.relative_expiry = _tu_read(t[INVOICE_RELATIVE_EXPIRY])
+        if INVOICE_PATHS in t:
+            inv.paths = _paths_parse(t[INVOICE_PATHS])
+        if INVOICE_BLINDEDPAY in t:
+            v, off = t[INVOICE_BLINDEDPAY], 0
+            while off + 28 <= len(v):
+                base = int.from_bytes(v[off:off + 4], "big")
+                ppm = int.from_bytes(v[off + 4:off + 8], "big")
+                cltv = int.from_bytes(v[off + 8:off + 10], "big")
+                hmin = int.from_bytes(v[off + 10:off + 18], "big")
+                hmax = int.from_bytes(v[off + 18:off + 26], "big")
+                fl = int.from_bytes(v[off + 26:off + 28], "big")
+                feat = v[off + 28:off + 28 + fl]
+                off += 28 + fl
+                inv.blindedpay.append((base, ppm, cltv, hmin, hmax, feat))
+        inv.features = t.get(INVOICE_FEATURES, b"")
+        inv.fallbacks = t.get(INVOICE_FALLBACKS)
+        inv.signature = t.get(SIGNATURE)
+        return inv
+
+    @classmethod
+    def parse(cls, raw: bytes) -> "Invoice12":
+        return cls.from_tlvs(read_tlv_stream(raw))
+
+    @classmethod
+    def decode(cls, s: str) -> "Invoice12":
+        hrp, raw = decode_string(s)
+        if hrp != "lni":
+            raise Bolt12Error(f"not an invoice: {hrp!r}")
+        return cls.parse(raw)
+
+    def validate_against(self, invreq: InvoiceRequest) -> None:
+        """Payer-side checks before paying (plugins/fetchinvoice.c
+        semantics)."""
+        if not self.check_signature():
+            raise Bolt12Error("bad invoice signature")
+        if len(self.payment_hash) != 32:
+            raise Bolt12Error("bad payment_hash")
+        mine = invreq.tlvs()
+        mine.pop(SIGNATURE, None)
+        theirs = {k: v for k, v in self.tlvs().items() if k < 160}
+        theirs.pop(SIGNATURE, None)
+        if mine != theirs:
+            raise Bolt12Error("invoice does not mirror invoice_request")
+        offer = invreq.offer
+        if offer.issuer_id is not None and not self.paths:
+            # unblinded issuer: invoice must be signed by the issuer key
+            if self.node_id != offer.issuer_id:
+                raise Bolt12Error("invoice node_id != offer issuer_id")
+        want = invreq.amount_msat
+        if want is None and offer.amount_msat is not None:
+            want = offer.amount_msat * (invreq.quantity or 1)
+        if want is not None and self.amount_msat > want:
+            raise Bolt12Error("invoice amount exceeds request")

@@ -1,0 +1,134 @@
+"""BOLT#4 onion messages: DoS-bounded, unreliable messaging over blinded
+paths — the carrier for BOLT12 invoice_request / invoice flows.
+
+Functional parity target: the reference's common/onion_message.c +
+lightningd/onion_message.c (blinded-path unwrap and forward) — written
+from the BOLT#4 "Onion Messages" spec text.
+
+An onion_message (wire type 513) is a sphinx onion whose hops are the
+*blinded* node ids of a blinded path; the clear-text `path_key` rides
+alongside the onion so each hop can derive the tweak for its blinded
+identity.  Payloads are `onionmsg_tlv` streams; only the final hop may
+carry content fields (invoice_request etc.), relays see just their
+encrypted_recipient_data.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..crypto import ref_python as ref
+from ..wire import messages as M
+from ..wire.codec import read_tlv_stream, write_tlv_stream
+from . import blindedpath as BP
+from . import sphinx
+
+# onionmsg_tlv field numbers (BOLT#4)
+REPLY_PATH = 2
+ENCRYPTED_RECIPIENT_DATA = 4
+INVOICE_REQUEST = 64
+INVOICE = 66
+INVOICE_ERROR = 68
+
+# onion messages come in two fixed sizes so relays can't correlate by
+# length (BOLT#4): the payment-onion 1300 and a jumbo 32768
+SMALL_ROUTING = 1300
+BIG_ROUTING = 32768
+
+
+class OnionMessageError(Exception):
+    pass
+
+
+def create(path: BP.BlindedPath, final_tlvs: dict[int, bytes],
+           session_key: int | None = None) -> M.OnionMessage:
+    """Wrap `final_tlvs` (content for the path's recipient) in an onion
+    over the blinded hops.  Returns the wire message to send to
+    path.first_node_id."""
+    payloads = []
+    for i, hop in enumerate(path.hops):
+        tlvs = {ENCRYPTED_RECIPIENT_DATA: hop.encrypted_recipient_data}
+        if i == len(path.hops) - 1:
+            tlvs.update(final_tlvs)
+        payloads.append(sphinx.tlv_payload(write_tlv_stream(tlvs)))
+
+    hop_ids = [h.blinded_node_id for h in path.hops]
+    total = sum(len(p) + sphinx.HMAC_SIZE for p in payloads)
+    routing = SMALL_ROUTING if total <= SMALL_ROUTING else BIG_ROUTING
+    if total > BIG_ROUTING:
+        raise OnionMessageError("onion message content too large")
+    sk = session_key or sphinx.random_session_key()
+    packet, _ = sphinx.create_onion(hop_ids, payloads, b"", sk,
+                                    routing_size=routing)
+    return M.OnionMessage(path_key=path.first_path_key,
+                          onionmsg=packet.serialize())
+
+
+@dataclass
+class Forward:
+    next_node_id: bytes | None   # from encrypted data (or scid-resolved)
+    short_channel_id: int | None
+    message: M.OnionMessage      # re-wrapped for the next hop
+
+
+@dataclass
+class Final:
+    path_id: bytes | None        # recipient's secret cookie, if any
+    tlvs: dict[int, bytes]       # content fields (invoice_request, ...)
+    reply_path: BP.BlindedPath | None
+
+
+def process(node_privkey: int, msg: M.OnionMessage) -> Forward | Final:
+    """One hop's handling: unblind, peel, and either forward or deliver.
+
+    Reference behavior split across connectd/onion_message handling and
+    lightningd/onion_message.c:  relays MUST NOT see content fields;
+    recipients get (path_id, tlvs, reply_path).
+    """
+    path_key = msg.path_key
+    E = ref.pubkey_parse(path_key)
+    ss = BP._ecdh(node_privkey, E)
+    tweaked = (node_privkey * BP.blind_factor(ss)) % ref.N
+
+    packet = sphinx.OnionPacket.parse(msg.onionmsg)
+    try:
+        peeled = sphinx.peel_onion(packet, b"", tweaked)
+    except sphinx.SphinxError as e:
+        raise OnionMessageError(f"onion peel failed: {e}") from None
+
+    tlvs = read_tlv_stream(peeled.payload)
+    enc = tlvs.get(ENCRYPTED_RECIPIENT_DATA)
+    if enc is None:
+        raise OnionMessageError("missing encrypted_recipient_data")
+    rho = BP._hmac(b"rho", ss)
+    data = BP.EncryptedData.parse(BP.decrypt_data(rho, enc))
+
+    if peeled.is_final:
+        reply = None
+        if REPLY_PATH in tlvs:
+            reply, _ = BP.BlindedPath.parse(tlvs[REPLY_PATH])
+        content = {t: v for t, v in tlvs.items()
+                   if t not in (REPLY_PATH, ENCRYPTED_RECIPIENT_DATA)}
+        return Final(path_id=data.path_id, tlvs=content, reply_path=reply)
+
+    # relay: spec forbids content fields for intermediate hops
+    if any(t >= 64 for t in tlvs):
+        raise OnionMessageError("content fields on non-final hop")
+    if data.next_path_key_override is not None:
+        next_key = data.next_path_key_override
+    else:
+        bf = int.from_bytes(BP._sha256(path_key + ss), "big") % ref.N
+        next_key = ref.pubkey_serialize(ref.point_mul(bf, E))
+    nxt = M.OnionMessage(path_key=next_key,
+                         onionmsg=peeled.next_packet.serialize())
+    return Forward(next_node_id=data.next_node_id,
+                   short_channel_id=data.short_channel_id, message=nxt)
+
+
+def reply_path_for(node_ids: list[bytes], path_id: bytes,
+                   session_key: int | None = None) -> BP.BlindedPath:
+    """Convenience: a blinded reply path ending at node_ids[-1] (us),
+    whose final hop carries only our path_id cookie."""
+    data = [BP.EncryptedData(next_node_id=node_ids[i + 1])
+            for i in range(len(node_ids) - 1)]
+    data.append(BP.EncryptedData(path_id=path_id))
+    return BP.create_path(node_ids, data, session_key)

@@ -104,10 +104,11 @@ def _frame_size(framed_payload: bytes) -> int:
 
 
 def _generate_filler(key_type: bytes, payloads: list[bytes],
-                     shared_secrets: list[bytes]) -> bytes:
+                     shared_secrets: list[bytes],
+                     routing_size: int = ROUTING_INFO_SIZE) -> bytes:
     """BOLT#4 filler: the overflow bytes that successive shifts push past
-    the end of the 1300-byte routing info, pre-XORed with each hop's
-    stream so the final hop's HMAC verifies."""
+    the end of the routing info, pre-XORed with each hop's stream so the
+    final hop's HMAC verifies."""
     filler = b""
     prev = 0  # bytes consumed by earlier hops' frames
     for payload, ss in zip(payloads[:-1], shared_secrets[:-1]):
@@ -117,8 +118,8 @@ def _generate_filler(key_type: bytes, payloads: list[bytes],
         # this hop's stream covers [0, ROUTING+fsize); the filler region
         # it touches starts where earlier frames pushed it: offset
         # ROUTING - prev, length prev + fsize
-        stream = cipher_stream(key, ROUTING_INFO_SIZE + fsize)
-        filler = _xor(filler, stream[ROUTING_INFO_SIZE - prev:])
+        stream = cipher_stream(key, routing_size + fsize)
+        filler = _xor(filler, stream[routing_size - prev:])
         prev += fsize
     return filler
 
@@ -127,7 +128,7 @@ def _generate_filler(key_type: bytes, payloads: list[bytes],
 class OnionPacket:
     version: int
     eph_pub: bytes  # 33
-    routing_info: bytes  # 1300
+    routing_info: bytes  # 1300 for payments; variable for onion messages
     hmac: bytes  # 32
 
     def serialize(self) -> bytes:
@@ -136,17 +137,20 @@ class OnionPacket:
 
     @classmethod
     def parse(cls, data: bytes) -> "OnionPacket":
-        if len(data) != ONION_PACKET_SIZE:
+        # routing-info length is inferred: onion messages permit sizes
+        # other than the payment onion's 1300 (BOLT#4 onion_message_packet)
+        if len(data) < 1 + 33 + 1 + HMAC_SIZE:
             raise SphinxError(f"bad onion size {len(data)}")
         if data[0] != VERSION:
             raise SphinxError(f"bad onion version {data[0]}")
-        return cls(data[0], data[1:34], data[34:34 + ROUTING_INFO_SIZE],
-                   data[-32:])
+        return cls(data[0], data[1:34], data[34:-32], data[-32:])
 
 
 def create_onion(hop_pubkeys: list[bytes], payloads: list[bytes],
                  assoc_data: bytes, session_key: int,
-                 pad_stream: bool = True) -> tuple[OnionPacket, list[bytes]]:
+                 pad_stream: bool = True,
+                 routing_size: int = ROUTING_INFO_SIZE,
+                 ) -> tuple[OnionPacket, list[bytes]]:
     """Build the onion for a route (sphinx.c create_onionpacket).
     `payloads` are ALREADY-FRAMED hop payloads — use tlv_payload() /
     legacy_payload() — mirroring the reference's raw_payload convention.
@@ -159,16 +163,16 @@ def create_onion(hop_pubkeys: list[bytes], payloads: list[bytes],
     constructor-local — it never affects peers, who only peel."""
     assert len(hop_pubkeys) == len(payloads) > 0
     total = sum(_frame_size(p) for p in payloads)
-    if total > ROUTING_INFO_SIZE:
+    if total > routing_size:
         raise SphinxError("route payloads exceed onion capacity")
     secrets = compute_shared_secrets(session_key, hop_pubkeys)
-    filler = _generate_filler(b"rho", payloads, secrets)
+    filler = _generate_filler(b"rho", payloads, secrets, routing_size)
 
     if pad_stream:
         pad_key = generate_key(b"pad", session_key.to_bytes(32, "big"))
-        routing = cipher_stream(pad_key, ROUTING_INFO_SIZE)
+        routing = cipher_stream(pad_key, routing_size)
     else:
-        routing = b"\x00" * ROUTING_INFO_SIZE
+        routing = b"\x00" * routing_size
     next_hmac = b"\x00" * HMAC_SIZE
 
     for i in range(len(payloads) - 1, -1, -1):
@@ -176,10 +180,10 @@ def create_onion(hop_pubkeys: list[bytes], payloads: list[bytes],
         rho = generate_key(b"rho", ss)
         mu = generate_key(b"mu", ss)
         frame = payloads[i] + next_hmac
-        routing = frame + routing[: ROUTING_INFO_SIZE - len(frame)]
-        routing = _xor(routing, cipher_stream(rho, ROUTING_INFO_SIZE))
+        routing = frame + routing[: routing_size - len(frame)]
+        routing = _xor(routing, cipher_stream(rho, routing_size))
         if i == len(payloads) - 1 and filler:
-            routing = routing[: ROUTING_INFO_SIZE - len(filler)] + filler
+            routing = routing[: routing_size - len(filler)] + filler
         next_hmac = _hmac(mu, routing + assoc_data)
 
     eph_pub = ref.pubkey_serialize(ref.pubkey_create(session_key))
@@ -206,14 +210,15 @@ def peel_onion(packet: OnionPacket, assoc_data: bytes,
     except ValueError as e:
         raise SphinxError(f"bad ephemeral key: {e}") from None
     ss = ecdh(privkey, eph)
+    routing_size = len(packet.routing_info)
     mu = generate_key(b"mu", ss)
     expect = _hmac(mu, packet.routing_info + assoc_data)
     if expect != packet.hmac:
         raise SphinxError("onion hmac mismatch")
 
     rho = generate_key(b"rho", ss)
-    stream = cipher_stream(rho, 2 * ROUTING_INFO_SIZE)
-    padded = packet.routing_info + b"\x00" * ROUTING_INFO_SIZE
+    stream = cipher_stream(rho, 2 * routing_size)
+    padded = packet.routing_info + b"\x00" * routing_size
     clear = _xor(padded, stream)
 
     # parse this hop's frame (content returned without framing)
@@ -225,13 +230,13 @@ def peel_onion(packet: OnionPacket, assoc_data: bytes,
             ln, off = read_bigsize(clear, 0)
         except Exception as e:
             raise SphinxError(f"bad frame length: {e}") from None
-        if off + ln + HMAC_SIZE > ROUTING_INFO_SIZE:
+        if off + ln + HMAC_SIZE > routing_size:
             raise SphinxError("hop frame exceeds routing info")
         payload = clear[off : off + ln]
         consumed = off + ln
     next_hmac = clear[consumed : consumed + HMAC_SIZE]
     consumed += HMAC_SIZE
-    next_routing = clear[consumed : consumed + ROUTING_INFO_SIZE]
+    next_routing = clear[consumed : consumed + routing_size]
 
     next_packet = None
     if next_hmac != b"\x00" * HMAC_SIZE:

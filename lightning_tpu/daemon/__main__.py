@@ -82,6 +82,21 @@ async def amain(args) -> int:
         print(f"gossmap: {g.n_channels} channels, {g.n_nodes} nodes",
               flush=True)
 
+    # invoice registry + onion messaging + BOLT#12 offers ride the node
+    # identity key (lightningd: invoice.c / onion_message.c / offers
+    # plugin wiring during startup)
+    from ..pay.invoices import InvoiceRegistry
+    from ..pay.offers import (FetchInvoice, OfferRegistry, OffersService,
+                              OnionMessenger, attach_offers_commands)
+
+    node_seckey = node.keypair.priv
+    db = wallet.db if wallet is not None else None
+    messenger = OnionMessenger(node, node_seckey)
+    offer_reg = OfferRegistry(db)
+    invoices = InvoiceRegistry(node_seckey, db=db)
+    offers_svc = OffersService(messenger, offer_reg, invoices, node_seckey)
+    fetcher = FetchInvoice(messenger, node_seckey)
+
     rpc = None
     stop_event = asyncio.Event()
     rpc_path = args.rpc_file or (
@@ -89,12 +104,21 @@ async def amain(args) -> int:
         else None
     )
     if rpc_path:
+        import hashlib as _hl
+
         from . import jsonrpc as RPC
+        from ..plugins.commando import Commando, attach_commando_commands
 
         rpc = RPC.JsonRpcServer(rpc_path)
         RPC.attach_core_commands(rpc, node, gossmap_ref,
                                  stop_event=stop_event)
         RPC.attach_admin_commands(rpc, args.cfg, args.logring)
+        attach_offers_commands(rpc, offers_svc, fetcher, offer_reg, invoices)
+        rune_secret = _hl.sha256(
+            b"commando" + node_seckey.to_bytes(32, "big")).digest()[:16]
+        commando = Commando(node, rpc, rune_secret)
+        attach_commando_commands(rpc, commando)
+
         await rpc.start()
         print(f"rpc ready {rpc_path}", flush=True)
 
@@ -106,7 +130,7 @@ async def amain(args) -> int:
 
             client = hsm.client(CAP_MASTER, peer.node_id, dbid=1)
             tx = await CD.channel_responder(peer, hsm, client, hsm.node_key,
-                                            wallet=wallet)
+                                            wallet=wallet, invoices=invoices)
             print(f"channel closed, closing txid {tx.txid().hex()}",
                   flush=True)
 

@@ -31,6 +31,7 @@ class InvoiceRecord:
     pay_index: int | None = None
     paid_at: int | None = None
     received_msat: int | None = None
+    local_offer_id: bytes | None = None   # bolt12: the offer it answers
 
     def to_rpc(self) -> dict:
         out = {
@@ -62,6 +63,9 @@ class InvoiceRegistry:
         self.by_hash: dict[bytes, InvoiceRecord] = {}
         self.by_label: dict[str, InvoiceRecord] = {}
         self._next_pay_index = 1
+        # offers service hook: fn(local_offer_id) once a bolt12 invoice
+        # settles (single-use offers are spent by payment)
+        self.on_bolt12_paid = None
         if db is not None:
             self._load()
 
@@ -71,7 +75,8 @@ class InvoiceRegistry:
         rows = self.db.conn.execute(
             "SELECT label, payment_hash, preimage, amount_msat, bolt11,"
             " description, status, expires_at, pay_index, paid_at,"
-            " received_msat, payment_secret FROM invoices").fetchall()
+            " received_msat, payment_secret, local_offer_id"
+            " FROM invoices").fetchall()
         for r in rows:
             if r[11] is not None:
                 secret = bytes(r[11])
@@ -84,7 +89,8 @@ class InvoiceRegistry:
                 amount_msat=r[3], bolt11=r[4], description=r[5] or "",
                 status=r[6], expires_at=r[7],
                 payment_secret=secret,
-                pay_index=r[8], paid_at=r[9], received_msat=r[10])
+                pay_index=r[8], paid_at=r[9], received_msat=r[10],
+                local_offer_id=bytes(r[12]) if r[12] is not None else None)
             self.by_hash[rec.payment_hash] = rec
             self.by_label[rec.label] = rec
             if rec.pay_index is not None:
@@ -131,6 +137,31 @@ class InvoiceRegistry:
         self.by_hash[payment_hash] = rec
         self.by_label[label] = rec
         self._save(rec)
+        return rec
+
+    def create_bolt12(self, label: str, amount_msat: int,
+                      payment_hash: bytes, preimage: bytes, bolt12: str,
+                      local_offer_id: bytes | None = None,
+                      expiry: int = 7200) -> InvoiceRecord:
+        """Register a BOLT#12 invoice we just minted for an
+        invoice_request (plugins/offers_invreq_hook.c → invoice
+        creation).  BOLT#12 has no payment_secret — the blinded-path
+        cookie plays that role — so the secret check is disabled."""
+        if label in self.by_label:
+            raise InvoiceError(f"duplicate label {label!r}")
+        rec = InvoiceRecord(
+            label=label, payment_hash=payment_hash, preimage=preimage,
+            amount_msat=amount_msat, bolt11=bolt12, description="",
+            status="unpaid", expires_at=int(time.time()) + expiry,
+            payment_secret=b"", local_offer_id=local_offer_id)
+        self.by_hash[payment_hash] = rec
+        self.by_label[label] = rec
+        self._save(rec)
+        if self.db is not None and local_offer_id is not None:
+            with self.db.transaction():
+                self.db.conn.execute(
+                    "UPDATE invoices SET local_offer_id=? WHERE label=?",
+                    (local_offer_id, label))
         return rec
 
     # -- resolution (the htlc_accepted / invoice_payment path) ------------
@@ -187,6 +218,8 @@ class InvoiceRegistry:
         rec.pay_index = self._next_pay_index
         self._next_pay_index += 1
         self._save(rec)
+        if rec.local_offer_id is not None and self.on_bolt12_paid:
+            self.on_bolt12_paid(rec.local_offer_id)
 
     # -- queries ----------------------------------------------------------
 

@@ -1,0 +1,254 @@
+"""Commando: peer-to-peer JSON-RPC over custom wire messages, gated by
+runes.
+
+Functional parity target: plugins/commando.c (request/reply custommsg
+protocol, rune authorization, reply fragmentation) — using the same
+public protocol constants so the shape matches, with our in-loop
+JsonRpcServer as the command table instead of a plugin round trip.
+
+Protocol: a frame is `u16 type || u64 request_id || JSON fragment`.
+Requests may span several CMD_CONTINUES frames ending with a CMD_TERM;
+replies mirror that with REPLY_CONTINUES/REPLY_TERM.  The request JSON
+is `{"method":..., "params":..., "rune":...}`.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from ..utils.runes import Rune, RuneError, Restriction
+from ..daemon.jsonrpc import RpcError
+
+log = logging.getLogger("lightning_tpu.commando")
+
+CMD_CONTINUES = 0x4C4D
+CMD_TERM = 0x4C4F
+REPLY_CONTINUES = 0x594B
+REPLY_TERM = 0x594D
+
+FRAGMENT = 65000           # max JSON bytes per frame
+MAX_REQUEST = 1024 * 1024  # drop silly accumulations
+
+COMMANDO_ERROR = -32600
+
+
+class Commando:
+    """Both sides of the protocol, attached to one node."""
+
+    def __init__(self, node, rpc, master_secret: bytes):
+        self.node = node
+        self.rpc = rpc                     # JsonRpcServer (command table)
+        self.secret = master_secret
+        # keys are (peer_id, request_id): replies only count from the
+        # peer the request went to (commando.c binds replies likewise —
+        # otherwise any connected peer could forge them)
+        self.partial: dict[tuple[bytes, int], bytearray] = {}
+        self.pending: dict[tuple[bytes, int], asyncio.Future] = {}
+        self.reply_buf: dict[tuple[bytes, int], bytearray] = {}
+        self._next_id = 1
+        for t in (CMD_CONTINUES, CMD_TERM):
+            node.raw_handlers[t] = self._on_request_frame
+        for t in (REPLY_CONTINUES, REPLY_TERM):
+            node.raw_handlers[t] = self._on_reply_frame
+
+    # -- rune management (createrune/checkrune RPC surface) ---------------
+
+    def create_rune(self, restrictions: list[str] | None = None) -> str:
+        rune = Rune.from_secret(
+            self.secret,
+            [Restriction.from_str(r) for r in (restrictions or [])])
+        return rune.encode()
+
+    def restrict_rune(self, rune_str: str, restrictions: list[str]) -> str:
+        rune = Rune.decode(rune_str)
+        for r in restrictions:
+            rune.add_restriction(Restriction.from_str(r))
+        return rune.encode()
+
+    def check_rune(self, rune_str: str, method: str, params: dict,
+                   peer_id: bytes) -> str | None:
+        try:
+            rune = Rune.decode(rune_str)
+        except RuneError as e:
+            return str(e)
+        except Exception as e:
+            # e.g. non-UTF8 restriction bytes; never let a junk rune
+            # from an unauthenticated peer escape into the peer pump
+            return f"unparseable rune: {type(e).__name__}"
+        values = {"method": method, "id": peer_id.hex()}
+        import time as _t
+
+        values["time"] = int(_t.time())
+        if isinstance(params, dict):
+            for k, v in params.items():
+                values[f"pname{_clean(k)}"] = v
+        elif isinstance(params, list):
+            for i, v in enumerate(params):
+                values[f"parr{i}"] = v
+        return rune.check(self.secret, values)
+
+    # -- server side ------------------------------------------------------
+
+    async def _on_request_frame(self, peer, raw: bytes) -> None:
+        t = int.from_bytes(raw[:2], "big")
+        if len(raw) < 10:
+            return
+        rid = int.from_bytes(raw[2:10], "big")
+        key = (peer.node_id, rid)
+        buf = self.partial.setdefault(key, bytearray())
+        buf += raw[10:]
+        if len(buf) > MAX_REQUEST:
+            del self.partial[key]
+            return
+        if t == CMD_CONTINUES:
+            return
+        del self.partial[key]
+        await self._serve(peer, rid, bytes(buf))
+
+    async def _serve(self, peer, rid: int, body: bytes) -> None:
+        try:
+            req = json.loads(body)
+            method = req["method"]
+            params = req.get("params") or {}
+            rune_str = req.get("rune")
+        except (json.JSONDecodeError, KeyError, TypeError):
+            await self._reply(peer, rid, _err(COMMANDO_ERROR, "bad request"))
+            return
+        if not isinstance(rune_str, str):
+            await self._reply(peer, rid,
+                              _err(COMMANDO_ERROR, "missing rune"))
+            return
+        why = self.check_rune(rune_str, method, params, peer.node_id)
+        if why is not None:
+            await self._reply(peer, rid,
+                              _err(COMMANDO_ERROR, f"rune rejected: {why}"))
+            return
+        handler = self.rpc.methods.get(method)
+        if handler is None:
+            await self._reply(peer, rid,
+                              _err(COMMANDO_ERROR,
+                                   f"unknown command {method!r}"))
+            return
+        try:
+            import inspect
+
+            if isinstance(params, list):
+                names = [p for p in inspect.signature(handler).parameters]
+                params = dict(zip(names, params))
+            result = handler(**params)
+            if inspect.isawaitable(result):
+                result = await result
+            await self._reply(peer, rid, {"result": result})
+        except RpcError as e:
+            await self._reply(peer, rid, _err(e.code, str(e)))
+        except TypeError as e:
+            await self._reply(peer, rid, _err(COMMANDO_ERROR, str(e)))
+        except Exception as e:
+            log.exception("commando %s failed", method)
+            await self._reply(peer, rid,
+                              _err(COMMANDO_ERROR,
+                                   f"{type(e).__name__}: {e}"))
+
+    async def _reply(self, peer, rid: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        frags = [body[i:i + FRAGMENT]
+                 for i in range(0, len(body), FRAGMENT)] or [b""]
+        for i, frag in enumerate(frags):
+            t = REPLY_TERM if i == len(frags) - 1 else REPLY_CONTINUES
+            await peer.send_raw(t.to_bytes(2, "big")
+                                + rid.to_bytes(8, "big") + frag)
+
+    # -- client side ------------------------------------------------------
+
+    async def call(self, peer, method: str, params=None,
+                   rune: str | None = None, timeout: float = 30.0):
+        """Run `method` on the remote peer; returns its result or raises
+        RpcError with the remote error."""
+        rid = self._next_id
+        self._next_id += 1
+        body = json.dumps({"method": method, "params": params or {},
+                           "rune": rune}).encode()
+        fut = asyncio.get_running_loop().create_future()
+        key = (peer.node_id, rid)
+        self.pending[key] = fut
+        try:
+            frags = [body[i:i + FRAGMENT]
+                     for i in range(0, len(body), FRAGMENT)] or [b""]
+            for i, frag in enumerate(frags):
+                t = CMD_TERM if i == len(frags) - 1 else CMD_CONTINUES
+                await peer.send_raw(t.to_bytes(2, "big")
+                                    + rid.to_bytes(8, "big") + frag)
+            resp = await asyncio.wait_for(fut, timeout)
+        finally:
+            self.pending.pop(key, None)
+            self.reply_buf.pop(key, None)
+        if "error" in resp:
+            err = resp["error"]
+            raise RpcError(err.get("code", COMMANDO_ERROR),
+                           err.get("message", "remote error"))
+        return resp.get("result")
+
+    async def _on_reply_frame(self, peer, raw: bytes) -> None:
+        if len(raw) < 10:
+            return
+        t = int.from_bytes(raw[:2], "big")
+        rid = int.from_bytes(raw[2:10], "big")
+        key = (peer.node_id, rid)
+        if key not in self.pending:
+            return   # unsolicited: don't buffer attacker bytes
+        buf = self.reply_buf.setdefault(key, bytearray())
+        buf += raw[10:]
+        if len(buf) > MAX_REQUEST:
+            del self.reply_buf[key]
+            return
+        if t == REPLY_CONTINUES:
+            return
+        del self.reply_buf[key]
+        fut = self.pending.get(key)
+        if fut is None or fut.done():
+            return
+        try:
+            fut.set_result(json.loads(bytes(buf)))
+        except json.JSONDecodeError:
+            fut.set_result({"error": {"code": COMMANDO_ERROR,
+                                      "message": "unparseable reply"}})
+
+
+def _clean(name: str) -> str:
+    return "".join(c for c in name if c.isalnum())
+
+
+def _err(code: int, message: str) -> dict:
+    return {"error": {"code": code, "message": message}}
+
+
+def attach_commando_commands(rpc, commando: Commando) -> None:
+    """createrune / checkrune / commando RPC entries
+    (lightningd/runes.c + plugins/commando.c surfaces)."""
+
+    async def createrune(restrictions: list[str] | None = None) -> dict:
+        r = commando.create_rune(restrictions)
+        return {"rune": r, "unique_id": None}
+
+    async def checkrune(rune: str, method: str = "",
+                        params: dict | None = None,
+                        nodeid: str = "") -> dict:
+        why = commando.check_rune(rune, method, params or {},
+                                  bytes.fromhex(nodeid) if nodeid else b"")
+        if why is not None:
+            raise RpcError(COMMANDO_ERROR, f"rune rejected: {why}")
+        return {"valid": True}
+
+    async def commando_cmd(peer_id: str, method: str,
+                           params: dict | None = None,
+                           rune: str = "") -> dict:
+        peer = commando.node.peers.get(bytes.fromhex(peer_id))
+        if peer is None:
+            raise RpcError(COMMANDO_ERROR, "peer not connected")
+        result = await commando.call(peer, method, params, rune)
+        return result if isinstance(result, dict) else {"result": result}
+
+    rpc.register("createrune", createrune)
+    rpc.register("checkrune", checkrune)
+    rpc.register("commando", commando_cmd)

@@ -1,0 +1,324 @@
+"""Runes: add-only bearer tokens authorizing (restricted) RPC access.
+
+Functional parity target: the reference's ccan/ccan/rune +
+lightningd/runes.c (createrune/checkrune/showrunes; used by commando and
+clnrest) — re-implemented from the public rune scheme.
+
+A rune is base64url(authcode32 || restriction-string).  The authcode is
+a SHA-256 *midstate*: the issuer hashes its secret (padded to a block),
+then each restriction (padded to a block) in turn.  Anyone holding a
+rune can add further restrictions by continuing the hash — but nobody
+can remove one without the secret, because SHA-256 midstates can't be
+rewound.  Verification recomputes the chain from the secret.
+
+Restrictions: '&'-joined; each is '|'-joined alternatives; an
+alternative is field + operator + value with '\\' escaping for
+[\\|&].  Operators: = (equal), / (not equal), ^ (starts with),
+$ (ends with), ~ (contains), < (int less), > (int greater),
+{ (lexicographic before), } (after), # (comment, always passes),
+! (field must be absent).
+"""
+from __future__ import annotations
+
+import base64
+import struct
+import time
+
+
+class RuneError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# SHA-256 with an exposed midstate (needed for the add-only property)
+
+_K = [
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+    0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+    0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+    0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+    0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+    0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+]
+_IV = (0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+       0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19)
+_M32 = 0xFFFFFFFF
+
+
+def _rotr(x: int, n: int) -> int:
+    return ((x >> n) | (x << (32 - n))) & _M32
+
+
+def _compress(state: tuple, block: bytes) -> tuple:
+    w = list(struct.unpack(">16I", block))
+    for i in range(16, 64):
+        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> 3)
+        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> 10)
+        w.append((w[i - 16] + s0 + w[i - 7] + s1) & _M32)
+    a, b, c, d, e, f, g, h = state
+    for i in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = (h + s1 + ch + _K[i] + w[i]) & _M32
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (s0 + maj) & _M32
+        h, g, f, e, d, c, b, a = (g, f, e, (d + t1) & _M32,
+                                  c, b, a, (t1 + t2) & _M32)
+    return tuple((x + y) & _M32 for x, y in zip(state, (a, b, c, d, e, f, g, h)))
+
+
+def _pad_to_block(data: bytes, total_len: int) -> bytes:
+    """SHA-2 end-padding as if the whole message so far were total_len
+    bytes, rounded out to a 64-byte boundary.  (total_len ≡ len(data)
+    mod 64 because every earlier absorption ended on a block boundary.)"""
+    padlen = (55 - total_len) % 64
+    return data + b"\x80" + b"\x00" * padlen + struct.pack(
+        ">Q", total_len * 8)
+
+
+def _absorb(state: tuple, data: bytes, total_len: int) -> tuple:
+    buf = _pad_to_block(data, total_len)
+    assert len(buf) % 64 == 0
+    for i in range(0, len(buf), 64):
+        state = _compress(state, buf[i:i + 64])
+    return state
+
+
+def _state_bytes(state: tuple) -> bytes:
+    return struct.pack(">8I", *state)
+
+
+def _state_from(b: bytes) -> tuple:
+    return struct.unpack(">8I", b)
+
+
+# ---------------------------------------------------------------------------
+# restriction model
+
+OPS = "=/^$~<>{}#!"
+
+
+def _escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("|", "\\|").replace("&", "\\&")
+
+
+def _split_unescaped(s: str, sep: str) -> list[str]:
+    """Split at unescaped separators, PRESERVING escapes (they are only
+    consumed at the innermost parse so '&' then '|' splits compose)."""
+    out, cur, i = [], [], 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            cur.append(c)
+            cur.append(s[i + 1])
+            i += 2
+            continue
+        if c == sep:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def _unescape(s: str) -> str:
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            out.append(s[i + 1])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+class Alternative:
+    def __init__(self, field: str, op: str, value: str):
+        if op not in OPS:
+            raise RuneError(f"unknown operator {op!r}")
+        self.field, self.op, self.value = field, op, value
+
+    def encode(self) -> str:
+        return _escape(self.field) + self.op + _escape(self.value)
+
+    @classmethod
+    def parse(cls, s: str) -> "Alternative":
+        # find the first unescaped operator character
+        i, esc = 0, False
+        while i < len(s):
+            if esc:
+                esc = False
+            elif s[i] == "\\":
+                esc = True
+            elif s[i] in OPS:
+                break
+            i += 1
+        else:
+            raise RuneError(f"no operator in alternative {s!r}")
+        return cls(_unescape(s[:i]), s[i], _unescape(s[i + 1:]))
+
+    def test(self, values: dict) -> str | None:
+        """None if satisfied, else a reason string."""
+        if self.op == "#":
+            return None
+        present = self.field in values
+        if self.op == "!":
+            return None if not present else f"{self.field} is present"
+        if not present:
+            return f"{self.field} not present"
+        v = values[self.field]
+        if callable(v):
+            return v(self)
+        sval = str(v)
+        if self.op == "=":
+            return None if sval == self.value else \
+                f"{self.field} != {self.value}"
+        if self.op == "/":
+            return None if sval != self.value else \
+                f"{self.field} = {self.value}"
+        if self.op == "^":
+            return None if sval.startswith(self.value) else "no prefix match"
+        if self.op == "$":
+            return None if sval.endswith(self.value) else "no suffix match"
+        if self.op == "~":
+            return None if self.value in sval else "no substring match"
+        if self.op in "<>":
+            try:
+                a, b = int(sval), int(self.value)
+            except ValueError:
+                return "not an integer"
+            ok = a < b if self.op == "<" else a > b
+            return None if ok else f"{a} not {self.op} {b}"
+        if self.op == "{":
+            return None if sval < self.value else "not lexicographically before"
+        if self.op == "}":
+            return None if sval > self.value else "not lexicographically after"
+        raise RuneError(f"unhandled op {self.op}")
+
+
+class Restriction:
+    def __init__(self, alternatives: list[Alternative]):
+        if not alternatives:
+            raise RuneError("empty restriction")
+        self.alternatives = alternatives
+
+    def encode(self) -> str:
+        return "|".join(a.encode() for a in self.alternatives)
+
+    @classmethod
+    def parse(cls, s: str) -> "Restriction":
+        return cls([Alternative.parse(a) for a in _split_unescaped(s, "|")])
+
+    @classmethod
+    def from_str(cls, s: str) -> "Restriction":
+        return cls.parse(s)
+
+    def test(self, values: dict) -> str | None:
+        reasons = []
+        for alt in self.alternatives:
+            r = alt.test(values)
+            if r is None:
+                return None
+            reasons.append(r)
+        return " AND ".join(reasons)
+
+
+class Rune:
+    def __init__(self, authcode: bytes, restrictions: list[Restriction],
+                 total_len: int):
+        self.authcode = authcode          # 32-byte midstate
+        self.restrictions = restrictions
+        self._total_len = total_len       # bytes absorbed so far
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def from_secret(cls, secret: bytes,
+                    restrictions: list[Restriction] = ()) -> "Rune":
+        if len(secret) + 1 + 8 > 64:
+            raise RuneError("secret too long for one block")
+        state = _absorb(_IV, secret, len(secret))
+        rune = cls(_state_bytes(state), [], 64)
+        for r in restrictions:
+            rune.add_restriction(r)
+        return rune
+
+    def add_restriction(self, r: Restriction) -> None:
+        data = r.encode().encode()
+        state = _state_from(self.authcode)
+        # continue the hash: absorb the restriction padded to a block
+        buf = _pad_to_block(data, self._total_len + len(data))
+        for i in range(0, len(buf), 64):
+            state = _compress(state, buf[i:i + 64])
+        self.authcode = _state_bytes(state)
+        self._total_len += len(buf)
+        self.restrictions.append(r)
+
+    # -- wire form --------------------------------------------------------
+
+    def encode(self) -> str:
+        body = "&".join(r.encode() for r in self.restrictions)
+        return base64.urlsafe_b64encode(
+            self.authcode + body.encode()).decode().rstrip("=")
+
+    @classmethod
+    def decode(cls, s: str) -> "Rune":
+        pad = "=" * (-len(s) % 4)
+        try:
+            raw = base64.urlsafe_b64decode(s + pad)
+        except Exception as e:
+            raise RuneError(f"bad base64: {e}")
+        if len(raw) < 32:
+            raise RuneError("rune too short")
+        try:
+            body = raw[32:].decode()
+        except UnicodeDecodeError:
+            raise RuneError("restrictions not utf8") from None
+        restrictions = []
+        if body:
+            restrictions = [Restriction.parse(p)
+                            for p in _split_unescaped(body, "&")]
+        total = 64
+        for r in restrictions:
+            enc = r.encode().encode()
+            total += len(_pad_to_block(enc, total + len(enc)))
+        return cls(raw[:32], restrictions, total)
+
+    # -- verification -----------------------------------------------------
+
+    def is_authorized(self, secret: bytes) -> bool:
+        expect = Rune.from_secret(secret, self.restrictions)
+        return expect.authcode == self.authcode
+
+    def check(self, secret: bytes, values: dict) -> str | None:
+        """None if the rune is valid AND every restriction passes."""
+        if not self.is_authorized(secret):
+            return "invalid rune authcode"
+        for r in self.restrictions:
+            reason = r.test(values)
+            if reason is not None:
+                return reason
+        return None
+
+
+def standard_values(method: str | None = None, rune_id: str | None = None,
+                    now: float | None = None, **extra) -> dict:
+    """The field set lightningd/runes.c exposes to checkrune: method,
+    time, id/unique_id plus caller params as pname<param>/parr<idx>."""
+    values = {"time": int(now if now is not None else time.time())}
+    if method is not None:
+        values["method"] = method
+    if rune_id is not None:
+        values["id"] = rune_id
+    for k, v in extra.items():
+        values[k] = v
+    return values

@@ -109,6 +109,16 @@ MIGRATIONS: list[str] = [
     # 8: store the payment_secret directly (re-deriving it by decoding
     # the bolt11 string on load was costly and fragile)
     "ALTER TABLE invoices ADD COLUMN payment_secret BLOB",
+    # 9: BOLT#12 offers we publish (wallet/wallet.c offers table role)
+    """CREATE TABLE offers (
+        offer_id BLOB PRIMARY KEY,
+        label TEXT,
+        bolt12 TEXT NOT NULL,
+        status TEXT NOT NULL DEFAULT 'active',
+        single_use INTEGER NOT NULL DEFAULT 0
+    )""",
+    # 10: bolt12 invoices reference the offer they answered
+    "ALTER TABLE invoices ADD COLUMN local_offer_id BLOB",
 ]
 
 

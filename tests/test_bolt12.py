@@ -1,0 +1,222 @@
+"""BOLT#12 stack tests: route blinding, onion messages, offer/invreq/
+invoice codecs and merkle signatures, and the fetchinvoice round trip."""
+import hashlib
+
+import pytest
+
+from lightning_tpu.bolt import blindedpath as BP
+from lightning_tpu.bolt import bolt12 as B12
+from lightning_tpu.bolt import onion_message as OM
+from lightning_tpu.crypto import ref_python as ref
+
+
+def _key(i: int) -> int:
+    return int.from_bytes(hashlib.sha256(bytes([i]) * 4).digest(), "big") % ref.N
+
+
+def _pub(i: int) -> bytes:
+    return ref.pubkey_serialize(ref.pubkey_create(_key(i)))
+
+
+class TestBlindedPath:
+    def test_unblind_walk(self):
+        """Each hop decrypts its own data and derives the next path key."""
+        ids = [_pub(1), _pub(2), _pub(3)]
+        data = [BP.EncryptedData(next_node_id=ids[1]),
+                BP.EncryptedData(next_node_id=ids[2]),
+                BP.EncryptedData(path_id=b"s" * 32)]
+        path = BP.create_path(ids, data, session_key=7777)
+
+        key = path.first_path_key
+        for i, hop in enumerate(path.hops):
+            ub = BP.unblind_hop(_key(i + 1), key, hop.encrypted_recipient_data)
+            if i < 2:
+                assert ub.data.next_node_id == ids[i + 1]
+            else:
+                assert ub.data.path_id == b"s" * 32
+            # the tweaked key must match the advertised blinded node id
+            assert ref.pubkey_serialize(
+                ref.pubkey_create(ub.onion_privkey)) == hop.blinded_node_id
+            key = ub.next_path_key
+
+    def test_wrong_node_cannot_decrypt(self):
+        ids = [_pub(1), _pub(2)]
+        data = [BP.EncryptedData(next_node_id=ids[1]),
+                BP.EncryptedData(path_id=b"x" * 32)]
+        path = BP.create_path(ids, data, session_key=42)
+        with pytest.raises(BP.BlindedPathError):
+            BP.unblind_hop(_key(9), path.first_path_key,
+                           path.hops[0].encrypted_recipient_data)
+
+    def test_serialize_roundtrip(self):
+        ids = [_pub(1), _pub(2)]
+        data = [BP.EncryptedData(next_node_id=ids[1]),
+                BP.EncryptedData(path_id=b"p" * 16)]
+        path = BP.create_path(ids, data, session_key=5)
+        wire = path.serialize()
+        back, off = BP.BlindedPath.parse(wire)
+        assert off == len(wire)
+        assert back.first_path_key == path.first_path_key
+        assert [h.blinded_node_id for h in back.hops] == \
+               [h.blinded_node_id for h in path.hops]
+
+
+class TestOnionMessage:
+    def _path(self, n):
+        ids = [_pub(i + 1) for i in range(n)]
+        data = [BP.EncryptedData(next_node_id=ids[i + 1])
+                for i in range(n - 1)]
+        data.append(BP.EncryptedData(path_id=b"cookie-0" * 4))
+        return ids, BP.create_path(ids, data, session_key=31337)
+
+    def test_three_hop_delivery(self):
+        ids, path = self._path(3)
+        msg = OM.create(path, {OM.INVOICE_REQUEST: b"hello invreq"},
+                        session_key=999)
+        # hop 1 relays to hop 2
+        r1 = OM.process(_key(1), msg)
+        assert isinstance(r1, OM.Forward) and r1.next_node_id == ids[1]
+        r2 = OM.process(_key(2), r1.message)
+        assert isinstance(r2, OM.Forward) and r2.next_node_id == ids[2]
+        r3 = OM.process(_key(3), r2.message)
+        assert isinstance(r3, OM.Final)
+        assert r3.path_id == b"cookie-0" * 4
+        assert r3.tlvs == {OM.INVOICE_REQUEST: b"hello invreq"}
+
+    def test_reply_path_round_trip(self):
+        """Recipient answers over the reply path carried in the request."""
+        ids, path = self._path(2)
+        reply = OM.reply_path_for([_pub(2), _pub(9)], b"r" * 32,
+                                  session_key=555)
+        msg = OM.create(path, {OM.INVOICE_REQUEST: b"req",
+                               OM.REPLY_PATH: reply.serialize()},
+                        session_key=888)
+        hop = OM.process(_key(1), msg)
+        fin = OM.process(_key(2), hop.message)
+        assert isinstance(fin, OM.Final) and fin.reply_path is not None
+        # answer over the reply path: 2 → 9
+        ans = OM.create(fin.reply_path, {OM.INVOICE: b"inv"},
+                        session_key=777)
+        leg1 = OM.process(_key(2), ans)
+        assert isinstance(leg1, OM.Forward) and leg1.next_node_id == _pub(9)
+        fin2 = OM.process(_key(9), leg1.message)
+        assert isinstance(fin2, OM.Final)
+        assert fin2.path_id == b"r" * 32
+        assert fin2.tlvs == {OM.INVOICE: b"inv"}
+
+    def test_relay_rejects_content(self):
+        """Intermediate hops must not carry content fields."""
+        ids = [_pub(1), _pub(2)]
+        data = [BP.EncryptedData(next_node_id=ids[1]),
+                BP.EncryptedData(path_id=b"z" * 32)]
+        path = BP.create_path(ids, data, session_key=3)
+        # maliciously attach content to the relay hop
+        from lightning_tpu.bolt import sphinx
+        from lightning_tpu.wire.codec import write_tlv_stream
+        from lightning_tpu.wire import messages as M
+        payloads = [
+            sphinx.tlv_payload(write_tlv_stream({
+                OM.ENCRYPTED_RECIPIENT_DATA:
+                    path.hops[0].encrypted_recipient_data,
+                OM.INVOICE: b"evil"})),
+            sphinx.tlv_payload(write_tlv_stream({
+                OM.ENCRYPTED_RECIPIENT_DATA:
+                    path.hops[1].encrypted_recipient_data})),
+        ]
+        packet, _ = sphinx.create_onion(
+            [h.blinded_node_id for h in path.hops], payloads, b"", 17,
+            routing_size=OM.SMALL_ROUTING)
+        bad = M.OnionMessage(path_key=path.first_path_key,
+                             onionmsg=packet.serialize())
+        with pytest.raises(OM.OnionMessageError):
+            OM.process(_key(1), bad)
+
+    def test_big_onion(self):
+        ids, path = self._path(2)
+        blob = b"B" * 4000  # forces the 32768 routing size
+        msg = OM.create(path, {OM.INVOICE: blob}, session_key=4)
+        hop = OM.process(_key(1), msg)
+        fin = OM.process(_key(2), hop.message)
+        assert fin.tlvs[OM.INVOICE] == blob
+
+
+class TestBolt12Codec:
+    def _offer(self):
+        return B12.Offer(description="coffee", amount_msat=5000,
+                         issuer="cafe", issuer_id=_pub(50))
+
+    def test_offer_string_roundtrip(self):
+        o = self._offer()
+        s = o.encode()
+        assert s.startswith("lno1")
+        back = B12.Offer.decode(s)
+        assert back.description == "coffee"
+        assert back.amount_msat == 5000
+        assert back.issuer == "cafe"
+        assert back.issuer_id == _pub(50)
+        assert back.offer_id() == o.offer_id()
+
+    def test_continuation_and_case(self):
+        s = self._offer().encode()
+        split = s[:20] + "+ " + s[20:40] + "+\n" + s[40:]
+        assert B12.Offer.decode(split).offer_id() == \
+               B12.Offer.decode(s).offer_id()
+        with pytest.raises(B12.Bolt12Error):
+            B12.decode_string(s[:10].upper() + s[10:])
+
+    def test_merkle_signature(self):
+        o = self._offer()
+        req = B12.InvoiceRequest(offer=o, metadata=b"m" * 16,
+                                 payer_id=_pub(60))
+        req.sign(_key(60))
+        assert req.check_signature()
+        # tamper → fail
+        t = req.tlvs()
+        t[B12.INVREQ_PAYER_NOTE] = b"evil"
+        assert not B12.check_signature("invoice_request", t, _pub(60))
+
+    def test_invreq_validation(self):
+        o = self._offer()
+        req = B12.InvoiceRequest(offer=o, metadata=b"m" * 16,
+                                 payer_id=_pub(60))
+        req.sign(_key(60))
+        req.validate_against(o)
+        # quantity not allowed unless offer says so
+        req2 = B12.InvoiceRequest(offer=o, metadata=b"m" * 16,
+                                  payer_id=_pub(60), quantity=2)
+        req2.sign(_key(60))
+        with pytest.raises(B12.Bolt12Error):
+            req2.validate_against(o)
+
+    def test_invoice_flow(self):
+        o = self._offer()
+        req = B12.InvoiceRequest(offer=o, metadata=b"k" * 16,
+                                 payer_id=_pub(61))
+        req.sign(_key(61))
+        req2 = B12.InvoiceRequest.parse(req.serialize())
+        req2.validate_against(o)
+
+        preimage = b"p" * 32
+        inv = B12.Invoice12(
+            invreq=req2,
+            payment_hash=hashlib.sha256(preimage).digest(),
+            amount_msat=5000, node_id=_pub(50), created_at=1_700_000_000)
+        inv.sign(_key(50))
+        wire = inv.serialize()
+        back = B12.Invoice12.parse(wire)
+        assert back.check_signature()
+        back.validate_against(req)
+        assert back.amount_msat == 5000
+        assert back.encode().startswith("lni1")
+
+    def test_invoice_wrong_signer_rejected(self):
+        o = self._offer()
+        req = B12.InvoiceRequest(offer=o, metadata=b"k" * 16,
+                                 payer_id=_pub(61))
+        req.sign(_key(61))
+        inv = B12.Invoice12(invreq=req, payment_hash=b"h" * 32,
+                            amount_msat=5000, node_id=_pub(99),
+                            created_at=1)
+        inv.sign(_key(99))  # signed by an imposter key
+        with pytest.raises(B12.Bolt12Error):
+            inv.validate_against(req)

@@ -22,7 +22,9 @@ FUNDING_SAT = 1_000_000
 
 
 def run(coro):
-    return asyncio.run(asyncio.wait_for(coro, 300))
+    # generous: first-use jit compiles of the EC kernels can take minutes
+    # on a loaded CPU host (they're cached afterwards)
+    return asyncio.run(asyncio.wait_for(coro, 600))
 
 
 async def _open_pair():

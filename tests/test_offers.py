@@ -1,0 +1,139 @@
+"""BOLT#12 offers end-to-end: offer → invoice_request over onion
+messages → invoice over the reply path, between real connected nodes.
+
+Models the reference's tests for plugins/offers.c + fetchinvoice.c
+(test_offers.py flows) on our in-loop services.
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lightning_tpu.bolt import bolt12 as B12
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.pay.invoices import InvoiceRegistry
+from lightning_tpu.pay.offers import (FetchInvoice, OfferRegistry,
+                                      OffersError, OffersService,
+                                      OnionMessenger)
+from lightning_tpu.wallet.db import Db
+
+ISSUER_KEY = 0xD00D
+PAYER_KEY = 0xBEEF
+RELAY_KEY = 0xCAFE
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60))
+
+
+def _services(node: LightningNode, privkey: int, db=None):
+    messenger = OnionMessenger(node, privkey)
+    registry = OfferRegistry(db)
+    invoices = InvoiceRegistry(privkey, db=db)
+    service = OffersService(messenger, registry, invoices, privkey)
+    fetcher = FetchInvoice(messenger, privkey)
+    return messenger, registry, invoices, service, fetcher
+
+
+async def _connect(a: LightningNode, b: LightningNode):
+    port = await a.listen()
+    await b.connect("127.0.0.1", port, a.node_id)
+    for _ in range(100):
+        if b.node_id in a.peers:
+            return
+        await asyncio.sleep(0.01)
+
+
+def test_fetchinvoice_direct(tmp_path):
+    """Payer fetches an invoice straight from a connected issuer."""
+    async def body():
+        issuer = LightningNode(privkey=ISSUER_KEY)
+        payer = LightningNode(privkey=PAYER_KEY)
+        db = Db(str(tmp_path / "issuer.sqlite3"))
+        _, registry, invoices, service, _ = _services(issuer, ISSUER_KEY, db)
+        _, _, _, _, fetcher = _services(payer, PAYER_KEY)
+        try:
+            await _connect(issuer, payer)
+            row = service.create_offer("widget", amount_msat=12_000,
+                                      issuer="acme", label="w1")
+            offer = B12.Offer.decode(row["bolt12"])
+
+            inv = await fetcher.fetch(offer, timeout=10)
+            assert inv.amount_msat == 12_000
+            assert inv.check_signature()
+            assert inv.node_id == issuer.node_id
+            # the issuer registered a matching local invoice
+            rec = invoices.by_hash.get(inv.payment_hash)
+            assert rec is not None and rec.amount_msat == 12_000
+            assert rec.bolt11.startswith("lni1")
+        finally:
+            await issuer.close()
+            await payer.close()
+
+    run(body())
+
+
+def test_fetchinvoice_quantity_and_error(tmp_path):
+    async def body():
+        issuer = LightningNode(privkey=ISSUER_KEY)
+        payer = LightningNode(privkey=PAYER_KEY)
+        _, registry, invoices, service, _ = _services(issuer, ISSUER_KEY)
+        _, _, _, _, fetcher = _services(payer, PAYER_KEY)
+        try:
+            await _connect(issuer, payer)
+            row = service.create_offer("eggs", amount_msat=100,
+                                      quantity_max=12)
+            offer = B12.Offer.decode(row["bolt12"])
+            inv = await fetcher.fetch(offer, quantity=6, timeout=10)
+            assert inv.amount_msat == 600
+
+            # over-quantity must come back as invoice_error, not timeout
+            with pytest.raises(OffersError, match="invoice_error"):
+                await fetcher.fetch(offer, quantity=13, timeout=10)
+        finally:
+            await issuer.close()
+            await payer.close()
+
+    run(body())
+
+
+def test_single_use_offer_spent_by_payment(tmp_path):
+    """A costless invoice_request must NOT brick a single-use offer;
+    settling the minted invoice must."""
+    async def body():
+        issuer = LightningNode(privkey=ISSUER_KEY)
+        payer = LightningNode(privkey=PAYER_KEY)
+        _, registry, invoices, service, _ = _services(issuer, ISSUER_KEY)
+        _, _, _, _, fetcher = _services(payer, PAYER_KEY)
+        try:
+            await _connect(issuer, payer)
+            row = service.create_offer("one-shot", amount_msat=5,
+                                      single_use=True)
+            offer = B12.Offer.decode(row["bolt12"])
+            inv1 = await fetcher.fetch(offer, timeout=10)
+            # a second (anonymous, costless) request still works
+            await fetcher.fetch(offer, timeout=10)
+            # ... but once an invoice is actually PAID the offer is spent
+            invoices.settle(inv1.payment_hash, 5)
+            assert registry.active(offer.offer_id()) is None
+            with pytest.raises(OffersError, match="invoice_error"):
+                await fetcher.fetch(offer, timeout=10)
+        finally:
+            await issuer.close()
+            await payer.close()
+
+    run(body())
+
+
+def test_offer_registry_persistence(tmp_path):
+    db = Db(str(tmp_path / "o.sqlite3"))
+    reg = OfferRegistry(db)
+    offer = B12.Offer(description="persist", amount_msat=1,
+                      issuer_id=b"\x02" + b"\x11" * 32)
+    row = reg.add(offer, label="keep")
+    reg.disable(row["offer_id"])
+
+    reg2 = OfferRegistry(db)
+    assert reg2.offers[row["offer_id"]]["status"] == "disabled"
+    assert reg2.active(row["offer_id"]) is None

@@ -1,0 +1,187 @@
+"""Rune token + commando peer-RPC tests.
+
+Models the reference's tests for ccan/rune + plugins/commando.c:
+add-only restriction chaining, operator semantics, and a live
+peer-to-peer RPC round trip with rune authorization.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import pytest
+
+from lightning_tpu.daemon.jsonrpc import JsonRpcServer, RpcError
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.plugins.commando import Commando, attach_commando_commands
+from lightning_tpu.utils.runes import (Restriction, Rune, RuneError,
+                                       standard_values)
+
+SECRET = b"s" * 16
+
+
+class TestRunes:
+    def test_master_rune_roundtrip(self):
+        r = Rune.from_secret(SECRET)
+        s = r.encode()
+        back = Rune.decode(s)
+        assert back.authcode == r.authcode
+        assert back.is_authorized(SECRET)
+        assert not back.is_authorized(b"x" * 16)
+        assert back.check(SECRET, {}) is None
+
+    def test_add_only(self):
+        """Adding a restriction works without the secret; removing one
+        invalidates the authcode."""
+        master = Rune.from_secret(SECRET)
+        derived = Rune.decode(master.encode())   # holder's copy, no secret
+        derived.add_restriction(Restriction.from_str("method=getinfo"))
+        assert derived.is_authorized(SECRET)
+        assert derived.check(SECRET, {"method": "getinfo"}) is None
+        assert derived.check(SECRET, {"method": "stop"}) is not None
+
+        # stripping the restriction but keeping the authcode must fail
+        stripped = Rune(derived.authcode, [], 64)
+        assert not stripped.is_authorized(SECRET)
+
+    def test_operators(self):
+        vals = {"method": "listpeers", "n": 5}
+        cases = [
+            ("method=listpeers", None),
+            ("method/listpeers", "fail"),
+            ("method^list", None),
+            ("method$peers", None),
+            ("method~tpee", None),
+            ("method~xyz", "fail"),
+            ("n<10", None),
+            ("n<3", "fail"),
+            ("n>3", None),
+            ("method{m", None),
+            ("method}z", "fail"),
+            ("missing!", None),
+            ("method!", "fail"),
+            ("anything#comment", None),
+        ]
+        for spec, expect in cases:
+            r = Restriction.from_str(spec)
+            result = r.test(vals)
+            if expect is None:
+                assert result is None, f"{spec} unexpectedly failed: {result}"
+            else:
+                assert result is not None, f"{spec} unexpectedly passed"
+
+    def test_alternatives(self):
+        r = Restriction.from_str("method=getinfo|method=listpeers")
+        assert r.test({"method": "listpeers"}) is None
+        assert r.test({"method": "stop"}) is not None
+
+    def test_escaping(self):
+        r = Restriction.from_str("note=a\\|b")
+        assert r.test({"note": "a|b"}) is None
+        rune = Rune.from_secret(SECRET, [r])
+        back = Rune.decode(rune.encode())
+        assert back.is_authorized(SECRET)
+        assert back.restrictions[0].test({"note": "a|b"}) is None
+
+    def test_time_restriction(self):
+        rune = Rune.from_secret(SECRET, [Restriction.from_str("time<9999")])
+        assert rune.check(SECRET, standard_values(now=5000)) is None
+        assert rune.check(SECRET, standard_values(now=10000)) is not None
+
+    def test_bad_decode(self):
+        with pytest.raises(RuneError):
+            Rune.decode("!notbase64!")
+        with pytest.raises(RuneError):
+            Rune.decode("AAAA")   # < 32 bytes
+
+    def test_authcode_is_sha256_midstate(self):
+        """The restriction-free authcode must equal the standard sha256
+        midstate — i.e. hashing the padded secret block directly."""
+        import struct
+
+        from lightning_tpu.utils.runes import _IV, _compress, _state_bytes
+
+        padded = SECRET + b"\x80" + b"\x00" * (55 - len(SECRET)) \
+            + struct.pack(">Q", len(SECRET) * 8)
+        assert _state_bytes(_compress(_IV, padded)) == \
+            Rune.from_secret(SECRET).authcode
+        # and the full digest of the secret agrees with hashlib
+        assert hashlib.sha256(SECRET).digest() == \
+            _state_bytes(_compress(_IV, padded))
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60))
+
+
+class TestCommando:
+    def test_peer_rpc_with_rune(self, tmp_path):
+        async def body():
+            server = LightningNode(privkey=0x5E41)
+            client = LightningNode(privkey=0xC11E)
+            rpc = JsonRpcServer(str(tmp_path / "rpc.sock"))
+
+            async def add(a: int, b: int) -> dict:
+                return {"sum": a + b}
+
+            rpc.register("add", add)
+            cmd_s = Commando(server, rpc, SECRET)
+            attach_commando_commands(rpc, cmd_s)
+            cmd_c = Commando(client, JsonRpcServer(str(tmp_path / "c.sock")),
+                             b"other")
+            try:
+                port = await server.listen()
+                peer = await client.connect("127.0.0.1", port, server.node_id)
+
+                rune = cmd_s.create_rune()
+                out = await cmd_c.call(peer, "add", {"a": 2, "b": 40},
+                                       rune=rune, timeout=10)
+                assert out == {"sum": 42}
+
+                # restricted rune: only `add` with a<10
+                r2 = cmd_s.restrict_rune(rune, ["method=add", "pnamea<10"])
+                assert await cmd_c.call(peer, "add", {"a": 3, "b": 1},
+                                        rune=r2, timeout=10) == {"sum": 4}
+                with pytest.raises(RpcError, match="rune rejected"):
+                    await cmd_c.call(peer, "add", {"a": 11, "b": 1},
+                                     rune=r2, timeout=10)
+                # no rune at all
+                with pytest.raises(RpcError, match="missing rune"):
+                    await cmd_c.call(peer, "add", {"a": 1, "b": 1},
+                                     timeout=10)
+                # forged rune (minted from the wrong secret)
+                forged = Rune.from_secret(b"forged").encode()
+                with pytest.raises(RpcError, match="rune rejected"):
+                    await cmd_c.call(peer, "add", {"a": 1, "b": 1},
+                                     rune=forged, timeout=10)
+            finally:
+                await server.close()
+                await client.close()
+
+        run(body())
+
+    def test_fragmented_reply(self, tmp_path):
+        """Replies larger than one frame reassemble."""
+        async def body():
+            server = LightningNode(privkey=0x5E42)
+            client = LightningNode(privkey=0xC12E)
+            rpc = JsonRpcServer(str(tmp_path / "rpc2.sock"))
+
+            async def big() -> dict:
+                return {"blob": "x" * 150_000}
+
+            rpc.register("big", big)
+            cmd_s = Commando(server, rpc, SECRET)
+            cmd_c = Commando(client, JsonRpcServer(str(tmp_path / "c2.sock")),
+                             b"other")
+            try:
+                port = await server.listen()
+                peer = await client.connect("127.0.0.1", port, server.node_id)
+                rune = cmd_s.create_rune()
+                out = await cmd_c.call(peer, "big", rune=rune, timeout=15)
+                assert len(out["blob"]) == 150_000
+            finally:
+                await server.close()
+                await client.close()
+
+        run(body())
