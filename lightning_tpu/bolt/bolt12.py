@@ -357,6 +357,12 @@ class InvoiceRequest:
             raise Bolt12Error("bad invoice_request signature")
         if merkle_root(offer.tlvs()) != merkle_root(self.offer.tlvs()):
             raise Bolt12Error("invoice_request does not match offer")
+        if offer.currency is not None:
+            # offer_amount is in fiat minor units; without a converter
+            # any msat comparison would be nonsense (reference rejects
+            # unless the currencyrate plugin converts)
+            raise Bolt12Error(
+                f"cannot convert {offer.currency} amount")
         amt = self.amount_msat
         if offer.amount_msat is not None:
             expect = offer.amount_msat * (self.quantity or 1)
@@ -497,6 +503,9 @@ class Invoice12:
             # unblinded issuer: invoice must be signed by the issuer key
             if self.node_id != offer.issuer_id:
                 raise Bolt12Error("invoice node_id != offer issuer_id")
+        if offer.currency is not None:
+            raise Bolt12Error(
+                f"cannot verify {offer.currency}-denominated amount")
         want = invreq.amount_msat
         if want is None and offer.amount_msat is not None:
             want = offer.amount_msat * (invreq.quantity or 1)

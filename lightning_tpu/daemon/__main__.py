@@ -114,6 +114,10 @@ async def amain(args) -> int:
                                  stop_event=stop_event)
         RPC.attach_admin_commands(rpc, args.cfg, args.logring)
         attach_offers_commands(rpc, offers_svc, fetcher, offer_reg, invoices)
+
+        from ..routing.mcf import attach_routing_commands
+
+        attach_routing_commands(rpc, gossmap_ref)
         rune_secret = _hl.sha256(
             b"commando" + node_seckey.to_bytes(32, "big")).digest()[:16]
         commando = Commando(node, rpc, rune_secret)

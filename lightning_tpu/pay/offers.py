@@ -197,7 +197,8 @@ class OffersService:
         except B12.Bolt12Error as e:
             from ..wire.codec import write_tlv_stream
 
-            err = write_tlv_stream({1: str(e).encode()})
+            # tlv_invoice_error: 5 = error (utf8), 1 = erroneous_field
+            err = write_tlv_stream({5: str(e).encode()})
             await self.messenger.send(
                 final.reply_path, {OM.INVOICE_ERROR: err})
 
@@ -244,6 +245,12 @@ class FetchInvoice:
                     quantity: int | None = None,
                     payer_note: str | None = None,
                     timeout: float = 30.0) -> B12.Invoice12:
+        if offer.currency is not None:
+            # no fiat converter on board (reference: currencyrate plugin)
+            raise OffersError(
+                f"offer denominated in {offer.currency}: unsupported")
+        if not offer.paths and offer.issuer_id is None:
+            raise OffersError("offer names no issuer_id and no paths")
         payer_key = int.from_bytes(os.urandom(32), "big") % ref.N or 1
         invreq = B12.InvoiceRequest(
             offer=offer, metadata=os.urandom(16),
@@ -290,7 +297,7 @@ class FetchInvoice:
         from ..wire.codec import read_tlv_stream
 
         tlvs = read_tlv_stream(final.tlvs[OM.INVOICE_ERROR])
-        fut.set_result(tlvs.get(1, b"unknown error"))
+        fut.set_result(tlvs.get(5, b"unknown error"))
 
 
 def attach_offers_commands(rpc, service: OffersService,

@@ -17,7 +17,7 @@ import asyncio
 import json
 import logging
 
-from ..utils.runes import Rune, RuneError, Restriction
+from ..utils.runes import (Restriction, Rune, RuneError, standard_values)
 from ..daemon.jsonrpc import RpcError
 
 log = logging.getLogger("lightning_tpu.commando")
@@ -76,16 +76,15 @@ class Commando:
             # e.g. non-UTF8 restriction bytes; never let a junk rune
             # from an unauthenticated peer escape into the peer pump
             return f"unparseable rune: {type(e).__name__}"
-        values = {"method": method, "id": peer_id.hex()}
-        import time as _t
-
-        values["time"] = int(_t.time())
+        extra = {}
         if isinstance(params, dict):
             for k, v in params.items():
-                values[f"pname{_clean(k)}"] = v
+                extra[f"pname{_clean(k)}"] = v
         elif isinstance(params, list):
             for i, v in enumerate(params):
-                values[f"parr{i}"] = v
+                extra[f"parr{i}"] = v
+        values = standard_values(method=method, rune_id=peer_id.hex(),
+                                 **extra)
         return rune.check(self.secret, values)
 
     # -- server side ------------------------------------------------------
