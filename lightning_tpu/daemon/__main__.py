@@ -32,17 +32,26 @@ async def amain(args) -> int:
         from ..wallet.db import Db
         from ..wallet.wallet import Wallet
 
+        from . import hsm_secret as HS
+
         _os.makedirs(args.data_dir, exist_ok=True)
         secret_path = _os.path.join(args.data_dir, "hsm_secret")
+        passphrase = _os.environ.get("LIGHTNING_TPU_HSM_PASSPHRASE")
         if _os.path.exists(secret_path):
-            with open(secret_path, "rb") as f:
-                secret = f.read()
+            try:
+                secret = HS.load(secret_path, passphrase=passphrase)
+            except HS.HsmSecretError as e:
+                print(f"hsm_secret error: {e}", file=sys.stderr)
+                return 1
         else:
-            secret = (privkey.to_bytes(32, "big") if privkey
-                      else _os.urandom(32))
-            fd = _os.open(secret_path, _os.O_WRONLY | _os.O_CREAT, 0o600)
-            _os.write(fd, secret)
-            _os.close(fd)
+            if args.mnemonic:
+                secret = HS.mnemonic_to_secret(args.mnemonic,
+                                               passphrase or "")
+            elif privkey:
+                secret = privkey.to_bytes(32, "big")
+            else:
+                secret = _os.urandom(32)
+            HS.save(secret_path, secret, passphrase=passphrase)
         hsm = Hsm(secret)
         wallet = Wallet(Db(_os.path.join(args.data_dir, "lightningd.sqlite3")))
         rows = wallet.list_channels()
@@ -89,6 +98,9 @@ async def amain(args) -> int:
     from ..pay.offers import (FetchInvoice, OfferRegistry, OffersService,
                               OnionMessenger, attach_offers_commands)
 
+    from .relay import Relay
+
+    relay_svc = Relay()
     node_seckey = node.keypair.priv
     db = wallet.db if wallet is not None else None
     messenger = OnionMessenger(node, node_seckey)
@@ -118,6 +130,30 @@ async def amain(args) -> int:
         from ..routing.mcf import attach_routing_commands
 
         attach_routing_commands(rpc, gossmap_ref)
+
+        from ..plugins.bookkeeper import (Bookkeeper,
+                                          attach_bookkeeper_commands)
+
+        attach_bookkeeper_commands(rpc, Bookkeeper(db))
+
+        if hsm is not None:
+            from ..wallet.chanbackup import (PeerStorageService,
+                                             attach_backup_commands)
+
+            backup = PeerStorageService(node, hsm._secret, wallet=wallet)
+            attach_backup_commands(rpc, backup)
+
+        from ..plugins.autoclean import Autoclean, attach_autoclean_commands
+        from ..plugins.sqlrpc import attach_sql_command
+
+        attach_sql_command(rpc)
+        autoclean = Autoclean(invoices=invoices, wallet=wallet,
+                              relay=relay_svc)
+        attach_autoclean_commands(rpc, autoclean)
+
+        from .relay import attach_relay_commands
+
+        attach_relay_commands(rpc, relay_svc)
         rune_secret = _hl.sha256(
             b"commando" + node_seckey.to_bytes(32, "big")).digest()[:16]
         commando = Commando(node, rpc, rune_secret)
@@ -126,15 +162,27 @@ async def amain(args) -> int:
         await rpc.start()
         print(f"rpc ready {rpc_path}", flush=True)
 
+        if args.rest_port is not None:
+            from .rest import RestServer
+
+            rest = RestServer(rpc, commando=commando, port=args.rest_port)
+            port = await rest.start()
+            print(f"rest ready 127.0.0.1:{port}", flush=True)
+
     if args.accept_channels:
         from . import channeld as CD
+        from ..pay.htlc_set import HtlcSets
+
+        htlc_sets = HtlcSets(invoices)
 
         async def serve_channels(peer):
             from .hsmd import CAP_MASTER
 
             client = hsm.client(CAP_MASTER, peer.node_id, dbid=1)
             tx = await CD.channel_responder(peer, hsm, client, hsm.node_key,
-                                            wallet=wallet, invoices=invoices)
+                                            wallet=wallet, invoices=invoices,
+                                            htlc_sets=htlc_sets,
+                                            relay=relay_svc)
             print(f"channel closed, closing txid {tx.txid().hex()}",
                   flush=True)
 
@@ -198,6 +246,13 @@ def main() -> int:
     p.add_argument("--privkey", default=None, help="node secret key (hex)")
     p.add_argument("--data-dir", default=None,
                    help="persistent node dir (hsm_secret + sqlite wallet)")
+    p.add_argument("--mnemonic", default=None,
+                   help="BIP39 mnemonic to derive a NEW hsm_secret from "
+                        "(with LIGHTNING_TPU_HSM_PASSPHRASE as the "
+                        "BIP39/encryption passphrase)")
+    p.add_argument("--rest-port", type=int, default=None,
+                   help="serve the clnrest-style HTTP API on this port "
+                        "(0 = ephemeral; requires --rpc-file)")
     p.add_argument("--rpc-file", default=None,
                    help="unix socket path for JSON-RPC (default: "
                         "<data-dir>/lightning-rpc)")

@@ -126,6 +126,7 @@ class Channeld:
         # every wire ack (write-ahead, SURVEY §5)
         self.wallet = None
         self.wallet_id: int | None = None
+        self.scid: int | None = None   # set when registered with a Relay
         self.hsm_dbid = 0
 
     def attach_wallet(self, wallet, hsm_dbid: int) -> None:
@@ -459,6 +460,22 @@ class Channeld:
         tx = self._closing_tx(fee)
         log.info("channel %s closed cooperatively, fee %d sat, txid %s",
                  self.channel_id.hex()[:16], fee, tx.txid().hex()[:16])
+        from ..utils import events
+
+        # bkpr: our balance returns to the wallet; the funder pays the
+        # close fee (full deposit + explicit onchain_fee debit keeps the
+        # double-entry net exact)
+        events.emit("coin_movement", {
+            "account": "channel", "tag": "channel_close", "debit_msat": self.core.to_local_msat,
+            "reference": tx.txid().hex()})
+        events.emit("coin_movement", {
+            "account": "wallet", "tag": "deposit",
+            "credit_msat": self.core.to_local_msat,
+            "reference": tx.txid().hex()})
+        if self.funder:
+            events.emit("coin_movement", {
+                "account": "wallet", "tag": "onchain_fee",
+                "debit_msat": fee * 1000, "reference": tx.txid().hex()})
         return tx
 
     async def _send_closing_signed(self, fee_sat: int) -> None:
@@ -634,6 +651,16 @@ async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
         ch._persist()
     log.info("channel %s open (funder), capacity %d sat",
              ch.channel_id.hex()[:16], funding_sat)
+    from ..utils import events
+
+    # bkpr: wallet funds move into the channel (channel_open mvt)
+    events.emit("coin_movement", {
+        "account": "wallet", "tag": "withdrawal",
+        "debit_msat": funding_sat * 1000,
+        "reference": ch.channel_id.hex()})
+    events.emit("coin_movement", {
+        "account": "channel", "tag": "channel_open", "credit_msat": ch.core.to_local_msat,
+        "reference": ch.channel_id.hex()})
     return ch
 
 
@@ -730,6 +757,12 @@ def classify_incoming(lh, node_privkey: int, invoices=None,
       ("fulfill", preimage)
       ("fail", encrypted_error_onion)     — update_fail_htlc reason
       ("malformed", failure_code)         — update_fail_malformed_htlc
+      ("mpp", (shared_secret, payload))   — valid partial payment: the
+          caller hands it to pay.htlc_set.HtlcSets (htlc_set.c holds
+          such HTLCs until the set completes or times out)
+      ("forward", (payload, next_onion, shared_secret)) — a relay hop:
+          the caller hands it to daemon.relay.Relay (peer_htlcs.c:812
+          forward_htlc semantics)
     """
     from ..bolt import onion_payload as OP
     from ..bolt import sphinx as SX
@@ -754,6 +787,15 @@ def classify_incoming(lh, node_privkey: int, invoices=None,
         return ("fail", SX.create_error_onion(peeled_raw.shared_secret,
                                               failmsg))
 
+    if not payload.is_final:
+        nxt = (peeled_raw.next_packet.serialize()
+               if peeled_raw.next_packet is not None else None)
+        if nxt is not None and payload.short_channel_id is not None:
+            return ("forward",
+                    (payload, nxt, peeled_raw.shared_secret))
+        failmsg = INVALID_ONION_PAYLOAD.to_bytes(2, "big")
+        return ("fail", SX.create_error_onion(peeled_raw.shared_secret,
+                                              failmsg))
     if (payload.is_final and payload.keysend_preimage is not None
             and hashlib.sha256(payload.keysend_preimage).digest()
             == lh.htlc.payment_hash
@@ -769,6 +811,10 @@ def classify_incoming(lh, node_privkey: int, invoices=None,
                        + lh.htlc.cltv_expiry.to_bytes(4, "big"))
             return ("fail", SX.create_error_onion(peeled_raw.shared_secret,
                                                   failmsg))
+        if (payload.total_msat is not None
+                and payload.total_msat > lh.htlc.amount_msat
+                and payload.payment_secret is not None):
+            return ("mpp", (peeled_raw.shared_secret, payload))
         preimage = invoices.resolve_htlc(
             lh.htlc.payment_hash, lh.htlc.amount_msat,
             payload.payment_secret, payload.total_msat)
@@ -784,25 +830,98 @@ def classify_incoming(lh, node_privkey: int, invoices=None,
     return ("fail", SX.create_error_onion(peeled_raw.shared_secret, failmsg))
 
 
+@dataclass
+class _Resolve:
+    """In-loop sentinel: settle an incoming HTLC we previously held
+    (MPP part or relayed forward).  The error onion is pre-built by the
+    enqueuer, so the loop just sends it."""
+    hid: int
+    preimage: bytes | None = None
+    reason_onion: bytes | None = None
+
+
 async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
                             node_privkey: int,
                             cfg: ChannelConfig | None = None,
                             wallet=None, hsm_dbid: int = 1,
-                            invoices=None) -> T.Tx:
-    """Accept one inbound channel and serve it until cooperative close:
-    apply updates, answer commitment dances (committing back our own
-    changes), fulfill keysend HTLCs addressed to us, negotiate shutdown.
-    Returns the closing tx.  This is the daemon-side channel loop the CLI
-    runs."""
+                            invoices=None, htlc_sets=None,
+                            relay=None) -> T.Tx:
+    """Accept one inbound channel and serve it to completion (see
+    channel_loop)."""
     ch = await accept_channel(peer, hsm, client, cfg, wallet=wallet,
                               hsm_dbid=hsm_dbid)
+    return await channel_loop(ch, node_privkey, invoices=invoices,
+                              htlc_sets=htlc_sets, relay=relay)
+
+
+async def channel_loop(ch: Channeld, node_privkey: int,
+                       invoices=None, htlc_sets=None, relay=None) -> T.Tx:
+    """Serve one OPEN channel until cooperative close: apply updates,
+    answer commitment dances, fulfill keysend/invoice HTLCs addressed to
+    us (MPP parts held in htlc_sets until their set completes), hand
+    relay hops to the Relay, place relayed offers, negotiate shutdown.
+    Returns the closing tx.  The asyncio analogue of channeld's main
+    loop + lightningd's peer_htlcs glue."""
+    from ..bolt import sphinx as SX
+    from .relay import _RelayOffer, TEMPORARY_CHANNEL_FAILURE
+
     handled: set[int] = set()
+    if relay is not None and ch.scid is None:
+        relay.register_channel(ch)
+
+    def _mpp_callbacks(hid: int, shared_secret: bytes):
+        # set completion/timeout may fire from ANOTHER channel's task or
+        # the sweeper; all channel I/O must stay in this loop, so the
+        # callbacks only enqueue sentinels into our own inbox
+        async def fulfill(preimage: bytes) -> None:
+            ch.peer.inbox.put_nowait(_Resolve(hid, preimage=preimage))
+
+        async def fail(code: int) -> None:
+            ch.peer.inbox.put_nowait(_Resolve(
+                hid, reason_onion=SX.create_error_onion(
+                    shared_secret, code.to_bytes(2, "big"))))
+
+        return fulfill, fail
+
+    async def _settle(r: _Resolve) -> None:
+        if r.preimage is not None:
+            await ch.fulfill_htlc(r.hid, r.preimage)
+        else:
+            await ch.fail_htlc(r.hid, r.reason_onion)
+
     while True:
         msg = await ch.peer.recv(
             M.UpdateAddHtlc, M.UpdateFulfillHtlc, M.UpdateFailHtlc,
-            M.UpdateFee, M.CommitmentSigned, M.Shutdown,
-            timeout=RECV_TIMEOUT,
+            M.UpdateFee, M.CommitmentSigned, M.Shutdown, _Resolve,
+            _RelayOffer, timeout=RECV_TIMEOUT,
         )
+        if isinstance(msg, _Resolve):
+            try:
+                await _settle(msg)
+                # batch queued sibling settlements, then one dance
+                while not ch.peer.inbox.empty():
+                    nxt = ch.peer.inbox._queue[0]
+                    if not isinstance(nxt, _Resolve):
+                        break
+                    await _settle(ch.peer.inbox.get_nowait())
+                await ch.commit()
+            except ChannelError:
+                log.exception("settling held HTLC failed")
+            continue
+        if isinstance(msg, _RelayOffer):
+            # we are the OUTGOING side of a forward: place the HTLC.
+            # Register the correlation only AFTER the commit succeeds —
+            # a failed dance fails the incoming HTLC immediately, and a
+            # stale pending entry would double-resolve it later.
+            try:
+                hid_out = await ch.offer_htlc(
+                    msg.amount_msat, msg.payment_hash, msg.cltv_expiry,
+                    onion=msg.onion)
+                await ch.commit()
+                relay.pending[(id(ch), hid_out)] = msg.on_result
+            except ChannelError:
+                msg.on_result(local_code=TEMPORARY_CHANNEL_FAILURE)
+            continue
         if isinstance(msg, M.Shutdown):
             ch.their_shutdown_script = msg.scriptpubkey
             if ch.core.state is ChannelState.NORMAL:
@@ -825,21 +944,84 @@ async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
                 try:
                     if verdict == "fulfill":
                         await ch.fulfill_htlc(hid, data)
-                        if invoices is not None:
+                        if invoices is not None and \
+                                lh.htlc.payment_hash in invoices.by_hash:
                             invoices.settle(lh.htlc.payment_hash,
                                             lh.htlc.amount_msat)
+                        else:
+                            # keysend: income with no invoice row
+                            # (plugins/keysend.c mints one; we log the
+                            # coin movement directly)
+                            from ..utils import events
+
+                            events.emit("coin_movement", {
+                                "account": "channel", "tag": "invoice",
+                                "credit_msat": lh.htlc.amount_msat,
+                                "reference": lh.htlc.payment_hash.hex()})
+                        resolved = True
+                    elif verdict == "forward":
+                        payload, next_onion, ss = data
+                        if relay is None:
+                            failmsg = UNKNOWN_NEXT_PEER_MSG
+                            await ch.fail_htlc(
+                                hid, SX.create_error_onion(ss, failmsg))
+                            resolved = True
+                        else:
+                            err = relay.handle_forward(
+                                ch, hid, payload, next_onion, ss)
+                            if err is not None:
+                                await ch.fail_htlc(hid, err)
+                                resolved = True
+                    elif verdict == "mpp":
+                        ss, payload = data
+                        if htlc_sets is None:
+                            await ch.fail_htlc(
+                                hid, SX.create_error_onion(
+                                    ss, _unknown_details(lh)))
+                            resolved = True
+                        else:
+                            fulfill, fail = _mpp_callbacks(hid, ss)
+                            status = await htlc_sets.add_part(
+                                lh.htlc.payment_hash,
+                                lh.htlc.amount_msat,
+                                payload.payment_secret,
+                                payload.total_msat, fulfill, fail)
+                            if status == "reject":
+                                await ch.fail_htlc(
+                                    hid, SX.create_error_onion(
+                                        ss, _unknown_details(lh)))
+                                resolved = True
+                            # held/complete: callbacks own settlement
                     elif verdict == "fail":
                         await ch.fail_htlc(hid, data)
+                        resolved = True
                     else:
                         await ch.fail_malformed_htlc(hid, lh.onion, data)
+                        resolved = True
                     handled.add(hid)
-                    resolved = True
                 except ChannelError:
                     pass  # not yet irrevocably committed; next dance
             if resolved:
                 await ch.commit()
         else:
             ch.apply_update(msg)
+            if relay is not None and isinstance(
+                    msg, (M.UpdateFulfillHtlc, M.UpdateFailHtlc)):
+                cb = relay.pending.pop((id(ch), msg.id), None)
+                if cb is not None:
+                    if isinstance(msg, M.UpdateFulfillHtlc):
+                        cb(preimage=msg.payment_preimage)
+                    else:
+                        cb(downstream_reason=msg.reason)
+
+
+def _unknown_details(lh) -> bytes:
+    return (INCORRECT_OR_UNKNOWN_PAYMENT_DETAILS.to_bytes(2, "big")
+            + lh.htlc.amount_msat.to_bytes(8, "big")
+            + (0).to_bytes(4, "big"))
+
+
+UNKNOWN_NEXT_PEER_MSG = (0x1000 | 10).to_bytes(2, "big")
 
 
 async def keysend_pay_and_close(ch: Channeld, amount_msat: int,

@@ -131,10 +131,15 @@ class Hsm:
         client._need(CAP_SIGN_COMMITMENT)
         if not sighashes:
             return np.zeros((0, 64), np.uint8)
-        secs = self.channel_secrets(client)
-        htlc_priv = K.derive_privkey(secs.htlc, remote_per_commitment_point)
-        hashes = np.stack([np.frombuffer(h, np.uint8) for h in sighashes])
-        return S.ecdsa_sign_batch(hashes, [htlc_priv] * len(sighashes))
+        from ..utils import trace
+
+        with trace.span("hsmd/sign_htlc_batch", n=len(sighashes)):
+            secs = self.channel_secrets(client)
+            htlc_priv = K.derive_privkey(secs.htlc,
+                                         remote_per_commitment_point)
+            hashes = np.stack([np.frombuffer(h, np.uint8)
+                               for h in sighashes])
+            return S.ecdsa_sign_batch(hashes, [htlc_priv] * len(sighashes))
 
     def sign_remote_commitment(
         self, client: HsmClient, sighash: bytes

@@ -73,14 +73,29 @@ class Peer:
     async def recv(self, *types: type, timeout: float = 30.0) -> codec.Message:
         """Await the next non-control message (optionally of given types).
         Protocol drivers (opening/closing/channel flows) consume this the
-        way reference subdaemons consume their peer fd."""
-        while True:
-            msg = await asyncio.wait_for(self.inbox.get(), timeout)
-            if not types or isinstance(msg, types):
-                return msg
-            log.warning("%s: ignoring unexpected %s while waiting for %s",
-                        self.node_id.hex()[:8], type(msg).__name__,
-                        [t.__name__ for t in types])
+        way reference subdaemons consume their peer fd.
+
+        Non-matching WIRE messages are dropped with a warning (lockstep
+        dances tolerate this).  Non-matching INTERNAL sentinels (MPP
+        settlements, relay offers — anything that isn't a codec.Message)
+        are deferred and requeued when this call completes: a commitment
+        dance mid-flight must never eat a cross-task settlement, or the
+        upstream HTLC of a forward would silently never be claimed."""
+        deferred: list = []
+        try:
+            while True:
+                msg = await asyncio.wait_for(self.inbox.get(), timeout)
+                if not types or isinstance(msg, types):
+                    return msg
+                if not isinstance(msg, codec.Message):
+                    deferred.append(msg)
+                    continue
+                log.warning("%s: ignoring unexpected %s while waiting for %s",
+                            self.node_id.hex()[:8], type(msg).__name__,
+                            [t.__name__ for t in types])
+        finally:
+            for m in deferred:
+                self.inbox.put_nowait(m)
 
     def start_pump(self) -> None:
         self._pump_task = asyncio.get_running_loop().create_task(self._pump())

@@ -1,0 +1,189 @@
+"""HTLC forwarding between channels: the relay core of a routing node.
+
+Functional parity target: lightningd/peer_htlcs.c — `forward_htlc`
+(:812) policy checks + `send_htlc_out` (:702) placement, with BOLT#4
+error attribution on every rejection, and the preimage/failure
+back-propagation when the downstream HTLC resolves.
+
+Concurrency model: each channel is served by its own channel_loop task;
+the relay never touches a channel directly.  A forward is handed to the
+outgoing channel as a `_RelayOffer` sentinel in that channel's inbox;
+resolution comes back to the incoming channel as a `_Resolve` sentinel.
+All cross-channel signalling is queue-to-queue — the asyncio analogue
+of the reference's cross-daemon wire messages.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from ..bolt import sphinx as SX
+
+log = logging.getLogger("lightning_tpu.relay")
+
+UPDATE = 0x1000
+TEMPORARY_CHANNEL_FAILURE = UPDATE | 7
+UNKNOWN_NEXT_PEER = UPDATE | 10
+FEE_INSUFFICIENT = UPDATE | 12
+INCORRECT_CLTV_EXPIRY = UPDATE | 13
+EXPIRY_TOO_SOON = UPDATE | 14
+
+
+@dataclass
+class RelayPolicy:
+    """Our forwarding terms (lightningd options: fee-base,
+    fee-per-satoshi, cltv-delta)."""
+    fee_base_msat: int = 1000
+    fee_ppm: int = 10
+    cltv_delta: int = 34
+
+    def fee_msat(self, forward_amount_msat: int) -> int:
+        return (self.fee_base_msat
+                + forward_amount_msat * self.fee_ppm // 1_000_000)
+
+
+def derive_scid(funding_txid: bytes, outidx: int) -> int:
+    """Stable synthetic short_channel_id from the funding outpoint
+    (BLOCKxTXxOUT packing with txid-derived block/tx fields)."""
+    block = int.from_bytes(funding_txid[:3], "big")
+    txn = int.from_bytes(funding_txid[3:6], "big")
+    return (block << 40) | (txn << 16) | (outidx & 0xFFFF)
+
+
+@dataclass
+class _RelayOffer:
+    """Sentinel for the outgoing channel's loop: place this HTLC."""
+    amount_msat: int
+    payment_hash: bytes
+    cltv_expiry: int
+    onion: bytes
+    on_result: object     # fn(preimage=, downstream_reason=, local_code=)
+
+
+class Relay:
+    """Node-wide forwarding table + in-flight correlation."""
+
+    def __init__(self, policy: RelayPolicy | None = None):
+        self.policy = policy or RelayPolicy()
+        self.by_scid: dict[int, object] = {}      # scid -> Channeld
+        # (id(out_ch), out_hid) -> on_result, popped by the out loop
+        self.pending: dict[tuple[int, int], object] = {}
+        self.forwards: list[dict] = []            # listforwards log
+
+    def register(self, scid: int, ch) -> None:
+        self.by_scid[scid] = ch
+        ch.scid = scid
+
+    def unregister(self, scid: int) -> None:
+        self.by_scid.pop(scid, None)
+
+    def register_channel(self, ch) -> int:
+        """Register under the channel's deterministic scid (real nodes
+        learn it at lockin depth; without a chain we derive a stable one
+        from the funding outpoint)."""
+        scid = derive_scid(ch.funding_txid, ch.funding_outidx)
+        self.register(scid, ch)
+        return scid
+
+    def handle_forward(self, in_ch, in_hid: int, payload, next_onion: bytes,
+                       shared_secret: bytes) -> bytes | None:
+        """Policy-check a forward and dispatch it to the outgoing
+        channel.  Returns an encrypted error onion to fail the incoming
+        HTLC with, or None when the forward is in flight (the incoming
+        loop must then leave the HTLC held)."""
+        inc = in_ch.core.htlcs[(False, in_hid)].htlc
+
+        def _err(code: int, data: bytes = b"") -> bytes:
+            return SX.create_error_onion(
+                shared_secret, code.to_bytes(2, "big") + data)
+
+        out_ch = self.by_scid.get(payload.short_channel_id)
+        if out_ch is None or out_ch is in_ch:
+            self._log(inc, payload, "failed", "unknown_next_peer")
+            return _err(UNKNOWN_NEXT_PEER)
+        fwd_amt = payload.amt_to_forward_msat
+        fee = inc.amount_msat - fwd_amt
+        if fee < self.policy.fee_msat(fwd_amt):
+            # fee_insufficient: htlc_msat u64 + channel_update (len 0)
+            self._log(inc, payload, "failed", "fee_insufficient")
+            return _err(FEE_INSUFFICIENT,
+                        inc.amount_msat.to_bytes(8, "big")
+                        + (0).to_bytes(2, "big"))
+        if inc.cltv_expiry < payload.outgoing_cltv + self.policy.cltv_delta:
+            self._log(inc, payload, "failed", "incorrect_cltv_expiry")
+            return _err(INCORRECT_CLTV_EXPIRY,
+                        inc.cltv_expiry.to_bytes(4, "big")
+                        + (0).to_bytes(2, "big"))
+
+        entry = {
+            "in_channel": getattr(in_ch, "scid", None),
+            "out_channel": payload.short_channel_id,
+            "in_msat": inc.amount_msat, "out_msat": fwd_amt,
+            "fee_msat": fee, "status": "offered",
+            "payment_hash": inc.payment_hash.hex(),
+        }
+        self.forwards.append(entry)
+
+        def on_result(preimage: bytes | None = None,
+                      downstream_reason: bytes | None = None,
+                      local_code: int | None = None) -> None:
+            from .channeld import _Resolve
+
+            if preimage is not None:
+                entry["status"] = "settled"
+                in_ch.peer.inbox.put_nowait(
+                    _Resolve(in_hid, preimage=preimage))
+                return
+            entry["status"] = "failed"
+            if downstream_reason is not None:
+                # add our obfuscation layer on the way back (BOLT#4
+                # returning-errors; onionreply wrap semantics)
+                reason = SX.wrap_error_onion(shared_secret,
+                                             downstream_reason)
+            else:
+                reason = SX.create_error_onion(
+                    shared_secret,
+                    (local_code or TEMPORARY_CHANNEL_FAILURE)
+                    .to_bytes(2, "big"))
+            in_ch.peer.inbox.put_nowait(
+                _Resolve(in_hid, reason_onion=reason))
+
+        out_ch.peer.inbox.put_nowait(_RelayOffer(
+            amount_msat=fwd_amt, payment_hash=inc.payment_hash,
+            cltv_expiry=payload.outgoing_cltv, onion=next_onion,
+            on_result=on_result))
+        return None
+
+    def _log(self, inc, payload, status: str, why: str) -> None:
+        self.forwards.append({
+            "in_channel": None, "out_channel": payload.short_channel_id,
+            "in_msat": inc.amount_msat,
+            "out_msat": payload.amt_to_forward_msat,
+            "fee_msat": inc.amount_msat - payload.amt_to_forward_msat,
+            "status": status, "failreason": why,
+            "payment_hash": inc.payment_hash.hex(),
+        })
+
+    def listforwards(self) -> list[dict]:
+        return list(self.forwards)
+
+
+def attach_relay_commands(rpc, relay: Relay) -> None:
+    async def listforwards() -> dict:
+        return {"forwards": relay.listforwards()}
+
+    async def setchannel(feebase: int | None = None,
+                         feeppm: int | None = None,
+                         cltv_delta: int | None = None) -> dict:
+        if feebase is not None:
+            relay.policy.fee_base_msat = int(feebase)
+        if feeppm is not None:
+            relay.policy.fee_ppm = int(feeppm)
+        if cltv_delta is not None:
+            relay.policy.cltv_delta = int(cltv_delta)
+        return {"fee_base_msat": relay.policy.fee_base_msat,
+                "fee_proportional_millionths": relay.policy.fee_ppm,
+                "cltv_delta": relay.policy.cltv_delta}
+
+    rpc.register("listforwards", listforwards)
+    rpc.register("setchannel", setchannel)

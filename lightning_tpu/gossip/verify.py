@@ -288,15 +288,19 @@ def verify_store(idx: StoreIndex, bucket: int = DEFAULT_BUCKET) -> StoreVerifyRe
     message (the reference's store *load* skips re-verification; its
     *ingest* path verifies serially — this is the ingest cost model run at
     load scale, the BASELINE.md target workload)."""
+    from ..utils import trace
+
     alive = idx.select(idx.alive())
     ca = alive.select(alive.types == wire.MSG_CHANNEL_ANNOUNCEMENT)
     na = alive.select(alive.types == wire.MSG_NODE_ANNOUNCEMENT)
     cu = alive.select(alive.types == wire.MSG_CHANNEL_UPDATE)
-    items_ca = extract_channel_announcements(ca)
-    items_na = extract_node_announcements(na)
-    items_cu = extract_channel_updates(cu, make_scid_map(ca))
-    all_items = VerifyItems.concat([items_ca, items_na, items_cu])
-    ok = verify_items(all_items, bucket)
+    with trace.span("gossip/extract", records=int(len(alive.types))):
+        items_ca = extract_channel_announcements(ca)
+        items_na = extract_node_announcements(na)
+        items_cu = extract_channel_updates(cu, make_scid_map(ca))
+        all_items = VerifyItems.concat([items_ca, items_na, items_cu])
+    with trace.span("gossip/verify", sigs=int(len(all_items.sigs))):
+        ok = verify_items(all_items, bucket)
     n_ca, n_na, n_cu = len(items_ca), len(items_na), len(items_cu)
     ca_ok = ok[:n_ca].reshape(4, -1).all(axis=0) if n_ca else np.zeros(0, bool)
     na_ok = ok[n_ca : n_ca + n_na]

@@ -1,0 +1,120 @@
+"""MPP receive: HTLC sets that accumulate partial payments.
+
+Functional parity target: lightningd/htlc_set.c — final-hop HTLCs
+sharing a payment_hash whose onion claims total_msat > this part's
+amount are HELD (not fulfilled, not failed) until the set sums to
+total_msat, then ALL fulfill with the invoice preimage; a set that
+does not complete within MPP_TIMEOUT fails every held part with
+mpp_timeout (BOLT#4 failure code 23).
+
+The registry is node-wide: parts may arrive over different channels.
+Each held part carries async callbacks (fulfill/fail) supplied by the
+channel loop that owns the HTLC, so completion can fan out to every
+involved channel from whichever task completed the set.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger("lightning_tpu.htlc_set")
+
+MPP_TIMEOUT_SECONDS = 60
+MPP_TIMEOUT = 23   # BOLT#4 mpp_timeout failure code (0x17)
+
+
+@dataclass
+class _Part:
+    amount_msat: int
+    fulfill: object       # async fn(preimage)
+    fail: object          # async fn(failure_code)
+
+
+@dataclass
+class _Set:
+    total_msat: int
+    deadline: float
+    parts: list = field(default_factory=list)
+
+    @property
+    def received(self) -> int:
+        return sum(p.amount_msat for p in self.parts)
+
+
+class HtlcSets:
+    """Node-wide MPP accumulator tied to an InvoiceRegistry."""
+
+    def __init__(self, invoices, timeout: float = MPP_TIMEOUT_SECONDS):
+        self.invoices = invoices
+        self.timeout = timeout
+        self.sets: dict[bytes, _Set] = {}
+        self._sweeper: asyncio.Task | None = None
+
+    def _ensure_sweeper(self) -> None:
+        if self._sweeper is None or self._sweeper.done():
+            self._sweeper = asyncio.get_running_loop().create_task(
+                self._sweep())
+
+    async def _sweep(self) -> None:
+        while self.sets:
+            now = time.monotonic()
+            for ph in [ph for ph, s in self.sets.items()
+                       if now >= s.deadline]:
+                await self._fail_set(ph)
+            await asyncio.sleep(1.0)
+
+    async def _fail_set(self, payment_hash: bytes) -> None:
+        s = self.sets.pop(payment_hash, None)
+        if s is None:
+            return
+        log.info("MPP set %s timed out with %d/%d msat",
+                 payment_hash.hex()[:16], s.received, s.total_msat)
+        for p in s.parts:
+            try:
+                await p.fail(MPP_TIMEOUT)
+            except Exception:
+                log.exception("failing MPP part")
+
+    async def add_part(self, payment_hash: bytes, amount_msat: int,
+                       payment_secret: bytes | None, total_msat: int,
+                       fulfill, fail) -> str:
+        """Register one partial HTLC.  Returns:
+          "held"     — valid part, waiting for the rest
+          "complete" — this part completed the set; every part's
+                       fulfill callback (including this one's) has run
+          "reject"   — not a valid part; caller fails the HTLC itself
+        """
+        rec = self.invoices.by_hash.get(payment_hash)
+        if rec is None or rec.status != "unpaid":
+            return "reject"
+        if time.time() > rec.expires_at:
+            return "reject"
+        if rec.payment_secret and payment_secret != rec.payment_secret:
+            return "reject"
+        # BOLT#4: total_msat replaces amt for the invoice amount rules
+        if rec.amount_msat is not None and not (
+                rec.amount_msat <= total_msat <= 2 * rec.amount_msat):
+            return "reject"
+
+        s = self.sets.get(payment_hash)
+        if s is None:
+            s = _Set(total_msat=total_msat,
+                     deadline=time.monotonic() + self.timeout)
+            self.sets[payment_hash] = s
+        elif s.total_msat != total_msat:
+            return "reject"   # parts must agree on the total
+        s.parts.append(_Part(amount_msat, fulfill, fail))
+
+        if s.received >= s.total_msat:
+            del self.sets[payment_hash]
+            for p in s.parts:
+                try:
+                    await p.fulfill(rec.preimage)
+                except Exception:
+                    log.exception("fulfilling MPP part")
+            self.invoices.settle(payment_hash, s.received)
+            return "complete"
+        self._ensure_sweeper()
+        return "held"

@@ -218,6 +218,14 @@ class InvoiceRegistry:
         rec.pay_index = self._next_pay_index
         self._next_pay_index += 1
         self._save(rec)
+        from ..utils import events
+
+        # bkpr feed (common/coin_mvt.c new_coin_channel_credit: invoice
+        # income; account granularity is node-wide here, not per-channel)
+        events.emit("coin_movement", {
+            "account": "channel", "tag": "invoice",
+            "credit_msat": amount_msat,
+            "reference": payment_hash.hex(), "timestamp": rec.paid_at})
         if rec.local_offer_id is not None and self.on_bolt12_paid:
             self.on_bolt12_paid(rec.local_offer_id)
 

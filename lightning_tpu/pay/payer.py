@@ -184,7 +184,9 @@ async def pay_over_channel(ch, invoice_str: str, *,
         raise PayError(f"payment dance failed: {e}") from e
 
     if isinstance(upd, M.UpdateFulfillHtlc):
-        _settle_payment(wallet, pay_id, upd.payment_preimage)
+        _settle_payment(wallet, pay_id, upd.payment_preimage,
+                        amount_msat=amount, amount_sent_msat=amount_sent,
+                        payment_hash=inv.payment_hash)
         return PayResult(inv.payment_hash, upd.payment_preimage,
                          amount, amount_sent)
     if isinstance(upd, M.UpdateFailHtlc):
@@ -204,6 +206,49 @@ async def pay_over_channel(ch, invoice_str: str, *,
     raise PayError(f"unexpected update {type(upd).__name__}")
 
 
+async def pay_mpp_direct(ch, invoice_str: str, parts: int = 2,
+                         blockheight: int = 0) -> PayResult:
+    """Multi-part payment to a DIRECT peer over one channel: the amount
+    splits into `parts` HTLCs, each onion claiming total_msat = full
+    amount, so the payee's htlc_set holds them until the set completes
+    (lightningd/pay.c MPP send ∘ htlc_set.c receive).  One commitment
+    dance locks in every part; the payee fulfills them together."""
+    inv = B11.decode(invoice_str)
+    if inv.amount_msat is None:
+        raise PayError("MPP needs an invoice amount")
+    if inv.payment_secret is None:
+        raise PayError("MPP needs a payment_secret")
+    if ch.peer.node_id != inv.payee:
+        raise PayError("pay_mpp_direct: payee is not the channel peer")
+    amount = inv.amount_msat
+    final_cltv = blockheight + inv.min_final_cltv
+
+    split = [amount // parts] * parts
+    split[-1] += amount - sum(split)
+    for part_amt in split:
+        route = [RouteStep(inv.payee, 0, part_amt, final_cltv)]
+        onion, _ = build_payment_onion(
+            route, inv.payment_hash, inv.payment_secret, amount,
+            SX.random_session_key())
+        await ch.offer_htlc(part_amt, inv.payment_hash, final_cltv,
+                            onion=onion)
+    await ch.commit()
+    await ch.handle_commit()
+
+    preimage = None
+    got = 0
+    while got < parts:
+        upd = await ch.recv_update()
+        if isinstance(upd, M.UpdateFulfillHtlc):
+            preimage = upd.payment_preimage
+            got += 1
+        elif isinstance(upd, M.UpdateFailHtlc):
+            raise PayError("MPP part failed")
+    await ch.handle_commit()
+    await ch.commit()
+    return PayResult(inv.payment_hash, preimage, amount, amount)
+
+
 def _record_payment(wallet, inv, bolt11_str, amount, amount_sent,
                     created) -> int | None:
     if wallet is None:
@@ -218,7 +263,22 @@ def _record_payment(wallet, inv, bolt11_str, amount, amount_sent,
     return cur.lastrowid
 
 
-def _settle_payment(wallet, pay_id, preimage: bytes) -> None:
+def _settle_payment(wallet, pay_id, preimage: bytes,
+                    amount_msat: int | None = None,
+                    amount_sent_msat: int | None = None,
+                    payment_hash: bytes | None = None) -> None:
+    if amount_msat is not None:
+        from ..utils import events
+
+        ref_hex = payment_hash.hex() if payment_hash else None
+        events.emit("coin_movement", {
+            "account": "channel", "tag": "payment",
+            "debit_msat": amount_msat, "reference": ref_hex})
+        fee = (amount_sent_msat or amount_msat) - amount_msat
+        if fee > 0:
+            events.emit("coin_movement", {
+                "account": "channel", "tag": "invoice_fee",
+                "debit_msat": fee, "reference": ref_hex})
     if wallet is None or pay_id is None:
         return
     with wallet.db.transaction():

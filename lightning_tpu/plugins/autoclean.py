@@ -1,0 +1,123 @@
+"""autoclean: periodic deletion of stale node records.
+
+Functional parity target: plugins/autoclean.c — ages (seconds) per
+category; 0 disables a category; a cycle timer sweeps
+expired invoices, succeeded/failed payments, and resolved forwards,
+keeping lifetime deletion counters for autoclean-status.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+log = logging.getLogger("lightning_tpu.autoclean")
+
+CATEGORIES = ("expiredinvoices", "paidinvoices", "succeededpays",
+              "failedpays", "succeededforwards", "failedforwards")
+
+
+class Autoclean:
+    def __init__(self, invoices=None, wallet=None, relay=None,
+                 cycle_seconds: float = 3600.0):
+        self.invoices = invoices
+        self.wallet = wallet
+        self.relay = relay
+        self.cycle_seconds = cycle_seconds
+        self.ages: dict[str, int] = {c: 0 for c in CATEGORIES}
+        self.cleaned: dict[str, int] = {c: 0 for c in CATEGORIES}
+        self._task: asyncio.Task | None = None
+
+    def configure(self, category: str, age_seconds: int) -> None:
+        if category not in CATEGORIES:
+            raise ValueError(f"unknown category {category!r}")
+        self.ages[category] = age_seconds
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.cycle_seconds)
+            try:
+                self.clean_once()
+            except Exception:
+                log.exception("autoclean cycle failed")
+
+    def clean_once(self, now: float | None = None) -> dict[str, int]:
+        """One sweep; returns per-category deletions this cycle."""
+        now = now if now is not None else time.time()
+        done = {c: 0 for c in CATEGORIES}
+
+        if self.invoices is not None:
+            for label, rec in list(self.invoices.by_label.items()):
+                if rec.status == "expired":
+                    cat, ref_t = "expiredinvoices", rec.expires_at
+                elif rec.status == "paid":
+                    cat, ref_t = "paidinvoices", rec.paid_at or 0
+                else:
+                    continue
+                age = self.ages[cat]
+                if age and now - ref_t > age:
+                    del self.invoices.by_label[label]
+                    self.invoices.by_hash.pop(rec.payment_hash, None)
+                    if self.invoices.db is not None:
+                        with self.invoices.db.transaction():
+                            self.invoices.db.conn.execute(
+                                "DELETE FROM invoices WHERE label=?",
+                                (label,))
+                    done[cat] += 1
+
+        if self.wallet is not None:
+            for cat, status in (("succeededpays", "complete"),
+                                ("failedpays", "failed")):
+                age = self.ages[cat]
+                if not age:
+                    continue
+                with self.wallet.db.transaction():
+                    cur = self.wallet.db.conn.execute(
+                        "DELETE FROM payments WHERE status=?"
+                        " AND completed_at IS NOT NULL"
+                        " AND completed_at < ?",
+                        (status, int(now - age)))
+                done[cat] += cur.rowcount
+
+        if self.relay is not None:
+            for cat, status in (("succeededforwards", "settled"),
+                                ("failedforwards", "failed")):
+                age = self.ages[cat]
+                if not age:
+                    continue
+                # forwards carry no timestamp yet: age>0 sweeps resolved
+                before = len(self.relay.forwards)
+                self.relay.forwards = [
+                    f for f in self.relay.forwards
+                    if f.get("status") != status]
+                done[cat] += before - len(self.relay.forwards)
+
+        for c, n in done.items():
+            self.cleaned[c] += n
+        return done
+
+
+def attach_autoclean_commands(rpc, ac: Autoclean) -> None:
+    async def autoclean_status() -> dict:
+        return {"autoclean": {
+            c: {"enabled": bool(ac.ages[c]), "age": ac.ages[c],
+                "cleaned": ac.cleaned[c]} for c in CATEGORIES}}
+
+    async def autoclean_once() -> dict:
+        return {"cleaned": ac.clean_once()}
+
+    async def autoclean_configure(category: str, age: int) -> dict:
+        ac.configure(category, int(age))
+        return {"category": category, "age": int(age)}
+
+    rpc.register("autoclean-status", autoclean_status)
+    rpc.register("autoclean-once", autoclean_once)
+    rpc.register("autoclean-configure", autoclean_configure)

@@ -1,0 +1,146 @@
+"""Bookkeeper: double-entry accounting over coin-movement events.
+
+Functional parity target: plugins/bkpr/ (bookkeeper.c + recorder.c:
+the accounts/events ledger, listaccountevents, listbalances, income
+statements) fed by common/coin_mvt.c's `coin_movement` notifications —
+here consumed from the in-process event bus (utils/events.py).
+
+Accounts: "wallet" (on-chain funds), "external" (the rest of the
+world), and one account per channel (named by channel id hex).  Every
+event credits or debits exactly one account; the invariant
+sum(credits) == sum(debits) across the ledger holds because each
+emission records both sides' perspective the way coin_mvt.c tags do.
+
+Income statement tags (bkpr income semantics): invoice (received),
+invoice_fee (routing fee we paid), routed (forward fee earned),
+onchain_fee (close/open fees).
+"""
+from __future__ import annotations
+
+import time
+
+from ..utils import events
+
+
+class Bookkeeper:
+    """Ledger + query surface.  Pass the wallet Db for persistence, or
+    None for an in-memory ledger."""
+
+    def __init__(self, db=None):
+        self.db = db
+        self.events: list[dict] = []
+        if db is not None:
+            self._ensure_table()
+            for r in db.conn.execute(
+                    "SELECT account, tag, credit_msat, debit_msat,"
+                    " currency, timestamp, reference FROM bkpr_events"
+                    " ORDER BY id").fetchall():
+                self.events.append({
+                    "account": r[0], "tag": r[1], "credit_msat": r[2],
+                    "debit_msat": r[3], "currency": r[4],
+                    "timestamp": r[5], "reference": r[6]})
+        events.subscribe("coin_movement", self._on_mvt)
+
+    def close(self) -> None:
+        events.unsubscribe("coin_movement", self._on_mvt)
+
+    def _ensure_table(self) -> None:
+        with self.db.transaction():
+            self.db.conn.execute(
+                """CREATE TABLE IF NOT EXISTS bkpr_events (
+                    id INTEGER PRIMARY KEY,
+                    account TEXT NOT NULL,
+                    tag TEXT NOT NULL,
+                    credit_msat INTEGER NOT NULL DEFAULT 0,
+                    debit_msat INTEGER NOT NULL DEFAULT 0,
+                    currency TEXT NOT NULL DEFAULT 'bcrt',
+                    timestamp INTEGER NOT NULL,
+                    reference TEXT
+                )""")
+
+    # -- ingestion ---------------------------------------------------------
+
+    def _on_mvt(self, payload: dict) -> None:
+        self.record(
+            account=payload.get("account", "wallet"),
+            tag=payload.get("tag", "journal"),
+            credit_msat=int(payload.get("credit_msat", 0)),
+            debit_msat=int(payload.get("debit_msat", 0)),
+            reference=payload.get("reference"),
+            timestamp=payload.get("timestamp"),
+        )
+
+    def record(self, account: str, tag: str, credit_msat: int = 0,
+               debit_msat: int = 0, reference: str | None = None,
+               timestamp: int | None = None) -> dict:
+        ev = {
+            "account": account, "tag": tag,
+            "credit_msat": credit_msat, "debit_msat": debit_msat,
+            "currency": "bcrt",
+            "timestamp": int(timestamp if timestamp is not None
+                             else time.time()),
+            "reference": reference,
+        }
+        self.events.append(ev)
+        if self.db is not None:
+            with self.db.transaction():
+                self.db.conn.execute(
+                    "INSERT INTO bkpr_events (account, tag, credit_msat,"
+                    " debit_msat, currency, timestamp, reference)"
+                    " VALUES (?,?,?,?,?,?,?)",
+                    (ev["account"], ev["tag"], ev["credit_msat"],
+                     ev["debit_msat"], ev["currency"], ev["timestamp"],
+                     ev["reference"]))
+        return ev
+
+    # -- queries (bkpr-* RPC shapes) --------------------------------------
+
+    def listaccountevents(self, account: str | None = None) -> list[dict]:
+        return [e for e in self.events
+                if account is None or e["account"] == account]
+
+    def listbalances(self) -> list[dict]:
+        bal: dict[str, int] = {}
+        for e in self.events:
+            bal[e["account"]] = (bal.get(e["account"], 0)
+                                 + e["credit_msat"] - e["debit_msat"])
+        return [{"account": a, "balance_msat": b}
+                for a, b in sorted(bal.items())]
+
+    INCOME_TAGS = ("invoice", "routed")
+    EXPENSE_TAGS = ("invoice_fee", "onchain_fee", "payment")
+
+    def listincome(self, start: int = 0, end: int | None = None) -> dict:
+        """Income statement: credits under income tags minus expense
+        debits in [start, end) (bkpr-listincome)."""
+        end = end if end is not None else 2 ** 63
+        items = []
+        income = expense = 0
+        for e in self.events:
+            if not (start <= e["timestamp"] < end):
+                continue
+            if e["tag"] in self.INCOME_TAGS and e["credit_msat"]:
+                income += e["credit_msat"]
+                items.append(e)
+            elif e["tag"] in self.EXPENSE_TAGS and e["debit_msat"]:
+                expense += e["debit_msat"]
+                items.append(e)
+        return {"income_events": items, "total_income_msat": income,
+                "total_expense_msat": expense,
+                "net_msat": income - expense}
+
+
+def attach_bookkeeper_commands(rpc, bk: Bookkeeper) -> None:
+    async def bkpr_listaccountevents(account: str | None = None) -> dict:
+        return {"events": bk.listaccountevents(account)}
+
+    async def bkpr_listbalances() -> dict:
+        return {"accounts": bk.listbalances()}
+
+    async def bkpr_listincome(start_time: int = 0,
+                              end_time: int | None = None) -> dict:
+        return bk.listincome(start_time, end_time)
+
+    rpc.register("bkpr-listaccountevents", bkpr_listaccountevents)
+    rpc.register("bkpr-listbalances", bkpr_listbalances)
+    rpc.register("bkpr-listincome", bkpr_listincome)

@@ -1,0 +1,134 @@
+"""sql: read-only SQL queries over the node's list commands.
+
+Functional parity target: plugins/sql.c (sqlite3 vtables lazily
+populated from listpeers/listchannels/... so operators can JOIN/filter
+node state with plain SQL).  Here each query materializes the current
+list-command snapshots into an in-memory sqlite database and runs the
+(SELECT-only) statement against it — simpler than vtables, same
+observable behavior at our scale.
+"""
+from __future__ import annotations
+
+import json
+import sqlite3
+
+
+class SqlRpcError(Exception):
+    pass
+
+
+# table name -> (rpc method, result list key, column spec)
+# columns: (name, type, extractor key or callable)
+TABLES = {
+    "peers": ("listpeers", "peers", [
+        ("id", "TEXT", "id"), ("connected", "INTEGER", "connected"),
+        ("features", "TEXT", "features"),
+    ]),
+    "nodes": ("listnodes", "nodes", [
+        ("nodeid", "TEXT", "nodeid"), ("alias", "TEXT", "alias"),
+        ("last_timestamp", "INTEGER", "last_timestamp"),
+    ]),
+    "channels": ("listchannels", "channels", [
+        ("short_channel_id", "TEXT", "short_channel_id"),
+        ("source", "TEXT", "source"),
+        ("destination", "TEXT", "destination"),
+        ("amount_msat", "INTEGER", "amount_msat"),
+        ("active", "INTEGER", "active"),
+        ("base_fee_millisatoshi", "INTEGER", "base_fee_millisatoshi"),
+        ("fee_per_millionth", "INTEGER", "fee_per_millionth"),
+        ("delay", "INTEGER", "delay"),
+    ]),
+    "invoices": ("listinvoices", "invoices", [
+        ("label", "TEXT", "label"),
+        ("payment_hash", "TEXT", "payment_hash"),
+        ("status", "TEXT", "status"),
+        ("amount_msat", "INTEGER", "amount_msat"),
+        ("description", "TEXT", "description"),
+        ("expires_at", "INTEGER", "expires_at"),
+    ]),
+    "payments": ("listpays", "pays", [
+        ("payment_hash", "TEXT", "payment_hash"),
+        ("status", "TEXT", "status"),
+        ("amount_msat", "INTEGER", "amount_msat"),
+        ("destination", "TEXT", "destination"),
+    ]),
+    "forwards": ("listforwards", "forwards", [
+        ("in_channel", "TEXT", "in_channel"),
+        ("out_channel", "TEXT", "out_channel"),
+        ("in_msat", "INTEGER", "in_msat"),
+        ("out_msat", "INTEGER", "out_msat"),
+        ("fee_msat", "INTEGER", "fee_msat"),
+        ("status", "TEXT", "status"),
+    ]),
+    "bkpr_events": ("bkpr-listaccountevents", "events", [
+        ("account", "TEXT", "account"), ("tag", "TEXT", "tag"),
+        ("credit_msat", "INTEGER", "credit_msat"),
+        ("debit_msat", "INTEGER", "debit_msat"),
+        ("timestamp", "INTEGER", "timestamp"),
+    ]),
+}
+
+FORBIDDEN = ("insert", "update", "delete", "drop", "create", "alter",
+             "attach", "pragma", "vacuum", "replace")
+
+
+async def run_query(rpc, query: str) -> list[list]:
+    """Populate a scratch db from the list commands the query mentions,
+    run it, return rows (sql.c returns arrays per row)."""
+    low = " ".join(query.lower().split())
+    first = low.split(" ", 1)[0] if low else ""
+    if first not in ("select", "with"):
+        raise SqlRpcError("only SELECT queries are allowed")
+    for bad in FORBIDDEN:
+        if f" {bad} " in f" {low} ":
+            raise SqlRpcError(f"forbidden keyword {bad!r}")
+
+    import inspect
+
+    db = sqlite3.connect(":memory:")
+    try:
+        for table, (method, key, cols) in TABLES.items():
+            if table not in low:
+                continue
+            handler = rpc.methods.get(method)
+            if handler is None:
+                continue
+            result = handler()
+            if inspect.isawaitable(result):
+                result = await result
+            rows = result.get(key, []) if isinstance(result, dict) else []
+            db.execute(
+                f"CREATE TABLE {table} "
+                f"({', '.join(f'{n} {t}' for n, t, _ in cols)})")
+            for item in rows:
+                vals = []
+                for _, _, k in cols:
+                    v = item.get(k) if isinstance(item, dict) else None
+                    if isinstance(v, (dict, list)):
+                        v = json.dumps(v)
+                    elif isinstance(v, bool):
+                        v = int(v)
+                    vals.append(v)
+                db.execute(
+                    f"INSERT INTO {table} VALUES "
+                    f"({','.join('?' * len(cols))})", vals)
+        try:
+            cur = db.execute(query)
+            return [list(r) for r in cur.fetchall()]
+        except sqlite3.Error as e:
+            raise SqlRpcError(str(e)) from None
+    finally:
+        db.close()
+
+
+def attach_sql_command(rpc) -> None:
+    from ..daemon.jsonrpc import RpcError
+
+    async def sql(query: str) -> dict:
+        try:
+            rows = await run_query(rpc, query)
+        except SqlRpcError as e:
+            raise RpcError(-1, str(e))
+        return {"rows": rows}
+
+    rpc.register("sql", sql)

@@ -134,7 +134,10 @@ def build_arcs(g: Gossmap, amount_msat: int, layers: Layers | None = None,
                 np.float64, len(idx))
             eff_ppm += bias
 
-        piece_cap = np.maximum(cap // NUM_PIECES, 1)
+        # piece capacities sum EXACTLY to cap: a reserved-to-zero or
+        # tiny direction must not leak phantom capacity (the last piece
+        # carries the remainder, earlier pieces may be 0 and are culled)
+        piece_cap = cap // NUM_PIECES
         # probability slope scaled so a full channel costs ~prob_weight
         # ppm-equivalents per msat at the steep end
         for p in range(NUM_PIECES):
@@ -234,11 +237,17 @@ def solve(g: Gossmap, source: bytes, destination: bytes, amount_msat: int,
         if pred is None:
             raise McfError(
                 f"no residual path for remaining {remaining} msat")
-        # walk dst → src along predecessor arcs
+        # walk dst → src along predecessor arcs (cycle guard: a
+        # MAX_ROUNDS-truncated BF on a residual graph with negative
+        # reverse arcs can leave a cyclic pred — fail loudly, never spin)
         path = []
         v = dst
+        seen = set()
         bottleneck = remaining
         while v != src:
+            if v in seen:
+                raise McfError("predecessor cycle (solver truncation)")
+            seen.add(v)
             a = int(pred[v])
             path.append(a)
             bottleneck = min(bottleneck, int(arcs.residual[a]))
@@ -337,10 +346,11 @@ def getroutes(g: Gossmap, source: bytes, destination: bytes,
               prob_weight: float = 1.0, delay_weight: float = 1.0,
               max_parts: int = MAX_PARTS) -> dict:
     """askrene's getroutes shape: multi-part routes + total fee, with
-    the maxfee constraint enforced on the SOLUTION (askrene refine.c
-    re-solves with a higher prob_weight if fees blow the budget; one
-    retry tier here)."""
-    for attempt_prob in (prob_weight, prob_weight * 10):
+    the maxfee constraint enforced on the SOLUTION.  If the first solve
+    blows the budget we re-solve with the reliability weight slashed so
+    fees dominate the objective (the direction askrene's refine step
+    moves its fee-weight mu)."""
+    for attempt_prob in (prob_weight, prob_weight / 100.0):
         parts = solve(g, source, destination, amount_msat, layers,
                       attempt_prob, delay_weight, max_parts)
         routes = routes_from_parts(g, parts, destination, final_cltv)

@@ -1,0 +1,39 @@
+"""Tiny synchronous notification bus.
+
+Functional parity target: lightningd/notification.c's topics
+(REGISTER_NOTIFICATION sites) — in-process subscribers instead of
+plugin-process fan-out; the PluginHost bridges topics to external
+plugins, the bookkeeper consumes `coin_movement` directly.
+
+Emission never raises: a broken subscriber must not break a payment.
+"""
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("lightning_tpu.events")
+
+_subscribers: dict[str, list] = {}
+
+
+def subscribe(topic: str, fn) -> None:
+    _subscribers.setdefault(topic, []).append(fn)
+
+
+def unsubscribe(topic: str, fn) -> None:
+    lst = _subscribers.get(topic, [])
+    if fn in lst:
+        lst.remove(fn)
+
+
+def emit(topic: str, payload: dict) -> None:
+    for fn in list(_subscribers.get(topic, [])):
+        try:
+            fn(payload)
+        except Exception:
+            log.exception("subscriber for %s failed", topic)
+
+
+def reset() -> None:
+    """Test isolation helper."""
+    _subscribers.clear()

@@ -1,0 +1,125 @@
+"""Tracing spans: lightweight structured profiling of daemon phases.
+
+Functional parity target: common/trace.c (trace_span_start/end/
+suspend/resume emitting USDT probes consumed by contrib/cln-tracer) —
+re-targeted: spans emit JSON lines (one object per completed span) to a
+sink, and — the TPU twist — a span can wrap a `jax.profiler` trace so
+host-side phases correlate with the device timeline.
+
+Usage:
+    from lightning_tpu.utils import trace
+    with trace.span("gossip/verify", batch=4096):
+        ...
+    trace.set_sink(path_or_callable)   # default: in-memory ring
+
+Spans nest via a contextvar; each record carries its parent's name so a
+flame view can be reconstructed.  Suspend/resume (for spans crossing an
+await) are modeled by `span()` measuring wall time only between enter
+and exit — matching trace.c's span lifetime semantics.
+"""
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import time
+from contextlib import contextmanager
+
+_current = contextvars.ContextVar("trace_span", default=None)
+
+_records: list[dict] = []
+_MAX_RECORDS = 10_000
+_sink = None          # None → ring buffer; else callable(record)
+_file = None
+
+
+def set_sink(sink) -> None:
+    """sink: a path (append JSON lines) or a callable(record) or None
+    (in-memory ring, default)."""
+    global _sink, _file
+    if _file is not None:
+        _file.close()
+        _file = None
+    if isinstance(sink, str):
+        _file = open(sink, "a")
+        _sink = lambda rec: (_file.write(json.dumps(rec) + "\n"),
+                             _file.flush())
+    else:
+        _sink = sink
+
+
+def records() -> list[dict]:
+    return list(_records)
+
+
+def reset() -> None:
+    _records.clear()
+
+
+def _emit(rec: dict) -> None:
+    if _sink is not None:
+        _sink(rec)
+        return
+    _records.append(rec)
+    if len(_records) > _MAX_RECORDS:
+        del _records[: _MAX_RECORDS // 2]
+
+
+@contextmanager
+def span(name: str, **attributes):
+    """Measure one phase; attaches to the enclosing span as parent."""
+    parent = _current.get()
+    token = _current.set(name)
+    t0 = time.monotonic_ns()
+    err = None
+    try:
+        yield
+    except BaseException as e:
+        err = type(e).__name__
+        raise
+    finally:
+        _current.reset(token)
+        rec = {
+            "name": name,
+            "parent": parent,
+            "start_ns": t0,
+            "duration_ns": time.monotonic_ns() - t0,
+        }
+        if attributes:
+            rec["attributes"] = attributes
+        if err is not None:
+            rec["error"] = err
+        _emit(rec)
+
+
+@contextmanager
+def device_span(name: str, **attributes):
+    """A span that also captures the XLA device timeline when
+    LIGHTNING_TPU_PROFILE_DIR is set (jax.profiler trace) — the
+    correlation hook cln-tracer gets from USDT probes."""
+    profile_dir = os.environ.get("LIGHTNING_TPU_PROFILE_DIR")
+    if profile_dir:
+        import jax
+
+        with jax.profiler.trace(profile_dir):
+            with span(name, profiled=True, **attributes):
+                yield
+    else:
+        with span(name, **attributes):
+            yield
+
+
+def summarize() -> dict:
+    """Aggregate by span name: count + total/mean duration (the quick
+    operator view `getlog`-style)."""
+    agg: dict[str, list[int]] = {}
+    for r in _records:
+        agg.setdefault(r["name"], []).append(r["duration_ns"])
+    return {
+        name: {
+            "count": len(ds),
+            "total_ms": sum(ds) / 1e6,
+            "mean_ms": sum(ds) / len(ds) / 1e6,
+        }
+        for name, ds in agg.items()
+    }

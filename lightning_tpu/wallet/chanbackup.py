@@ -1,0 +1,270 @@
+"""Static channel backups (SCB) + peer_storage distribution.
+
+Functional parity targets: plugins/chanbackup.c (the encrypted
+`emergency.recover` blob: one static record per channel, re-encrypted
+and re-distributed on every channel change) and the BOLT `peer_storage`
+/`peer_storage_retrieval` messages (wire/peer_wire.csv:30-34) that let
+peers hold our blob for us; lightningd's recover flow
+(lightningd/lightningd.c:1434, plugins/recover.c) restores from it.
+
+The SCB deliberately holds only STATIC data: enough to identify the
+channel, reconnect to the peer, and run channel_reestablish so the
+peer force-closes to us (we cannot reconstruct HTLC state — that is the
+wallet db's job; the SCB is the disaster floor, not a checkpoint).
+
+Encryption: ChaCha20-Poly1305, key = sha256("scb secret" || hsm_secret),
+random 12-byte nonce prepended.  Version byte leads the plaintext.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import struct
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+log = logging.getLogger("lightning_tpu.chanbackup")
+
+SCB_VERSION = 1
+MAX_PEER_STORAGE = 65531   # BOLT#1 peer_storage blob cap
+
+
+class ScbError(Exception):
+    pass
+
+
+def scb_key(hsm_secret: bytes) -> bytes:
+    return hashlib.sha256(b"scb secret" + hsm_secret).digest()
+
+
+def _pack_chan(row: dict) -> bytes:
+    """One channel's static record from its wallet row."""
+    addr = row.get("peer_addr", "").encode()
+    return struct.pack(
+        ">B33s32s32sHQB H", SCB_VERSION, row["peer_node_id"],
+        row["channel_id"], row["funding_txid"], row["funding_outidx"],
+        row["funding_sat"], int(bool(row["opener_is_local"])), len(addr),
+    ) + addr
+
+
+_FIXED = struct.calcsize(">B33s32s32sHQB H")
+
+
+def _unpack_chan(raw: bytes, off: int) -> tuple[dict, int]:
+    if off + _FIXED > len(raw):
+        raise ScbError("truncated channel record")
+    (ver, node_id, cid, txid, outidx, sat, opener,
+     alen) = struct.unpack_from(">B33s32s32sHQB H", raw, off)
+    if ver != SCB_VERSION:
+        raise ScbError(f"unknown SCB record version {ver}")
+    off += _FIXED
+    addr = raw[off:off + alen].decode(errors="replace")
+    off += alen
+    return {
+        "peer_node_id": node_id, "channel_id": cid, "funding_txid": txid,
+        "funding_outidx": outidx, "funding_sat": sat,
+        "opener_is_local": bool(opener), "peer_addr": addr,
+    }, off
+
+
+def serialize(channels: list[dict]) -> bytes:
+    out = [struct.pack(">BH", SCB_VERSION, len(channels))]
+    out += [_pack_chan(c) for c in channels]
+    return b"".join(out)
+
+
+def parse(raw: bytes) -> list[dict]:
+    if len(raw) < 3:
+        raise ScbError("short SCB")
+    ver, n = struct.unpack_from(">BH", raw, 0)
+    if ver != SCB_VERSION:
+        raise ScbError(f"unknown SCB version {ver}")
+    off, chans = 3, []
+    for _ in range(n):
+        c, off = _unpack_chan(raw, off)
+        chans.append(c)
+    return chans
+
+
+def encrypt(hsm_secret: bytes, channels: list[dict]) -> bytes:
+    nonce = os.urandom(12)
+    ct = ChaCha20Poly1305(scb_key(hsm_secret)).encrypt(
+        nonce, serialize(channels), b"")
+    blob = nonce + ct
+    if len(blob) > MAX_PEER_STORAGE:
+        raise ScbError("SCB exceeds peer_storage size cap")
+    return blob
+
+
+def decrypt(hsm_secret: bytes, blob: bytes) -> list[dict]:
+    if len(blob) < 12 + 16:
+        raise ScbError("short SCB blob")
+    try:
+        pt = ChaCha20Poly1305(scb_key(hsm_secret)).decrypt(
+            blob[:12], blob[12:], b"")
+    except InvalidTag:
+        raise ScbError("SCB decryption failed (wrong secret or tampered)") \
+            from None
+    return parse(pt)
+
+
+class PeerStorageService:
+    """Both halves of the peer_storage protocol on one node.
+
+    - we SEND our encrypted SCB to every peer on connect and whenever a
+      channel changes (chanbackup.c send_to_peers)
+    - we STORE up to one blob per peer (BOLT#1: nodes SHOULD store if
+      they have a channel with the sender) and echo it back with
+      peer_storage_retrieval on reconnect
+    """
+
+    def __init__(self, node, hsm_secret: bytes, wallet=None):
+        from ..wire import messages as M
+
+        self.node = node
+        self.hsm_secret = hsm_secret
+        self.wallet = wallet
+        self.stored: dict[bytes, bytes] = {}     # peer -> their blob
+        self.retrieved: bytes | None = None      # our blob, echoed back
+        self._table_ready = False
+        node.register(M.PeerStorage, self._on_storage)
+        node.register(M.PeerStorageRetrieval, self._on_retrieval)
+        if wallet is not None:
+            self._ensure_table()
+            for r in wallet.db.conn.execute(
+                    "SELECT peer_id, blob FROM peer_storage").fetchall():
+                self.stored[bytes(r[0])] = bytes(r[1])
+
+    def _ensure_table(self) -> None:
+        with self.wallet.db.transaction():
+            self.wallet.db.conn.execute(
+                """CREATE TABLE IF NOT EXISTS peer_storage (
+                    peer_id BLOB PRIMARY KEY, blob BLOB NOT NULL)""")
+        self._table_ready = True
+
+    # -- our backup -------------------------------------------------------
+
+    def our_blob(self) -> bytes | None:
+        if self.wallet is None:
+            return None
+        rows = self.wallet.list_channels()
+        live = [r for r in rows if r["state"] not in
+                ("closingd_complete", "onchain", "closed")]
+        if not live:
+            return None
+        return encrypt(self.hsm_secret, live)
+
+    async def distribute(self) -> int:
+        """Send our current SCB to every connected peer."""
+        from ..wire import messages as M
+
+        blob = self.our_blob()
+        if blob is None:
+            return 0
+        n = 0
+        for peer in list(self.node.peers.values()):
+            try:
+                await peer.send(M.PeerStorage(blob=blob))
+                n += 1
+            except (ConnectionError, OSError):
+                pass
+        return n
+
+    async def send_ours_to(self, peer) -> None:
+        from ..wire import messages as M
+
+        blob = self.our_blob()
+        if blob is not None:
+            await peer.send(M.PeerStorage(blob=blob))
+
+    # -- storing for peers ------------------------------------------------
+
+    async def _on_storage(self, peer, msg) -> None:
+        if len(msg.blob) > MAX_PEER_STORAGE:
+            return
+        self.stored[peer.node_id] = msg.blob
+        if self.wallet is not None:
+            with self.wallet.db.transaction():
+                self.wallet.db.conn.execute(
+                    "INSERT INTO peer_storage (peer_id, blob) VALUES (?,?)"
+                    " ON CONFLICT(peer_id) DO UPDATE SET blob=excluded.blob",
+                    (peer.node_id, msg.blob))
+
+    async def _on_retrieval(self, peer, msg) -> None:
+        self.retrieved = msg.blob
+        log.info("peer %s returned our %d-byte backup",
+                 peer.node_id.hex()[:16], len(msg.blob))
+
+    async def echo_back(self, peer) -> bool:
+        """On reconnect, return the peer's stored blob (BOLT#1: a node
+        storing peer data MUST send peer_storage_retrieval on
+        reconnection)."""
+        from ..wire import messages as M
+
+        blob = self.stored.get(peer.node_id)
+        if blob is None:
+            return False
+        await peer.send(M.PeerStorageRetrieval(blob=blob))
+        return True
+
+    # -- recovery ---------------------------------------------------------
+
+    def emergencyrecover(self, blob: bytes | None = None) -> list[dict]:
+        """Decrypt an SCB (ours from a peer echo, or supplied hex) and
+        re-register channel stubs so reestablish can trigger the peer's
+        unilateral close (plugins/recover.c flow)."""
+        raw = blob if blob is not None else self.retrieved
+        if raw is None:
+            raise ScbError("no backup available to recover from")
+        chans = decrypt(self.hsm_secret, raw)
+        if self.wallet is not None:
+            for c in chans:
+                self._restore_stub(c)
+        return chans
+
+    def _restore_stub(self, c: dict) -> None:
+        """Insert a minimal 'recover' channel row unless one exists."""
+        db = self.wallet.db
+        row = db.conn.execute(
+            "SELECT id FROM channels WHERE channel_id=?",
+            (c["channel_id"],)).fetchone()
+        if row is not None:
+            return
+        with db.transaction():
+            db.conn.execute(
+                "INSERT INTO channels (peer_node_id, hsm_dbid, funder,"
+                " channel_id, funding_txid, funding_outidx, funding_sat,"
+                " state, to_local_msat, to_remote_msat, feerate_per_kw,"
+                " opener_is_local, anchors, reserve_local_msat,"
+                " reserve_remote_msat, next_local_commit,"
+                " next_remote_commit, delay_on_local, delay_on_remote,"
+                " their_dust_limit, their_funding_pub, their_basepoints,"
+                " their_points, their_last_secret)"
+                " VALUES (?,?,?,?,?,?,?,'recover',0,0,253,?,1,0,0,0,0,"
+                " 144,144,546,x'',x'',x'',x'')",
+                (c["peer_node_id"], 0, int(c["opener_is_local"]),
+                 c["channel_id"], c["funding_txid"], c["funding_outidx"],
+                 c["funding_sat"], int(c["opener_is_local"])))
+
+
+def attach_backup_commands(rpc, svc: PeerStorageService) -> None:
+    """staticbackup / emergencyrecover RPC surface."""
+
+    async def staticbackup() -> dict:
+        blob = svc.our_blob()
+        return {"scb": blob.hex() if blob else None,
+                "peers_holding": len(svc.stored)}
+
+    async def emergencyrecover(scb: str | None = None) -> dict:
+        chans = svc.emergencyrecover(bytes.fromhex(scb) if scb else None)
+        return {"stubs": [{
+            "channel_id": c["channel_id"].hex(),
+            "peer_id": c["peer_node_id"].hex(),
+            "funding_txid": c["funding_txid"].hex(),
+            "funding_sat": c["funding_sat"],
+        } for c in chans]}
+
+    rpc.register("staticbackup", staticbackup)
+    rpc.register("emergencyrecover", emergencyrecover)

@@ -1,0 +1,85 @@
+"""Bookkeeper tests: ledger ingestion from coin_movement events,
+balances, income statement, persistence — plugins/bkpr parity."""
+from __future__ import annotations
+
+import pytest
+
+from lightning_tpu.plugins.bookkeeper import Bookkeeper
+from lightning_tpu.utils import events
+from lightning_tpu.wallet.db import Db
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    events.reset()
+    yield
+    events.reset()
+
+
+def test_ledger_and_balances():
+    bk = Bookkeeper()
+    events.emit("coin_movement", {"account": "wallet", "tag": "deposit",
+                                  "credit_msat": 1_000_000})
+    events.emit("coin_movement", {"account": "wallet", "tag": "withdrawal",
+                                  "debit_msat": 400_000})
+    events.emit("coin_movement", {"account": "channel",
+                                  "tag": "channel_open",
+                                  "credit_msat": 400_000})
+    bal = {b["account"]: b["balance_msat"] for b in bk.listbalances()}
+    assert bal == {"wallet": 600_000, "channel": 400_000}
+    assert len(bk.listaccountevents()) == 3
+    assert len(bk.listaccountevents("wallet")) == 2
+
+
+def test_income_statement():
+    bk = Bookkeeper()
+    bk.record("channel", "invoice", credit_msat=50_000, timestamp=100)
+    bk.record("channel", "routed", credit_msat=1_000, timestamp=200)
+    bk.record("channel", "payment", debit_msat=30_000, timestamp=300)
+    bk.record("channel", "invoice_fee", debit_msat=25, timestamp=300)
+    bk.record("wallet", "onchain_fee", debit_msat=2_000, timestamp=400)
+    inc = bk.listincome()
+    assert inc["total_income_msat"] == 51_000
+    assert inc["total_expense_msat"] == 32_025
+    assert inc["net_msat"] == 51_000 - 32_025
+    # time-window filter
+    early = bk.listincome(0, 250)
+    assert early["total_income_msat"] == 51_000
+    assert early["total_expense_msat"] == 0
+
+
+def test_persistence_roundtrip(tmp_path):
+    db = Db(str(tmp_path / "bk.sqlite3"))
+    bk = Bookkeeper(db)
+    bk.record("wallet", "deposit", credit_msat=77)
+    bk.close()
+
+    bk2 = Bookkeeper(db)
+    assert bk2.listbalances() == [{"account": "wallet",
+                                   "balance_msat": 77}]
+    bk2.close()
+
+
+def test_invoice_settle_feeds_ledger():
+    from lightning_tpu.pay.invoices import InvoiceRegistry
+
+    bk = Bookkeeper()
+    reg = InvoiceRegistry(0xAA11)
+    rec = reg.create("x", 10_000, "feed")
+    reg.settle(rec.payment_hash, 10_000)
+    inc = bk.listincome()
+    assert inc["total_income_msat"] == 10_000
+    ev = bk.listaccountevents("channel")
+    assert ev and ev[0]["tag"] == "invoice"
+    assert ev[0]["reference"] == rec.payment_hash.hex()
+
+
+def test_broken_subscriber_never_breaks_payment():
+    def bad(_payload):
+        raise RuntimeError("boom")
+
+    events.subscribe("coin_movement", bad)
+    bk = Bookkeeper()
+    events.emit("coin_movement", {"account": "wallet", "tag": "deposit",
+                                  "credit_msat": 5})
+    assert bk.listbalances()[0]["balance_msat"] == 5

@@ -1,0 +1,142 @@
+"""Static channel backup tests: SCB blob roundtrip/tamper, peer_storage
+exchange over real nodes, emergencyrecover stub restore —
+plugins/chanbackup.c + recover flow parity."""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.wallet import chanbackup as CB
+from lightning_tpu.wallet.db import Db
+from lightning_tpu.wallet.wallet import Wallet
+
+SECRET_A = b"\xa1" * 32
+SECRET_B = b"\xb2" * 32
+
+
+def _chan_row(i=1):
+    return {
+        "peer_node_id": b"\x02" + bytes([i]) * 32,
+        "channel_id": bytes([i]) * 32,
+        "funding_txid": bytes([0x10 + i]) * 32,
+        "funding_outidx": i,
+        "funding_sat": 100_000 * i,
+        "opener_is_local": i % 2 == 0,
+        "state": "normal",
+    }
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60))
+
+
+class TestScbBlob:
+    def test_roundtrip(self):
+        chans = [_chan_row(1), _chan_row(2)]
+        blob = CB.encrypt(SECRET_A, chans)
+        back = CB.decrypt(SECRET_A, blob)
+        assert len(back) == 2
+        for a, b in zip(chans, back):
+            for k in ("peer_node_id", "channel_id", "funding_txid",
+                      "funding_outidx", "funding_sat", "opener_is_local"):
+                assert a[k] == b[k], k
+
+    def test_wrong_secret_and_tamper(self):
+        blob = CB.encrypt(SECRET_A, [_chan_row()])
+        with pytest.raises(CB.ScbError):
+            CB.decrypt(SECRET_B, blob)
+        bad = blob[:20] + bytes([blob[20] ^ 1]) + blob[21:]
+        with pytest.raises(CB.ScbError):
+            CB.decrypt(SECRET_A, bad)
+
+    def test_nonce_freshness(self):
+        chans = [_chan_row()]
+        assert CB.encrypt(SECRET_A, chans) != CB.encrypt(SECRET_A, chans)
+
+
+def test_peer_storage_exchange(tmp_path):
+    """A sends its SCB to B; B stores it (persisted) and echoes it back
+    on request; A recovers channel stubs from the echo."""
+    async def body():
+        na = LightningNode(privkey=0xA111)
+        nb = LightningNode(privkey=0xB222)
+        wa = Wallet(Db(str(tmp_path / "a.sqlite3")))
+        wb = Wallet(Db(str(tmp_path / "b.sqlite3")))
+        svc_a = CB.PeerStorageService(na, SECRET_A, wallet=wa)
+        svc_b = CB.PeerStorageService(nb, SECRET_B, wallet=wb)
+
+        # give A one live channel row to back up
+        class _Ch:
+            pass
+
+        row = _chan_row(2)
+        with wa.db.transaction():
+            wa.db.conn.execute(
+                "INSERT INTO channels (peer_node_id, hsm_dbid, funder,"
+                " channel_id, funding_txid, funding_outidx, funding_sat,"
+                " state, to_local_msat, to_remote_msat, feerate_per_kw,"
+                " opener_is_local, anchors, reserve_local_msat,"
+                " reserve_remote_msat, next_local_commit,"
+                " next_remote_commit, delay_on_local, delay_on_remote,"
+                " their_dust_limit, their_funding_pub, their_basepoints,"
+                " their_points, their_last_secret)"
+                " VALUES (?,?,?,?,?,?,?,'normal',0,0,253,1,1,0,0,1,1,"
+                "144,144,546,x'',x'',x'',x'')",
+                (row["peer_node_id"], 1, 1, row["channel_id"],
+                 row["funding_txid"], row["funding_outidx"],
+                 row["funding_sat"]))
+        try:
+            port = await na.listen()
+            peer_ab = await nb.connect("127.0.0.1", port, na.node_id)
+            for _ in range(100):
+                if nb.node_id in na.peers:
+                    break
+                await asyncio.sleep(0.01)
+            peer_ba = na.peers[nb.node_id]
+
+            # A → B: distribute; B stores
+            assert await svc_a.distribute() == 1
+            for _ in range(100):
+                if na.node_id in svc_b.stored:
+                    break
+                await asyncio.sleep(0.01)
+            assert na.node_id in svc_b.stored
+
+            # B's store survives a restart (db-backed)
+            svc_b2 = CB.PeerStorageService(nb, SECRET_B, wallet=wb)
+            assert na.node_id in svc_b2.stored
+
+            # B echoes back; A recovers stubs from it
+            assert await svc_b2.echo_back(peer_ab)
+            for _ in range(100):
+                if svc_a.retrieved is not None:
+                    break
+                await asyncio.sleep(0.01)
+            assert svc_a.retrieved is not None
+
+            # wipe A's wallet rows, then emergencyrecover reinstates stubs
+            with wa.db.transaction():
+                wa.db.conn.execute("DELETE FROM channels")
+            stubs = svc_a.emergencyrecover()
+            assert len(stubs) == 1
+            assert stubs[0]["channel_id"] == row["channel_id"]
+            rows = wa.list_channels()
+            assert len(rows) == 1 and rows[0]["state"] == "recover"
+            assert rows[0]["funding_sat"] == row["funding_sat"]
+        finally:
+            await na.close()
+            await nb.close()
+
+    run(body())
+
+
+def test_recover_is_idempotent(tmp_path):
+    wa = Wallet(Db(str(tmp_path / "i.sqlite3")))
+    na = LightningNode(privkey=0xA112)
+    svc = CB.PeerStorageService(na, SECRET_A, wallet=wa)
+    blob = CB.encrypt(SECRET_A, [_chan_row(3)])
+    svc.emergencyrecover(blob)
+    svc.emergencyrecover(blob)
+    assert len(wa.list_channels()) == 1

@@ -1,0 +1,146 @@
+"""MPP receive tests: htlc_set accumulation, completion fan-in, and the
+mpp_timeout failure — lightningd/htlc_set.c semantics — plus a live
+two-part payment over a real channel.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import pytest
+
+from lightning_tpu.daemon import channeld as CD
+from lightning_tpu.daemon.hsmd import CAP_MASTER, Hsm
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.pay import payer as P
+from lightning_tpu.pay.htlc_set import MPP_TIMEOUT, HtlcSets
+from lightning_tpu.pay.invoices import InvoiceRegistry
+
+FUND = 1_000_000
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 600))
+
+
+class TestHtlcSets:
+    def _mk(self, amount=100_000, timeout=60.0):
+        reg = InvoiceRegistry(0xAA11)
+        rec = reg.create("mpp", amount, "multi")
+        return reg, rec, HtlcSets(reg, timeout=timeout)
+
+    def test_accumulate_and_complete(self):
+        async def body():
+            reg, rec, sets = self._mk()
+            fulfilled, failed = [], []
+
+            async def ff(pre):
+                fulfilled.append(pre)
+
+            async def fl(code):
+                failed.append(code)
+
+            s1 = await sets.add_part(rec.payment_hash, 60_000,
+                                     rec.payment_secret, 100_000, ff, fl)
+            assert s1 == "held" and not fulfilled
+            s2 = await sets.add_part(rec.payment_hash, 40_000,
+                                     rec.payment_secret, 100_000, ff, fl)
+            assert s2 == "complete"
+            assert len(fulfilled) == 2
+            assert all(hashlib.sha256(p).digest() == rec.payment_hash
+                       for p in fulfilled)
+            assert reg.by_label["mpp"].status == "paid"
+            assert reg.by_label["mpp"].received_msat == 100_000
+            assert not failed and not sets.sets
+
+        run(body())
+
+    def test_rejections(self):
+        async def body():
+            reg, rec, sets = self._mk()
+
+            async def nop(_):
+                pass
+
+            # unknown hash / wrong secret / total below invoice amount
+            assert await sets.add_part(b"\x00" * 32, 1, b"s" * 32, 2,
+                                       nop, nop) == "reject"
+            assert await sets.add_part(rec.payment_hash, 60_000,
+                                       b"\x00" * 32, 100_000,
+                                       nop, nop) == "reject"
+            assert await sets.add_part(rec.payment_hash, 60_000,
+                                       rec.payment_secret, 90_000,
+                                       nop, nop) == "reject"
+            # parts disagreeing on total: second rejected
+            assert await sets.add_part(rec.payment_hash, 60_000,
+                                       rec.payment_secret, 100_000,
+                                       nop, nop) == "held"
+            assert await sets.add_part(rec.payment_hash, 40_000,
+                                       rec.payment_secret, 120_000,
+                                       nop, nop) == "reject"
+
+        run(body())
+
+    def test_timeout_fails_all_parts(self):
+        async def body():
+            reg, rec, sets = self._mk(timeout=0.2)
+            failed = []
+
+            async def ff(pre):
+                raise AssertionError("must not fulfill")
+
+            async def fl(code):
+                failed.append(code)
+
+            await sets.add_part(rec.payment_hash, 60_000,
+                                rec.payment_secret, 100_000, ff, fl)
+            await sets.add_part(rec.payment_hash, 10_000,
+                                rec.payment_secret, 100_000, ff, fl)
+            await asyncio.sleep(1.6)
+            assert failed == [MPP_TIMEOUT, MPP_TIMEOUT]
+            assert not sets.sets
+            assert reg.by_label["mpp"].status == "unpaid"
+
+        run(body())
+
+
+def test_mpp_payment_over_channel(tmp_path):
+    """Two-part payment over one real channel: held, completed, both
+    fulfilled in one dance."""
+    async def body():
+        hsm_a, hsm_b = Hsm(b"\xa7" * 32), Hsm(b"\xb8" * 32)
+        na = LightningNode(privkey=hsm_b.node_key)
+        nb = LightningNode(privkey=hsm_a.node_key)
+        reg_b = InvoiceRegistry(hsm_b.node_key)
+        sets_b = HtlcSets(reg_b)
+        done = asyncio.Event()
+
+        async def serve(peer):
+            client = hsm_b.client(CAP_MASTER, peer.node_id, dbid=1)
+            await CD.channel_responder(peer, hsm_b, client, hsm_b.node_key,
+                                       invoices=reg_b, htlc_sets=sets_b)
+            done.set()
+
+        na.on_peer = serve
+        try:
+            port = await na.listen()
+            peer = await nb.connect("127.0.0.1", port, na.node_id)
+            client = hsm_a.client(CAP_MASTER, peer.node_id, dbid=1)
+            ch = await CD.open_channel(peer, hsm_a, client, FUND)
+
+            rec = reg_b.create("mpp-live", 30_000_000, "two parts")
+            res = await P.pay_mpp_direct(ch, rec.bolt11, parts=2)
+            assert hashlib.sha256(res.preimage).digest() == rec.payment_hash
+            assert reg_b.by_label["mpp-live"].status == "paid"
+            assert reg_b.by_label["mpp-live"].received_msat == 30_000_000
+            assert ch.core.to_remote_msat == 30_000_000
+
+            await ch.shutdown()
+            await ch.recv_shutdown()
+            await ch.negotiate_close()
+            await asyncio.wait_for(done.wait(), 60)
+        finally:
+            await na.close()
+            await nb.close()
+
+    run(body())

@@ -181,9 +181,11 @@ def test_multihop_route_construction(tmp_path):
             with pytest.raises(P.PayError) as ei:
                 await P.pay_over_channel(ch, rec.bolt11, gossmap=g,
                                          wallet=wallet_a)
-            # B PEELED the onion (not malformed) and failed in the clear
+            # B PEELED the onion (not malformed), recognized a forward
+            # it cannot place (no relay service on this responder), and
+            # failed with unknown_next_peer (BOLT#4 UPDATE|10)
             assert ei.value.erring_index == 0
-            assert ei.value.code == 0x400F
+            assert ei.value.code == 0x100A
             # what we sent funds B's forwarding fee on top of the amount
             pays = P.listpays(wallet_a)
             assert pays[0]["amount_msat"] == 5_000_000

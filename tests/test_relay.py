@@ -1,0 +1,176 @@
+"""HTLC relay tests: a three-node A—B—C payment where B's relay service
+does the forwarding autonomously (peer_htlcs.c forward_htlc parity) —
+policy enforcement, preimage back-propagation, and error attribution.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import pytest
+
+from lightning_tpu.bolt import onion_payload as OP
+from lightning_tpu.daemon import channeld as CD
+from lightning_tpu.daemon.hsmd import CAP_MASTER, Hsm
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.daemon.relay import Relay, RelayPolicy
+from lightning_tpu.pay.invoices import InvoiceRegistry
+
+FUND = 1_000_000
+SCID_BC = 0x0001_0000_0001
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 600))
+
+
+async def _open(na, nb, hsm_a, hsm_b, dbid):
+    """Channel na→nb; returns (funder_ch, fundee_ch)."""
+    port = await na.listen()
+    fut = asyncio.get_running_loop().create_future()
+
+    async def serve(peer):
+        client = hsm_a.client(CAP_MASTER, peer.node_id, dbid=dbid)
+        ch = await CD.accept_channel(peer, hsm_a, client)
+        fut.set_result(ch)
+
+    na.on_peer = serve
+    peer = await nb.connect("127.0.0.1", port, na.node_id)
+    client = hsm_b.client(CAP_MASTER, peer.node_id, dbid=dbid)
+    ch_out = await CD.open_channel(peer, hsm_b, client, FUND)
+    ch_in = await asyncio.wait_for(fut, 60)
+    return ch_out, ch_in
+
+
+async def _relay_network(policy=None):
+    """A —chan→ B —chan→ C with B running the full relay service.
+    Returns (ch_ab payer side, relay, invoices_c, cleanup, tasks)."""
+    privs = {"a": 0xA001, "b": 0xB002, "c": 0xC003}
+    hsms = {k: Hsm(bytes([i + 0x51]) * 32) for i, k in enumerate("abc")}
+    na = LightningNode(privkey=privs["a"])
+    nb = LightningNode(privkey=privs["b"])
+    nc = LightningNode(privkey=privs["c"])
+
+    # A → B channel: A funder, B fundee (B serves it with channel_loop)
+    ch_ab, ch_ba = await _open(nb, na, hsms["b"], hsms["a"], 1)
+    # B → C channel: B funder, C fundee
+    ch_bc, ch_cb = await _open(nc, nb, hsms["c"], hsms["b"], 2)
+
+    relay = Relay(policy or RelayPolicy(fee_base_msat=1000, fee_ppm=0,
+                                        cltv_delta=20))
+    relay.register(SCID_BC, ch_bc)
+    invoices_c = InvoiceRegistry(privs["c"])
+
+    tasks = [
+        asyncio.get_running_loop().create_task(
+            CD.channel_loop(ch_ba, privs["b"], relay=relay)),
+        asyncio.get_running_loop().create_task(
+            CD.channel_loop(ch_bc, privs["b"], relay=relay)),
+        asyncio.get_running_loop().create_task(
+            CD.channel_loop(ch_cb, privs["c"], invoices=invoices_c)),
+    ]
+
+    async def cleanup():
+        for t in tasks:
+            t.cancel()
+        for n in (na, nb, nc):
+            await n.close()
+
+    return ch_ab, relay, invoices_c, cleanup
+
+
+async def _send_via_relay(ch_ab, nb_id, nc_id, rec, amount, fee,
+                          final_cltv=500_020):
+    onion, secrets = OP.build_route_onion(
+        [nb_id, nc_id],
+        [OP.HopPayload(amount, final_cltv, short_channel_id=SCID_BC),
+         OP.HopPayload(amount, final_cltv,
+                       payment_secret=rec.payment_secret,
+                       total_msat=amount)],
+        rec.payment_hash, session_key=0x1234567,
+    )
+    await ch_ab.offer_htlc(amount + fee, rec.payment_hash,
+                           final_cltv + 20, onion=onion)
+    await ch_ab.commit()
+    await ch_ab.handle_commit()
+    upd = await ch_ab.recv_update()
+    await ch_ab.handle_commit()
+    await ch_ab.commit()
+    return upd, secrets
+
+
+def test_relay_forwards_and_propagates_preimage():
+    async def body():
+        ch_ab, relay, invoices_c, cleanup = await _relay_network()
+        try:
+            amount = 10_000_000
+            rec = invoices_c.create("relayed", amount, "via B")
+            upd, _ = await _send_via_relay(
+                ch_ab, ch_ab.peer.node_id, _node_id(0xC003),
+                rec, amount, fee=1000)
+            assert hasattr(upd, "payment_preimage"), f"failed: {upd}"
+            assert hashlib.sha256(upd.payment_preimage).digest() \
+                == rec.payment_hash
+            assert invoices_c.by_label["relayed"].status == "paid"
+            fwd = relay.listforwards()
+            assert fwd and fwd[-1]["status"] == "settled"
+            assert fwd[-1]["fee_msat"] == 1000
+        finally:
+            await cleanup()
+
+    run(body())
+
+
+def test_relay_rejects_insufficient_fee():
+    async def body():
+        policy = RelayPolicy(fee_base_msat=5000, fee_ppm=0, cltv_delta=20)
+        ch_ab, relay, invoices_c, cleanup = await _relay_network(policy)
+        try:
+            amount = 10_000_000
+            rec = invoices_c.create("cheap", amount, "underpaid fee")
+            upd, secrets = await _send_via_relay(
+                ch_ab, ch_ab.peer.node_id, _node_id(0xC003),
+                rec, amount, fee=1000)   # below the 5000 policy
+            from lightning_tpu.bolt import sphinx as SX
+            from lightning_tpu.wire import messages as M
+
+            assert isinstance(upd, M.UpdateFailHtlc)
+            idx, failmsg = SX.unwrap_error_onion(secrets, upd.reason)
+            assert idx == 0                       # B (first hop) failed it
+            code = int.from_bytes(failmsg[:2], "big")
+            assert code == 0x1000 | 12            # fee_insufficient
+            assert invoices_c.by_label["cheap"].status == "unpaid"
+            assert relay.listforwards()[-1]["failreason"] \
+                == "fee_insufficient"
+        finally:
+            await cleanup()
+
+    run(body())
+
+
+def test_relay_unknown_scid_fails_cleanly():
+    async def body():
+        ch_ab, relay, invoices_c, cleanup = await _relay_network()
+        try:
+            relay.unregister(SCID_BC)
+            amount = 5_000_000
+            rec = invoices_c.create("nowhere", amount, "no such channel")
+            upd, secrets = await _send_via_relay(
+                ch_ab, ch_ab.peer.node_id, _node_id(0xC003),
+                rec, amount, fee=1000)
+            from lightning_tpu.bolt import sphinx as SX
+            from lightning_tpu.wire import messages as M
+
+            assert isinstance(upd, M.UpdateFailHtlc)
+            _, failmsg = SX.unwrap_error_onion(secrets, upd.reason)
+            assert int.from_bytes(failmsg[:2], "big") == 0x1000 | 10
+        finally:
+            await cleanup()
+
+    run(body())
+
+
+def _node_id(priv: int) -> bytes:
+    from lightning_tpu.crypto import ref_python as ref
+
+    return ref.pubkey_serialize(ref.pubkey_create(priv))

@@ -1,0 +1,105 @@
+"""sql plugin + autoclean tests (plugins/sql.c, plugins/autoclean.c)."""
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from lightning_tpu.daemon.jsonrpc import JsonRpcServer, RpcError
+from lightning_tpu.pay.invoices import InvoiceRegistry
+from lightning_tpu.plugins.autoclean import Autoclean
+from lightning_tpu.plugins.sqlrpc import attach_sql_command
+from lightning_tpu.wallet.db import Db
+from lightning_tpu.wallet.wallet import Wallet
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+class TestSql:
+    def _rpc(self, tmp_path):
+        rpc = JsonRpcServer(str(tmp_path / "r.sock"))
+
+        async def listinvoices(label=None):
+            return {"invoices": [
+                {"label": "a", "payment_hash": "00" * 32,
+                 "status": "paid", "amount_msat": 100,
+                 "description": "x", "expires_at": 1},
+                {"label": "b", "payment_hash": "11" * 32,
+                 "status": "unpaid", "amount_msat": 250,
+                 "description": "y", "expires_at": 2},
+            ]}
+
+        async def listpeers():
+            return {"peers": [{"id": "02aa", "connected": True,
+                               "features": ""}]}
+
+        rpc.register("listinvoices", listinvoices)
+        rpc.register("listpeers", listpeers)
+        attach_sql_command(rpc)
+        return rpc
+
+    def test_select_and_aggregate(self, tmp_path):
+        async def body():
+            rpc = self._rpc(tmp_path)
+            sql = rpc.methods["sql"]
+            out = await sql(query="SELECT label, amount_msat FROM invoices"
+                                  " WHERE status='unpaid'")
+            assert out["rows"] == [["b", 250]]
+            out = await sql(query="SELECT SUM(amount_msat) FROM invoices")
+            assert out["rows"] == [[350]]
+            out = await sql(
+                query="SELECT COUNT(*) FROM peers WHERE connected=1")
+            assert out["rows"] == [[1]]
+
+        run(body())
+
+    def test_writes_rejected(self, tmp_path):
+        async def body():
+            rpc = self._rpc(tmp_path)
+            sql = rpc.methods["sql"]
+            for q in ("DELETE FROM invoices",
+                      "INSERT INTO invoices VALUES (1)",
+                      "SELECT * FROM invoices; DROP TABLE invoices",
+                      "PRAGMA journal_mode"):
+                with pytest.raises(RpcError):
+                    await sql(query=q)
+
+        run(body())
+
+
+class TestAutoclean:
+    def test_sweeps_by_age(self, tmp_path):
+        reg = InvoiceRegistry(0xAA11, db=Db(str(tmp_path / "i.sqlite3")))
+        old = reg.create("old", 1000, "old", expiry=1)
+        keep = reg.create("keep", 1000, "keep", expiry=10_000)
+        # expire the old one
+        reg.listinvoices()  # triggers expiry sweep after its expires_at
+        time.sleep(1.1)
+        reg.listinvoices()
+        assert reg.by_label["old"].status == "expired"
+
+        wallet = Wallet(Db(str(tmp_path / "w.sqlite3")))
+        with wallet.db.transaction():
+            wallet.db.conn.execute(
+                "INSERT INTO payments (payment_hash, amount_msat,"
+                " amount_sent_msat, status, created_at, completed_at)"
+                " VALUES (x'00', 1, 1, 'failed', 1, 1)")
+
+        ac = Autoclean(invoices=reg, wallet=wallet)
+        ac.configure("expiredinvoices", 1)
+        ac.configure("failedpays", 1)
+        done = ac.clean_once(now=time.time() + 100)
+        assert done["expiredinvoices"] == 1
+        assert done["failedpays"] == 1
+        assert "old" not in reg.by_label and "keep" in reg.by_label
+        # db row went too
+        rows = reg.db.conn.execute(
+            "SELECT label FROM invoices").fetchall()
+        assert [r[0] for r in rows] == ["keep"]
+        # zero age = disabled
+        done = ac.clean_once(now=time.time() + 10 ** 6)
+        assert done["paidinvoices"] == 0
+        assert ac.cleaned["expiredinvoices"] == 1

@@ -1,0 +1,75 @@
+"""Tracing span tests (common/trace.c span semantics)."""
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from lightning_tpu.utils import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.set_sink(None)
+    trace.reset()
+    yield
+    trace.set_sink(None)
+    trace.reset()
+
+
+def test_nested_spans_record_parentage():
+    with trace.span("outer"):
+        with trace.span("inner", n=3):
+            time.sleep(0.01)
+    recs = trace.records()
+    assert [r["name"] for r in recs] == ["inner", "outer"]
+    inner, outer = recs
+    assert inner["parent"] == "outer"
+    assert outer["parent"] is None
+    assert inner["attributes"] == {"n": 3}
+    assert inner["duration_ns"] >= 10_000_000
+    assert outer["duration_ns"] >= inner["duration_ns"]
+
+
+def test_error_annotated_and_reraised():
+    with pytest.raises(ValueError):
+        with trace.span("boom"):
+            raise ValueError("x")
+    assert trace.records()[0]["error"] == "ValueError"
+
+
+def test_file_sink(tmp_path):
+    p = str(tmp_path / "trace.jsonl")
+    trace.set_sink(p)
+    with trace.span("to-file"):
+        pass
+    trace.set_sink(None)
+    lines = [json.loads(x) for x in open(p)]
+    assert lines and lines[0]["name"] == "to-file"
+    assert trace.records() == []   # sink bypasses the ring
+
+
+def test_summarize():
+    for _ in range(3):
+        with trace.span("phase/a"):
+            pass
+    with trace.span("phase/b"):
+        pass
+    s = trace.summarize()
+    assert s["phase/a"]["count"] == 3
+    assert s["phase/b"]["count"] == 1
+    assert s["phase/a"]["total_ms"] >= 0
+
+
+def test_instrumented_paths_emit():
+    """The hsmd batch signer emits a span."""
+    from lightning_tpu.daemon.hsmd import CAP_MASTER, Hsm
+    from lightning_tpu.crypto import ref_python as ref
+
+    hsm = Hsm(b"\x11" * 32)
+    client = hsm.client(CAP_MASTER, b"", dbid=1)
+    point = ref.pubkey_create(5)
+    hsm.sign_htlc_batch(client, [b"\xab" * 32], point)
+    names = [r["name"] for r in trace.records()]
+    assert "hsmd/sign_htlc_batch" in names
