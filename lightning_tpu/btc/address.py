@@ -1,0 +1,127 @@
+"""Bitcoin addresses: segwit bech32/bech32m encode/decode.
+
+Functional parity target: the reference's bitcoin/bech32.c (BIP173) +
+bip173/bip350 address handling in common/addr.c and bitcoin/script.c's
+scriptpubkey builders — written from the BIP173/BIP350 specs (the
+bech32 charset/checksum core is shared with our bolt11 codec).
+"""
+from __future__ import annotations
+
+import hashlib
+
+from ..bolt.bolt11 import CHARSET, _REV, _hrp_expand, _polymod
+from ..bolt.bolt11 import _to5 as _bolt11_to5
+
+BECH32M_CONST = 0x2BC830A3
+
+HRP_FOR_NETWORK = {"bitcoin": "bc", "testnet": "tb", "signet": "tb",
+                   "regtest": "bcrt"}
+
+
+class AddressError(Exception):
+    pass
+
+
+def _checksum(hrp: str, data: list[int], const: int) -> list[int]:
+    pm = _polymod(_hrp_expand(hrp) + data + [0] * 6) ^ const
+    return [(pm >> 5 * (5 - i)) & 31 for i in range(6)]
+
+
+_to5 = _bolt11_to5   # shared 8→5 bit regrouping (bolt11.py)
+
+
+def _to8(data: list[int]) -> bytes:
+    """5→8 regrouping — NOT shared with bolt11's: BIP173 additionally
+    rejects >4 leftover padding bits, which bolt11 tolerates."""
+    acc, bits, out = 0, 0, bytearray()
+    for v in data:
+        acc = (acc << 5) | v
+        bits += 5
+        while bits >= 8:
+            bits -= 8
+            out.append((acc >> bits) & 0xFF)
+    if bits >= 5 or (acc & ((1 << bits) - 1)):
+        raise AddressError("bad bech32 padding")
+    return bytes(out)
+
+
+def encode(hrp: str, witver: int, witprog: bytes) -> str:
+    """BIP173 (v0, bech32) / BIP350 (v1+, bech32m) address."""
+    if not 0 <= witver <= 16:
+        raise AddressError("bad witness version")
+    if witver == 0 and len(witprog) not in (20, 32):
+        raise AddressError("bad v0 program length")
+    if not 2 <= len(witprog) <= 40:
+        raise AddressError("bad program length")
+    const = 1 if witver == 0 else BECH32M_CONST
+    data = [witver] + _to5(witprog)
+    return hrp + "1" + "".join(
+        CHARSET[d] for d in data + _checksum(hrp, data, const))
+
+
+def decode(addr: str, expected_hrp: str | None = None) \
+        -> tuple[int, bytes]:
+    """Returns (witness_version, witness_program); validates the right
+    checksum constant per version (BIP350)."""
+    if addr.lower() != addr and addr.upper() != addr:
+        raise AddressError("mixed case")
+    addr = addr.lower()
+    pos = addr.rfind("1")
+    if pos < 1 or pos + 7 > len(addr) or len(addr) > 90:
+        raise AddressError("bad address form")
+    hrp, rest = addr[:pos], addr[pos + 1:]
+    if expected_hrp is not None and hrp != expected_hrp:
+        raise AddressError(f"wrong network hrp {hrp!r}")
+    try:
+        data = [_REV[c] for c in rest]
+    except KeyError as e:
+        raise AddressError(f"invalid character {e.args[0]!r}")
+    if len(data) < 7:
+        raise AddressError("too short")
+    pm = _polymod(_hrp_expand(hrp) + data)
+    witver = data[0]
+    want = 1 if witver == 0 else BECH32M_CONST
+    if pm != want:
+        raise AddressError("bad checksum")
+    prog = _to8(data[1:-6])
+    if witver == 0 and len(prog) not in (20, 32):
+        raise AddressError("bad v0 program length")
+    if not 2 <= len(prog) <= 40 or witver > 16:
+        raise AddressError("bad program")
+    return witver, prog
+
+
+# -- script ↔ address ------------------------------------------------------
+
+def to_scriptpubkey(addr: str, expected_hrp: str | None = None) -> bytes:
+    witver, prog = decode(addr, expected_hrp)
+    op = 0x00 if witver == 0 else 0x50 + witver
+    return bytes([op, len(prog)]) + prog
+
+
+def from_scriptpubkey(spk: bytes, hrp: str = "bcrt") -> str:
+    if len(spk) < 4 or spk[1] != len(spk) - 2:
+        raise AddressError("not a segwit scriptpubkey")
+    if spk[0] == 0x00:
+        witver = 0
+    elif 0x51 <= spk[0] <= 0x60:
+        witver = spk[0] - 0x50
+    else:
+        raise AddressError("not a segwit scriptpubkey")
+    return encode(hrp, witver, spk[2:])
+
+
+def p2wpkh(pubkey33: bytes, hrp: str = "bcrt") -> str:
+    h = hashlib.new("ripemd160",
+                    hashlib.sha256(pubkey33).digest()).digest()
+    return encode(hrp, 0, h)
+
+
+def p2wsh(witness_script: bytes, hrp: str = "bcrt") -> str:
+    return encode(hrp, 0, hashlib.sha256(witness_script).digest())
+
+
+def p2tr(output_key_x: bytes, hrp: str = "bcrt") -> str:
+    if len(output_key_x) != 32:
+        raise AddressError("x-only key must be 32 bytes")
+    return encode(hrp, 1, output_key_x)

@@ -1,0 +1,255 @@
+"""PSBT (BIP174): partially-signed bitcoin transactions.
+
+Functional parity target: the reference's use of libwally PSBTs —
+bitcoin/psbt.c wrappers and common/psbt_open.c's combine/join helpers
+that drive dual-funded interactive tx construction — re-implemented
+from the BIP174 spec.  Subset: v0 PSBTs with witness UTXOs, partial
+sigs, witness scripts, finalization of p2wpkh and 2-of-2 p2wsh inputs
+(the two shapes channel funding needs), and combining.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from .tx import Tx, TxInput, TxOutput, read_varint, write_varint
+
+MAGIC = b"psbt\xff"
+
+# global types
+PSBT_GLOBAL_UNSIGNED_TX = 0x00
+# input types
+PSBT_IN_NON_WITNESS_UTXO = 0x00
+PSBT_IN_WITNESS_UTXO = 0x01
+PSBT_IN_PARTIAL_SIG = 0x02
+PSBT_IN_SIGHASH_TYPE = 0x03
+PSBT_IN_WITNESS_SCRIPT = 0x05
+PSBT_IN_FINAL_SCRIPTSIG = 0x07
+PSBT_IN_FINAL_SCRIPTWITNESS = 0x08
+# output types
+PSBT_OUT_WITNESS_SCRIPT = 0x01
+
+
+class PsbtError(Exception):
+    pass
+
+
+def _write_kv(out: bytearray, key: bytes, value: bytes) -> None:
+    out += write_varint(len(key)) + key
+    out += write_varint(len(value)) + value
+
+
+def _read_map(raw: bytes, off: int) -> tuple[dict[bytes, bytes], int]:
+    m: dict[bytes, bytes] = {}
+    while True:
+        if off >= len(raw):
+            raise PsbtError("unterminated map")
+        klen, off = read_varint(raw, off)
+        if klen == 0:
+            return m, off
+        key = raw[off:off + klen]
+        off += klen
+        vlen, off = read_varint(raw, off)
+        val = raw[off:off + vlen]
+        off += vlen
+        if len(key) != klen or len(val) != vlen:
+            raise PsbtError("truncated map entry")
+        if key in m:
+            raise PsbtError("duplicate key")
+        m[key] = val
+    # not reached
+
+
+@dataclass
+class PsbtInput:
+    witness_utxo: TxOutput | None = None
+    partial_sigs: dict[bytes, bytes] = field(default_factory=dict)
+    sighash_type: int | None = None
+    witness_script: bytes | None = None
+    final_scriptsig: bytes = b""
+    final_witness: list[bytes] | None = None
+
+    def to_map(self) -> dict[bytes, bytes]:
+        m: dict[bytes, bytes] = {}
+        if self.witness_utxo is not None:
+            m[bytes([PSBT_IN_WITNESS_UTXO])] = self.witness_utxo.serialize()
+        for pub, sig in sorted(self.partial_sigs.items()):
+            m[bytes([PSBT_IN_PARTIAL_SIG]) + pub] = sig
+        if self.sighash_type is not None:
+            m[bytes([PSBT_IN_SIGHASH_TYPE])] = \
+                self.sighash_type.to_bytes(4, "little")
+        if self.witness_script is not None:
+            m[bytes([PSBT_IN_WITNESS_SCRIPT])] = self.witness_script
+        if self.final_scriptsig:
+            m[bytes([PSBT_IN_FINAL_SCRIPTSIG])] = self.final_scriptsig
+        if self.final_witness is not None:
+            m[bytes([PSBT_IN_FINAL_SCRIPTWITNESS])] = \
+                _serialize_witness(self.final_witness)
+        return m
+
+    @classmethod
+    def from_map(cls, m: dict[bytes, bytes]) -> "PsbtInput":
+        inp = cls()
+        for key, val in m.items():
+            t = key[0]
+            if t == PSBT_IN_WITNESS_UTXO and len(key) == 1:
+                inp.witness_utxo = _parse_txout(val)
+            elif t == PSBT_IN_PARTIAL_SIG:
+                inp.partial_sigs[key[1:]] = val
+            elif t == PSBT_IN_SIGHASH_TYPE and len(key) == 1:
+                inp.sighash_type = int.from_bytes(val, "little")
+            elif t == PSBT_IN_WITNESS_SCRIPT and len(key) == 1:
+                inp.witness_script = val
+            elif t == PSBT_IN_FINAL_SCRIPTSIG and len(key) == 1:
+                inp.final_scriptsig = val
+            elif t == PSBT_IN_FINAL_SCRIPTWITNESS and len(key) == 1:
+                inp.final_witness = _parse_witness(val)
+        return inp
+
+
+def _serialize_witness(items: list[bytes]) -> bytes:
+    out = bytearray(write_varint(len(items)))
+    for it in items:
+        out += write_varint(len(it)) + it
+    return bytes(out)
+
+
+def _parse_witness(raw: bytes) -> list[bytes]:
+    n, off = read_varint(raw, 0)
+    items = []
+    for _ in range(n):
+        ln, off = read_varint(raw, off)
+        items.append(raw[off:off + ln])
+        off += ln
+    return items
+
+
+def _parse_txout(raw: bytes) -> TxOutput:
+    amount = int.from_bytes(raw[:8], "little")
+    ln, off = read_varint(raw, 8)
+    return TxOutput(amount, raw[off:off + ln])
+
+
+@dataclass
+class Psbt:
+    tx: Tx
+    inputs: list[PsbtInput] = field(default_factory=list)
+    outputs: list[dict] = field(default_factory=list)
+
+    @classmethod
+    def from_tx(cls, tx: Tx) -> "Psbt":
+        return cls(tx=tx,
+                   inputs=[PsbtInput() for _ in tx.inputs],
+                   outputs=[{} for _ in tx.outputs])
+
+    def serialize(self) -> bytes:
+        out = bytearray(MAGIC)
+        _write_kv(out, bytes([PSBT_GLOBAL_UNSIGNED_TX]),
+                  self.tx.serialize(include_witness=False))
+        out += b"\x00"
+        for inp in self.inputs:
+            for k, v in inp.to_map().items():
+                _write_kv(out, k, v)
+            out += b"\x00"
+        for o in self.outputs:
+            for k, v in o.items():
+                _write_kv(out, k, v)
+            out += b"\x00"
+        return bytes(out)
+
+    @classmethod
+    def parse(cls, raw: bytes) -> "Psbt":
+        if raw[:5] != MAGIC:
+            raise PsbtError("bad magic")
+        gmap, off = _read_map(raw, 5)
+        txraw = gmap.get(bytes([PSBT_GLOBAL_UNSIGNED_TX]))
+        if txraw is None:
+            raise PsbtError("missing unsigned tx")
+        tx = Tx.parse(txraw)
+        if any(i.script_sig for i in tx.inputs):
+            raise PsbtError("unsigned tx has scriptSigs")
+        inputs, outputs = [], []
+        for _ in tx.inputs:
+            m, off = _read_map(raw, off)
+            inputs.append(PsbtInput.from_map(m))
+        for _ in tx.outputs:
+            m, off = _read_map(raw, off)
+            outputs.append(m)
+        return cls(tx=tx, inputs=inputs, outputs=outputs)
+
+    # -- roles ------------------------------------------------------------
+
+    def combine(self, other: "Psbt") -> None:
+        """BIP174 Combiner: merge signatures/fields for the same tx."""
+        if other.tx.serialize(False) != self.tx.serialize(False):
+            raise PsbtError("combine: different transactions")
+        for mine, theirs in zip(self.inputs, other.inputs):
+            mine.partial_sigs.update(theirs.partial_sigs)
+            mine.witness_utxo = mine.witness_utxo or theirs.witness_utxo
+            mine.witness_script = mine.witness_script or theirs.witness_script
+            if theirs.final_witness is not None:
+                mine.final_witness = theirs.final_witness
+
+    def sighash(self, idx: int, script_code: bytes,
+                sighash_type: int = 0x01) -> bytes:
+        inp = self.inputs[idx]
+        if inp.witness_utxo is None:
+            raise PsbtError("input has no witness_utxo")
+        return self.tx.sighash_segwit(idx, script_code,
+                                      inp.witness_utxo.amount_sat,
+                                      sighash_type)
+
+    def finalize(self) -> None:
+        """Finalizer for p2wpkh and 2-of-2 p2wsh multisig inputs."""
+        for i, inp in enumerate(self.inputs):
+            if inp.final_witness is not None:
+                continue
+            if inp.witness_utxo is None:
+                raise PsbtError(f"input {i}: no witness_utxo")
+            spk = inp.witness_utxo.script_pubkey
+            if inp.witness_script is not None:
+                ws = inp.witness_script
+                if (len(spk) != 34 or spk[:2] != b"\x00\x20"
+                        or hashlib.sha256(ws).digest() != spk[2:]):
+                    raise PsbtError(f"input {i}: script/spk mismatch")
+                sigs = _multisig_order(ws, inp.partial_sigs)
+                if sigs is None:
+                    raise PsbtError(f"input {i}: missing signatures")
+                # BIP147 NULLDUMMY leading empty element
+                inp.final_witness = [b""] + sigs + [ws]
+            elif len(spk) == 22 and spk[:2] == b"\x00\x14":
+                if len(inp.partial_sigs) != 1:
+                    raise PsbtError(f"input {i}: need exactly one sig")
+                (pub, sig), = inp.partial_sigs.items()
+                h = hashlib.new("ripemd160",
+                                hashlib.sha256(pub).digest()).digest()
+                if h != spk[2:]:
+                    raise PsbtError(f"input {i}: pubkey/spk mismatch")
+                inp.final_witness = [sig, pub]
+            else:
+                raise PsbtError(f"input {i}: unsupported script type")
+            inp.partial_sigs.clear()
+            inp.witness_script = None
+
+    def extract(self) -> Tx:
+        """BIP174 Extractor: the fully-signed network transaction."""
+        for i, inp in enumerate(self.inputs):
+            if inp.final_witness is None:
+                raise PsbtError(f"input {i} not finalized")
+            self.tx.inputs[i].witness = inp.final_witness
+        return self.tx
+
+
+def _multisig_order(witness_script: bytes,
+                    partial_sigs: dict[bytes, bytes]) -> list[bytes] | None:
+    """Order sigs per the 2-of-2 OP_CHECKMULTISIG pubkey order
+    (bitcoin/script.c bitcoin_redeem_2of2 layout: 52 <p1> <p2> 52 ae)."""
+    if (len(witness_script) != 71 or witness_script[0] != 0x52
+            or witness_script[-1] != 0xAE):
+        return None
+    p1 = witness_script[2:35]
+    p2 = witness_script[36:69]
+    s1, s2 = partial_sigs.get(p1), partial_sigs.get(p2)
+    if s1 is None or s2 is None:
+        return None
+    return [s1, s2]

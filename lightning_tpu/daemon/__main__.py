@@ -99,8 +99,10 @@ async def amain(args) -> int:
                               OnionMessenger, attach_offers_commands)
 
     from .relay import Relay
+    from ..plugins.funder import FunderPolicy
 
     relay_svc = Relay()
+    funder_policy = FunderPolicy()
     node_seckey = node.keypair.priv
     db = wallet.db if wallet is not None else None
     messenger = OnionMessenger(node, node_seckey)
@@ -154,6 +156,10 @@ async def amain(args) -> int:
         from .relay import attach_relay_commands
 
         attach_relay_commands(rpc, relay_svc)
+
+        from ..plugins.funder import FunderPolicy, attach_funder_commands
+
+        attach_funder_commands(rpc, funder_policy)
         rune_secret = _hl.sha256(
             b"commando" + node_seckey.to_bytes(32, "big")).digest()[:16]
         commando = Commando(node, rpc, rune_secret)
@@ -177,12 +183,32 @@ async def amain(args) -> int:
 
         async def serve_channels(peer):
             from .hsmd import CAP_MASTER
+            from ..wire import messages as WM
 
             client = hsm.client(CAP_MASTER, peer.node_id, dbid=1)
-            tx = await CD.channel_responder(peer, hsm, client, hsm.node_key,
-                                            wallet=wallet, invoices=invoices,
-                                            htlc_sets=htlc_sets,
-                                            relay=relay_svc)
+            # dispatch v1 vs v2 opens on the first message; for v2 the
+            # funder policy decides our contribution (0 until the
+            # on-chain UTXO wallet lands: available funds are 0)
+            first = await peer.recv(WM.OpenChannel, WM.OpenChannel2,
+                                    timeout=600)
+            if isinstance(first, WM.OpenChannel2):
+                from . import dualopend as DO
+
+                contribute = funder_policy.contribution(
+                    first.funding_satoshis, available_sat=0)
+                ch, _tx = await DO.accept_channel_v2(
+                    peer, hsm, client, contribute_sat=contribute,
+                    first_msg=first)
+                tx = await CD.channel_loop(ch, hsm.node_key,
+                                           invoices=invoices,
+                                           htlc_sets=htlc_sets,
+                                           relay=relay_svc)
+            else:
+                tx = await CD.channel_responder(
+                    peer, hsm, client, hsm.node_key,
+                    wallet=wallet, invoices=invoices,
+                    htlc_sets=htlc_sets, relay=relay_svc,
+                    first_msg=first)
             print(f"channel closed, closing txid {tx.txid().hex()}",
                   flush=True)
 

@@ -666,10 +666,13 @@ async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
 
 async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
                          cfg: ChannelConfig | None = None,
-                         wallet=None, hsm_dbid: int = 0) -> Channeld:
-    """Fundee-side v1 open."""
+                         wallet=None, hsm_dbid: int = 0,
+                         first_msg=None) -> Channeld:
+    """Fundee-side v1 open.  first_msg: an already-received OpenChannel
+    (the daemon peeks the first message to dispatch v1 vs v2)."""
     cfg = cfg or ChannelConfig()
-    oc = await peer.recv(M.OpenChannel, timeout=RECV_TIMEOUT)
+    oc = first_msg if first_msg is not None else \
+        await peer.recv(M.OpenChannel, timeout=RECV_TIMEOUT)
     ch = Channeld(peer, hsm, client, funder=False, cfg=cfg)
     ch.their_base = _parse_basepoints(oc)
     ch.their_funding_pub = oc.funding_pubkey
@@ -845,11 +848,11 @@ async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
                             cfg: ChannelConfig | None = None,
                             wallet=None, hsm_dbid: int = 1,
                             invoices=None, htlc_sets=None,
-                            relay=None) -> T.Tx:
+                            relay=None, first_msg=None) -> T.Tx:
     """Accept one inbound channel and serve it to completion (see
     channel_loop)."""
     ch = await accept_channel(peer, hsm, client, cfg, wallet=wallet,
-                              hsm_dbid=hsm_dbid)
+                              hsm_dbid=hsm_dbid, first_msg=first_msg)
     return await channel_loop(ch, node_privkey, invoices=invoices,
                               htlc_sets=htlc_sets, relay=relay)
 

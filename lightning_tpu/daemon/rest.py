@@ -1,0 +1,121 @@
+"""REST API: HTTP gateway onto the JSON-RPC command table.
+
+Functional parity target: the clnrest plugin (plugins/rest-plugin,
+Rust) — `POST /v1/<method>` with a JSON body of parameters, authorized
+by a rune in the `Rune` header; responses are the raw command results.
+Implemented on asyncio streams (no framework): requests are small,
+one-shot, and local-operator-facing.
+"""
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+import logging
+
+from .jsonrpc import RpcError
+
+log = logging.getLogger("lightning_tpu.rest")
+
+MAX_BODY = 4 * 1024 * 1024
+
+
+class RestServer:
+    def __init__(self, rpc, commando=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        """rpc: JsonRpcServer (command table).  commando: when given,
+        its master secret checks the `Rune` header (clnrest requires a
+        rune per request; without commando the server is auth-less and
+        should only bind loopback)."""
+        self.rpc = rpc
+        self.commando = commando
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        try:
+            status, body = await self._handle(reader)
+        except Exception:
+            log.exception("rest request failed")
+            status, body = 500, {"error": "internal error"}
+        try:
+            payload = json.dumps(body).encode()
+            reason = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
+                      404: "Not Found", 500: "Error"}.get(status, "?")
+            writer.write(
+                f"HTTP/1.1 {status} {reason}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + payload)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    async def _handle(self, reader) -> tuple[int, dict]:
+        request = await asyncio.wait_for(reader.readline(), 30)
+        try:
+            method_verb, target, _ = request.decode().split(" ", 2)
+        except ValueError:
+            return 400, {"error": "malformed request line"}
+        headers = {}
+        while True:
+            line = await asyncio.wait_for(reader.readline(), 30)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if b":" in line:
+                k, v = line.decode().split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+
+        if not target.startswith("/v1/"):
+            return 404, {"error": "unknown path (use /v1/<method>)"}
+        method = target[4:].strip("/")
+        if method_verb != "POST":
+            return 400, {"error": "use POST"}
+
+        length = int(headers.get("content-length", "0") or 0)
+        if length > MAX_BODY:
+            return 400, {"error": "body too large"}
+        raw = await asyncio.wait_for(reader.readexactly(length), 30) \
+            if length else b""
+        try:
+            params = json.loads(raw) if raw else {}
+        except json.JSONDecodeError:
+            return 400, {"error": "invalid JSON body"}
+        if not isinstance(params, dict):
+            return 400, {"error": "params must be an object"}
+
+        if self.commando is not None:
+            rune = headers.get("rune")
+            if not rune:
+                return 401, {"error": "missing Rune header"}
+            why = self.commando.check_rune(rune, method, params, b"")
+            if why is not None:
+                return 401, {"error": f"rune rejected: {why}"}
+
+        handler = self.rpc.methods.get(method)
+        if handler is None:
+            return 404, {"error": f"unknown command {method!r}"}
+        try:
+            result = handler(**params)
+            if inspect.isawaitable(result):
+                result = await result
+            return 200, result
+        except RpcError as e:
+            return 400, {"error": str(e), "code": e.code}
+        except TypeError as e:
+            return 400, {"error": str(e)}

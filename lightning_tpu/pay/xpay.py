@@ -1,0 +1,181 @@
+"""xpay: the modern payment engine — MCF routes + multi-part sends.
+
+Functional parity target: plugins/xpay/xpay.c (asks askrene for
+`getroutes`, splits into parts, injects each part's onion, retries with
+the failing channel disabled) — here the solver is routing.mcf and the
+injection path is our own channel driver.
+
+Flow: decode invoice → mcf.getroutes from our direct peer to the payee
+(our unannounced channel is prepended to every part) → build one onion
+per part with payment_secret + total_msat → offer all parts, one
+commitment dance → collect fulfills/fails.  On a part failure the
+erring channel is disabled in the layers and the WHOLE payment retries
+(up to `retries` times), matching xpay's "disable and re-ask" loop.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+from ..bolt import bolt11 as B11
+from ..bolt import sphinx as SX
+from ..routing import mcf
+from ..wire import messages as M
+from .payer import (FAILURE_NAMES, PayError, PayResult, RouteStep,
+                    _fail_payment, _record_payment, _settle_payment,
+                    build_payment_onion)
+
+log = logging.getLogger("lightning_tpu.xpay")
+
+
+async def xpay(ch, invoice_str: str, gossmap, *,
+               amount_msat: int | None = None,
+               maxfee_msat: int | None = None,
+               layers: mcf.Layers | None = None,
+               max_parts: int = 8, retries: int = 2,
+               blockheight: int = 0, wallet=None) -> PayResult:
+    """Pay a BOLT#11 invoice over `ch` using min-cost-flow routing."""
+    inv = B11.decode(invoice_str)
+    amount = inv.amount_msat or amount_msat
+    if amount is None:
+        raise PayError("invoice has no amount; amount_msat required")
+    if inv.payment_secret is None:
+        raise PayError("xpay requires a payment_secret (MPP)")
+    if time.time() > inv.expires_at:
+        raise PayError("invoice expired")
+    final_cltv = blockheight + inv.min_final_cltv
+    layers = layers or mcf.Layers()
+
+    created = int(time.time())
+    pay_id = _record_payment(wallet, inv, invoice_str, amount, amount,
+                             created)
+    last_err: PayError | None = None
+    for attempt in range(retries + 1):
+        try:
+            result = await _attempt(ch, inv, gossmap, amount, layers,
+                                    maxfee_msat, max_parts, final_cltv)
+            _settle_payment(wallet, pay_id, result.preimage,
+                            amount_msat=amount,
+                            amount_sent_msat=result.amount_sent_msat,
+                            payment_hash=inv.payment_hash)
+            return result
+        except _PartFailure as pf:
+            last_err = pf.err
+            if pf.erring_scid is not None:
+                layers.disabled.add(pf.erring_scid)
+                log.info("xpay: disabled %s after failure, retrying",
+                         pf.erring_scid)
+            else:
+                break
+        except mcf.McfError as e:
+            last_err = PayError(f"no route: {e}", code=205)
+            break
+    _fail_payment(wallet, pay_id, str(last_err))
+    raise last_err
+
+
+class _PartFailure(Exception):
+    def __init__(self, err: PayError, erring_scid: int | None):
+        self.err = err
+        self.erring_scid = erring_scid
+
+
+async def _attempt(ch, inv, gossmap, amount: int, layers,
+                   maxfee_msat, max_parts: int,
+                   final_cltv: int) -> PayResult:
+    if ch.peer.node_id == inv.payee:
+        routes = [{"source_amount_msat": amount,
+                   "source_delay": final_cltv, "path": [],
+                   "amount_msat": amount}]
+    else:
+        res = mcf.getroutes(gossmap, ch.peer.node_id, inv.payee, amount,
+                            layers=layers, maxfee_msat=maxfee_msat,
+                            final_cltv=final_cltv, max_parts=max_parts)
+        routes = []
+        for r in res["routes"]:
+            routes.append({
+                "source_amount_msat": r["source_amount_msat"],
+                "source_delay": r["source_delay"],
+                "amount_msat": r["amount_msat"],
+                "path": [(bytes.fromhex(h["next_node_id"]),
+                          h["short_channel_id"], h["amount_msat"],
+                          h["delay"]) for h in r["path"]],
+            })
+
+    # the WHOLE premium we pay includes the source peer's own
+    # forwarding fee (mcf's fee excludes the source hop, since a
+    # source doesn't charge itself) — enforce maxfee on it up front
+    total_sent = sum(r["source_amount_msat"] for r in routes)
+    if maxfee_msat is not None and total_sent - amount > maxfee_msat:
+        raise mcf.McfError(
+            f"fee {total_sent - amount} msat exceeds maxfee "
+            f"{maxfee_msat}")
+
+    # build + offer every part, then one dance
+    parts_by_hid = {}   # hid -> (route_scids, sphinx secrets)
+    sent = 0
+    for r in routes:
+        steps = [RouteStep(ch.peer.node_id, 0, r["source_amount_msat"],
+                           r["source_delay"])]
+        steps += [RouteStep(n, s, a, d) for n, s, a, d in r["path"]]
+        onion, secrets = build_payment_onion(
+            steps, inv.payment_hash, inv.payment_secret, amount,
+            SX.random_session_key())
+        hid = await ch.offer_htlc(r["source_amount_msat"],
+                                  inv.payment_hash,
+                                  r["source_delay"], onion=onion)
+        parts_by_hid[hid] = ([0] + [s for _, s, _, _ in r["path"]],
+                             secrets)
+        sent += r["source_amount_msat"]
+    await ch.commit()
+    await ch.handle_commit()
+
+    # collect a resolution for EVERY part before touching the dance:
+    # raising on the first failure would leave sibling fails queued and
+    # desync our commitment view from the peer's
+    preimage = None
+    first_failure: tuple[PayError, int | None] | None = None
+    for _ in range(len(routes)):
+        upd = await ch.recv_update()
+        if isinstance(upd, M.UpdateFulfillHtlc):
+            preimage = upd.payment_preimage
+            continue
+        if isinstance(upd, M.UpdateFailMalformedHtlc):
+            if first_failure is None:
+                first_failure = (PayError(
+                    f"part failed: malformed onion "
+                    f"({upd.failure_code:#x})",
+                    code=upd.failure_code, erring_index=0), None)
+            continue
+        if isinstance(upd, M.UpdateFailHtlc):
+            scids, secrets = parts_by_hid.get(upd.id, (None, None))
+            hop_idx = code = None
+            if secrets is not None:
+                try:
+                    hop_idx, failmsg = SX.unwrap_error_onion(secrets,
+                                                             upd.reason)
+                    code = int.from_bytes(failmsg[:2], "big") \
+                        if len(failmsg) >= 2 else None
+                except SX.SphinxError:
+                    pass
+            name = FAILURE_NAMES.get(code,
+                                     f"code {code:#x}" if code else "?")
+            err = PayError(f"part failed at hop {hop_idx}: {name}",
+                           code=code, erring_index=hop_idx)
+            # disable the erring node's OUTGOING channel (xpay's
+            # "disable and re-ask"); hop 0 is our own unannounced hop
+            erring_scid = None
+            if scids and hop_idx is not None:
+                if hop_idx + 1 < len(scids) and scids[hop_idx + 1]:
+                    erring_scid = scids[hop_idx + 1]
+                elif 0 <= hop_idx < len(scids) and scids[hop_idx]:
+                    erring_scid = scids[hop_idx]
+            if first_failure is None:
+                first_failure = (err, erring_scid)
+    await ch.handle_commit()
+    await ch.commit()
+    if first_failure is not None:
+        raise _PartFailure(*first_failure)
+    if preimage is None:
+        raise PayError("no part fulfilled and no failure reported")
+    return PayResult(inv.payment_hash, preimage, amount, sent)

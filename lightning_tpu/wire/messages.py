@@ -124,6 +124,127 @@ class FundingSigned(Message):
     FIELDS = [("channel_id", "bytes:32"), ("signature", "signature")]
 
 
+# ---------------------------------------------------------------------------
+# BOLT#2 — channel establishment v2 (dual funding) + interactive tx
+# construction (peer_wire.csv types 64-74)
+
+
+class OpenChannel2(Message):
+    TYPE = 64
+    FIELDS = [
+        ("chain_hash", "chain_hash"),
+        ("temporary_channel_id", "bytes:32"),
+        ("funding_feerate_perkw", "u32"),
+        ("commitment_feerate_perkw", "u32"),
+        ("funding_satoshis", "u64"),
+        ("dust_limit_satoshis", "u64"),
+        ("max_htlc_value_in_flight_msat", "u64"),
+        ("htlc_minimum_msat", "u64"),
+        ("to_self_delay", "u16"),
+        ("max_accepted_htlcs", "u16"),
+        ("locktime", "u32"),
+        ("funding_pubkey", "point"),
+        ("revocation_basepoint", "point"),
+        ("payment_basepoint", "point"),
+        ("delayed_payment_basepoint", "point"),
+        ("htlc_basepoint", "point"),
+        ("first_per_commitment_point", "point"),
+        ("second_per_commitment_point", "point"),
+        ("channel_flags", "u8"),
+        ("tlvs", "tlvs"),
+    ]
+
+
+class AcceptChannel2(Message):
+    TYPE = 65
+    FIELDS = [
+        ("temporary_channel_id", "bytes:32"),
+        ("funding_satoshis", "u64"),
+        ("dust_limit_satoshis", "u64"),
+        ("max_htlc_value_in_flight_msat", "u64"),
+        ("htlc_minimum_msat", "u64"),
+        ("minimum_depth", "u32"),
+        ("to_self_delay", "u16"),
+        ("max_accepted_htlcs", "u16"),
+        ("funding_pubkey", "point"),
+        ("revocation_basepoint", "point"),
+        ("payment_basepoint", "point"),
+        ("delayed_payment_basepoint", "point"),
+        ("htlc_basepoint", "point"),
+        ("first_per_commitment_point", "point"),
+        ("second_per_commitment_point", "point"),
+        ("tlvs", "tlvs"),
+    ]
+
+
+class TxAddInput(Message):
+    TYPE = 66
+    FIELDS = [
+        ("channel_id", "bytes:32"),
+        ("serial_id", "u64"),
+        ("prevtx", "varbytes"),
+        ("prevtx_vout", "u32"),
+        ("sequence", "u32"),
+        ("tlvs", "tlvs"),
+    ]
+
+
+class TxAddOutput(Message):
+    TYPE = 67
+    FIELDS = [
+        ("channel_id", "bytes:32"),
+        ("serial_id", "u64"),
+        ("sats", "u64"),
+        ("script", "varbytes"),
+    ]
+
+
+class TxRemoveInput(Message):
+    TYPE = 68
+    FIELDS = [("channel_id", "bytes:32"), ("serial_id", "u64")]
+
+
+class TxRemoveOutput(Message):
+    TYPE = 69
+    FIELDS = [("channel_id", "bytes:32"), ("serial_id", "u64")]
+
+
+class TxComplete(Message):
+    TYPE = 70
+    FIELDS = [("channel_id", "bytes:32")]
+
+
+class TxSignatures(Message):
+    TYPE = 71
+    FIELDS = [
+        ("channel_id", "bytes:32"),
+        ("txid", "bytes:32"),
+        # u16 count, then per input: u16 num_elements, each
+        # (u16 len || element) — parsed by daemon/dualopend helpers
+        ("witnesses", "remainder"),
+    ]
+
+
+class TxInitRbf(Message):
+    TYPE = 72
+    FIELDS = [
+        ("channel_id", "bytes:32"),
+        ("locktime", "u32"),
+        ("feerate", "u32"),
+        ("tlvs", "tlvs"),
+    ]
+
+
+class TxAckRbf(Message):
+    TYPE = 73
+    FIELDS = [("channel_id", "bytes:32"), ("tlvs", "tlvs")]
+
+
+class TxAbort(Message):
+    TYPE = 74
+    FIELDS = [("channel_id", "bytes:32"), ("data", "varbytes")]
+
+
 class ChannelReady(Message):
     TYPE = 36
     FIELDS = [

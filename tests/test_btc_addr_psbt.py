@@ -1,0 +1,157 @@
+"""Address + PSBT tests.
+
+Addresses are pinned by the public BIP173/BIP350 spec vectors; PSBT by
+construction→sign→finalize→extract roundtrips over our own tx engine
+(the 2-of-2 shape is the channel-funding spend path).
+"""
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+from lightning_tpu.btc import address as A
+from lightning_tpu.btc import psbt as P
+from lightning_tpu.btc import script as SC
+from lightning_tpu.btc import tx as T
+from lightning_tpu.crypto import ref_python as ref
+
+
+class TestAddress:
+    def test_bip173_valid_vectors(self):
+        # (address, witver, program hex) from BIP173/BIP350
+        cases = [
+            ("BC1QW508D6QEJXTDG4Y5R3ZARVARY0C5XW7KV8F3T4", 0,
+             "751e76e8199196d454941c45d1b3a323f1433bd6"),
+            ("tb1qrp33g0q5c5txsp9arysrx4k6zdkfs4nce4xj0gdcccefvpysxf3q0sl5k7",
+             0, "1863143c14c5166804bd19203356da136c985678cd4d27a1b8c63296049032620"[:64]),
+            ("bc1pw508d6qejxtdg4y5r3zarvary0c5xw7kw508d6qejxtdg4y5r3zarvary0c5xw7kt5nd6y",
+             1, "751e76e8199196d454941c45d1b3a323f1433bd6751e76e8199196d454941c45d1b3a323f1433bd6"),
+            ("BC1SW50QGDZ25J", 16, "751e"),
+            ("bc1zw508d6qejxtdg4y5r3zarvaryvaxxpcs", 2,
+             "751e76e8199196d454941c45d1b3a323"),
+            ("bc1p0xlxvlhemja6c4dqv22uapctqupfhlxm9h8z3k2e72q4k9hcz7vqzk5jj0",
+             1, "79be667ef9dcbbac55a06295ce870b07029bfcdb2dce28d959f2815b16f81798"),
+        ]
+        for addr, ver, prog in cases:
+            v, p = A.decode(addr)
+            assert v == ver, addr
+            assert p.hex() == prog, addr
+            # re-encode (canonical lower-case) must survive decode
+            again = A.encode(addr.lower().split("1")[0], v, p)
+            assert A.decode(again) == (v, p)
+
+    def test_bip350_invalid_vectors(self):
+        bad = [
+            # wrong checksum algo for version (bech32 on v1+, m on v0)
+            "bc1qw508d6qejxtdg4y5r3zarvary0c5xw7kemeawh",
+            "tb1q0xlxvlhemja6c4dqv22uapctqupfhlxm9h8z3k2e72q4k9hcz7vq24jc47",
+            "bc1p38j9r5y49hruaue7wxjce0updqjuyyx0kh56v8s25huc6995vvpql3jow4",
+            # invalid chars / mixed case / bad padding
+            "bc1p38j9r5y49hruaue7wxjce0updqjuyyx0kh56v8s25huc6995vvpql3jOw4",
+            "bc1gmk9yu",
+            # v0 with wrong program length
+            "BC1QR508D6QEJXTDG4Y5R3ZARVARYV98GJ9P",
+        ]
+        for addr in bad:
+            with pytest.raises(A.AddressError):
+                A.decode(addr)
+
+    def test_script_roundtrip(self):
+        pub = ref.pubkey_serialize(ref.pubkey_create(7))
+        addr = A.p2wpkh(pub)
+        assert addr.startswith("bcrt1q")
+        spk = A.to_scriptpubkey(addr)
+        h = hashlib.new("ripemd160", hashlib.sha256(pub).digest()).digest()
+        assert spk == b"\x00\x14" + h
+        assert A.from_scriptpubkey(spk) == addr
+
+        ws = b"\x51"  # trivial script
+        addr2 = A.p2wsh(ws)
+        assert A.to_scriptpubkey(addr2) == \
+            b"\x00\x20" + hashlib.sha256(ws).digest()
+
+        addr3 = A.p2tr(b"\x33" * 32)
+        v, p = A.decode(addr3)
+        assert v == 1 and p == b"\x33" * 32
+
+
+class TestPsbt:
+    def _unsigned(self, spk: bytes) -> T.Tx:
+        return T.Tx(
+            inputs=[T.TxInput(txid=b"\xaa" * 32, vout=1)],
+            outputs=[T.TxOutput(amount_sat=99_000, script_pubkey=spk)],
+        )
+
+    def test_serialize_parse_roundtrip(self):
+        pub = ref.pubkey_serialize(ref.pubkey_create(11))
+        h = hashlib.new("ripemd160", hashlib.sha256(pub).digest()).digest()
+        spk = b"\x00\x14" + h
+        tx = self._unsigned(spk)
+        psbt = P.Psbt.from_tx(tx)
+        psbt.inputs[0].witness_utxo = T.TxOutput(100_000, spk)
+        psbt.inputs[0].partial_sigs[pub] = b"\x30" * 71
+        raw = psbt.serialize()
+        assert raw[:5] == b"psbt\xff"
+        back = P.Psbt.parse(raw)
+        assert back.tx.serialize(False) == tx.serialize(False)
+        assert back.inputs[0].witness_utxo.amount_sat == 100_000
+        assert back.inputs[0].partial_sigs == {pub: b"\x30" * 71}
+
+    def test_p2wpkh_sign_finalize_extract(self):
+        priv = 0x1234
+        pub = ref.pubkey_serialize(ref.pubkey_create(priv))
+        h = hashlib.new("ripemd160", hashlib.sha256(pub).digest()).digest()
+        spk = b"\x00\x14" + h
+        tx = self._unsigned(spk)
+        psbt = P.Psbt.from_tx(tx)
+        psbt.inputs[0].witness_utxo = T.TxOutput(100_000, spk)
+        # p2wpkh script code is the p2pkh script of the hash (BIP143)
+        code = b"\x76\xa9\x14" + h + b"\x88\xac"
+        sighash = psbt.sighash(0, code)
+        r, s = ref.ecdsa_sign(sighash, priv)
+        psbt.inputs[0].partial_sigs[pub] = T.sig_to_der(r, s)
+        psbt.finalize()
+        final = psbt.extract()
+        assert final.inputs[0].witness == [T.sig_to_der(r, s), pub]
+        assert final.has_witness()
+
+    def test_2of2_combine_finalize(self):
+        """Two signers each produce a PSBT with their sig; combining and
+        finalizing yields the channel-funding spend witness."""
+        k1, k2 = 0x51, 0x52
+        p1 = ref.pubkey_serialize(ref.pubkey_create(k1))
+        p2 = ref.pubkey_serialize(ref.pubkey_create(k2))
+        ws = SC.funding_script(p1, p2)
+        spk = b"\x00\x20" + hashlib.sha256(ws).digest()
+        tx = self._unsigned(b"\x00\x14" + b"\x01" * 20)
+        tx.inputs[0] = T.TxInput(txid=b"\xbb" * 32, vout=0)
+
+        def signed_by(priv, pub):
+            psbt = P.Psbt.from_tx(T.Tx.parse(tx.serialize(False)))
+            psbt.inputs[0].witness_utxo = T.TxOutput(1_000_000, spk)
+            psbt.inputs[0].witness_script = ws
+            sh = psbt.sighash(0, ws)
+            r, s = ref.ecdsa_sign(sh, priv)
+            psbt.inputs[0].partial_sigs[pub] = T.sig_to_der(r, s)
+            return psbt
+
+        a, b = signed_by(k1, p1), signed_by(k2, p2)
+        with pytest.raises(P.PsbtError, match="missing signatures"):
+            solo = signed_by(k1, p1)
+            solo.finalize()
+        a.combine(b)
+        a.finalize()
+        final = a.extract()
+        w = final.inputs[0].witness
+        assert w[0] == b"" and w[-1] == ws and len(w) == 4
+        # sigs are in pubkey order regardless of arrival order
+        i1 = ws.index(p1)
+        i2 = ws.index(p2)
+        assert (i1 < i2) == (w[1] == a.inputs[0].final_witness[1])
+
+    def test_combine_different_tx_rejected(self):
+        t1 = self._unsigned(b"\x00\x14" + b"\x01" * 20)
+        t2 = self._unsigned(b"\x00\x14" + b"\x02" * 20)
+        with pytest.raises(P.PsbtError, match="different"):
+            P.Psbt.from_tx(t1).combine(P.Psbt.from_tx(t2))

@@ -1,0 +1,52 @@
+"""Funder policy tests (plugins/funder_policy.c semantics)."""
+from __future__ import annotations
+
+import pytest
+
+from lightning_tpu.plugins.funder import FunderPolicy
+
+
+def test_fixed_policy():
+    p = FunderPolicy(policy="fixed", policy_mod=50_000)
+    assert p.contribution(100_000, available_sat=1_000_000, roll=0) \
+        == 50_000
+    # clamped by available - reserve_tank
+    p.reserve_tank = 980_000
+    assert p.contribution(100_000, 1_000_000, roll=0) == 20_000
+    # below per_channel_min → nothing
+    p.reserve_tank = 995_000
+    assert p.contribution(100_000, 1_000_000, roll=0) == 0
+
+
+def test_match_policy():
+    p = FunderPolicy(policy="match", policy_mod=50)
+    assert p.contribution(200_000, 10_000_000, roll=0) == 100_000
+    p.policy_mod = 100
+    assert p.contribution(200_000, 10_000_000, roll=0) == 200_000
+
+
+def test_available_policy():
+    p = FunderPolicy(policy="available", policy_mod=10)
+    assert p.contribution(50_000, 2_000_000, roll=0) == 200_000
+
+
+def test_their_funding_gates():
+    p = FunderPolicy(policy="fixed", policy_mod=50_000,
+                     min_their_funding=100_000)
+    assert p.contribution(99_999, 10 ** 7, roll=0) == 0
+    p.max_their_funding = 150_000
+    assert p.contribution(200_000, 10 ** 7, roll=0) == 0
+    assert p.contribution(120_000, 10 ** 7, roll=0) == 50_000
+
+
+def test_probability_gate():
+    p = FunderPolicy(policy="fixed", policy_mod=50_000,
+                     fund_probability=30)
+    assert p.contribution(100_000, 10 ** 7, roll=29) == 50_000
+    assert p.contribution(100_000, 10 ** 7, roll=30) == 0
+
+
+def test_per_channel_max():
+    p = FunderPolicy(policy="match", policy_mod=100,
+                     per_channel_max=75_000)
+    assert p.contribution(200_000, 10 ** 7, roll=0) == 75_000

@@ -1,0 +1,78 @@
+"""hsm_secret format tests: plaintext/encrypted containers, BIP39 seed
+derivation (pinned by the public BIP39 trezor vector), file IO."""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from lightning_tpu.daemon import hsm_secret as HS
+from lightning_tpu.daemon.hsmd import Hsm
+
+
+class TestEncrypted:
+    def test_roundtrip(self):
+        sec = b"\x5a" * 32
+        blob = HS.encrypt_secret(sec, "open sesame")
+        assert HS.is_encrypted(blob)
+        assert HS.decrypt_secret(blob, "open sesame") == sec
+
+    def test_wrong_passphrase(self):
+        blob = HS.encrypt_secret(b"\x5a" * 32, "right")
+        with pytest.raises(HS.HsmSecretError):
+            HS.decrypt_secret(blob, "wrong")
+
+    def test_tamper(self):
+        blob = HS.encrypt_secret(b"\x5a" * 32, "x")
+        bad = blob[:-1] + bytes([blob[-1] ^ 1])
+        with pytest.raises(HS.HsmSecretError):
+            HS.decrypt_secret(bad, "x")
+
+
+class TestBip39:
+    # the canonical "abandon ... about" vector (BIP39 spec test data):
+    # seed with passphrase TREZOR starts with c55257c360c07c72
+    VEC = ("abandon abandon abandon abandon abandon abandon abandon "
+           "abandon abandon abandon abandon about")
+
+    def test_spec_vector(self):
+        sec = HS.mnemonic_to_secret(self.VEC, "TREZOR")
+        assert sec.hex().startswith("c55257c360c07c72")
+        assert len(sec) == 32
+
+    def test_passphrase_changes_secret(self):
+        assert HS.mnemonic_to_secret(self.VEC, "a") != \
+            HS.mnemonic_to_secret(self.VEC, "b")
+
+    def test_word_count_enforced(self):
+        with pytest.raises(HS.HsmSecretError):
+            HS.mnemonic_to_secret("only three words")
+
+    def test_node_identity_from_mnemonic(self):
+        """The derived secret boots a deterministic node identity."""
+        sec = HS.mnemonic_to_secret(self.VEC, "")
+        assert Hsm(sec).node_key == Hsm(sec).node_key
+
+
+class TestFileIO:
+    def test_plaintext_roundtrip(self, tmp_path):
+        p = str(tmp_path / "hsm_secret")
+        HS.save(p, b"\x11" * 32)
+        assert HS.load(p) == b"\x11" * 32
+        assert os.stat(p).st_mode & 0o777 == 0o600
+        with pytest.raises(HS.HsmSecretError):
+            HS.load(p, passphrase="unexpected")
+
+    def test_encrypted_roundtrip(self, tmp_path):
+        p = str(tmp_path / "hsm_secret")
+        HS.save(p, b"\x22" * 32, passphrase="pw")
+        assert HS.load(p, passphrase="pw") == b"\x22" * 32
+        with pytest.raises(HS.HsmSecretError):
+            HS.load(p)   # passphrase required
+
+    def test_bad_size_rejected(self, tmp_path):
+        p = str(tmp_path / "hsm_secret")
+        with open(p, "wb") as f:
+            f.write(b"\x00" * 31)
+        with pytest.raises(HS.HsmSecretError):
+            HS.load(p)
