@@ -35,9 +35,28 @@ from jax import lax
 LIMB_BITS = 13
 LIMB_MASK = (1 << LIMB_BITS) - 1
 NLIMBS = 20  # 260 bits ≥ 256
-LOOSE_BOUND = 1 << 15  # stored-limb invariant (exclusive)
+LOOSE_BOUND = 1 << 15  # historical name; see STORED_LIMB_MAX below
 REPR_BITS = LIMB_BITS * NLIMBS  # 260
-REPR_BOUND = 1 << REPR_BITS  # values are kept < 2^260
+REPR_BOUND = 1 << REPR_BITS  # canonical-packed values fit 260 bits
+
+# THE stored-representative invariant between ops: each limb ≤
+# STORED_LIMB_MAX (chosen = the minimum per-limb floor of every sub()
+# borrow constant, so subtraction never underflows limb-wise), value ≤
+# STORED_VMAX.  NOTE the VALUE may exceed 2^260: 20 loose limbs can
+# carry up to ~5·2^260.  Round-2 postmortem: the original interval
+# analysis assumed the low-20-limb value < 2^260, understating fold
+# bounds; _carry_once then dropped a real top carry for ~4e-4 of random
+# inputs — silently wrong signatures/verifies.  All interval math below
+# therefore tracks BOTH a value bound and a per-limb bound, exactly.
+STORED_LIMB_MAX = 40955
+
+
+def _limbsum(bound: int, n: int) -> int:
+    """Max value of n limbs each ≤ bound."""
+    return bound * ((1 << (LIMB_BITS * n)) - 1) // LIMB_MASK
+
+
+STORED_VMAX = _limbsum(STORED_LIMB_MAX, NLIMBS)
 
 
 def int_to_limbs(x: int, n: int = NLIMBS) -> np.ndarray:
@@ -65,9 +84,9 @@ class Modulus:
         self.c_limbs = int_to_limbs(self.c260, kc)
         self.m_limbs = int_to_limbs(m, NLIMBS)
         # Borrow-safe decomposition of K·m (K·m ≥ the max representable
-        # loose value) with per-limb floor LOOSE_BOUND-1, so M[k] - b[k] ≥ 0
-        # limb-wise for any loose b.  Used by sub().
-        max_loose = (LOOSE_BOUND - 1) * ((1 << REPR_BITS) - 1) // LIMB_MASK
+        # stored value) with per-limb floor STORED_LIMB_MAX, so
+        # M[k] - b[k] ≥ 0 limb-wise for any stored b.  Used by sub().
+        max_loose = STORED_VMAX
         K = -(-max_loose // m)  # ceil
         while True:
             Km = K * m
@@ -79,7 +98,7 @@ class Modulus:
                 d[k] += 5 << LIMB_BITS
                 d[k + 1] -= 5
             ok = (
-                all(d[k] >= LOOSE_BOUND - 1 for k in range(NLIMBS))
+                all(d[k] >= STORED_LIMB_MAX for k in range(NLIMBS))
                 and all(v >= 0 for v in d)
                 and all(v < (1 << 18) for v in d)
             )
@@ -164,43 +183,64 @@ def _diag_onehot(na: int, nb: int):
     return jnp.asarray(_DIAG_CACHE[key])
 
 
-def _reduce(mod: Modulus, limbs, vmax: int):
-    """Fold limbs (value ≤ vmax, limbs < 2^16) until the value provably
-    fits in NLIMBS limbs (< 2^260).  Static, minimal fold sequence."""
+def _reduce(mod: Modulus, limbs, vmax: int, colmax: int):
+    """Fold limbs down to the stored invariant (NLIMBS limbs, each ≤
+    STORED_LIMB_MAX, value ≤ STORED_VMAX, congruent mod m).
+
+    limbs must be the output of _carry_once over columns each ≤ colmax;
+    vmax bounds the represented VALUE.  The interval analysis tracks
+    both bounds exactly in Python bigints at trace time — per-limb
+    bounds decide overflow-safety and the exit, the value bound decides
+    which top limbs are provably zero (truncation) and how many output
+    limbs each carry pass needs (NEVER drop a possibly-live carry)."""
     c = mod.c260
     c_arr = jnp.asarray(mod.c_limbs)
-    while vmax > REPR_BOUND - 1:
+    lbound = LIMB_MASK + (colmax >> LIMB_BITS)   # per-limb, post-carry
+    for _ in range(16):
         n = limbs.shape[-1]
-        n_needed = max(NLIMBS, (vmax.bit_length() + LIMB_BITS - 1) // LIMB_BITS)
+        # limbs at k with 2^(13k) > vmax are provably zero
+        n_needed = max(
+            NLIMBS, (max(vmax.bit_length(), 1) + LIMB_BITS - 1) // LIMB_BITS
+        )
         if n > n_needed:
             limbs = limbs[..., :n_needed]
             n = n_needed
         if n <= NLIMBS:
-            break
-        L = limbs[..., :NLIMBS]
-        H = limbs[..., NLIMBS:]
+            assert lbound <= STORED_LIMB_MAX and vmax <= STORED_VMAX, (
+                f"stored invariant violated: lbound={lbound} vmax bits="
+                f"{vmax.bit_length()}"
+            )
+            return limbs
         hn = n - NLIMBS
-        hcols = _mul_cols(H, c_arr, hn, mod.kc)  # hn+kc+1 columns
+        hval = min(vmax >> REPR_BITS, _limbsum(lbound, hn))
+        lval = min(vmax, _limbsum(lbound, NLIMBS))
+        if hn == 1 and hval * LIMB_MASK + lbound <= STORED_LIMB_MAX:
+            # merge exit: out[k] = L[k] + H0·c[k] needs NO carry pass —
+            # limb bound lbound + hval·(2^13-1) stays stored-safe
+            L = limbs[..., :NLIMBS]
+            h0 = limbs[..., NLIMBS]
+            add_part = h0[..., None] * c_arr
+            out = L + _pad_last(add_part, 0, NLIMBS)
+            assert lval + hval * c <= STORED_VMAX
+            return out
+        hcols = _mul_cols(limbs[..., NLIMBS:], c_arr, hn, mod.kc)
         ncols = max(NLIMBS, hn + mod.kc + 1)
-        cols = _pad_last(L, 0, ncols) + _pad_last(hcols, 0, ncols)
-        # interval: maximize L + h*c260 s.t. h*2^260 + L ≤ vmax, L < 2^260·loose
-        hmax = vmax >> REPR_BITS
-        h1 = max(0, (vmax - (REPR_BOUND - 1)) >> REPR_BITS)
-        new_vmax = 0
-        for h in {0, min(h1, hmax), min(h1 + 1, hmax), hmax}:
-            lmax = min(REPR_BOUND - 1, vmax - (h << REPR_BITS))
-            if lmax < 0:
-                continue
-            new_vmax = max(new_vmax, lmax + h * c)
+        cols = _pad_last(limbs[..., :NLIMBS], 0, ncols) + _pad_last(
+            hcols, 0, ncols
+        )
+        cnt = min(hn, mod.kc)
+        prodmax = lbound * LIMB_MASK          # c limbs are canonical
+        colmax2 = lbound + cnt * (LIMB_MASK + (prodmax >> LIMB_BITS))
+        assert colmax2 < (1 << 32) - (1 << 19), "column overflow"
+        new_vmax = lval + hval * c
         out_limbs = max(
             NLIMBS, (new_vmax.bit_length() + LIMB_BITS - 1) // LIMB_BITS
         )
         limbs = _carry_once(cols, out_limbs)
         assert new_vmax < vmax, "fold failed to make progress"
         vmax = new_vmax
-    if limbs.shape[-1] > NLIMBS:
-        limbs = limbs[..., :NLIMBS]
-    return limbs
+        lbound = LIMB_MASK + (colmax2 >> LIMB_BITS)
+    raise AssertionError("reduce did not converge in 16 folds")
 
 
 # ---------------------------------------------------------------------------
@@ -226,30 +266,33 @@ def from_const(x: int, shape=()):
 
 
 def add(mod: Modulus, a, b):
-    cols = a + b  # < 2^16
-    limbs = _carry_once(cols, NLIMBS + 1)
-    return _reduce(mod, limbs, 2 * (REPR_BOUND - 1))
+    colmax = 2 * STORED_LIMB_MAX
+    limbs = _carry_once(a + b, NLIMBS + 1)
+    return _reduce(mod, limbs, 2 * STORED_VMAX, colmax)
 
 
 def add3(mod: Modulus, a, b, c):
-    cols = a + b + c  # < 3·2^15 < 2^17
-    limbs = _carry_once(cols, NLIMBS + 1)
-    return _reduce(mod, limbs, 3 * (REPR_BOUND - 1))
+    colmax = 3 * STORED_LIMB_MAX
+    limbs = _carry_once(a + b + c, NLIMBS + 1)
+    return _reduce(mod, limbs, 3 * STORED_VMAX, colmax)
 
 
 def sub(mod: Modulus, a, b):
     neg = jnp.asarray(mod.neg_limbs)  # borrow-safe K·m, limbs < 2^18
     nn = len(mod.neg_limbs)
-    d = neg - _pad_last(b, 0, nn)  # ≥ 0 limb-wise
-    cols = d + _pad_last(a, 0, nn)  # < 2^18 + 2^15
+    d = neg - _pad_last(b, 0, nn)  # ≥ 0 limb-wise (floor ≥ STORED_LIMB_MAX)
+    cols = d + _pad_last(a, 0, nn)
+    colmax = (1 << 18) - 1 + STORED_LIMB_MAX
     limbs = _carry_once(cols, nn + 1)
-    return _reduce(mod, limbs, mod.neg_bound + REPR_BOUND - 1)
+    return _reduce(mod, limbs, mod.neg_bound + STORED_VMAX, colmax)
 
 
 def mul(mod: Modulus, a, b):
     cols = _mul_cols(a, b, NLIMBS, NLIMBS)
+    prodmax = STORED_LIMB_MAX * STORED_LIMB_MAX  # < 2^32
+    colmax = NLIMBS * (LIMB_MASK + (prodmax >> LIMB_BITS))
     limbs = _carry_once(cols, 2 * NLIMBS + 1)
-    return _reduce(mod, limbs, (REPR_BOUND - 1) ** 2)
+    return _reduce(mod, limbs, STORED_VMAX * STORED_VMAX, colmax)
 
 
 def sqr(mod: Modulus, a):
@@ -257,12 +300,11 @@ def sqr(mod: Modulus, a):
 
 
 def mul_small(mod: Modulus, a, k: int):
-    """Multiply by a small constant.  k < 6144 keeps the single carry
-    pass inside the loose-limb invariant (out-limb < 2^13 + 4k < 2^15)."""
+    """Multiply by a small constant; k bounded so columns stay in u32."""
     assert 0 <= k < 6144
-    cols = a * jnp.uint32(k)  # < 2^15·k < 2^28
+    cols = a * jnp.uint32(k)
     limbs = _carry_once(cols, NLIMBS + 2)
-    return _reduce(mod, limbs, (REPR_BOUND - 1) * k)
+    return _reduce(mod, limbs, STORED_VMAX * k, STORED_LIMB_MAX * k)
 
 
 def _ripple(cols, out_limbs: int):
