@@ -499,10 +499,18 @@ class Invoice12:
         if mine != theirs:
             raise Bolt12Error("invoice does not mirror invoice_request")
         offer = invreq.offer
-        if offer.issuer_id is not None and not self.paths:
-            # unblinded issuer: invoice must be signed by the issuer key
+        if offer.issuer_id is not None:
+            # Invoice must be signed by the issuer key UNCONDITIONALLY —
+            # invoice_paths are attacker-controlled, so they must never
+            # relax the signer check (plugins/fetchinvoice.c:240-248).
             if self.node_id != offer.issuer_id:
                 raise Bolt12Error("invoice node_id != offer issuer_id")
+        else:
+            # Blinded-only offer: the signer must be one of the offer's
+            # path tips (the blinded id the invreq was delivered to).
+            tips = {p.hops[-1].blinded_node_id for p in offer.paths if p.hops}
+            if self.node_id not in tips:
+                raise Bolt12Error("invoice node_id not an offer path tip")
         if offer.currency is not None:
             raise Bolt12Error(
                 f"cannot verify {offer.currency}-denominated amount")

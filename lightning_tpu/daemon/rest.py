@@ -18,6 +18,7 @@ from .jsonrpc import RpcError
 log = logging.getLogger("lightning_tpu.rest")
 
 MAX_BODY = 4 * 1024 * 1024
+MAX_HEADERS = 100
 
 
 class RestServer:
@@ -73,13 +74,17 @@ class RestServer:
         except ValueError:
             return 400, {"error": "malformed request line"}
         headers = {}
-        while True:
+        # bounded: each readline gets a fresh timeout, so without a cap a
+        # client could stream headers forever and grow the dict unboundedly
+        for _ in range(MAX_HEADERS):
             line = await asyncio.wait_for(reader.readline(), 30)
             if line in (b"\r\n", b"\n", b""):
                 break
             if b":" in line:
                 k, v = line.decode().split(":", 1)
                 headers[k.strip().lower()] = v.strip()
+        else:
+            return 400, {"error": "too many headers"}
 
         if not target.startswith("/v1/"):
             return 404, {"error": "unknown path (use /v1/<method>)"}

@@ -142,18 +142,22 @@ class InvoiceRegistry:
     def create_bolt12(self, label: str, amount_msat: int,
                       payment_hash: bytes, preimage: bytes, bolt12: str,
                       local_offer_id: bytes | None = None,
-                      expiry: int = 7200) -> InvoiceRecord:
+                      expiry: int = 7200,
+                      payment_secret: bytes = b"") -> InvoiceRecord:
         """Register a BOLT#12 invoice we just minted for an
         invoice_request (plugins/offers_invreq_hook.c → invoice
-        creation).  BOLT#12 has no payment_secret — the blinded-path
-        cookie plays that role — so the secret check is disabled."""
+        creation).  BOLT#12 has no payment_secret TLV — the blinded-path
+        path_id cookie plays that role, so the caller passes it here and
+        resolve_htlc demands it like any bolt11 secret (without it, any
+        on-route node that sees the payment_hash could claim the
+        preimage directly)."""
         if label in self.by_label:
             raise InvoiceError(f"duplicate label {label!r}")
         rec = InvoiceRecord(
             label=label, payment_hash=payment_hash, preimage=preimage,
             amount_msat=amount_msat, bolt11=bolt12, description="",
             status="unpaid", expires_at=int(time.time()) + expiry,
-            payment_secret=b"", local_offer_id=local_offer_id)
+            payment_secret=payment_secret, local_offer_id=local_offer_id)
         self.by_hash[payment_hash] = rec
         self.by_label[label] = rec
         self._save(rec)

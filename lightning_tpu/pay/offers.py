@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import hmac
 import logging
 import os
 import time
@@ -212,15 +213,32 @@ class OffersService:
             amount = (offer.amount_msat or 0) * (invreq.quantity or 1)
         preimage = os.urandom(32)
         payment_hash = hashlib.sha256(preimage).digest()
+        node_id = ref.pubkey_serialize(ref.pubkey_create(self.node_seckey))
+        # BOLT#12 has no payment_secret TLV; the secret that stops an
+        # on-route node from claiming the preimage is the blinded path's
+        # path_id — a cookie only we can derive (lightningd/invoice.c
+        # invoice_path_id semantics).  Even a direct payment rides a
+        # 1-hop blinded path whose introduction point is us.
+        cookie = self.invoice_path_id(payment_hash)
+        path = BP.create_path([node_id], [BP.EncryptedData(path_id=cookie)])
         inv = B12.Invoice12(
             invreq=invreq, payment_hash=payment_hash, amount_msat=amount,
-            node_id=ref.pubkey_serialize(ref.pubkey_create(self.node_seckey)),
-            created_at=int(time.time()))
+            node_id=node_id, created_at=int(time.time()),
+            paths=[path],
+            blindedpay=[(0, 0, self.invoices.min_final_cltv, 0,
+                         21_000_000 * 100_000_000 * 1000, b"")])
         inv.sign(self.node_seckey)
         label = f"bolt12-{payment_hash[:8].hex()}"
         self.invoices.create_bolt12(label, amount, payment_hash, preimage,
-                                    inv.encode(), invreq.offer.offer_id())
+                                    inv.encode(), invreq.offer.offer_id(),
+                                    payment_secret=cookie)
         return inv
+
+    def invoice_path_id(self, payment_hash: bytes) -> bytes:
+        """Deterministic path_id cookie for a bolt12 invoice we mint."""
+        key = self.node_seckey.to_bytes(32, "big")
+        return hmac.new(key, b"bolt12-invoice-path" + payment_hash,
+                        hashlib.sha256).digest()
 
     def on_invoice_paid(self, local_offer_id: bytes) -> None:
         """Called when a bolt12 invoice settles: single-use offers are

@@ -111,6 +111,28 @@ def build_payment_onion(route: list[RouteStep], payment_hash: bytes,
         session_key=session_key)
 
 
+def bolt12_final_payload(inv12, amount_msat: int, cltv: int,
+                         total_msat: int | None = None):
+    """Final-hop payload for paying a BOLT#12 invoice over its blinded
+    path.  The invoice carries ≥1 blinded path whose tip is the payee;
+    the payer copies the tip hop's ciphertext + the path key into the
+    final onion payload so the recipient can recover its path_id cookie
+    (which plays payment_secret's role — BOLT#4 blinded payments)."""
+    if not inv12.paths or not inv12.paths[0].hops:
+        raise PayError("bolt12 invoice has no blinded path")
+    path = inv12.paths[0]
+    if len(path.hops) != 1:
+        # multi-hop blinded tails need in-flight path-key evolution at
+        # each blinded hop; we pay the 1-hop (intro-point-is-payee)
+        # shape every make_invoice mints
+        raise PayError("only 1-hop blinded paths supported")
+    return OP.HopPayload(
+        amount_msat, cltv,
+        encrypted_recipient_data=path.hops[0].encrypted_recipient_data,
+        path_key=path.first_path_key,
+        total_msat=total_msat or amount_msat)
+
+
 async def pay_over_channel(ch, invoice_str: str, *,
                            amount_msat: int | None = None,
                            gossmap=None, source_node_id: bytes | None = None,

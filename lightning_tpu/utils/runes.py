@@ -21,6 +21,7 @@ $ (ends with), ~ (contains), < (int less), > (int greater),
 from __future__ import annotations
 
 import base64
+import hmac
 import struct
 import time
 
@@ -297,7 +298,9 @@ class Rune:
 
     def is_authorized(self, secret: bytes) -> bool:
         expect = Rune.from_secret(secret, self.restrictions)
-        return expect.authcode == self.authcode
+        # constant-time: runes gate network-reachable surfaces (commando,
+        # REST), so the compare must not leak a byte-position oracle
+        return hmac.compare_digest(expect.authcode, self.authcode)
 
     def check(self, secret: bytes, values: dict) -> str | None:
         """None if the rune is valid AND every restriction passes."""

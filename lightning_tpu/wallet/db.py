@@ -119,6 +119,20 @@ MIGRATIONS: list[str] = [
     )""",
     # 10: bolt12 invoices reference the offer they answered
     "ALTER TABLE invoices ADD COLUMN local_offer_id BLOB",
+    # 11: on-chain UTXOs (wallet/migrations.c:59 outputs table role)
+    """CREATE TABLE outputs (
+        txid BLOB NOT NULL,
+        vout INTEGER NOT NULL,
+        amount_sat INTEGER NOT NULL,
+        scriptpubkey BLOB NOT NULL,
+        keyindex INTEGER NOT NULL,
+        status TEXT NOT NULL DEFAULT 'available',
+        reserved_til INTEGER,
+        confirmation_height INTEGER,
+        spent_height INTEGER,
+        spending_txid BLOB,
+        PRIMARY KEY (txid, vout)
+    )""",
 ]
 
 

@@ -1,0 +1,369 @@
+"""On-chain UTXO wallet: deposits, reservations, funding, withdraw.
+
+Parity targets: wallet/wallet.c (outputs table, wallet_add_utxo /
+wallet_confirm_tx paths), wallet/txfilter.c (block-scan for our
+scriptpubkeys), wallet/reservation.c (UTXO reservations expiring at
+height+72), wallet/walletrpc.c (newaddr / listfunds / withdraw /
+fundpsbt) and lightningd/chaintopology.c's deposit flow.
+
+Keys are BIP32 m/0/keyindex P2WPKH, derived from the hsm's bip32 seed
+(hsmd/hsmd.c hands lightningd the base at init); the wallet only ever
+sees public material — signing rides the hsm's CAP_SIGN_ONCHAIN door
+(`sign_withdrawal`), which signs every wallet input of a PSBT-shaped tx
+in one batched device call (vs the reference's per-input loop inside
+hsmd's sign_withdrawal handler).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..btc import address as ADDR
+from ..btc import script as SCRIPT
+from ..btc.bip32 import ExtKey
+from ..btc.tx import SIGHASH_ALL, Tx, TxInput, TxOutput
+
+# reservation lifetime in blocks (wallet/reservation.c RESERVATION_INC)
+RESERVATION_BLOCKS = 72
+DUST_LIMIT_SAT = 546
+
+
+class WalletError(Exception):
+    pass
+
+
+class KeyManager:
+    """Derives wallet keys/addresses; persists the high-water keyindex."""
+
+    def __init__(self, base: ExtKey, db, hrp: str = "bcrt"):
+        self.base = base.ckd(0)          # external chain m/0
+        self.db = db
+        self.hrp = hrp
+        self._cache: dict[int, ExtKey] = {}
+
+    def key(self, index: int) -> ExtKey:
+        k = self._cache.get(index)
+        if k is None:
+            k = self._cache[index] = self.base.ckd(index)
+        return k
+
+    def pubkey(self, index: int) -> bytes:
+        return self.key(index).pubkey
+
+    def scriptpubkey(self, index: int) -> bytes:
+        return SCRIPT.p2wpkh(self.pubkey(index))
+
+    def address(self, index: int) -> str:
+        return ADDR.p2wpkh(self.pubkey(index), self.hrp)
+
+    @property
+    def max_index(self) -> int:
+        v = self.db.get_var("bip32_max_index")
+        return int(v) if v is not None else -1
+
+    def fresh_index(self) -> int:
+        nxt = self.max_index + 1
+        self.db.set_var("bip32_max_index", nxt)
+        return nxt
+
+
+class TxFilter:
+    """scriptpubkey → keyindex lookup for block scanning
+    (wallet/txfilter.c:1)."""
+
+    def __init__(self):
+        self._by_spk: dict[bytes, int] = {}
+
+    def add(self, scriptpubkey: bytes, keyindex: int) -> None:
+        self._by_spk[scriptpubkey] = keyindex
+
+    def match(self, tx: Tx) -> list[tuple[int, int, bytes, int]]:
+        """[(vout, amount_sat, scriptpubkey, keyindex)] of ours in tx."""
+        out = []
+        for i, o in enumerate(tx.outputs):
+            idx = self._by_spk.get(o.script_pubkey)
+            if idx is not None:
+                out.append((i, o.amount_sat, o.script_pubkey, idx))
+        return out
+
+
+@dataclass
+class Utxo:
+    txid: bytes
+    vout: int
+    amount_sat: int
+    scriptpubkey: bytes
+    keyindex: int
+    status: str                      # available | reserved | spent
+    reserved_til: int | None
+    confirmation_height: int | None
+
+    @property
+    def outpoint(self) -> tuple[bytes, int]:
+        return (self.txid, self.vout)
+
+
+class OnchainWallet:
+    """The node's coins.  All mutations are write-ahead into the db."""
+
+    def __init__(self, db, keyman: KeyManager):
+        self.db = db
+        self.keyman = keyman
+        self.filter = TxFilter()
+        # every address ever issued watches forever (reference loads the
+        # whole scriptpubkeys set into its txfilter at startup)
+        for i in range(self.keyman.max_index + 1):
+            self.filter.add(self.keyman.scriptpubkey(i), i)
+        self.height = 0
+
+    # -- address issuance -------------------------------------------------
+
+    def newaddr(self) -> dict:
+        idx = self.keyman.fresh_index()
+        spk = self.keyman.scriptpubkey(idx)
+        self.filter.add(spk, idx)
+        return {"bech32": self.keyman.address(idx), "keyindex": idx}
+
+    def listaddresses(self) -> list[dict]:
+        return [{"keyindex": i, "bech32": self.keyman.address(i)}
+                for i in range(self.keyman.max_index + 1)]
+
+    # -- chain feed (wire into ChainTopology) -----------------------------
+
+    def attach(self, topology) -> None:
+        topology.on_block(self.on_block)
+        topology.on_reorg(self.on_reorg)
+
+    def on_block(self, height: int, block) -> None:
+        self.height = height
+        with self.db.transaction() as c:
+            for tx in block.txs:
+                txid = tx.txid()
+                # deposits: outputs paying one of our scriptpubkeys
+                for vout, amount, spk, keyindex in self.filter.match(tx):
+                    c.execute(
+                        "INSERT INTO outputs (txid, vout, amount_sat,"
+                        " scriptpubkey, keyindex, status,"
+                        " confirmation_height) VALUES (?,?,?,?,?,?,?)"
+                        " ON CONFLICT(txid, vout) DO UPDATE SET"
+                        " confirmation_height=excluded.confirmation_height",
+                        (txid, vout, amount, spk, keyindex, "available",
+                         height))
+                # spends of our outputs (any tx, ours or not)
+                for vin in tx.inputs:
+                    c.execute(
+                        "UPDATE outputs SET status='spent', spent_height=?,"
+                        " spending_txid=? WHERE txid=? AND vout=?",
+                        (height, txid, vin.txid, vin.vout))
+            # reservation expiry (reservation.c: height-based timeout)
+            c.execute(
+                "UPDATE outputs SET status='available', reserved_til=NULL"
+                " WHERE status='reserved' AND reserved_til IS NOT NULL"
+                " AND reserved_til <= ?", (height,))
+
+    def on_reorg(self, new_height: int) -> None:
+        self.height = min(self.height, new_height)
+        with self.db.transaction() as c:
+            c.execute(
+                "UPDATE outputs SET confirmation_height=NULL"
+                " WHERE confirmation_height > ?", (new_height,))
+            c.execute(
+                "UPDATE outputs SET status='available', spent_height=NULL,"
+                " spending_txid=NULL"
+                " WHERE status='spent' AND spent_height > ?", (new_height,))
+
+    # -- queries ----------------------------------------------------------
+
+    def _rows(self, where: str = "", args: tuple = ()) -> list[Utxo]:
+        cur = self.db.conn.execute(
+            "SELECT txid, vout, amount_sat, scriptpubkey, keyindex,"
+            f" status, reserved_til, confirmation_height FROM outputs {where}",
+            args)
+        return [Utxo(bytes(r[0]), r[1], r[2], bytes(r[3]), r[4], r[5],
+                     r[6], r[7]) for r in cur.fetchall()]
+
+    def utxos(self, include_reserved: bool = False) -> list[Utxo]:
+        if include_reserved:
+            return self._rows("WHERE status != 'spent'")
+        return self._rows("WHERE status = 'available'")
+
+    def balance_sat(self) -> int:
+        return sum(u.amount_sat for u in self.utxos())
+
+    def listfunds(self) -> list[dict]:
+        out = []
+        for u in self.utxos(include_reserved=True):
+            out.append({
+                "txid": u.txid.hex(), "output": u.vout,
+                "amount_msat": u.amount_sat * 1000,
+                "scriptpubkey": u.scriptpubkey.hex(),
+                "address": ADDR.from_scriptpubkey(u.scriptpubkey,
+                                                  self.keyman.hrp),
+                "status": ("confirmed" if u.confirmation_height is not None
+                           else "unconfirmed"),
+                "reserved": u.status == "reserved",
+                **({"blockheight": u.confirmation_height}
+                   if u.confirmation_height is not None else {}),
+            })
+        return out
+
+    # -- reservations (wallet/reservation.c) ------------------------------
+
+    def reserve(self, outpoints: list[tuple[bytes, int]],
+                blocks: int = RESERVATION_BLOCKS) -> None:
+        til = self.height + blocks
+        with self.db.transaction() as c:
+            for txid, vout in outpoints:
+                cur = c.execute(
+                    "UPDATE outputs SET status='reserved', reserved_til=?"
+                    " WHERE txid=? AND vout=? AND status='available'",
+                    (til, txid, vout))
+                if cur.rowcount != 1:
+                    raise WalletError(
+                        f"cannot reserve {txid.hex()}:{vout} (missing or"
+                        " not available)")
+
+    def unreserve(self, outpoints: list[tuple[bytes, int]]) -> None:
+        with self.db.transaction() as c:
+            for txid, vout in outpoints:
+                c.execute(
+                    "UPDATE outputs SET status='available',"
+                    " reserved_til=NULL WHERE txid=? AND vout=?"
+                    " AND status='reserved'", (txid, vout))
+
+    def mark_spent(self, outpoints: list[tuple[bytes, int]],
+                   spending_txid: bytes) -> None:
+        """Inputs of a tx we just broadcast: spent immediately (the
+        confirmation scan is idempotent on them)."""
+        with self.db.transaction() as c:
+            for txid, vout in outpoints:
+                c.execute(
+                    "UPDATE outputs SET status='spent', spending_txid=?"
+                    " WHERE txid=? AND vout=?", (spending_txid, txid, vout))
+
+    def add_unconfirmed_change(self, tx: Tx) -> None:
+        """Track our own outputs of a tx we broadcast before any block
+        confirms it (spendable immediately, like the reference)."""
+        txid = tx.txid()
+        with self.db.transaction() as c:
+            for vout, amount, spk, keyindex in self.filter.match(tx):
+                c.execute(
+                    "INSERT OR IGNORE INTO outputs (txid, vout, amount_sat,"
+                    " scriptpubkey, keyindex, status) VALUES (?,?,?,?,?,?)",
+                    (txid, vout, amount, spk, keyindex, "available"))
+
+    # -- coin selection + tx building -------------------------------------
+
+    @staticmethod
+    def _input_weight() -> int:
+        # P2WPKH input: 36 outpoint + 1 scriptlen + 4 sequence = 41 vbytes
+        # base, witness ~(73 sig + 34 key + 2) / 4 ≈ 27.25 → 273 WU total
+        return 41 * 4 + 109
+
+    def select_coins(self, amount_sat: int, feerate_per_kw: int,
+                     base_weight: int, confirmed_only: bool = False,
+                     min_conf: int = 0) -> tuple[list[Utxo], int, int]:
+        """Largest-first selection (the reference delegates to
+        bitcoind-style knapsack; largest-first keeps change counts low
+        and is deterministic for tests).  Returns (picked, fee, change).
+        """
+        cands = [u for u in self.utxos()
+                 if not confirmed_only or u.confirmation_height is not None]
+        if min_conf:
+            cands = [u for u in cands
+                     if u.confirmation_height is not None
+                     and self.height - u.confirmation_height + 1 >= min_conf]
+        cands.sort(key=lambda u: -u.amount_sat)
+        picked: list[Utxo] = []
+        total = 0
+        weight = base_weight
+        for u in cands:
+            picked.append(u)
+            total += u.amount_sat
+            weight += self._input_weight()
+            fee = feerate_per_kw * weight // 1000
+            if total >= amount_sat + fee:
+                # change output adds 31 vbytes = 124 WU
+                change_fee = feerate_per_kw * (weight + 124) // 1000
+                change = total - amount_sat - change_fee
+                if change < DUST_LIMIT_SAT:
+                    return picked, total - amount_sat, 0
+                return picked, change_fee, change
+        raise WalletError(
+            f"insufficient funds: need {amount_sat} sat + fee,"
+            f" have {total} sat across {len(picked)} utxos")
+
+    def fund_tx(self, outputs: list[TxOutput], feerate_per_kw: int,
+                confirmed_only: bool = False, reserve: bool = True,
+                extra_weight: int = 0, reserve_blocks: int =
+                RESERVATION_BLOCKS) -> tuple[Tx, list[Utxo], int | None]:
+        """Build a funded tx paying `outputs`, adding inputs + change.
+        Returns (tx, picked_utxos, change_vout|None).  Inputs are
+        reserved (fundpsbt semantics) so concurrent fundings don't
+        double-spend each other.  extra_weight: caller-supplied weight
+        (fundpsbt startweight) the fee must also cover."""
+        amount = sum(o.amount_sat for o in outputs)
+        base_weight = (4 + 1 + 1 + 4 + 2) * 4 + extra_weight \
+            + sum(len(o.serialize()) for o in outputs) * 4
+        picked, fee, change = self.select_coins(
+            amount, feerate_per_kw, base_weight, confirmed_only)
+        tx = Tx(version=2)
+        for u in picked:
+            tx.inputs.append(TxInput(u.txid, u.vout, sequence=0xFFFFFFFD))
+        tx.outputs = list(outputs)
+        change_vout = None
+        if change > 0:
+            idx = self.keyman.fresh_index()
+            spk = self.keyman.scriptpubkey(idx)
+            self.filter.add(spk, idx)
+            change_vout = len(tx.outputs)
+            tx.outputs.append(TxOutput(change, spk))
+        if reserve:
+            self.reserve([u.outpoint for u in picked],
+                         blocks=reserve_blocks)
+        return tx, picked, change_vout
+
+    def utxo_meta(self, tx: Tx) -> list[tuple[int, int] | None]:
+        """Per-input (amount_sat, keyindex) for OUR inputs, None for
+        foreign ones — the shape hsm.sign_withdrawal consumes."""
+        meta: list[tuple[int, int] | None] = []
+        for vin in tx.inputs:
+            row = self.db.conn.execute(
+                "SELECT amount_sat, keyindex FROM outputs"
+                " WHERE txid=? AND vout=?", (vin.txid, vin.vout)).fetchone()
+            meta.append((row[0], row[1]) if row is not None else None)
+        return meta
+
+
+def wallet_input_digests(tx: Tx, meta, key_for_index):
+    """Per wallet input: (input_index, sighash_digest, privkey, pubkey).
+    key_for_index: keyindex → ExtKey.  The single source of the P2WPKH
+    scriptCode/sighash recipe (used by both the standalone signer below
+    and Hsm.sign_withdrawal — keep it in one place so a sighash change
+    can never drift between them)."""
+    items = []
+    for i, m in enumerate(meta):
+        if m is None:
+            continue
+        amount_sat, keyindex = m
+        key = key_for_index(keyindex)
+        pub = key.pubkey
+        # BIP143 P2WPKH scriptCode: the implied P2PKH script (the length
+        # varint is written by sighash_segwit itself)
+        code = b"\x76\xa9\x14" + SCRIPT.hash160(pub) + b"\x88\xac"
+        items.append((i, tx.sighash_segwit(i, code, amount_sat,
+                                           SIGHASH_ALL), key.key, pub))
+    return items
+
+
+def sign_wallet_inputs(tx: Tx, meta, keyman: KeyManager) -> Tx:
+    """Fill P2WPKH witnesses for every input with (amount, keyindex)
+    metadata.  Standalone (non-hsm) variant used by tests; the daemon
+    path goes through Hsm.sign_withdrawal which adds the capability
+    check + batched low-R device signing."""
+    from ..btc.tx import sig_to_der
+    from ..crypto import ref_python as ref
+
+    for i, digest, priv, pub in wallet_input_digests(tx, meta, keyman.key):
+        r, s = ref.ecdsa_sign(digest, priv)
+        tx.inputs[i].witness = [sig_to_der(r, s), pub]
+    return tx

@@ -1,0 +1,197 @@
+"""Wallet RPC commands: newaddr / listfunds / withdraw / fundpsbt /
+reserveinputs / unreserveinputs.
+
+Parity target: wallet/walletrpc.c (json_newaddr :?, json_listfunds,
+json_withdraw, json_fundpsbt/json_utxopsbt) and wallet/reservation.c's
+reserve RPC trio, over our OnchainWallet.
+"""
+from __future__ import annotations
+
+import base64
+
+from ..btc import address as ADDR
+from ..btc.psbt import Psbt, PsbtInput
+from ..btc.tx import Tx, TxOutput
+from .onchain import OnchainWallet, WalletError
+
+
+def _parse_outpoints(items: list[str]) -> list[tuple[bytes, int]]:
+    out = []
+    for it in items:
+        txid_hex, vout = it.split(":")
+        out.append((bytes.fromhex(txid_hex), int(vout)))
+    return out
+
+
+def _feerate_per_kw(feerate, topology) -> int:
+    # topology.feerate() is sat/kVB (FeeEstimates contract) — per-kw
+    # is a quarter of that (4 WU per vbyte)
+    if feerate is None or feerate == "normal":
+        return topology.feerate(12) // 4 if topology is not None else 1250
+    if feerate == "urgent":
+        return topology.feerate(2) // 4 if topology is not None else 1875
+    if feerate == "slow":
+        return topology.feerate(100) // 4 if topology is not None else 253
+    s = str(feerate)
+    if s.endswith("perkw"):
+        return int(s[:-5])
+    if s.endswith("perkb"):
+        return int(s[:-5]) // 4
+    return int(s)
+
+
+def _to_psbt(tx: Tx, wallet: OnchainWallet) -> str:
+    p = Psbt.from_tx(Tx(tx.version, [
+        # strip witnesses: a PSBT's unsigned tx must be witness-free
+        type(i)(i.txid, i.vout, b"", i.sequence) for i in tx.inputs
+    ], list(tx.outputs), tx.locktime))
+    for i, vin in enumerate(tx.inputs):
+        row = wallet.db.conn.execute(
+            "SELECT amount_sat, scriptpubkey FROM outputs"
+            " WHERE txid=? AND vout=?", (vin.txid, vin.vout)).fetchone()
+        if row is not None:
+            p.inputs[i].witness_utxo = TxOutput(row[0], bytes(row[1]))
+    return base64.b64encode(p.serialize()).decode()
+
+
+def attach_wallet_commands(rpc, wallet: OnchainWallet, hsm=None,
+                           hsm_client=None, backend=None,
+                           topology=None) -> None:
+    async def newaddr(addresstype: str = "bech32") -> dict:
+        if addresstype not in ("bech32", "all"):
+            raise ValueError(f"unsupported addresstype {addresstype!r}")
+        return {"bech32": wallet.newaddr()["bech32"]}
+
+    async def listaddresses() -> dict:
+        return {"addresses": wallet.listaddresses()}
+
+    async def listfunds(spent: bool = False) -> dict:
+        return {"outputs": wallet.listfunds(), "channels": []}
+
+    async def fundpsbt(satoshi, feerate=None, startweight: int = 0,
+                       reserve: int = 72, min_witness_weight: int = 0,
+                       excess_as_change: bool = False) -> dict:
+        """Reserve inputs summing past `satoshi` + fee; return the
+        funding skeleton as a PSBT (walletrpc.c json_fundpsbt).
+        startweight: weight of the outputs the CALLER will add — it is
+        part of the fee the selection must cover.  excess_msat already
+        has the fee deducted (lightningd contract)."""
+        per_kw = _feerate_per_kw(feerate, topology)
+        from ..btc.tx import TxInput
+        from .onchain import OnchainWallet as _W
+
+        if satoshi == "all":
+            utxos = wallet.utxos()
+            if not utxos:
+                raise WalletError("no available utxos")
+            tx = Tx(version=2)
+            for u in utxos:
+                tx.inputs.append(TxInput(u.txid, u.vout,
+                                         sequence=0xFFFFFFFD))
+            weight = (4 + 1 + 1 + 4 + 2) * 4 + startweight \
+                + len(utxos) * _W._input_weight()
+            fee = per_kw * weight // 1000
+            total = sum(u.amount_sat for u in utxos)
+            if total <= fee:
+                raise WalletError("available funds would not cover the fee")
+            wallet.reserve([u.outpoint for u in utxos], blocks=reserve)
+            picked, change_vout = utxos, None
+            excess = total - fee
+        else:
+            amount = int(satoshi)
+            tx, picked, change_vout = wallet.fund_tx(
+                [TxOutput(amount, b"\x00" * 22)], per_kw,
+                extra_weight=startweight, reserve_blocks=reserve)
+            # fundpsbt returns inputs + change only; the caller adds
+            # its own outputs (the placeholder primary output is ours
+            # to drop)
+            tx.outputs.pop(0)
+            if change_vout is not None:
+                change_vout = 0
+            excess = amount
+        return {
+            "psbt": _to_psbt(tx, wallet),
+            "feerate_per_kw": per_kw,
+            "reservations": [
+                {"txid": u.txid.hex(), "vout": u.vout, "reserved": True}
+                for u in picked],
+            "excess_msat": excess * 1000,
+            **({"change_outnum": change_vout}
+               if change_vout is not None else {}),
+        }
+
+    async def reserveinputs(psbt: str = None, outpoints: list = None,
+                            exclusive: bool = True,
+                            reserve: int = 72) -> dict:
+        pts = _parse_outpoints(outpoints or [])
+        wallet.reserve(pts, blocks=reserve)
+        return {"reservations": [
+            {"txid": t.hex(), "vout": v, "reserved": True}
+            for t, v in pts]}
+
+    async def unreserveinputs(psbt: str = None,
+                              outpoints: list = None) -> dict:
+        pts = _parse_outpoints(outpoints or [])
+        wallet.unreserve(pts)
+        return {"reservations": [
+            {"txid": t.hex(), "vout": v, "reserved": False}
+            for t, v in pts]}
+
+    async def withdraw(destination: str, satoshi, feerate=None,
+                       minconf: int = 0) -> dict:
+        per_kw = _feerate_per_kw(feerate, topology)
+        spk = ADDR.to_scriptpubkey(destination, wallet.keyman.hrp)
+        if satoshi == "all":
+            utxos = [u for u in wallet.utxos()
+                     if not minconf or (
+                         u.confirmation_height is not None
+                         and wallet.height - u.confirmation_height + 1
+                         >= minconf)]
+            if not utxos:
+                raise WalletError("no available utxos")
+            from ..btc.tx import TxInput
+
+            tx = Tx(version=2)
+            for u in utxos:
+                tx.inputs.append(TxInput(u.txid, u.vout,
+                                         sequence=0xFFFFFFFD))
+            tx.outputs = [TxOutput(0, spk)]
+            weight = tx.weight() + len(utxos) * 109  # witness-to-come
+            fee = per_kw * weight // 1000
+            total = sum(u.amount_sat for u in utxos)
+            if total <= fee:
+                raise WalletError("funds would not cover the fee")
+            tx.outputs[0].amount_sat = total - fee
+            picked = utxos
+            # reserve BEFORE the awaited broadcast: a concurrent
+            # fundpsbt/withdraw task must not see these as available
+            wallet.reserve([u.outpoint for u in picked])
+        else:
+            tx, picked, _ = wallet.fund_tx(
+                [TxOutput(int(satoshi), spk)], per_kw,
+                confirmed_only=bool(minconf))
+        meta = wallet.utxo_meta(tx)
+        if hsm is not None:
+            hsm.sign_withdrawal(hsm_client, tx, meta)
+        else:
+            from .onchain import sign_wallet_inputs
+
+            sign_wallet_inputs(tx, meta, wallet.keyman)
+        raw = tx.serialize()
+        if backend is not None:
+            ok, err = await backend.sendrawtransaction(raw)
+            if not ok:
+                wallet.unreserve([u.outpoint for u in picked])
+                raise WalletError(f"sendrawtransaction failed: {err}")
+        txid = tx.txid()
+        wallet.mark_spent([u.outpoint for u in picked], txid)
+        wallet.add_unconfirmed_change(tx)
+        return {"tx": raw.hex(), "txid": txid.hex()}
+
+    rpc.register("newaddr", newaddr)
+    rpc.register("listaddresses", listaddresses)
+    rpc.register("listfunds", listfunds)
+    rpc.register("fundpsbt", fundpsbt)
+    rpc.register("reserveinputs", reserveinputs)
+    rpc.register("unreserveinputs", unreserveinputs)
+    rpc.register("withdraw", withdraw)

@@ -137,3 +137,59 @@ def test_offer_registry_persistence(tmp_path):
     reg2 = OfferRegistry(db)
     assert reg2.offers[row["offer_id"]]["status"] == "disabled"
     assert reg2.active(row["offer_id"]) is None
+
+
+def test_bolt12_blinded_path_cookie(tmp_path):
+    """A minted bolt12 invoice carries a 1-hop blinded path whose
+    path_id cookie gates the preimage: a blinded final HTLC carrying the
+    right ciphertext fulfills; a bare HTLC that merely knows the
+    payment_hash (an on-route observer) must NOT obtain the preimage."""
+    from types import SimpleNamespace
+
+    from lightning_tpu.bolt import blindedpath as BP
+    from lightning_tpu.bolt import onion_payload as OP
+    from lightning_tpu.daemon.channeld import classify_incoming
+    from lightning_tpu.pay import payer as PAYER
+
+    async def body():
+        issuer = LightningNode(privkey=ISSUER_KEY)
+        payer = LightningNode(privkey=PAYER_KEY)
+        _, registry, invoices, service, _ = _services(issuer, ISSUER_KEY)
+        _, _, _, _, fetcher = _services(payer, PAYER_KEY)
+        try:
+            await _connect(issuer, payer)
+            row = service.create_offer("blinded", amount_msat=7_000)
+            offer = B12.Offer.decode(row["bolt12"])
+            inv = await fetcher.fetch(offer, timeout=10)
+        finally:
+            await issuer.close()
+            await payer.close()
+        return inv, invoices
+
+    inv, invoices = run(body())
+    from lightning_tpu.crypto import ref_python as ref
+    issuer_id = ref.pubkey_serialize(ref.pubkey_create(ISSUER_KEY))
+    assert inv.paths and len(inv.paths[0].hops) == 1
+    # recipient recovers the cookie from its own ciphertext
+    ub = BP.unblind_hop(ISSUER_KEY, inv.paths[0].first_path_key,
+                        inv.paths[0].hops[0].encrypted_recipient_data)
+    assert ub.data.path_id == invoices.by_hash[inv.payment_hash].payment_secret
+
+    # full onion: payer builds the blinded final payload, issuer peels it
+    final = PAYER.bolt12_final_payload(inv, 7_000, 600)
+    onion, _ = OP.build_route_onion(
+        [issuer_id], [final], inv.payment_hash, session_key=0x1234)
+    lh = SimpleNamespace(onion=onion, htlc=SimpleNamespace(
+        payment_hash=inv.payment_hash, amount_msat=7_000, cltv_expiry=600))
+    verdict, data = classify_incoming(lh, ISSUER_KEY, invoices=invoices)
+    assert verdict == "fulfill"
+    assert data == invoices.by_hash[inv.payment_hash].preimage
+
+    # bare HTLC with no secret: rejected
+    bare = OP.HopPayload(7_000, 600, total_msat=None)
+    onion2, _ = OP.build_route_onion(
+        [issuer_id], [bare], inv.payment_hash, session_key=0x4321)
+    lh2 = SimpleNamespace(onion=onion2, htlc=SimpleNamespace(
+        payment_hash=inv.payment_hash, amount_msat=7_000, cltv_expiry=600))
+    verdict2, _ = classify_incoming(lh2, ISSUER_KEY, invoices=invoices)
+    assert verdict2 == "fail"

@@ -1,0 +1,272 @@
+"""On-chain UTXO wallet: BIP32 keys, deposits via the chain filter,
+reservations, withdraw with real signatures, reorg handling, restart
+persistence.
+
+Models the reference's wallet/wallet.c + txfilter.c + reservation.c +
+walletrpc.c behavior over the FakeBitcoind regtest chain.
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lightning_tpu.btc import address as ADDR
+from lightning_tpu.btc import script as SCRIPT
+from lightning_tpu.btc.bip32 import ExtKey
+from lightning_tpu.btc.tx import Tx, TxInput, TxOutput
+from lightning_tpu.chain.backend import FakeBitcoind
+from lightning_tpu.chain.topology import ChainTopology
+from lightning_tpu.crypto import ref_python as ref
+from lightning_tpu.wallet.db import Db
+from lightning_tpu.wallet.onchain import (KeyManager, OnchainWallet,
+                                          WalletError, sign_wallet_inputs)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60))
+
+
+# -- BIP32 test vector 1 (public spec data) ---------------------------------
+
+SEED1 = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+
+
+def test_bip32_vector1():
+    m = ExtKey.from_seed(SEED1)
+    assert m.key == int(
+        "e8f32e723decf4051aefac8e2c93c9c5b214313817cdb01a1494b917c8436b35", 16)
+    assert m.chain == bytes.fromhex(
+        "873dff81c02f525623fd1fe5167eac3a55a049de3d314bb42ee227ffed37d508")
+    h0 = m.ckd(0x80000000)
+    assert h0.key == int(
+        "edb2e14f9ee77d26dd93b4ecede8d16ed408ce149b6cd80b0715a2d911a0afea", 16)
+    n1 = h0.ckd(1)
+    assert n1.key == int(
+        "3c6cb8d0f6a264c91ea8b5030fadaa8e538b020f0a387421a12de9319dc93368", 16)
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _mk_wallet(tmp_path, name="w.sqlite3"):
+    db = Db(str(tmp_path / name))
+    km = KeyManager(ExtKey.from_seed(b"\x07" * 32), db)
+    return db, OnchainWallet(db, km)
+
+
+def _pay_to(wallet_addr: str, sat: int) -> Tx:
+    """A coinbase-ish deposit tx paying the wallet."""
+    spk = ADDR.to_scriptpubkey(wallet_addr)
+    return Tx(inputs=[TxInput(b"\x00" * 32, 0xFFFFFFFF)],
+              outputs=[TxOutput(sat, spk)])
+
+
+async def _sync(chain, topo_wallet):
+    bitcoind, topo = chain
+    await topo.sync_once()
+
+
+def _chain(wallet):
+    bitcoind = FakeBitcoind()
+    topo = ChainTopology(bitcoind)
+    wallet.attach(topo)
+    return bitcoind, topo
+
+
+def test_deposit_and_listfunds(tmp_path):
+    async def body():
+        db, wallet = _mk_wallet(tmp_path)
+        bitcoind, topo = _chain(wallet)
+        addr = wallet.newaddr()["bech32"]
+        dep = _pay_to(addr, 250_000)
+        bitcoind.mempool[dep.txid()] = dep
+        bitcoind.generate(2)
+        await topo.sync_once()
+        funds = wallet.listfunds()
+        assert len(funds) == 1
+        assert funds[0]["amount_msat"] == 250_000_000
+        assert funds[0]["status"] == "confirmed"
+        assert funds[0]["address"] == addr
+        assert wallet.balance_sat() == 250_000
+    run(body())
+
+
+def test_restart_reloads_filter_and_coins(tmp_path):
+    async def body():
+        db, wallet = _mk_wallet(tmp_path)
+        bitcoind, topo = _chain(wallet)
+        addr = wallet.newaddr()["bech32"]
+        dep = _pay_to(addr, 99_000)
+        bitcoind.mempool[dep.txid()] = dep
+        bitcoind.generate(1)
+        await topo.sync_once()
+        db.close()
+
+        # fresh process: same db path, fresh KeyManager/wallet objects
+        db2 = Db(str(tmp_path / "w.sqlite3"))
+        km2 = KeyManager(ExtKey.from_seed(b"\x07" * 32), db2)
+        w2 = OnchainWallet(db2, km2)
+        assert w2.balance_sat() == 99_000
+        # the reloaded filter still catches deposits to the old address
+        bitcoind2, topo2 = _chain(w2)
+        dep2 = _pay_to(addr, 1_000)
+        bitcoind2.mempool[dep2.txid()] = dep2
+        bitcoind2.generate(1)
+        await topo2.sync_once()
+        assert w2.balance_sat() == 100_000
+    run(body())
+
+
+def test_reservation_and_expiry(tmp_path):
+    async def body():
+        db, wallet = _mk_wallet(tmp_path)
+        bitcoind, topo = _chain(wallet)
+        addr = wallet.newaddr()["bech32"]
+        dep = _pay_to(addr, 50_000)
+        bitcoind.mempool[dep.txid()] = dep
+        bitcoind.generate(1)
+        await topo.sync_once()
+        (u,) = wallet.utxos()
+        wallet.reserve([u.outpoint], blocks=2)
+        assert wallet.utxos() == []           # reserved ≠ available
+        with pytest.raises(WalletError):
+            wallet.reserve([u.outpoint])      # double-reserve refused
+        # expiry: height reaches reserved_til → available again
+        bitcoind.generate(2)
+        await topo.sync_once()
+        assert len(wallet.utxos()) == 1
+        # explicit unreserve also works
+        wallet.reserve([u.outpoint])
+        wallet.unreserve([u.outpoint])
+        assert len(wallet.utxos()) == 1
+    run(body())
+
+
+def test_withdraw_signs_and_tracks_change(tmp_path):
+    async def body():
+        db, wallet = _mk_wallet(tmp_path)
+        bitcoind, topo = _chain(wallet)
+        addr = wallet.newaddr()["bech32"]
+        dep = _pay_to(addr, 1_000_000)
+        bitcoind.mempool[dep.txid()] = dep
+        bitcoind.generate(1)
+        await topo.sync_once()
+
+        # destination outside the wallet
+        dest_key = ExtKey.from_seed(b"\x55" * 32)
+        dest = ADDR.p2wpkh(dest_key.pubkey)
+        tx, picked, change_vout = wallet.fund_tx(
+            [TxOutput(300_000, ADDR.to_scriptpubkey(dest))],
+            feerate_per_kw=1000)
+        assert change_vout is not None
+        meta = wallet.utxo_meta(tx)
+        sign_wallet_inputs(tx, meta, wallet.keyman)
+
+        # every wallet input got a valid P2WPKH witness
+        for i, m in enumerate(meta):
+            assert m is not None
+            sig_der, pub = tx.inputs[i].witness
+            code = b"\x76\xa9\x14" + SCRIPT.hash160(pub) + b"\x88\xac"
+            digest = tx.sighash_segwit(i, code, m[0])
+            # strip sighash byte, parse DER
+            r, s = _parse_der(sig_der[:-1])
+            assert ref.ecdsa_verify(digest, r, s, ref.pubkey_parse(pub))
+
+        ok, err = await bitcoind.sendrawtransaction(tx.serialize())
+        assert ok, err
+        wallet.mark_spent([u.outpoint for u in picked], tx.txid())
+        wallet.add_unconfirmed_change(tx)
+        # change is spendable pre-confirmation; original coin is spent
+        assert wallet.balance_sat() == tx.outputs[change_vout].amount_sat
+        bitcoind.generate(1)
+        await topo.sync_once()
+        funds = wallet.listfunds()
+        assert len(funds) == 1
+        assert funds[0]["status"] == "confirmed"
+    run(body())
+
+
+def test_reorg_unconfirms(tmp_path):
+    async def body():
+        db, wallet = _mk_wallet(tmp_path)
+        bitcoind, topo = _chain(wallet)
+        addr = wallet.newaddr()["bech32"]
+        dep = _pay_to(addr, 77_000)
+        bitcoind.mempool[dep.txid()] = dep
+        bitcoind.generate(1)
+        await topo.sync_once()
+        assert wallet.listfunds()[0]["status"] == "confirmed"
+        # drop the deposit block; replacement chain without the tx
+        bitcoind.reorg(1, new_blocks=2)
+        # the deposit went back to the mempool: still tracked, unconfirmed
+        await topo.sync_once()
+        funds = wallet.listfunds()
+        assert funds[0]["status"] == "unconfirmed"
+        # re-confirm
+        bitcoind.generate(1)
+        await topo.sync_once()
+        assert wallet.listfunds()[0]["status"] == "confirmed"
+    run(body())
+
+
+def test_insufficient_funds(tmp_path):
+    db, wallet = _mk_wallet(tmp_path)
+    with pytest.raises(WalletError, match="insufficient"):
+        wallet.select_coins(10_000, 1000, 400)
+
+
+def _parse_der(der: bytes) -> tuple[int, int]:
+    assert der[0] == 0x30
+    rl = der[3]
+    r = int.from_bytes(der[4:4 + rl], "big")
+    sl = der[5 + rl]
+    s = int.from_bytes(der[6 + rl:6 + rl + sl], "big")
+    return r, s
+
+
+def test_hsm_sign_withdrawal(tmp_path):
+    """The hsm door signs wallet inputs (batched when >1) and the
+    witnesses verify against the hsm-derived pubkeys."""
+    from lightning_tpu.daemon.hsmd import CAP_SIGN_ONCHAIN, Hsm, HsmError
+
+    async def body():
+        hsm = Hsm(b"\x42" * 32)
+        db = Db(str(tmp_path / "h.sqlite3"))
+        km = KeyManager(hsm.bip32_base(), db)
+        wallet = OnchainWallet(db, km)
+        bitcoind, topo = _chain(wallet)
+        a1, a2 = wallet.newaddr()["bech32"], wallet.newaddr()["bech32"]
+        for a, amt in ((a1, 40_000), (a2, 60_000)):
+            dep = _pay_to(a, amt)
+            bitcoind.mempool[dep.txid()] = dep
+        bitcoind.generate(1)
+        await topo.sync_once()
+        assert wallet.balance_sat() == 100_000
+
+        dest = ADDR.p2wpkh(ExtKey.from_seed(b"\x66" * 32).pubkey)
+        tx, picked, _ = wallet.fund_tx(
+            [TxOutput(90_000, ADDR.to_scriptpubkey(dest))],
+            feerate_per_kw=1000)
+        assert len(tx.inputs) == 2      # forces the batched sign path
+        meta = wallet.utxo_meta(tx)
+
+        # capability enforcement
+        weak = hsm.client(0)
+        with pytest.raises(HsmError):
+            hsm.sign_withdrawal(weak, tx, meta)
+
+        client = hsm.client(CAP_SIGN_ONCHAIN)
+        hsm.sign_withdrawal(client, tx, meta)
+        for i, m in enumerate(meta):
+            sig_der, pub = tx.inputs[i].witness
+            assert pub == km.pubkey(
+                [u for u in picked if u.outpoint ==
+                 (tx.inputs[i].txid, tx.inputs[i].vout)][0].keyindex)
+            code = b"\x76\xa9\x14" + SCRIPT.hash160(pub) + b"\x88\xac"
+            digest = tx.sighash_segwit(i, code, m[0])
+            r, s = _parse_der(sig_der[:-1])
+            assert ref.ecdsa_verify(digest, r, s, ref.pubkey_parse(pub))
+        ok, err = await bitcoind.sendrawtransaction(tx.serialize())
+        assert ok, err
+    run(body())
