@@ -214,6 +214,9 @@ class ChannelCore:
     state: ChannelState = ChannelState.NORMAL
     htlcs: dict = field(default_factory=dict)  # (offered_by_us, id) -> LiveHtlc
     next_htlc_id: dict = field(default_factory=lambda: {True: 0, False: 0})
+    # pre-update_fee rate while the change is uncommitted (reverted by
+    # forget_uncommitted on reconnect; cleared once a commit covers it)
+    _fee_before_uncommitted: int | None = None
 
     def __post_init__(self):
         if self.reserve_local_msat is None:
@@ -319,6 +322,11 @@ class ChannelCore:
         if self._offered_balance_msat(self.opener_is_local) - fee < \
                 self._reserve_for(self.opener_is_local):
             raise ChannelError("opener cannot afford new feerate")
+        # remember the pre-update rate until a commitment covers the
+        # change: an uncommitted update_fee is forgotten on reconnect
+        # (BOLT#2), and forgetting must roll the rate back too
+        if self._fee_before_uncommitted is None:
+            self._fee_before_uncommitted = self.feerate_per_kw
         self.feerate_per_kw = feerate_per_kw
 
     # -- commitment flow events -------------------------------------------
@@ -339,6 +347,7 @@ class ChannelCore:
 
     def send_commit(self) -> list[LiveHtlc]:
         changed = self._apply(_ON_SEND_COMMIT)
+        self._fee_before_uncommitted = None  # fee change now committed
         if not changed:
             # BOLT#2: MUST NOT send commitment_signed with no changes —
             # callers decide; we surface it
@@ -351,12 +360,45 @@ class ChannelCore:
         return changed
 
     def recv_commit(self) -> list[LiveHtlc]:
+        self._fee_before_uncommitted = None  # fee change now committed
         return self._apply(_ON_RECV_COMMIT)
 
     def send_revoke(self) -> list[LiveHtlc]:
         changed = self._apply(_ON_SEND_REVOKE)
         self._settle_removed()
         return changed
+
+    def forget_uncommitted(self) -> list[tuple[bool, int]]:
+        """BOLT#2 reconnect rule: updates not yet covered by any
+        commitment_signed are forgotten by BOTH sides on reconnect (the
+        sender may re-issue them as fresh updates).  Adds in the
+        pre-commit state are dropped; removes in the pre-commit state
+        revert to the fully-committed add state.  HTLC ids roll back so
+        re-issued adds reuse them (the peer forgot the old ones too).
+        Returns the dropped (by_us, id) keys."""
+        dropped = []
+        for key, lh in list(self.htlcs.items()):
+            if lh.state in (HS.SENT_ADD_HTLC, HS.RCVD_ADD_HTLC):
+                dropped.append(key)
+                del self.htlcs[key]
+            elif lh.state is HS.RCVD_REMOVE_HTLC:
+                lh.state = HS.SENT_ADD_ACK_REVOCATION
+                lh.preimage = None
+                lh.fail_reason = None
+            elif lh.state is HS.SENT_REMOVE_HTLC:
+                lh.state = HS.RCVD_ADD_ACK_REVOCATION
+                lh.preimage = None
+                lh.fail_reason = None
+        for by_us in (True, False):
+            back = [hid for d, hid in dropped if d == by_us]
+            if back:
+                # uncommitted adds are necessarily the newest ids, so
+                # rolling back to the lowest dropped one is exact
+                self.next_htlc_id[by_us] = min(back)
+        if self._fee_before_uncommitted is not None:
+            self.feerate_per_kw = self._fee_before_uncommitted
+            self._fee_before_uncommitted = None
+        return dropped
 
     def _settle_removed(self):
         dead = [k for k, lh in self.htlcs.items() if lh.removed]

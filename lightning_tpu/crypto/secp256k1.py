@@ -295,13 +295,15 @@ def decompress(qx, parity):
 # ECDSA
 
 
-def ecdsa_verify_kernel(z, r, s, qx, q_parity):
+def ecdsa_verify_kernel(z, r, s, qx, q_parity, dual_mul_impl=None):
     """Batched ECDSA verify.
 
     z: (B, 20) hash limbs (raw 256-bit value, reduced mod n implicitly)
     r, s: (B, 20) canonical signature scalar limbs
     qx: (B, 20) canonical pubkey x limbs; q_parity: (B,) y parity (0/1)
     Returns bool (B,).  Fully branchless; invalid encodings yield False.
+    dual_mul_impl: alternate u1·G+u2·Q engine (the fused Pallas kernel
+    in crypto.pallas_secp); default = the XLA scan.
     """
     r_ok = F.lt_const(r, N_INT) & _nonzero(r)
     # libsecp256k1's secp256k1_ecdsa_verify (bitcoin/signature.c:174 path)
@@ -313,7 +315,7 @@ def ecdsa_verify_kernel(z, r, s, qx, q_parity):
     w = F.inv(FN, s)
     u1 = F.normalize(FN, F.mul(FN, z, w))
     u2 = F.normalize(FN, F.mul(FN, r, w))
-    R = dual_mul(u1, u2, qx, qy)
+    R = (dual_mul_impl or dual_mul)(u1, u2, qx, qy)
     Rx, _, Rz = R
     not_inf = ~F.is_zero(FP, Rz)
     # projective x(R) ≡ r (mod n) check without inversion:

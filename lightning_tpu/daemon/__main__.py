@@ -81,15 +81,30 @@ async def amain(args) -> int:
         print(f"listening {args.bind}:{port}", flush=True)
 
     gossmap_ref = {"map": None}
+    store_idx = None
     if args.gossip_store:
         from ..gossip import gossmap as GM
         from ..gossip import store as gstore
 
-        gossmap_ref["map"] = GM.from_store(
-            gstore.load_store(args.gossip_store))
+        store_idx = gstore.load_store(args.gossip_store)
+        gossmap_ref["map"] = GM.from_store(store_idx)
         g = gossmap_ref["map"]
         print(f"gossmap: {g.n_channels} channels, {g.n_nodes} nodes",
               flush=True)
+
+    # live gossipd: ingest from peers, serve BOLT#7 queries, stream out
+    # (gossip_init, lightningd.c:1375 — previously only tests wired this)
+    gossipd = None
+    if args.data_dir:
+        from ..gossip.gossipd import Gossipd
+
+        gpath = args.gossip_store or _os.path.join(args.data_dir,
+                                                   "gossip_store")
+        gossipd = Gossipd(node, gpath)
+        loaded = gossipd.load_existing(gpath, idx=store_idx)
+        gossipd.start()
+        if loaded:
+            print(f"gossipd: {loaded} records from {gpath}", flush=True)
 
     # invoice registry + onion messaging + BOLT#12 offers ride the node
     # identity key (lightningd: invoice.c / onion_message.c / offers
@@ -141,6 +156,22 @@ async def amain(args) -> int:
     offers_svc = OffersService(messenger, offer_reg, invoices, node_seckey)
     fetcher = FetchInvoice(messenger, node_seckey)
 
+    # channel manager: live channel registry + fundchannel/pay/close RPC
+    manager = None
+    if hsm is not None:
+        from ..pay.htlc_set import HtlcSets
+        from .manager import ChannelManager
+
+        manager = ChannelManager(
+            node, hsm, wallet=wallet, onchain=onchain,
+            chain_backend=chain_backend, topology=topology,
+            invoices=invoices, relay=relay_svc,
+            htlc_sets=HtlcSets(invoices), gossmap_ref=gossmap_ref,
+            funder_policy=funder_policy)
+        restored = await manager.restore_all()
+        if restored:
+            print(f"restored {restored} live channel(s)", flush=True)
+
     rpc = None
     stop_event = asyncio.Event()
     rpc_path = args.rpc_file or (
@@ -155,7 +186,12 @@ async def amain(args) -> int:
 
         rpc = RPC.JsonRpcServer(rpc_path)
         RPC.attach_core_commands(rpc, node, gossmap_ref,
-                                 stop_event=stop_event)
+                                 stop_event=stop_event,
+                                 manager=manager, topology=topology)
+        if manager is not None:
+            from .manager import attach_manager_commands
+
+            attach_manager_commands(rpc, manager)
         RPC.attach_admin_commands(rpc, args.cfg, args.logring)
         attach_offers_commands(rpc, offers_svc, fetcher, offer_reg, invoices)
 
@@ -214,46 +250,8 @@ async def amain(args) -> int:
             port = await rest.start()
             print(f"rest ready 127.0.0.1:{port}", flush=True)
 
-    if args.accept_channels:
-        from . import channeld as CD
-        from ..pay.htlc_set import HtlcSets
-
-        htlc_sets = HtlcSets(invoices)
-
-        async def serve_channels(peer):
-            from .hsmd import CAP_MASTER
-            from ..wire import messages as WM
-
-            client = hsm.client(CAP_MASTER, peer.node_id, dbid=1)
-            # dispatch v1 vs v2 opens on the first message; for v2 the
-            # funder policy decides our contribution (0 until the
-            # on-chain UTXO wallet lands: available funds are 0)
-            first = await peer.recv(WM.OpenChannel, WM.OpenChannel2,
-                                    timeout=600)
-            if isinstance(first, WM.OpenChannel2):
-                from . import dualopend as DO
-
-                contribute = funder_policy.contribution(
-                    first.funding_satoshis,
-                    available_sat=(onchain.balance_sat()
-                                   if onchain is not None else 0))
-                ch, _tx = await DO.accept_channel_v2(
-                    peer, hsm, client, contribute_sat=contribute,
-                    first_msg=first)
-                tx = await CD.channel_loop(ch, hsm.node_key,
-                                           invoices=invoices,
-                                           htlc_sets=htlc_sets,
-                                           relay=relay_svc)
-            else:
-                tx = await CD.channel_responder(
-                    peer, hsm, client, hsm.node_key,
-                    wallet=wallet, invoices=invoices,
-                    htlc_sets=htlc_sets, relay=relay_svc,
-                    first_msg=first)
-            print(f"channel closed, closing txid {tx.txid().hex()}",
-                  flush=True)
-
-        node.on_peer = serve_channels
+    if args.accept_channels and manager is not None:
+        node.on_peer = manager.serve_inbound
 
     if args.connect:
         try:
@@ -301,6 +299,8 @@ async def amain(args) -> int:
         pass
     if rpc is not None:
         await rpc.close()
+    if gossipd is not None:
+        await gossipd.close()
     if topology is not None:
         await topology.stop()
     await node.close()

@@ -52,6 +52,14 @@ class PaymentError(Exception):
     pass
 
 
+class DataLossError(ChannelError):
+    """option_data_loss_protect: the peer PROVED it holds channel state
+    beyond ours (its your_last_per_commitment_secret matches a secret we
+    generated after our last checkpoint).  Broadcasting our stale
+    commitment would be treated as a cheat; the only safe move is to
+    wait for the peer's unilateral close and sweep via onchaind."""
+
+
 @dataclass
 class ChannelConfig:
     """Our side's negotiable channel parameters (BOLT#2 open/accept)."""
@@ -128,6 +136,14 @@ class Channeld:
         self.wallet_id: int | None = None
         self.scid: int | None = None   # set when registered with a Relay
         self.hsm_dbid = 0
+        # retransmission journal (channeld.c peer_reconnect): serialized
+        # update_* msgs since the last commitment_signed we sent; sealed
+        # (last entry = the commitment_signed itself) once that commit is
+        # in flight, cleared when its revoke_and_ack arrives.  Persisted
+        # with the channel so a crash between _persist() and peer.send
+        # can replay the exact bytes.
+        self.retransmit: list[bytes] = []
+        self.retransmit_sealed = False
 
     def attach_wallet(self, wallet, hsm_dbid: int) -> None:
         self.wallet = wallet
@@ -136,6 +152,14 @@ class Channeld:
     def _persist(self) -> None:
         if self.wallet is not None:
             self.wallet.save_channel(self, self.peer.node_id, self.hsm_dbid)
+
+    def _journal(self, msg) -> None:
+        """Append an update_* to the retransmission journal.  A sealed
+        journal means a new batch of updates starts fresh."""
+        if self.retransmit_sealed:
+            self.retransmit.clear()
+            self.retransmit_sealed = False
+        self.retransmit.append(msg.serialize())
 
     # ------------------------------------------------------------------
     # key/commitment helpers
@@ -262,12 +286,20 @@ class Channeld:
         n = self.next_remote_commit
         fsig, hsigs = await asyncio.to_thread(self._sign_remote, n)
         self.next_remote_commit = n + 1
+        cs = M.CommitmentSigned(
+            channel_id=self.channel_id, signature=fsig,
+            htlc_signatures=hsigs,
+        )
+        # seal the journal: a crash after this persist but before (or
+        # during) the send replays these exact bytes at reestablish
+        self.retransmit.append(cs.serialize())
+        self.retransmit_sealed = True
         self._persist()  # checkpoint BEFORE the signature leaves us
-        await self.peer.send(M.CommitmentSigned(
-            channel_id=self.channel_id, signature=fsig, htlc_signatures=hsigs,
-        ))
+        await self.peer.send(cs)
         raa = await self.peer.recv(M.RevokeAndAck, timeout=RECV_TIMEOUT)
         self._process_revoke(raa, revoked_n=n - 1)
+        self.retransmit.clear()  # acked: no retransmission needed
+        self.retransmit_sealed = False
         self._persist()  # their revocation secret must survive a crash
 
     async def handle_commit(self) -> None:
@@ -318,47 +350,57 @@ class Channeld:
                          onion: bytes = b"\x00" * M.ONION_PACKET_LEN) -> int:
         lh = self.core.add_htlc(True, amount_msat, payment_hash, cltv_expiry,
                                 onion=onion)
-        self._persist()
-        await self.peer.send(M.UpdateAddHtlc(
+        msg = M.UpdateAddHtlc(
             channel_id=self.channel_id, id=lh.htlc.id,
             amount_msat=amount_msat, payment_hash=payment_hash,
             cltv_expiry=cltv_expiry, onion_routing_packet=onion,
-        ))
+        )
+        self._journal(msg)
+        self._persist()
+        await self.peer.send(msg)
         return lh.htlc.id
 
     async def fulfill_htlc(self, hid: int, preimage: bytes) -> None:
         """Fulfill an HTLC the peer offered us."""
         self.core.fulfill_htlc(False, hid, preimage)
-        self._persist()
-        await self.peer.send(M.UpdateFulfillHtlc(
+        msg = M.UpdateFulfillHtlc(
             channel_id=self.channel_id, id=hid, payment_preimage=preimage,
-        ))
+        )
+        self._journal(msg)
+        self._persist()
+        await self.peer.send(msg)
 
     async def fail_htlc(self, hid: int, reason: bytes = b"") -> None:
         self.core.fail_htlc(False, hid, reason)
-        self._persist()
-        await self.peer.send(M.UpdateFailHtlc(
+        msg = M.UpdateFailHtlc(
             channel_id=self.channel_id, id=hid, reason=reason,
-        ))
+        )
+        self._journal(msg)
+        self._persist()
+        await self.peer.send(msg)
 
     async def fail_malformed_htlc(self, hid: int, onion: bytes,
                                   failure_code: int) -> None:
         """BOLT#2: unparseable onions are reported in the clear with the
         onion's hash (no shared secret exists to encrypt an error)."""
         self.core.fail_htlc(False, hid, failure_code.to_bytes(2, "big"))
-        self._persist()
-        await self.peer.send(M.UpdateFailMalformedHtlc(
+        msg = M.UpdateFailMalformedHtlc(
             channel_id=self.channel_id, id=hid,
             sha256_of_onion=hashlib.sha256(onion or b"").digest(),
             failure_code=failure_code,
-        ))
+        )
+        self._journal(msg)
+        self._persist()
+        await self.peer.send(msg)
 
     async def send_update_fee(self, feerate_per_kw: int) -> None:
         self.core.update_fee(feerate_per_kw, from_local=True)
-        self._persist()
-        await self.peer.send(M.UpdateFee(
+        msg = M.UpdateFee(
             channel_id=self.channel_id, feerate_per_kw=feerate_per_kw,
-        ))
+        )
+        self._journal(msg)
+        self._persist()
+        await self.peer.send(msg)
 
     async def recv_update(self):
         """Receive one update_* message and apply it to the state machine."""
@@ -500,10 +542,28 @@ class Channeld:
     # ------------------------------------------------------------------
     # channel_reestablish (reconnect)
 
-    async def reestablish(self) -> None:
-        """Exchange channel_reestablish after a reconnect; resume if the
-        peer's numbers match ours (retransmission needs persistence and
-        lands with the wallet layer)."""
+    async def reestablish(self, theirs_first=None) -> None:
+        """Exchange channel_reestablish after a reconnect and retransmit
+        whatever the peer provably missed (channeld.c peer_reconnect):
+
+        * their next_commitment_number is one behind ours → replay the
+          journaled update_* msgs + the commitment_signed byte-exact,
+          then run the revoke half of the dance;
+        * their next_revocation_number is one behind → re-derive and
+          resend our last revoke_and_ack (it is deterministic from the
+          shachain, nothing extra to store);
+        * we are missing their last revoke_and_ack → consume their
+          retransmission;
+        * option_data_loss_protect: if the peer is AHEAD of our state,
+          verify its proof (your_last_per_commitment_secret) — on proof
+          we must NOT broadcast our stale commitment: the channel parks
+          in AWAITING_UNILATERAL and DataLossError surfaces.
+        """
+        # uncommitted updates are forgotten by both sides on reconnect
+        if not self.retransmit_sealed:
+            self.retransmit.clear()
+        self.core.forget_uncommitted()
+        our_revealed = self.next_local_commit - 1
         await self.peer.send(M.ChannelReestablish(
             channel_id=self.channel_id,
             next_commitment_number=self.next_local_commit,
@@ -513,14 +573,62 @@ class Channeld:
                 self.our_point(self.next_local_commit - 1)
             ),
         ))
-        theirs = await self.peer.recv(M.ChannelReestablish, timeout=RECV_TIMEOUT)
+        theirs = theirs_first if theirs_first is not None else \
+            await self.peer.recv(M.ChannelReestablish, timeout=RECV_TIMEOUT)
         if theirs.channel_id != self.channel_id:
             raise ChannelError("reestablish for unknown channel")
-        if theirs.next_commitment_number != self.next_remote_commit:
+
+        # --- data-loss detection (we are the stale side) ---------------
+        if (theirs.next_commitment_number > self.next_remote_commit
+                or theirs.next_revocation_number > our_revealed):
+            proof = theirs.your_last_per_commitment_secret
+            n_proof = theirs.next_revocation_number - 1
+            if n_proof >= 0 and proof == self.hsm.per_commitment_secret(
+                    self.client, n_proof):
+                # peer proved it has state beyond ours: broadcasting our
+                # stale commitment would be a cheat — park and wait for
+                # THEIR unilateral close
+                self.core.state = ChannelState.AWAITING_UNILATERAL
+                self._persist()
+                raise DataLossError(
+                    "peer proved we lost channel state; awaiting their "
+                    "unilateral close")
             raise ChannelError(
-                f"peer expects commitment {theirs.next_commitment_number}, "
-                f"we are at {self.next_remote_commit}"
-            )
+                "peer claims state beyond ours without a valid proof")
+        if theirs.next_commitment_number < self.next_remote_commit - 1 \
+                or theirs.next_revocation_number < our_revealed - 1:
+            # the PEER lost more than one step: its own data-loss logic
+            # must take over; we can only error (it has our reestablish
+            # msg with our proof fields)
+            raise ChannelError("peer is behind by more than one step")
+
+        # --- retransmit our last revoke_and_ack if they missed it -------
+        if theirs.next_revocation_number == our_revealed - 1:
+            n_last = self.next_local_commit - 1   # commit their raa acks
+            await self.peer.send(M.RevokeAndAck(
+                channel_id=self.channel_id,
+                per_commitment_secret=self.hsm.per_commitment_secret(
+                    self.client, n_last - 1),
+                next_per_commitment_point=ref.pubkey_serialize(
+                    self.our_point(n_last + 1)),
+            ))
+
+        # --- retransmit our last commitment batch if they missed it -----
+        if theirs.next_commitment_number == self.next_remote_commit - 1:
+            if not (self.retransmit_sealed and self.retransmit):
+                raise ChannelError(
+                    "peer missed our commitment but no journal survives")
+            for raw in self.retransmit:
+                await self.peer.send_raw(raw)
+
+        # --- consume their retransmitted revoke_and_ack if we miss it ---
+        if self._their_revoked_count() < self.next_remote_commit - 1:
+            raa = await self.peer.recv(M.RevokeAndAck, timeout=RECV_TIMEOUT)
+            self._process_revoke(raa,
+                                 revoked_n=self.next_remote_commit - 2)
+            self.retransmit.clear()
+            self.retransmit_sealed = False
+            self._persist()
 
     def _their_revoked_count(self) -> int:
         """How many of the peer's commitments they have revoked to us
@@ -572,9 +680,18 @@ def _open_core(funding_sat: int, push_msat: int, local_is_funder: bool,
 async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
                        funding_sat: int, push_msat: int = 0,
                        cfg: ChannelConfig | None = None,
-                       wallet=None, hsm_dbid: int = 0) -> Channeld:
+                       wallet=None, hsm_dbid: int = 0,
+                       onchain=None, chain_backend=None,
+                       topology=None) -> Channeld:
     """Funder-side v1 open: open_channel → accept_channel →
-    funding_created → funding_signed → channel_ready (both ways)."""
+    funding_created → funding_signed → channel_ready (both ways).
+
+    With `onchain` (wallet.onchain.OnchainWallet) the funding tx spends
+    REAL tracked UTXOs — coin selection, change, hsm-signed inputs,
+    broadcast through `chain_backend` after the peer's funding_signed
+    verifies (never before: the reference refuses to put coins at risk
+    without the counter-signature, opening_control.c).  With `topology`
+    channel_ready waits for cfg.minimum_depth confirmations."""
     cfg = cfg or ChannelConfig()
     ch = Channeld(peer, hsm, client, funder=True, cfg=cfg)
     tmp_id = os.urandom(32)
@@ -612,33 +729,69 @@ async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
     ch.core = _open_core(funding_sat, push_msat, True, cfg,
                          acc.channel_reserve_satoshis)
 
-    # fabricate the funding tx (no chain backend yet: the wallet/chain
-    # layer will replace this with real coin selection + broadcast)
-    funding_tx = T.Tx(
-        version=2,
-        inputs=[T.TxInput(hashlib.sha256(b"faucet" + tmp_id).digest(), 0)],
-        outputs=[T.TxOutput(funding_sat, SC.p2wsh(ch._funding_script()))],
-    )
+    picked = None
+    if onchain is not None:
+        # real coins: select + reserve UTXOs, change back to the wallet
+        funding_tx, picked, _change = onchain.fund_tx(
+            [T.TxOutput(funding_sat, SC.p2wsh(ch._funding_script()))],
+            feerate_per_kw=cfg.feerate_per_kw,
+        )
+    else:
+        # fabricated funding input (chainless unit tests)
+        funding_tx = T.Tx(
+            version=2,
+            inputs=[T.TxInput(hashlib.sha256(b"faucet" + tmp_id).digest(),
+                              0)],
+            outputs=[T.TxOutput(funding_sat,
+                                SC.p2wsh(ch._funding_script()))],
+        )
     ch.funding_txid = funding_tx.txid()
     ch.funding_outidx = 0
     ch.channel_id = derive_channel_id(ch.funding_txid, 0)
 
-    # sign THEIR initial commitment (number 0)
-    fsig, hsigs = await asyncio.to_thread(ch._sign_remote, 0)
-    assert not hsigs  # no HTLCs at open
-    await peer.send(M.FundingCreated(
-        temporary_channel_id=tmp_id,
-        funding_txid=ch.funding_txid,
-        funding_output_index=0,
-        signature=fsig,
-    ))
-    fs = await peer.recv(M.FundingSigned, timeout=RECV_TIMEOUT)
-    if fs.channel_id != ch.channel_id:
-        raise ChannelError("funding_signed for wrong channel")
-    await asyncio.to_thread(ch._verify_local, 0, fs.signature, [])
+    try:
+        # sign THEIR initial commitment (number 0)
+        fsig, hsigs = await asyncio.to_thread(ch._sign_remote, 0)
+        assert not hsigs  # no HTLCs at open
+        await peer.send(M.FundingCreated(
+            temporary_channel_id=tmp_id,
+            funding_txid=ch.funding_txid,
+            funding_output_index=0,
+            signature=fsig,
+        ))
+        fs = await peer.recv(M.FundingSigned, timeout=RECV_TIMEOUT)
+        if fs.channel_id != ch.channel_id:
+            raise ChannelError("funding_signed for wrong channel")
+        await asyncio.to_thread(ch._verify_local, 0, fs.signature, [])
+    except BaseException:
+        # any failure before broadcast releases the reserved coins —
+        # a failed open must not strand UTXOs for RESERVATION_BLOCKS
+        if picked is not None:
+            onchain.unreserve([u.outpoint for u in picked])
+        raise
 
-    # chain-depth stub: both sides treat funding as confirmed immediately
     ch.core.transition(ChannelState.AWAITING_LOCKIN)
+    if onchain is not None:
+        # counter-signature verified: NOW the coins may leave.  Sign our
+        # wallet inputs (batched through the hsm onchain door) and
+        # broadcast; the wallet tracks spend + change immediately.
+        from .hsmd import CAP_SIGN_ONCHAIN
+
+        meta = onchain.utxo_meta(funding_tx)
+        hsm.sign_withdrawal(hsm.client(CAP_SIGN_ONCHAIN), funding_tx, meta)
+        if chain_backend is not None:
+            ok, err = await chain_backend.sendrawtransaction(
+                funding_tx.serialize())
+            if not ok:
+                onchain.unreserve([u.outpoint for u in picked])
+                raise ChannelError(f"funding broadcast failed: {err}")
+        onchain.mark_spent([u.outpoint for u in picked],
+                           ch.funding_txid)
+        onchain.add_unconfirmed_change(funding_tx)
+    if topology is not None:
+        # wait for funding depth (watch.c txwatch → lockin flow)
+        while topology.depth(ch.funding_txid) < cfg.minimum_depth:
+            await asyncio.sleep(0.05)
     await peer.send(M.ChannelReady(
         channel_id=ch.channel_id,
         second_per_commitment_point=ref.pubkey_serialize(ch.our_point(1)),
@@ -667,7 +820,7 @@ async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
 async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
                          cfg: ChannelConfig | None = None,
                          wallet=None, hsm_dbid: int = 0,
-                         first_msg=None) -> Channeld:
+                         first_msg=None, topology=None) -> Channeld:
     """Fundee-side v1 open.  first_msg: an already-received OpenChannel
     (the daemon peeks the first message to dispatch v1 vs v2)."""
     cfg = cfg or ChannelConfig()
@@ -719,6 +872,10 @@ async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
         channel_id=ch.channel_id, signature=fsig,
     ))
     ch.core.transition(ChannelState.AWAITING_LOCKIN)
+    if topology is not None:
+        # the fundee ALSO waits for its own view of funding depth
+        while topology.depth(ch.funding_txid) < cfg.minimum_depth:
+            await asyncio.sleep(0.05)
     cr = await peer.recv(M.ChannelReady, timeout=RECV_TIMEOUT)
     ch.their_points[1] = ref.pubkey_parse(cr.second_per_commitment_point)
     await peer.send(M.ChannelReady(
@@ -863,6 +1020,25 @@ class _Resolve:
     reason_onion: bytes | None = None
 
 
+@dataclass
+class _PayCommand:
+    """In-loop sentinel from the RPC layer: originate an outgoing HTLC
+    on this channel (lightningd's sendpay → channeld offer path).  The
+    loop resolves `done` with the preimage or sets the failure."""
+    amount_msat: int
+    payment_hash: bytes
+    cltv_expiry: int
+    onion: bytes
+    done: object = None            # asyncio.Future[(preimage|None, reason)]
+
+
+@dataclass
+class _CloseCommand:
+    """In-loop sentinel from the RPC layer: cooperative close now."""
+    done: object = None            # asyncio.Future[Tx]
+    scriptpubkey: bytes | None = None
+
+
 async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
                             node_privkey: int,
                             cfg: ChannelConfig | None = None,
@@ -912,12 +1088,49 @@ async def channel_loop(ch: Channeld, node_privkey: int,
         else:
             await ch.fail_htlc(r.hid, r.reason_onion)
 
+    # our in-flight originated payments: htlc id -> done future
+    originated: dict[int, object] = {}
+
     while True:
         msg = await ch.peer.recv(
             M.UpdateAddHtlc, M.UpdateFulfillHtlc, M.UpdateFailHtlc,
             M.UpdateFee, M.CommitmentSigned, M.Shutdown, _Resolve,
-            _RelayOffer, timeout=RECV_TIMEOUT,
+            _RelayOffer, _PayCommand, _CloseCommand, timeout=RECV_TIMEOUT,
         )
+        if isinstance(msg, _PayCommand):
+            try:
+                hid_out = await ch.offer_htlc(
+                    msg.amount_msat, msg.payment_hash, msg.cltv_expiry,
+                    onion=msg.onion)
+                await ch.commit()
+                originated[hid_out] = msg.done
+            except (ChannelError, asyncio.TimeoutError) as e:
+                # a commit timeout means the HTLC's fate is UNKNOWN
+                # (it may have hit the wire); surface that, then let
+                # the loop die so reestablish resolves the truth
+                if msg.done is not None and not msg.done.done():
+                    msg.done.set_exception(PaymentError(
+                        f"{type(e).__name__}: {e}"))
+                if isinstance(e, asyncio.TimeoutError):
+                    raise
+            continue
+        if isinstance(msg, _CloseCommand):
+            try:
+                # settle in-flight HTLC dances first: shutdown while a
+                # commitment_signed is crossing would drop it (BOLT#2
+                # allows shutdown with pending updates, but closing
+                # cannot start until HTLCs clear — we quiesce first)
+                await _quiesce(ch, node_privkey, invoices)
+                await ch.shutdown(msg.scriptpubkey)
+                await ch.recv_shutdown()
+                tx = await ch.negotiate_close()
+                if msg.done is not None and not msg.done.done():
+                    msg.done.set_result(tx)
+                return tx
+            except ChannelError as e:
+                if msg.done is not None and not msg.done.done():
+                    msg.done.set_exception(e)
+                raise
         if isinstance(msg, _Resolve):
             try:
                 await _settle(msg)
@@ -949,6 +1162,7 @@ async def channel_loop(ch: Channeld, node_privkey: int,
             ch.their_shutdown_script = msg.scriptpubkey
             if ch.core.state is ChannelState.NORMAL:
                 ch.core.transition(ChannelState.SHUTTING_DOWN)
+            await _quiesce(ch, node_privkey, invoices)
             await ch.shutdown()
             return await ch.negotiate_close()
         if isinstance(msg, M.CommitmentSigned):
@@ -1028,14 +1242,72 @@ async def channel_loop(ch: Channeld, node_privkey: int,
                 await ch.commit()
         else:
             ch.apply_update(msg)
-            if relay is not None and isinstance(
-                    msg, (M.UpdateFulfillHtlc, M.UpdateFailHtlc)):
-                cb = relay.pending.pop((id(ch), msg.id), None)
-                if cb is not None:
+            if isinstance(msg, (M.UpdateFulfillHtlc, M.UpdateFailHtlc)):
+                fut = originated.pop(msg.id, None)
+                if fut is not None and not fut.done():
                     if isinstance(msg, M.UpdateFulfillHtlc):
-                        cb(preimage=msg.payment_preimage)
+                        fut.set_result((msg.payment_preimage, None))
                     else:
-                        cb(downstream_reason=msg.reason)
+                        fut.set_result((None, msg.reason))
+                if relay is not None:
+                    cb = relay.pending.pop((id(ch), msg.id), None)
+                    if cb is not None:
+                        if isinstance(msg, M.UpdateFulfillHtlc):
+                            cb(preimage=msg.payment_preimage)
+                        else:
+                            cb(downstream_reason=msg.reason)
+
+
+async def _quiesce(ch, node_privkey: int | None = None,
+                   invoices=None) -> None:
+    """Drive in-flight HTLC dances to completion so the channel is
+    update-free (every HTLC removed, nothing uncommitted) — the
+    precondition for closing (and for splicing's stfu).
+
+    The peer may legitimately still send adds/fees (it has not seen our
+    shutdown yet) — those are applied, and incoming adds that lock in
+    during the drain are failed (we are closing, not forwarding).
+    Held local settlements (_Resolve sentinels) are honored so an
+    inbound HTLC whose preimage we owe doesn't deadlock the drain."""
+    failed: set[int] = set()
+    while any(not lh.removed for lh in ch.core.htlcs.values()) \
+            or ch.core.pending_for_commit():
+        # fail any fully-committed incoming add: we're closing
+        acted = False
+        for (by_us, hid), lh in list(ch.core.htlcs.items()):
+            from ..channel.state import HtlcState as HS
+
+            if not by_us and hid not in failed \
+                    and lh.state is HS.RCVD_ADD_ACK_REVOCATION \
+                    and lh.preimage is None and lh.fail_reason is None:
+                verdict, data = classify_incoming(
+                    lh, node_privkey or 0, invoices=invoices)
+                if verdict == "fulfill":
+                    await ch.fulfill_htlc(hid, data)
+                elif verdict == "fail":
+                    await ch.fail_htlc(hid, data)
+                else:
+                    await ch.fail_malformed_htlc(
+                        hid, lh.onion, INVALID_ONION_HMAC)
+                failed.add(hid)
+                acted = True
+        if acted or ch.core.pending_for_commit():
+            await ch.commit()
+            continue
+        m2 = await ch.peer.recv(
+            M.UpdateAddHtlc, M.UpdateFulfillHtlc, M.UpdateFailHtlc,
+            M.UpdateFailMalformedHtlc, M.UpdateFee,
+            M.CommitmentSigned, _Resolve, timeout=RECV_TIMEOUT)
+        if isinstance(m2, _Resolve):
+            if m2.preimage is not None:
+                await ch.fulfill_htlc(m2.hid, m2.preimage)
+            else:
+                await ch.fail_htlc(m2.hid, m2.reason_onion)
+            failed.add(m2.hid)
+        elif isinstance(m2, M.CommitmentSigned):
+            await ch.handle_commit_msg(m2)
+        else:
+            ch.apply_update(m2)
 
 
 def _unknown_details(lh) -> bytes:

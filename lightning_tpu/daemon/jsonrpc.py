@@ -167,7 +167,8 @@ VERSION = "lightning-tpu-0.2"
 
 def attach_core_commands(rpc: JsonRpcServer, node, gossmap_ref: dict,
                          started_at: float | None = None,
-                         stop_event: "asyncio.Event | None" = None) -> None:
+                         stop_event: "asyncio.Event | None" = None,
+                         manager=None, topology=None) -> None:
     """Register the first-wave commands against a LightningNode and a
     mutable {'map': Gossmap|None} holder (hot-swapped on gossip load)."""
     t0 = started_at or time.time()
@@ -178,8 +179,10 @@ def attach_core_commands(rpc: JsonRpcServer, node, gossmap_ref: dict,
             "id": node.node_id.hex(),
             "version": VERSION,
             "num_peers": len(node.peers),
-            "num_active_channels": 0,
-            "blockheight": 0,
+            "num_active_channels": (len(manager.channels)
+                                    if manager is not None else 0),
+            "blockheight": (max(topology.height, 0)
+                            if topology is not None else 0),
             "network": "regtest",
             "uptime_seconds": int(time.time() - t0),
             "num_known_channels": g.n_channels if g else 0,

@@ -1,0 +1,471 @@
+"""Channel manager: the daemon's registry of live channels + the RPC
+commands that drive them.
+
+Parity targets: lightningd/peer_control.c (channel ownership +
+listpeerchannels), opening_control.c json_fundchannel, pay.c
+json_sendpay/json_waitsendpay, lightningd/close path, plus the pay/xpay
+front doors.  Every live channel runs its channel_loop task; RPC
+commands talk to the loop through the peer inbox sentinels
+(_PayCommand/_CloseCommand) — the asyncio analogue of lightningd's
+cross-daemon wire msgs to channeld.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+
+from ..bolt import bolt11 as B11
+from ..wire import messages as WM
+from . import channeld as CD
+from .channeld import _CloseCommand, _PayCommand
+from .hsmd import CAP_MASTER, CAP_SIGN_ONCHAIN
+
+log = logging.getLogger("lightning_tpu.manager")
+
+
+class ManagerError(Exception):
+    pass
+
+
+class ChannelManager:
+    def __init__(self, node, hsm, wallet=None, onchain=None,
+                 chain_backend=None, topology=None, invoices=None,
+                 relay=None, htlc_sets=None, gossmap_ref=None,
+                 funder_policy=None):
+        self.node = node
+        self.hsm = hsm
+        self.wallet = wallet
+        self.onchain = onchain
+        self.chain_backend = chain_backend
+        self.topology = topology
+        self.invoices = invoices
+        self.relay = relay
+        self.htlc_sets = htlc_sets
+        self.gossmap_ref = gossmap_ref or {"map": None}
+        self.funder_policy = funder_policy
+        # channel_id -> (Channeld, loop task)
+        self.channels: dict[bytes, tuple] = {}
+        self._next_dbid = 1
+        self._load_next_dbid()
+
+    def _load_next_dbid(self) -> None:
+        if self.wallet is not None:
+            rows = self.wallet.list_channels()
+            if rows:
+                self._next_dbid = max(r["hsm_dbid"] for r in rows) + 1
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _spawn_loop(self, ch) -> None:
+        task = asyncio.get_running_loop().create_task(
+            self._run_loop(ch))
+        self.channels[ch.channel_id] = (ch, task)
+
+    async def _run_loop(self, ch) -> None:
+        try:
+            await CD.channel_loop(
+                ch, self.hsm.node_key, invoices=self.invoices,
+                htlc_sets=self.htlc_sets, relay=self.relay)
+        except (CD.ChannelError, ConnectionError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError) as e:
+            log.info("channel %s loop ended: %s",
+                     ch.channel_id.hex()[:16], e)
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            log.exception("channel %s loop crashed",
+                          ch.channel_id.hex()[:16])
+        finally:
+            self.channels.pop(ch.channel_id, None)
+            if self.relay is not None and ch.scid is not None:
+                self.relay.unregister(ch.scid)
+
+    async def serve_inbound(self, peer) -> None:
+        """node.on_peer hook: accept v1/v2 channel opens and inbound
+        reestablishes.  The peer inbox is strictly SINGLE-consumer
+        (Peer.recv drops non-matching wire msgs), so while a channel
+        loop owns this peer we must NOT recv concurrently — channels on
+        one connection are served sequentially, and the next open is
+        only awaited after the previous channel's loop ends."""
+        while True:
+            first = await peer.recv(WM.OpenChannel, WM.OpenChannel2,
+                                    WM.ChannelReestablish, timeout=86400)
+            if isinstance(first, WM.ChannelReestablish):
+                ch = self._restore_for(peer, first.channel_id)
+                if ch is None:
+                    await peer.send_error(b"unknown channel",
+                                          first.channel_id)
+                    continue
+                try:
+                    await ch.reestablish(theirs_first=first)
+                except CD.ChannelError as e:
+                    log.warning("inbound reestablish failed: %s", e)
+                    continue
+                self._spawn_loop(ch)
+            elif isinstance(first, WM.OpenChannel2):
+                from . import dualopend as DO
+
+                dbid = self._next_dbid
+                self._next_dbid += 1
+                client = self.hsm.client(CAP_MASTER, peer.node_id,
+                                         dbid=dbid)
+                avail = (self.onchain.balance_sat()
+                         if self.onchain is not None else 0)
+                contribute = (self.funder_policy.contribution(
+                    first.funding_satoshis, available_sat=avail)
+                    if self.funder_policy is not None else 0)
+                ch, _tx = await DO.accept_channel_v2(
+                    peer, self.hsm, client, contribute_sat=contribute,
+                    first_msg=first)
+                if self.wallet is not None:
+                    ch.attach_wallet(self.wallet, dbid)
+                    ch._persist()
+                self._spawn_loop(ch)
+            else:
+                dbid = self._next_dbid
+                self._next_dbid += 1
+                client = self.hsm.client(CAP_MASTER, peer.node_id,
+                                         dbid=dbid)
+                ch = await CD.accept_channel(
+                    peer, self.hsm, client, wallet=self.wallet,
+                    hsm_dbid=dbid, first_msg=first,
+                    topology=self.topology)
+                self._spawn_loop(ch)
+            # hand the inbox to the channel loop until it finishes
+            _ch, task = self.channels.get(ch.channel_id, (None, None))
+            if task is not None:
+                try:
+                    await task
+                except Exception:
+                    pass
+
+    def _restore_for(self, peer, channel_id: bytes):
+        if self.wallet is None:
+            return None
+        for row in self.wallet.list_channels():
+            if row["channel_id"] == channel_id \
+                    and row["peer_node_id"] == peer.node_id \
+                    and row["state"] in ("normal", "shutting_down"):
+                return CD.restore_channeld(self.wallet, row, peer,
+                                           self.hsm)
+        return None
+
+    async def restore_all(self) -> int:
+        """Reload channels from the db; reestablish + serve the live
+        ones as their peers reconnect (load_channels_from_wallet)."""
+        if self.wallet is None:
+            return 0
+        n = 0
+        for row in self.wallet.list_channels():
+            if row["state"] not in ("normal", "shutting_down"):
+                continue
+            peer = self.node.peers.get(row["peer_node_id"])
+            if peer is None:
+                continue   # reconnect lifecycle will call us again
+            ch = CD.restore_channeld(self.wallet, row, peer, self.hsm)
+            try:
+                await ch.reestablish()
+            except CD.ChannelError as e:
+                log.warning("reestablish failed for %s: %s",
+                            row["channel_id"].hex()[:16], e)
+                continue
+            self._spawn_loop(ch)
+            n += 1
+        return n
+
+    # -- RPC: channels -------------------------------------------------
+
+    async def fundchannel(self, peer_id: bytes, amount_sat: int,
+                          push_msat: int = 0) -> dict:
+        peer = self.node.peers.get(peer_id)
+        if peer is None:
+            raise ManagerError(f"peer {peer_id.hex()[:16]} not connected")
+        if self.onchain is not None \
+                and self.onchain.balance_sat() < amount_sat:
+            raise ManagerError(
+                f"insufficient funds: {self.onchain.balance_sat()} sat "
+                f"< {amount_sat} sat")
+        dbid = self._next_dbid
+        self._next_dbid += 1
+        client = self.hsm.client(CAP_MASTER, peer_id, dbid=dbid)
+        ch = await CD.open_channel(
+            peer, self.hsm, client, amount_sat, push_msat=push_msat,
+            wallet=self.wallet, hsm_dbid=dbid, onchain=self.onchain,
+            chain_backend=self.chain_backend, topology=self.topology)
+        self._spawn_loop(ch)
+        return {"channel_id": ch.channel_id.hex(),
+                "funding_txid": ch.funding_txid.hex(),
+                "outnum": ch.funding_outidx}
+
+    async def close(self, target: str) -> dict:
+        ch = self._find(target)
+        fut = asyncio.get_running_loop().create_future()
+        ch.peer.inbox.put_nowait(_CloseCommand(done=fut))
+        tx = await asyncio.wait_for(fut, 120)
+        raw = tx.serialize()
+        if self.chain_backend is not None:
+            await self.chain_backend.sendrawtransaction(raw)
+        return {"type": "mutual", "txid": tx.txid().hex(),
+                "tx": raw.hex()}
+
+    def _find(self, target: str):
+        try:
+            cid = bytes.fromhex(target)
+        except ValueError:
+            cid = b""
+        for ch, _task in self.channels.values():
+            if ch.channel_id == cid or ch.peer.node_id == cid \
+                    or str(ch.scid) == target:
+                return ch
+        raise ManagerError(f"unknown channel {target!r}")
+
+    def listpeerchannels(self) -> list[dict]:
+        out = []
+        for ch, _task in self.channels.values():
+            out.append({
+                "peer_id": ch.peer.node_id.hex(),
+                "channel_id": ch.channel_id.hex(),
+                "short_channel_id": str(ch.scid) if ch.scid else None,
+                "state": ch.core.state.value.upper(),
+                "funding_txid": ch.funding_txid.hex(),
+                "total_msat": ch.funding_sat * 1000,
+                "to_us_msat": ch.core.to_local_msat,
+                "htlcs": [
+                    {"direction": "out" if by_us else "in", "id": hid,
+                     "amount_msat": lh.htlc.amount_msat,
+                     "state": lh.state.name}
+                    for (by_us, hid), lh in ch.core.htlcs.items()],
+            })
+        return out
+
+    # -- RPC: payments ---------------------------------------------------
+
+    async def sendpay_direct(self, ch, amount_msat: int,
+                             payment_hash: bytes, onion: bytes,
+                             cltv: int, timeout: float = 60.0):
+        fut = asyncio.get_running_loop().create_future()
+        ch.peer.inbox.put_nowait(_PayCommand(
+            amount_msat=amount_msat, payment_hash=payment_hash,
+            cltv_expiry=cltv, onion=onion, done=fut))
+        preimage, reason = await asyncio.wait_for(fut, timeout)
+        return preimage, reason
+
+    async def pay(self, bolt11_str: str,
+                  amount_msat: int | None = None,
+                  timeout: float = 60.0) -> dict:
+        """The pay/xpay front door: route (direct peer or gossmap),
+        build the onion, originate on the right channel, await the
+        preimage, record the payments row."""
+        from ..bolt import sphinx as SX
+        from ..pay import payer as PAYER
+
+        inv = B11.decode(bolt11_str)
+        if inv.amount_msat is None and amount_msat is None:
+            raise ManagerError("invoice has no amount; pass amount_msat")
+        if inv.amount_msat is not None and amount_msat is not None \
+                and amount_msat != inv.amount_msat:
+            raise ManagerError("amount_msat conflicts with invoice")
+        amount = inv.amount_msat or amount_msat
+        if time.time() > inv.expires_at:
+            raise ManagerError("invoice expired")
+        blockheight = self.topology.height if self.topology is not None \
+            and self.topology.height > 0 else 0
+        final_cltv = blockheight + inv.min_final_cltv
+
+        ch = route = None
+        for cand, _task in self.channels.values():
+            if cand.peer.node_id == inv.payee:
+                ch = cand
+                route = [PAYER.RouteStep(inv.payee, 0, amount, final_cltv)]
+                break
+        if ch is None:
+            g = self.gossmap_ref.get("map")
+            if g is None:
+                raise ManagerError("no route: payee is not a direct peer "
+                                   "and no gossip graph is loaded")
+            best = None
+            for cand, _task in self.channels.values():
+                try:
+                    tail, src_amount, src_cltv = PAYER.route_from_gossmap(
+                        g, cand.peer.node_id, inv.payee, amount,
+                        inv.min_final_cltv, blockheight)
+                except Exception:
+                    continue
+                if best is None or src_amount < best[1]:
+                    best = (cand, src_amount, src_cltv, tail)
+            if best is None:
+                raise ManagerError("no route to destination")
+            cand, src_amount, src_cltv, tail = best
+            ch = cand
+            route = [PAYER.RouteStep(ch.peer.node_id, 0, src_amount,
+                                     src_cltv)] + tail
+        onion, _secrets = PAYER.build_payment_onion(
+            route, inv.payment_hash, inv.payment_secret, amount,
+            SX.random_session_key())
+        sent_msat = route[0].amount_msat
+        created = int(time.time())
+        pay_id = self._record_payment(inv, bolt11_str, amount, sent_msat,
+                                      created)
+        try:
+            preimage, reason = await self.sendpay_direct(
+                ch, sent_msat, inv.payment_hash, onion,
+                route[0].delay, timeout)
+        except Exception as e:
+            self._resolve_payment(pay_id, None, failure=str(e))
+            raise
+        if preimage is None:
+            self._resolve_payment(pay_id, None, failure="payment failed")
+            raise ManagerError("payment failed (downstream error)")
+        self._resolve_payment(pay_id, preimage)
+        return {
+            "payment_preimage": preimage.hex(),
+            "payment_hash": inv.payment_hash.hex(),
+            "amount_msat": amount,
+            "amount_sent_msat": sent_msat,
+            "parts": 1,
+            "status": "complete",
+        }
+
+    def _record_payment(self, inv, bolt11_str, amount, sent, created):
+        if self.wallet is None:
+            return None
+        with self.wallet.db.transaction() as c:
+            cur = c.execute(
+                "INSERT INTO payments (payment_hash, destination,"
+                " amount_msat, amount_sent_msat, bolt11, status,"
+                " created_at) VALUES (?,?,?,?,?,'pending',?)",
+                (inv.payment_hash, inv.payee, amount, sent, bolt11_str,
+                 created))
+            return cur.lastrowid
+
+    def _resolve_payment(self, pay_id, preimage, failure=None):
+        if self.wallet is None or pay_id is None:
+            return
+        with self.wallet.db.transaction() as c:
+            if preimage is not None:
+                c.execute(
+                    "UPDATE payments SET status='complete', preimage=?,"
+                    " completed_at=? WHERE id=?",
+                    (preimage, int(time.time()), pay_id))
+            else:
+                c.execute(
+                    "UPDATE payments SET status='failed', failure=?,"
+                    " completed_at=? WHERE id=?",
+                    (failure, int(time.time()), pay_id))
+
+    def listpays(self) -> list[dict]:
+        if self.wallet is None:
+            return []
+        cur = self.wallet.db.conn.execute(
+            "SELECT payment_hash, destination, amount_msat,"
+            " amount_sent_msat, bolt11, status, preimage, created_at,"
+            " completed_at, failure FROM payments ORDER BY id")
+        out = []
+        for r in cur.fetchall():
+            d = {"payment_hash": bytes(r[0]).hex(),
+                 "amount_msat": r[2], "amount_sent_msat": r[3],
+                 "status": r[5], "created_at": r[7]}
+            if r[1] is not None:
+                d["destination"] = bytes(r[1]).hex()
+            if r[4]:
+                d["bolt11"] = r[4]
+            if r[6] is not None:
+                d["preimage"] = bytes(r[6]).hex()
+            if r[9]:
+                d["failure"] = r[9]
+            out.append(d)
+        return out
+
+
+def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
+    async def fundchannel(id: str, amount, push_msat: int = 0,
+                          announce: bool = True) -> dict:
+        return await mgr.fundchannel(bytes.fromhex(id), int(amount),
+                                     push_msat=int(push_msat))
+
+    async def close(id: str) -> dict:
+        return await mgr.close(id)
+
+    async def pay(bolt11: str, amount_msat=None, retry_for: int = 60,
+                  maxfeepercent=None) -> dict:
+        return await mgr.pay(bolt11,
+                             amount_msat=(int(amount_msat)
+                                          if amount_msat else None),
+                             timeout=float(retry_for))
+
+    async def xpay(invstring: str, amount_msat=None,
+                   retry_for: int = 60) -> dict:
+        # the dedicated MCF/MPP engine needs per-part onions; until the
+        # manager grows multi-channel parts, xpay == pay single-path
+        return await mgr.pay(invstring,
+                             amount_msat=(int(amount_msat)
+                                          if amount_msat else None),
+                             timeout=float(retry_for))
+
+    async def sendpay(route: list, payment_hash: str,
+                      payment_secret: str | None = None,
+                      amount_msat=None) -> dict:
+        """Low-level: caller supplies the route hops
+        ([{id, channel, amount_msat, delay}...], pay.c json_sendpay)."""
+        from ..bolt import sphinx as SX
+        from ..pay import payer as PAYER
+
+        hops = [PAYER.RouteStep(bytes.fromhex(h["id"]),
+                                int(h.get("channel", 0)),
+                                int(h["amount_msat"]), int(h["delay"]))
+                for h in route]
+        ph = bytes.fromhex(payment_hash)
+        secret = bytes.fromhex(payment_secret) if payment_secret else None
+        first = hops[0]
+        ch = None
+        for cand, _t in mgr.channels.values():
+            if cand.peer.node_id == first.node_id:
+                ch = cand
+                break
+        if ch is None:
+            raise ManagerError("first hop is not a connected channel")
+        onion, _ = PAYER.build_payment_onion(
+            hops, ph, secret, int(amount_msat or hops[-1].amount_msat),
+            SX.random_session_key())
+        fut = asyncio.get_running_loop().create_future()
+        mgr._pending_sendpays = getattr(mgr, "_pending_sendpays", {})
+        mgr._pending_sendpays[ph] = fut
+        ch.peer.inbox.put_nowait(_PayCommand(
+            amount_msat=first.amount_msat, payment_hash=ph,
+            cltv_expiry=first.delay, onion=onion, done=fut))
+        return {"payment_hash": payment_hash, "status": "pending"}
+
+    async def waitsendpay(payment_hash: str, timeout: int = 60) -> dict:
+        ph = bytes.fromhex(payment_hash)
+        fut = getattr(mgr, "_pending_sendpays", {}).get(ph)
+        if fut is None:
+            raise ManagerError("no pending sendpay for that hash")
+        preimage, reason = await asyncio.wait_for(fut, timeout)
+        if preimage is None:
+            raise ManagerError("payment failed")
+        return {"payment_hash": payment_hash, "status": "complete",
+                "payment_preimage": preimage.hex()}
+
+    async def listpays(bolt11: str | None = None) -> dict:
+        return {"pays": mgr.listpays()}
+
+    async def listsendpays(bolt11: str | None = None) -> dict:
+        return {"payments": mgr.listpays()}
+
+    async def listpeerchannels(id: str | None = None) -> dict:
+        chans = mgr.listpeerchannels()
+        if id:
+            chans = [c for c in chans if c["peer_id"] == id]
+        return {"channels": chans}
+
+    rpc.register("fundchannel", fundchannel)
+    rpc.register("close", close)
+    rpc.register("pay", pay)
+    rpc.register("xpay", xpay)
+    rpc.register("sendpay", sendpay)
+    rpc.register("waitsendpay", waitsendpay)
+    rpc.register("listpays", listpays)
+    rpc.register("listsendpays", listsendpays)
+    rpc.register("listpeerchannels", listpeerchannels)

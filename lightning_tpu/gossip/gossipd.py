@@ -79,18 +79,24 @@ class Gossipd:
         node.register(M.ReplyShortChannelIdsEnd, self._on_scids_end)
         node.register(M.GossipTimestampFilter, self._on_filter)
 
-    def load_existing(self, store_path: str, verify: bool = False) -> int:
+    def load_existing(self, store_path: str, verify: bool = False,
+                      idx=None) -> int:
         """Rebuild the in-memory view from an existing store (restart
         path; common/gossmap.c:749's load role).  verify=True replays
         every signature through the batched kernels first
-        (tools/bench-gossipd.sh's store_load workload)."""
+        (tools/bench-gossipd.sh's store_load workload).  idx: an
+        already-loaded StoreIndex for this path (saves the second scan
+        when the daemon also built a Gossmap from the same file)."""
         import os
 
         from . import store as gstore
 
-        if not os.path.exists(store_path):
-            return 0
-        idx = gstore.load_store(store_path)
+        if idx is None:
+            if not os.path.exists(store_path):
+                return 0
+            if os.path.getsize(store_path) <= 1:
+                return 0  # fresh store: version byte only (just created)
+            idx = gstore.load_store(store_path)  # corrupt store DOES raise
         alive = idx.select(idx.alive())
         if verify:
             from . import verify as gverify

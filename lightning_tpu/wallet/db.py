@@ -133,6 +133,11 @@ MIGRATIONS: list[str] = [
         spending_txid BLOB,
         PRIMARY KEY (txid, vout)
     )""",
+    # 12: retransmission journal — the exact update_*/commitment_signed
+    # bytes in flight, replayed at channel_reestablish (BOLT#2
+    # retransmission; channeld.c peer_reconnect).  Format: 1 sealed
+    # byte + repeated [u32-be length][raw wire msg].
+    "ALTER TABLE channels ADD COLUMN retransmit BLOB NOT NULL DEFAULT x''",
 ]
 
 

@@ -27,6 +27,26 @@ def _unpack_basepoints(raw: bytes) -> K.Basepoints:
     return K.Basepoints(*ks)
 
 
+def _pack_retransmit(sealed: bool, msgs: list[bytes]) -> bytes:
+    out = [b"\x01" if sealed else b"\x00"]
+    for m in msgs:
+        out.append(len(m).to_bytes(4, "big"))
+        out.append(m)
+    return b"".join(out)
+
+
+def _unpack_retransmit(raw: bytes) -> tuple[bool, list[bytes]]:
+    if not raw:
+        return False, []
+    sealed = raw[0] == 1
+    msgs, off = [], 1
+    while off < len(raw):
+        ln = int.from_bytes(raw[off:off + 4], "big")
+        msgs.append(bytes(raw[off + 4:off + 4 + ln]))
+        off += 4 + ln
+    return sealed, msgs
+
+
 class Wallet:
     def __init__(self, db: Db):
         self.db = db
@@ -66,6 +86,8 @@ class Wallet:
             their_last_secret=ch.their_last_secret,
             our_shutdown_script=ch.our_shutdown_script,
             their_shutdown_script=ch.their_shutdown_script,
+            retransmit=_pack_retransmit(ch.retransmit_sealed,
+                                        ch.retransmit),
         )
         with self.db.transaction() as c:
             if getattr(ch, "wallet_id", None) is None:
@@ -140,6 +162,8 @@ class Wallet:
         ch.next_remote_commit = row["next_remote_commit"]
         ch.our_shutdown_script = row["our_shutdown_script"]
         ch.their_shutdown_script = row["their_shutdown_script"]
+        ch.retransmit_sealed, ch.retransmit = _unpack_retransmit(
+            row.get("retransmit") or b"")
         ch.core = ChannelCore(
             funding_sat=row["funding_sat"],
             to_local_msat=row["to_local_msat"],

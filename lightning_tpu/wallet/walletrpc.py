@@ -195,3 +195,30 @@ def attach_wallet_commands(rpc, wallet: OnchainWallet, hsm=None,
     rpc.register("reserveinputs", reserveinputs)
     rpc.register("unreserveinputs", unreserveinputs)
     rpc.register("withdraw", withdraw)
+
+    if backend is not None and hasattr(backend, "generate"):
+        # regtest-in-a-box controls (pyln-testing's bitcoind.generate /
+        # faucet role) — only exist on the FakeBitcoind backend
+        from ..btc.tx import TxInput
+
+        async def dev_generate(blocks: int = 1) -> dict:
+            backend.generate(int(blocks))
+            if topology is not None:
+                await topology.sync_once()
+            return {"blockheight": topology.height
+                    if topology is not None else None}
+
+        async def dev_faucet(satoshi: int) -> dict:
+            """Mint a deposit to a fresh wallet address and confirm it."""
+            addr = wallet.newaddr()["bech32"]
+            tx = Tx(inputs=[TxInput(b"\x00" * 32, 0xFFFFFFFF)],
+                    outputs=[TxOutput(int(satoshi),
+                                      ADDR.to_scriptpubkey(addr))])
+            backend.mempool[tx.txid()] = tx
+            backend.generate(1)
+            if topology is not None:
+                await topology.sync_once()
+            return {"txid": tx.txid().hex(), "address": addr}
+
+        rpc.register("dev-generate", dev_generate)
+        rpc.register("dev-faucet", dev_faucet)

@@ -1,0 +1,17 @@
+"""CI fuzz smoke: every parser target survives a deterministic
+mutation campaign with only its declared exceptions (the reference
+gates tests/fuzz/check-fuzz.sh the same way — a crash is a finding)."""
+from __future__ import annotations
+
+import pytest
+
+from lightning_tpu.utils import fuzz
+
+N = 1500   # per target; deterministic seeds keep this reproducible
+
+
+@pytest.mark.parametrize("name", sorted(fuzz.TARGETS))
+def test_fuzz_target(name):
+    fn, seeds, allowed = fuzz.TARGETS[name]()
+    execs = fuzz.run_target(name, fn, seeds, allowed, n=N)
+    assert execs >= N

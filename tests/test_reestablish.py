@@ -1,0 +1,233 @@
+"""BOLT#2 reestablish retransmission + option_data_loss_protect.
+
+Crash injection at the worst moments of the commitment dance — after
+the write-ahead _persist() but before the wire message leaves — then
+full restart from sqlite and reestablish.  Models channeld.c
+peer_reconnect's retransmission rules and the dev_disconnect-style
+tests the reference runs (tests/test_connection.py --dev-disconnect).
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import shutil
+
+import pytest
+
+from lightning_tpu.channel.state import ChannelState, HtlcState
+from lightning_tpu.daemon import channeld as CD
+from lightning_tpu.daemon.hsmd import CAP_MASTER, Hsm
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.wallet.db import Db
+from lightning_tpu.wallet.wallet import Wallet
+from lightning_tpu.wire import messages as M
+
+FUND = 1_000_000
+PREIMAGE = b"\x77" * 32
+PAYHASH = hashlib.sha256(PREIMAGE).digest()
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 300))
+
+
+class SendCrash(Exception):
+    """Injected 'kill -9' between _persist() and the wire send."""
+
+
+def crash_on_send(peer, *msg_types):
+    orig = peer.send
+
+    async def send(msg):
+        if isinstance(msg, tuple(msg_types)):
+            raise SendCrash(type(msg).__name__)
+        await orig(msg)
+
+    peer.send = send
+    return lambda: setattr(peer, "send", orig)
+
+
+async def _open_pair(tmp_path, keys=(0xA11CE, 0xB0B)):
+    na = LightningNode(privkey=keys[0])
+    nb = LightningNode(privkey=keys[1])
+    port = await na.listen()
+    peer_b2a = await nb.connect("127.0.0.1", port, na.node_id)
+    while nb.node_id not in na.peers:
+        await asyncio.sleep(0.01)
+    hsm_a, hsm_b = Hsm(b"\x0a" * 32), Hsm(b"\x0b" * 32)
+    wa = Wallet(Db(str(tmp_path / "a.sqlite3")))
+    wb = Wallet(Db(str(tmp_path / "b.sqlite3")))
+    cl_a = hsm_a.client(CAP_MASTER, nb.node_id, dbid=1)
+    cl_b = hsm_b.client(CAP_MASTER, na.node_id, dbid=1)
+    ch_a, ch_b = await asyncio.gather(
+        CD.open_channel(na.peers[nb.node_id], hsm_a, cl_a, FUND,
+                        wallet=wa, hsm_dbid=1),
+        CD.accept_channel(peer_b2a, hsm_b, cl_b, wallet=wb, hsm_dbid=1),
+    )
+    return na, nb, wa, wb, ch_a, ch_b
+
+
+async def _teardown(na, nb, wa, wb):
+    await na.close()
+    await nb.close()
+    wa.db.close()
+    wb.db.close()
+
+
+async def _restore_pair(tmp_path, keys=(0xA11CE, 0xB0B)):
+    wa = Wallet(Db(str(tmp_path / "a.sqlite3")))
+    wb = Wallet(Db(str(tmp_path / "b.sqlite3")))
+    na = LightningNode(privkey=keys[0])
+    nb = LightningNode(privkey=keys[1])
+    port = await na.listen()
+    peer_b2a = await nb.connect("127.0.0.1", port, na.node_id)
+    while nb.node_id not in na.peers:
+        await asyncio.sleep(0.01)
+    hsm_a, hsm_b = Hsm(b"\x0a" * 32), Hsm(b"\x0b" * 32)
+    ch_a = CD.restore_channeld(wa, wa.list_channels()[0],
+                               na.peers[nb.node_id], hsm_a)
+    ch_b = CD.restore_channeld(wb, wb.list_channels()[0], peer_b2a, hsm_b)
+    return na, nb, wa, wb, ch_a, ch_b
+
+
+async def _complete_payment(ch_a, ch_b, hid):
+    await ch_b.fulfill_htlc(hid, PREIMAGE)
+    await ch_a.recv_update()
+    await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+    await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+    assert ch_a.core.to_local_msat == FUND * 1000 - 25_000_000
+    assert ch_b.core.to_local_msat == 25_000_000
+
+
+def test_lost_commitment_signed(tmp_path):
+    """Crash between _persist() and the commitment_signed send: on
+    reconnect the journal replays the update_add + commitment_signed
+    byte-exact and the dance completes."""
+
+    async def phase1():
+        na, nb, wa, wb, ch_a, ch_b = await _open_pair(tmp_path)
+        hid = await ch_a.offer_htlc(25_000_000, PAYHASH, 500_000)
+        await ch_b.recv_update()
+        crash_on_send(ch_a.peer, M.CommitmentSigned)
+        with pytest.raises(SendCrash):
+            await ch_a.commit()
+        await _teardown(na, nb, wa, wb)
+        return hid
+
+    hid = run(phase1())
+
+    async def phase2():
+        na, nb, wa, wb, ch_a, ch_b = await _restore_pair(tmp_path)
+        # A's journal survived sealed: [update_add, commitment_signed]
+        assert ch_a.retransmit_sealed and len(ch_a.retransmit) == 2
+        assert ch_a.next_remote_commit == 2
+
+        async def b_side():
+            await ch_b.reestablish()
+            # B forgot the uncommitted add; A's replay re-delivers it
+            await ch_b.recv_update()
+            await ch_b.handle_commit()
+
+        await asyncio.gather(ch_a.reestablish(), b_side())
+        assert not ch_a.retransmit_sealed and not ch_a.retransmit
+        assert ch_a._their_revoked_count() == 1
+        # B must answer with its own commitment covering the HTLC
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        assert ch_a.core.htlcs[(True, hid)].state \
+            is HtlcState.SENT_ADD_ACK_REVOCATION
+        await _complete_payment(ch_a, ch_b, hid)
+        await _teardown(na, nb, wa, wb)
+
+    run(phase2())
+
+
+def test_lost_revoke_and_ack(tmp_path):
+    """Crash between _persist() and the revoke_and_ack send on the
+    RECEIVING side: on reconnect the revoker re-derives the exact
+    revoke_and_ack from its shachain and retransmits."""
+
+    async def phase1():
+        na, nb, wa, wb, ch_a, ch_b = await _open_pair(tmp_path)
+        hid = await ch_a.offer_htlc(25_000_000, PAYHASH, 500_000)
+        await ch_b.recv_update()
+        crash_on_send(ch_b.peer, M.RevokeAndAck)
+        a_task = asyncio.create_task(ch_a.commit())
+        with pytest.raises(SendCrash):
+            await ch_b.handle_commit()
+        a_task.cancel()
+        try:
+            await a_task
+        except (asyncio.CancelledError, Exception):
+            pass
+        await _teardown(na, nb, wa, wb)
+        return hid
+
+    hid = run(phase1())
+
+    async def phase2():
+        na, nb, wa, wb, ch_a, ch_b = await _restore_pair(tmp_path)
+        assert ch_b.next_local_commit == 2      # B processed the commit
+        assert ch_a._their_revoked_count() == 0  # A never saw the raa
+        await asyncio.gather(ch_a.reestablish(), ch_b.reestablish())
+        assert ch_a._their_revoked_count() == 1
+        # finish: B commits its side with the HTLC on board
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        await _complete_payment(ch_a, ch_b, hid)
+        await _teardown(na, nb, wa, wb)
+
+    run(phase2())
+
+
+def test_data_loss_protection(tmp_path):
+    """Restore one side from a STALE snapshot (two dances behind): the
+    stale side must verify the peer's proof, refuse to broadcast, and
+    park in AWAITING_UNILATERAL; the healthy side refuses to continue."""
+
+    async def phase1():
+        na, nb, wa, wb, ch_a, ch_b = await _open_pair(tmp_path)
+        # dance once so there's a baseline
+        hid = await ch_a.offer_htlc(10_000_000, PAYHASH, 500_000)
+        await ch_b.recv_update()
+        await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        # flush the WAL so the bare .sqlite3 file IS the snapshot
+        wa.db.conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+        shutil.copy(tmp_path / "a.sqlite3", tmp_path / "a_stale.sqlite3")
+        # two more full dances A no longer remembers
+        await ch_b.fulfill_htlc(hid, PREIMAGE)
+        await ch_a.recv_update()
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+        h2 = hashlib.sha256(b"\x88" * 32).digest()
+        await ch_a.offer_htlc(5_000_000, h2, 500_000)
+        await ch_b.recv_update()
+        await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        await _teardown(na, nb, wa, wb)
+
+    run(phase1())
+    shutil.copy(tmp_path / "a_stale.sqlite3", tmp_path / "a.sqlite3")
+    for suffix in ("-wal", "-shm"):
+        p = tmp_path / f"a.sqlite3{suffix}"
+        if p.exists():
+            p.unlink()   # newer WAL must not resurrect the lost state
+
+    async def phase2():
+        na, nb, wa, wb, ch_a, ch_b = await _restore_pair(tmp_path)
+
+        async def a_side():
+            with pytest.raises(CD.DataLossError):
+                await ch_a.reestablish()
+
+        async def b_side():
+            with pytest.raises(CD.ChannelError):
+                await ch_b.reestablish()
+
+        await asyncio.gather(a_side(), b_side())
+        # the stale side parked itself: no broadcast, wait for unilateral
+        assert ch_a.core.state is ChannelState.AWAITING_UNILATERAL
+        row = wa.list_channels()[0]
+        assert row["state"] == "awaiting_unilateral"
+        await _teardown(na, nb, wa, wb)
+
+    run(phase2())
