@@ -171,6 +171,7 @@ async def amain(args) -> int:
         restored = await manager.restore_all()
         if restored:
             print(f"restored {restored} live channel(s)", flush=True)
+        manager.enable_reconnect()
 
     rpc = None
     stop_event = asyncio.Event()

@@ -78,8 +78,16 @@ class ChannelManager:
             log.exception("channel %s loop crashed",
                           ch.channel_id.hex()[:16])
         finally:
-            self.channels.pop(ch.channel_id, None)
-            if self.relay is not None and ch.scid is not None:
+            # pop only OUR registration: a reestablish may have replaced
+            # this entry with a fresh Channeld under the same channel_id,
+            # and a dying old loop must not evict its successor
+            cur = self.channels.get(ch.channel_id)
+            if cur is not None and cur[0] is ch:
+                self.channels.pop(ch.channel_id, None)
+            # relay cleanup stands alone: an entry evicted from the
+            # registry earlier may still own the relay slot
+            if self.relay is not None and ch.scid is not None \
+                    and self.relay.by_scid.get(ch.scid) is ch:
                 self.relay.unregister(ch.scid)
 
     async def serve_inbound(self, peer) -> None:
@@ -151,6 +159,99 @@ class ChannelManager:
                 return CD.restore_channeld(self.wallet, row, peer,
                                            self.hsm)
         return None
+
+    # -- reconnect lifecycle (connectd.c:86) ---------------------------
+
+    def enable_reconnect(self, max_backoff: float = 60.0,
+                         initial_backoff: float = 1.0) -> None:
+        """Auto-redial important peers (those we have live channels
+        with) with exponential backoff, re-running reestablish."""
+        self._max_backoff = max_backoff
+        self._initial_backoff = initial_backoff
+        self._reconnecting: set[bytes] = set()
+        self.node.on_peer_gone = self._on_peer_gone
+
+    async def _on_peer_gone(self, peer) -> None:
+        node_id = peer.node_id
+        if node_id in getattr(self, "_reconnecting", set()):
+            return
+        if not self._important(node_id):
+            return
+        addr = self.node.addresses.get(node_id)
+        if addr is None:
+            return   # they dialed us; they own the reconnect
+        self._reconnecting.add(node_id)
+        try:
+            backoff = self._initial_backoff
+            while not self.node.closing:
+                await asyncio.sleep(backoff)
+                existing = self.node.peers.get(node_id)
+                if existing is not None and existing.connected:
+                    # the remote redialed us first (or a handover
+                    # finished): dialing now would kill the healthy
+                    # connection via the duplicate-peer rule
+                    return
+                try:
+                    newpeer = await self.node.connect(addr[0], addr[1],
+                                                      node_id)
+                    n = await self._reestablish_peer(newpeer)
+                except (ConnectionError, OSError,
+                        asyncio.TimeoutError) as e:
+                    # dial failed OR the fresh link died mid-reestablish:
+                    # both mean retry, never kill the reconnect loop
+                    log.info("reconnect to %s failed (%s); backoff %.1fs",
+                             node_id.hex()[:16], e, backoff)
+                    backoff = min(backoff * 2, self._max_backoff)
+                    continue
+                log.info("reconnected %s: %d channel(s) reestablished",
+                         node_id.hex()[:16], n)
+                return
+        finally:
+            self._reconnecting.discard(node_id)
+
+    def _important(self, node_id: bytes) -> bool:
+        if any(ch.peer.node_id == node_id
+               for ch, _t in self.channels.values()):
+            return True
+        if self.wallet is not None:
+            return any(r["peer_node_id"] == node_id
+                       and r["state"] in ("normal", "shutting_down")
+                       for r in self.wallet.list_channels())
+        return False
+
+    async def _reestablish_peer(self, peer) -> int:
+        """Restore + reestablish the live channel with this peer (the
+        outbound half; inbound reestablishes ride serve_inbound).
+
+        The peer inbox is single-consumer, so only ONE channel per
+        connection can be served concurrently — the same constraint
+        serve_inbound enforces by awaiting each loop.  Additional live
+        channels with the peer are logged and left for later (proper
+        multi-channel muxing needs channel_id-routed inboxes)."""
+        if self.wallet is None:
+            return 0
+        rows = [r for r in self.wallet.list_channels()
+                if r["peer_node_id"] == peer.node_id
+                and r["state"] in ("normal", "shutting_down")]
+        if len(rows) > 1:
+            log.warning("peer %s has %d live channels; serving the first "
+                        "(single-consumer inbox)", peer.node_id.hex()[:16],
+                        len(rows))
+        for row in rows[:1]:
+            # drop any stale loop still tracked for this channel
+            old = self.channels.pop(row["channel_id"], None)
+            if old is not None:
+                old[1].cancel()
+            ch = CD.restore_channeld(self.wallet, row, peer, self.hsm)
+            try:
+                await ch.reestablish()
+            except CD.ChannelError as e:
+                log.warning("reestablish with %s failed: %s",
+                            peer.node_id.hex()[:16], e)
+                continue
+            self._spawn_loop(ch)
+            return 1
+        return 0
 
     async def restore_all(self) -> int:
         """Reload channels from the db; reestablish + serve the live

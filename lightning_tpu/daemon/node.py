@@ -42,8 +42,13 @@ class LightningNode:
         self.handlers: dict[type, object] = {}
         self.raw_handlers: dict[int, object] = {}  # msg type -> fn(peer, raw)
         self.on_peer = None  # async callback(peer) run for each new peer
+        # fired when a peer's transport dies (reconnect lifecycle hook,
+        # connectd.c:86 schedule_reconnect_if_important)
+        self.on_peer_gone = None
+        self.addresses: dict[bytes, tuple[str, int]] = {}  # last good addr
         self._server: asyncio.AbstractServer | None = None
         self._peer_tasks: set[asyncio.Task] = set()
+        self.closing = False
 
     @property
     def node_id(self) -> bytes:
@@ -85,10 +90,12 @@ class LightningNode:
             connect_noise(host, port, self.keypair, node_id), timeout
         )
         try:
-            return await self._setup_peer(stream, incoming=False)
+            peer = await self._setup_peer(stream, incoming=False)
         except BaseException:
             await stream.close()
             raise
+        self.addresses[node_id] = (host, port)
+        return peer
 
     # -- init exchange ----------------------------------------------------
 
@@ -142,8 +149,14 @@ class LightningNode:
     def _peer_gone(self, peer: Peer) -> None:
         if self.peers.get(peer.node_id) is peer:
             del self.peers[peer.node_id]
+            if self.on_peer_gone is not None and not self.closing:
+                task = asyncio.get_running_loop().create_task(
+                    self.on_peer_gone(peer))
+                self._peer_tasks.add(task)
+                task.add_done_callback(self._peer_task_done)
 
     async def close(self) -> None:
+        self.closing = True   # suppress reconnect storms during shutdown
         # stop accepting first, then drop peers: 3.12's Server.wait_closed
         # blocks until every accepted transport is gone
         if self._server is not None:

@@ -26,6 +26,10 @@ class PeerError(Exception):
     pass
 
 
+class _PeerGone:
+    """Inbox sentinel: the transport died under a blocked recv."""
+
+
 class Peer:
     """One connected, init-exchanged peer."""
 
@@ -41,10 +45,29 @@ class Peer:
         self.connected_at = time.monotonic()
         self._pong_waiters: list[asyncio.Future] = []
         self._pump_task: asyncio.Task | None = None
+        # dev fault injection (common/dev_disconnect.h role): kill or
+        # blackhole the transport after N more sends.  Tests script the
+        # worst-moment disconnects the reference scripts with
+        # --dev-disconnect files.
+        self._dev_disconnect_after: int | None = None
+        self._dev_blackhole = False
 
     # -- sending ---------------------------------------------------------
 
+    def dev_disconnect(self, after_sends: int, blackhole: bool = False):
+        """Drop (or blackhole: swallow writes without closing) the
+        transport after `after_sends` more outbound messages."""
+        self._dev_disconnect_after = after_sends
+        self._dev_blackhole = blackhole
+
     async def send(self, msg: codec.Message) -> None:
+        if self._dev_disconnect_after is not None:
+            if self._dev_disconnect_after <= 0:
+                if self._dev_blackhole:
+                    return            # swallowed: peer never sees it
+                await self.disconnect()
+                raise ConnectionError("dev_disconnect")
+            self._dev_disconnect_after -= 1
         await self.stream.send_msg(msg.serialize())
 
     async def send_error(self, data: bytes, channel_id: bytes = ZERO_CHANNEL_ID):
@@ -85,6 +108,13 @@ class Peer:
         try:
             while True:
                 msg = await asyncio.wait_for(self.inbox.get(), timeout)
+                if isinstance(msg, _PeerGone):
+                    # transport died: wake the consumer instead of letting
+                    # it sit out the full protocol timeout on a dead link.
+                    # Requeue the sentinel so EVERY later recv on this dead
+                    # peer fails fast too (disconnect is sticky).
+                    self.inbox.put_nowait(msg)
+                    raise ConnectionError("peer disconnected")
                 if not types or isinstance(msg, types):
                     return msg
                 if not isinstance(msg, codec.Message):
@@ -182,6 +212,7 @@ class Peer:
             if not fut.done():
                 fut.set_exception(ConnectionError("peer disconnected"))
         self._pong_waiters.clear()
+        self.inbox.put_nowait(_PeerGone())  # wake any blocked recv
         self.node._peer_gone(self)
 
     async def wait_closed(self) -> None:

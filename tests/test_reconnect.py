@@ -1,0 +1,122 @@
+"""Reconnect lifecycle: a dropped transport redials with backoff,
+reestablishes, and the channel keeps working — including a
+dev_disconnect-scripted kill at the worst moment (commitment_signed in
+flight), where the retransmission journal completes the dance.
+
+Parity: connectd.c:86 schedule_reconnect_if_important +
+common/dev_disconnect.h scripted disconnects.
+"""
+from __future__ import annotations
+
+import asyncio
+import pathlib
+import shutil
+
+import pytest
+
+from lightning_tpu.chain.backend import FakeBitcoind
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_daemon_rpc import Stack, rpc_call  # noqa: E402
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 900))
+
+
+async def _open_pair(tmp_path):
+    bitcoind = FakeBitcoind()
+    bitcoind.generate(1)
+    a = await Stack(tmp_path, "a", b"\x0a" * 32, bitcoind).start()
+    b = await Stack(tmp_path, "b", b"\x0b" * 32, bitcoind).start()
+    a.manager.enable_reconnect(initial_backoff=0.1, max_backoff=1.0)
+    port = await b.node.listen()
+    await a.node.connect("127.0.0.1", port, b.node.node_id)
+    await rpc_call(a.rpc.rpc_path, "dev-faucet", {"satoshi": 2_000_000})
+    task = asyncio.create_task(
+        a.manager.fundchannel(b.node.node_id, 1_000_000))
+    while not bitcoind.mempool and not task.done():
+        await asyncio.sleep(0.05)
+    if bitcoind.mempool:
+        bitcoind.generate(1)
+    opened = await asyncio.wait_for(task, 600)
+    return bitcoind, a, b, opened
+
+
+async def _pay(a, b, label, msat=50_000):
+    inv = await rpc_call(b.rpc.rpc_path, "invoice", {
+        "amount_msat": msat, "label": label, "description": label})
+    return await rpc_call(a.rpc.rpc_path, "pay", {"bolt11": inv["bolt11"]})
+
+
+async def _wait_channels(mgr, n=1, timeout=30.0):
+    """Wait for n LIVE channels (connected peer, loop running)."""
+    for _ in range(int(timeout / 0.1)):
+        live = [1 for ch, t in mgr.channels.values()
+                if ch.peer.connected and not t.done()]
+        if len(live) >= n:
+            await asyncio.sleep(0.3)   # let both loops settle
+            return
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"channels never came back ({len(mgr.channels)})")
+
+
+def test_reconnect_after_clean_drop(tmp_path):
+    async def body():
+        bitcoind, a, b, opened = await _open_pair(tmp_path)
+        try:
+            paid = await _pay(a, b, "before-drop")
+            assert paid["status"] == "complete"
+
+            # kill the transport out from under both sides
+            peer = a.node.peers[b.node.node_id]
+            await peer.disconnect()
+            # auto-reconnect redials, reestablishes, respawns the loop
+            await _wait_channels(a.manager)
+            await _wait_channels(b.manager)
+            paid = await _pay(a, b, "after-drop")
+            assert paid["status"] == "complete"
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
+
+
+def test_reconnect_mid_dance_replays_journal(tmp_path):
+    """dev_disconnect kills the link exactly when commitment_signed is
+    about to go out: the payment's fate is unknown at the sender, the
+    reconnect replays the journal, and the HTLC completes (the invoice
+    ends up PAID on the recipient)."""
+    async def body():
+        bitcoind, a, b, opened = await _open_pair(tmp_path)
+        try:
+            inv = await rpc_call(b.rpc.rpc_path, "invoice", {
+                "amount_msat": 70_000, "label": "mid-dance",
+                "description": "x"})
+            peer = a.node.peers[b.node.node_id]
+            # allow the update_add through, kill on the commitment_signed
+            peer.dev_disconnect(after_sends=1)
+            with pytest.raises(Exception):
+                await a.manager.pay(inv["bolt11"], timeout=5)
+            # reconnect + journal replay complete the payment
+            await _wait_channels(a.manager)
+            await _wait_channels(b.manager)
+            for _ in range(200):
+                got = await rpc_call(b.rpc.rpc_path, "listinvoices",
+                                     {"label": "mid-dance"})
+                if got["invoices"][0]["status"] == "paid":
+                    break
+                await asyncio.sleep(0.1)
+            assert got["invoices"][0]["status"] == "paid"
+            # and the channel still works both ways
+            paid = await _pay(a, b, "post-replay")
+            assert paid["status"] == "complete"
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
