@@ -1039,6 +1039,16 @@ class _CloseCommand:
     scriptpubkey: bytes | None = None
 
 
+@dataclass
+class _SpliceCommand:
+    """In-loop sentinel from the RPC layer: splice-in add_sat using the
+    provided wallet inputs (daemon/splice.py drives the protocol)."""
+    add_sat: int
+    inputs: list
+    change_script: bytes | None = None
+    done: object = None            # asyncio.Future[Tx]
+
+
 async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
                             node_privkey: int,
                             cfg: ChannelConfig | None = None,
@@ -1054,7 +1064,8 @@ async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
 
 
 async def channel_loop(ch: Channeld, node_privkey: int,
-                       invoices=None, htlc_sets=None, relay=None) -> T.Tx:
+                       invoices=None, htlc_sets=None, relay=None,
+                       chain_backend=None, topology=None) -> T.Tx:
     """Serve one OPEN channel until cooperative close: apply updates,
     answer commitment dances, fulfill keysend/invoice HTLCs addressed to
     us (MPP parts held in htlc_sets until their set completes), hand
@@ -1094,9 +1105,38 @@ async def channel_loop(ch: Channeld, node_privkey: int,
     while True:
         msg = await ch.peer.recv(
             M.UpdateAddHtlc, M.UpdateFulfillHtlc, M.UpdateFailHtlc,
-            M.UpdateFee, M.CommitmentSigned, M.Shutdown, _Resolve,
-            _RelayOffer, _PayCommand, _CloseCommand, timeout=RECV_TIMEOUT,
+            M.UpdateFee, M.CommitmentSigned, M.Shutdown, M.Stfu,
+            _Resolve, _RelayOffer, _PayCommand, _CloseCommand,
+            _SpliceCommand, timeout=RECV_TIMEOUT,
         )
+        if isinstance(msg, M.Stfu):
+            # peer initiates quiescence → a splice is coming
+            from . import splice as SPL
+
+            try:
+                await SPL.splice_accept(ch, msg,
+                                        chain_backend=chain_backend,
+                                        topology=topology,
+                                        node_privkey=node_privkey,
+                                        invoices=invoices)
+            except ChannelError:
+                log.exception("inbound splice failed")
+            continue
+        if isinstance(msg, _SpliceCommand):
+            from . import splice as SPL
+
+            try:
+                tx = await SPL.splice_initiate(
+                    ch, msg.add_sat, msg.inputs,
+                    change_script=msg.change_script,
+                    chain_backend=chain_backend, topology=topology,
+                    node_privkey=node_privkey, invoices=invoices)
+                if msg.done is not None and not msg.done.done():
+                    msg.done.set_result(tx)
+            except ChannelError as e:
+                if msg.done is not None and not msg.done.done():
+                    msg.done.set_exception(e)
+            continue
         if isinstance(msg, _PayCommand):
             try:
                 hid_out = await ch.offer_htlc(

@@ -85,6 +85,13 @@ def _side_fee_sat(feerate_perkw: int, n_inputs: int, n_outputs: int,
     return feerate_perkw * wu // 1000
 
 
+def _change_spk(pub: bytes) -> bytes:
+    """Fallback change scriptpubkey keyed to the side's funding pubkey
+    (callers with a wallet pass a tracked key instead)."""
+    h = hashlib.new("ripemd160", hashlib.sha256(pub).digest()).digest()
+    return b"\x00\x14" + h
+
+
 def _v2_channel_id(rev1: bytes, rev2: bytes) -> bytes:
     lo, hi = sorted((rev1, rev2))
     return hashlib.sha256(lo + hi).digest()
@@ -206,8 +213,9 @@ def _unpack_witnesses(raw: bytes) -> list[list[bytes]]:
 async def _finish_v2(ch: Channeld, peer: Peer, con: _Construction,
                      tx: T.Tx, our_inputs, my_serials,
                      our_total: int, their_total: int,
-                     we_initiate: bool) -> T.Tx:
-    """Commitment exchange + tx_signatures + channel_ready."""
+                     we_initiate: bool, lockin: bool = True) -> T.Tx:
+    """Commitment exchange + tx_signatures (+ channel_ready unless the
+    caller holds lockin open for RBF rounds)."""
     # both sides send commitment_signed for the other's first commitment
     fsig, hsigs = ch._sign_remote(0)
     await peer.send(M.CommitmentSigned(
@@ -249,21 +257,30 @@ async def _finish_v2(ch: Channeld, peer: Peer, con: _Construction,
     for serial, stack in zip(their_serials, theirs):
         tx.inputs[order.index(serial)].witness = stack
 
-    # lockin (no chain): channel_ready both ways, like v1 open
     from ..channel.state import ChannelState
 
-    ch.core.transition(ChannelState.AWAITING_LOCKIN)
-    await peer.send(M.ChannelReady(
+    if ch.core.state is not ChannelState.AWAITING_LOCKIN:
+        ch.core.transition(ChannelState.AWAITING_LOCKIN)
+    if lockin:
+        await lockin_v2(ch)
+        log.info("channel %s open (v2 %s), capacity %d sat",
+                 ch.channel_id.hex()[:16],
+                 "opener" if we_initiate else "accepter",
+                 ch.funding_sat)
+    return tx
+
+
+async def lockin_v2(ch: Channeld) -> None:
+    """channel_ready both ways (chainless lockin; with a chain the
+    caller waits for depth on the WINNING candidate first)."""
+    from ..channel.state import ChannelState
+
+    await ch.peer.send(M.ChannelReady(
         channel_id=ch.channel_id,
         second_per_commitment_point=ref.pubkey_serialize(ch.our_point(1))))
-    cr = await peer.recv(M.ChannelReady, timeout=RECV_TIMEOUT)
+    cr = await ch.peer.recv(M.ChannelReady, timeout=RECV_TIMEOUT)
     ch.their_points[1] = ref.pubkey_parse(cr.second_per_commitment_point)
     ch.core.transition(ChannelState.NORMAL)
-    log.info("channel %s open (v2 %s), capacity %d sat",
-             ch.channel_id.hex()[:16],
-             "opener" if we_initiate else "accepter",
-             ch.funding_sat)
-    return tx
 
 
 def _setup_core(ch: Channeld, total_sat: int, our_sat: int,
@@ -303,6 +320,7 @@ async def open_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
                           cfg: ChannelConfig | None = None,
                           locktime: int = 0,
                           funding_feerate: int = 2500,
+                          lockin: bool = True,
                           ) -> tuple[Channeld, T.Tx]:
     """Opener side.  Returns (live channel, fully-signed funding tx)."""
     cfg = cfg or ChannelConfig()
@@ -356,9 +374,7 @@ async def open_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
     change = in_total - funding_sat - fee
     outs = [(total, spk)]
     if change > 546:
-        change_spk = b"\x00\x14" + hashlib.new(
-            "ripemd160", hashlib.sha256(ch.our_funding_pub).digest()
-        ).digest()
+        change_spk = _change_spk(ch.our_funding_pub)
         outs.append((change, change_spk))
     my_serials = await _interactive_construct(
         peer, ch.channel_id, con, True, our_inputs, outs, serial_base=0)
@@ -370,7 +386,10 @@ async def open_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
                                   T.Tx.parse(p).outputs[v].amount_sat
                                   for s, (p, v, q) in con.inputs.items()
                                   if s not in my_serials),
-                              True)
+                              True, lockin=lockin)
+    ch._v2_feerate = funding_feerate
+    ch._v2_our_sat = funding_sat
+    ch._v2_outpoints = {(i.txid, i.vout) for i in signed.inputs}
     return ch, signed
 
 
@@ -378,7 +397,7 @@ async def accept_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
                             cfg: ChannelConfig | None = None,
                             contribute_sat: int = 0,
                             our_inputs: list[FundingInput] | None = None,
-                            first_msg=None,
+                            first_msg=None, lockin: bool = True,
                             ) -> tuple[Channeld, T.Tx]:
     """Accepter side; contribute_sat > 0 makes the channel dual-funded
     for real (requires our_inputs covering it)."""
@@ -433,9 +452,7 @@ async def accept_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
         raise DualOpenError("inputs do not cover contribution + fee")
     change = in_total - contribute_sat - fee if our_inputs else 0
     if change > 546:
-        change_spk = b"\x00\x14" + hashlib.new(
-            "ripemd160", hashlib.sha256(ch.our_funding_pub).digest()
-        ).digest()
+        change_spk = _change_spk(ch.our_funding_pub)
         outs.append((change, change_spk))
     my_serials = await _interactive_construct(
         peer, ch.channel_id, con, False, our_inputs, outs, serial_base=1)
@@ -447,5 +464,133 @@ async def accept_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
                                   T.Tx.parse(p).outputs[v].amount_sat
                                   for s, (p, v, q) in con.inputs.items()
                                   if s not in my_serials),
-                              False)
+                              False, lockin=lockin)
+    ch._v2_feerate = oc.funding_feerate_perkw
+    ch._v2_our_sat = contribute_sat
+    ch._v2_outpoints = {(i.txid, i.vout) for i in signed.inputs}
+    ch._v2_their_sat = ch.funding_sat - contribute_sat
     return ch, signed
+
+
+# ---------------------------------------------------------------------------
+# RBF (openingd/dualopend.c tx_init_rbf/tx_ack_rbf path): before lockin,
+# the opener may fee-bump the funding tx with a fresh interactive round.
+# BOLT#2: the new feerate must be ≥ 25/24 of the previous one, and the
+# replacement must share an input with the original (guaranteed here by
+# re-contributing the same wallet inputs).
+
+
+async def rbf_initiate(ch: Channeld, our_inputs: list[FundingInput],
+                       new_feerate: int, locktime: int = 0) -> T.Tx:
+    """Opener: fee-bump the unconfirmed funding.  Returns the signed
+    replacement tx; ch now points at it."""
+    prev = getattr(ch, "_v2_feerate", 0)
+    if new_feerate * 24 < prev * 25:
+        raise DualOpenError(
+            f"rbf feerate {new_feerate} < 25/24 of previous {prev}")
+    await ch.peer.send(M.TxInitRbf(channel_id=ch.channel_id,
+                                   locktime=locktime,
+                                   feerate=new_feerate))
+    ack = await ch.peer.recv(M.TxAckRbf, M.TxAbort, timeout=RECV_TIMEOUT)
+    if isinstance(ack, M.TxAbort):
+        raise DualOpenError(f"peer rejected rbf: {ack.data!r}")
+    # tlv 0 = funding_output_contribution (absent → 0 this round)
+    their_sat = int.from_bytes(ack.tlvs.get(0, b""), "big") \
+        if ack.tlvs.get(0) else 0
+    funding_sat = ch._v2_our_sat
+    in_total = sum(fi.amount_sat for fi in our_inputs)
+    total = funding_sat + their_sat
+    fscript = ch._funding_script()
+    spk = b"\x00\x20" + hashlib.sha256(fscript).digest()
+    con = _Construction(locktime=locktime)
+    fee = _side_fee_sat(new_feerate, len(our_inputs), 2, common=True)
+    if in_total < funding_sat + fee:
+        raise DualOpenError("inputs do not cover contribution + rbf fee")
+    change = in_total - funding_sat - fee
+    outs = [(total, spk)]
+    if change > 546:
+        change_spk = _change_spk(ch.our_funding_pub)
+        outs.append((change, change_spk))
+    my_serials = await _interactive_construct(
+        ch.peer, ch.channel_id, con, True, our_inputs, outs,
+        serial_base=0)
+    _setup_core(ch, total, funding_sat, True, ch.cfg, con, fscript)
+    tx = con.build_tx()
+    signed = await _finish_v2(ch, ch.peer, con, tx, our_inputs,
+                              my_serials, in_total,
+                              sum(T.Tx.parse(p).outputs[v].amount_sat
+                                  for s, (p, v, q) in con.inputs.items()
+                                  if s not in my_serials),
+                              True, lockin=False)
+    ch._v2_feerate = new_feerate
+    ch._v2_outpoints = {(i.txid, i.vout) for i in signed.inputs}
+    log.info("channel %s rbf to feerate %d (txid %s)",
+             ch.channel_id.hex()[:16], new_feerate,
+             signed.txid().hex()[:16])
+    return signed
+
+
+async def rbf_accept(ch: Channeld, first_msg: M.TxInitRbf,
+                     contribute_sat: int | None = None,
+                     our_inputs: list[FundingInput] | None = None) -> T.Tx:
+    """Accepter: answer a tx_init_rbf round (contribution defaults to
+    0 — the accepter need not re-fund a bump it didn't ask for)."""
+    our_inputs = our_inputs or []
+    prev = getattr(ch, "_v2_feerate", 0)
+    if first_msg.feerate * 24 < prev * 25:
+        await ch.peer.send(M.TxAbort(
+            channel_id=ch.channel_id,
+            data=f"feerate {first_msg.feerate} too low".encode()))
+        raise DualOpenError("rbf feerate below 25/24 of previous")
+    contribute = contribute_sat if contribute_sat is not None else 0
+    tlvs = {}
+    if contribute:
+        tlvs[0] = contribute.to_bytes(8, "big")
+    await ch.peer.send(M.TxAckRbf(channel_id=ch.channel_id, tlvs=tlvs))
+    in_total = sum(fi.amount_sat for fi in our_inputs)
+    # the opener's contribution is its original one (tx_init_rbf does
+    # not renegotiate it; capacity changes only via OUR tlv)
+    con = _Construction(locktime=first_msg.locktime)
+    fee = _side_fee_sat(first_msg.feerate, len(our_inputs),
+                        1 if our_inputs else 0, common=False)
+    outs = []
+    change = in_total - contribute - fee if our_inputs else 0
+    if change > 546:
+        change_spk = _change_spk(ch.our_funding_pub)
+        outs.append((change, change_spk))
+    my_serials = await _interactive_construct(
+        ch.peer, ch.channel_id, con, False, our_inputs, outs,
+        serial_base=1)
+    # the opener's contribution is fixed by the ORIGINAL negotiation
+    # (tx_init_rbf does not renegotiate it); the replacement's funding
+    # output must equal opener_sat + our new contribution exactly —
+    # trusting the constructed output here would let a malicious opener
+    # shrink the channel after we sign our inputs in
+    fscript = ch._funding_script()
+    spk = b"\x00\x20" + hashlib.sha256(fscript).digest()
+    opener_sat = getattr(ch, "_v2_their_sat",
+                         ch.funding_sat - ch._v2_our_sat)
+    total = opener_sat + contribute
+    totals = [sats for sats, script in con.outputs.values()
+              if script == spk]
+    if totals != [total]:
+        raise DualOpenError(
+            f"rbf funding output {totals} != expected {total}")
+    # BOLT#2: the replacement MUST spend at least one input of the
+    # original, or both could confirm
+    prev_pts = getattr(ch, "_v2_outpoints", set())
+    new_pts = {(T.Tx.parse(p).txid(), v)
+               for p, v, _q in con.inputs.values()}
+    if prev_pts and not (prev_pts & new_pts):
+        raise DualOpenError("rbf candidate shares no input with original")
+    _setup_core(ch, total, contribute, False, ch.cfg, con, fscript)
+    tx = con.build_tx()
+    signed = await _finish_v2(ch, ch.peer, con, tx, our_inputs,
+                              my_serials, in_total,
+                              sum(T.Tx.parse(p).outputs[v].amount_sat
+                                  for s, (p, v, q) in con.inputs.items()
+                                  if s not in my_serials),
+                              False, lockin=False)
+    ch._v2_feerate = first_msg.feerate
+    ch._v2_outpoints = {(i.txid, i.vout) for i in signed.inputs}
+    return signed

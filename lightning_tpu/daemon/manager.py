@@ -67,7 +67,8 @@ class ChannelManager:
         try:
             await CD.channel_loop(
                 ch, self.hsm.node_key, invoices=self.invoices,
-                htlc_sets=self.htlc_sets, relay=self.relay)
+                htlc_sets=self.htlc_sets, relay=self.relay,
+                chain_backend=self.chain_backend, topology=self.topology)
         except (CD.ChannelError, ConnectionError, asyncio.TimeoutError,
                 asyncio.IncompleteReadError) as e:
             log.info("channel %s loop ended: %s",
@@ -300,6 +301,62 @@ class ChannelManager:
                 "funding_txid": ch.funding_txid.hex(),
                 "outnum": ch.funding_outidx}
 
+    async def splice(self, target: str, add_sat: int) -> dict:
+        """Splice-in: grow the channel with wallet coins (channeld/
+        splice.c orchestration + spender/splice.c's funding role)."""
+        from .channeld import _SpliceCommand
+        from .dualopend import FundingInput
+        from .hsmd import CAP_SIGN_ONCHAIN  # noqa: F401  (capability doc)
+
+        ch = self._find(target)
+        if self.onchain is None or self.topology is None:
+            raise ManagerError("splice needs the on-chain wallet")
+        # pick coins covering add + a generous fee bound, then build
+        # FundingInputs (the interactive protocol ships full prevtxs,
+        # which the topology has seen for every confirmed deposit)
+        picked, _fee, _change = self.onchain.select_coins(
+            add_sat + 5000, 1000, 600)
+        self.onchain.reserve([u.outpoint for u in picked])
+        base = self.hsm.bip32_base().ckd(0)
+        inputs = []
+        try:
+            for u in picked:
+                seen = self.topology.txs_seen.get(u.txid)
+                if seen is None:
+                    raise ManagerError(
+                        f"prevtx for {u.txid.hex()[:16]} not in chain view")
+                inputs.append(FundingInput(
+                    prevtx=seen[0], vout=u.vout,
+                    privkey=base.ckd(u.keyindex).key))
+            idx = self.onchain.keyman.fresh_index()
+            change_spk = self.onchain.keyman.scriptpubkey(idx)
+            self.onchain.filter.add(change_spk, idx)
+            fut = asyncio.get_running_loop().create_future()
+            ch.peer.inbox.put_nowait(_SpliceCommand(
+                add_sat=add_sat, inputs=inputs,
+                change_script=change_spk, done=fut))
+        except BaseException:
+            # pre-enqueue failure: the splice never started
+            self.onchain.unreserve([u.outpoint for u in picked])
+            raise
+        try:
+            tx = await asyncio.wait_for(fut, 300)
+        except asyncio.TimeoutError:
+            # the splice may STILL complete in the channel loop and
+            # spend these coins — keep them reserved (the height-based
+            # reservation expires them if it truly died)
+            raise ManagerError(
+                "splice still in flight; coins remain reserved")
+        except Exception:
+            # definitive protocol failure: the coins are free again
+            self.onchain.unreserve([u.outpoint for u in picked])
+            raise
+        self.onchain.mark_spent([u.outpoint for u in picked], tx.txid())
+        self.onchain.add_unconfirmed_change(tx)
+        return {"txid": tx.txid().hex(),
+                "channel_id": ch.channel_id.hex(),
+                "capacity_sat": ch.funding_sat}
+
     async def close(self, target: str) -> dict:
         ch = self._find(target)
         fut = asyncio.get_running_loop().create_future()
@@ -489,6 +546,9 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     async def close(id: str) -> dict:
         return await mgr.close(id)
 
+    async def splice(id: str, amount) -> dict:
+        return await mgr.splice(id, int(amount))
+
     async def pay(bolt11: str, amount_msat=None, retry_for: int = 60,
                   maxfeepercent=None) -> dict:
         return await mgr.pay(bolt11,
@@ -563,6 +623,7 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
 
     rpc.register("fundchannel", fundchannel)
     rpc.register("close", close)
+    rpc.register("splice", splice)
     rpc.register("pay", pay)
     rpc.register("xpay", xpay)
     rpc.register("sendpay", sendpay)

@@ -98,7 +98,7 @@ def read_tlv_stream(buf: bytes, off: int = 0) -> dict[int, bytes]:
     return out
 
 
-_INT_FMT = {"u8": ">B", "u16": ">H", "u32": ">I", "u64": ">Q"}
+_INT_FMT = {"u8": ">B", "u16": ">H", "u32": ">I", "u64": ">Q", "s64": ">q"}
 _FIXED_LEN = {"point": 33, "signature": 64, "chain_hash": 32, "sha256": 32}
 
 

@@ -245,6 +245,34 @@ class TxAbort(Message):
     FIELDS = [("channel_id", "bytes:32"), ("data", "varbytes")]
 
 
+class SpliceInit(Message):
+    TYPE = 80
+    FIELDS = [
+        ("channel_id", "bytes:32"),
+        # >0: splice-in (adding funds); <0: splice-out
+        ("funding_contribution_satoshis", "s64"),
+        ("funding_feerate_perkw", "u32"),
+        ("locktime", "u32"),
+        ("funding_pubkey", "point"),
+        ("tlvs", "tlvs"),
+    ]
+
+
+class SpliceAck(Message):
+    TYPE = 81
+    FIELDS = [
+        ("channel_id", "bytes:32"),
+        ("funding_contribution_satoshis", "s64"),
+        ("funding_pubkey", "point"),
+        ("tlvs", "tlvs"),
+    ]
+
+
+class SpliceLocked(Message):
+    TYPE = 77
+    FIELDS = [("channel_id", "bytes:32"), ("splice_txid", "sha256")]
+
+
 class ChannelReady(Message):
     TYPE = 36
     FIELDS = [

@@ -1,0 +1,101 @@
+"""Dual-funding RBF: a fee-bumped replacement funding tx constructed in
+a fresh interactive round before lockin replaces the original
+(openingd/dualopend.c tx_init_rbf/tx_ack_rbf parity)."""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import pytest
+
+from lightning_tpu.btc import tx as T
+from lightning_tpu.daemon import channeld as CD
+from lightning_tpu.daemon import dualopend as DO
+from lightning_tpu.daemon.hsmd import CAP_MASTER, Hsm
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.crypto import ref_python as ref
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 600))
+
+
+def _utxo(privkey: int, amount_sat: int, salt: int = 0) -> DO.FundingInput:
+    pub = ref.pubkey_serialize(ref.pubkey_create(privkey))
+    h = hashlib.new("ripemd160", hashlib.sha256(pub).digest()).digest()
+    prev = T.Tx(
+        inputs=[T.TxInput(txid=bytes([salt + 1]) * 32, vout=0)],
+        outputs=[T.TxOutput(amount_sat=amount_sat,
+                            script_pubkey=b"\x00\x14" + h)],
+    )
+    return DO.FundingInput(prevtx=prev, vout=0, privkey=privkey)
+
+
+def _fee_of(tx: T.Tx, inputs: list[DO.FundingInput]) -> int:
+    spent = {(_i.prevtx.txid(), _i.vout): _i.amount_sat for _i in inputs}
+    total_in = sum(spent[(i.txid, i.vout)] for i in tx.inputs)
+    return total_in - sum(o.amount_sat for o in tx.outputs)
+
+
+def test_rbf_replaces_funding(tmp_path):
+    async def body():
+        hsm_a, hsm_b = Hsm(b"\xe1" * 32), Hsm(b"\xe2" * 32)
+        na = LightningNode(privkey=hsm_b.node_key)
+        nb = LightningNode(privkey=hsm_a.node_key)
+        fut = asyncio.get_running_loop().create_future()
+        opener_inputs = [_utxo(0xA11CE, 1_060_000, salt=3)]
+
+        async def serve(peer):
+            client = hsm_b.client(CAP_MASTER, peer.node_id, dbid=9)
+            ch_b, tx_b = await DO.accept_channel_v2(
+                peer, hsm_b, client, lockin=False)
+            # answer the rbf round, then lock in the replacement
+            rbf_msg = await peer.recv(DO.M.TxInitRbf, timeout=120)
+            tx_b2 = await DO.rbf_accept(ch_b, rbf_msg)
+            await DO.lockin_v2(ch_b)
+            fut.set_result((ch_b, tx_b, tx_b2))
+
+        na.on_peer = serve
+        port = await na.listen()
+        peer = await nb.connect("127.0.0.1", port, na.node_id)
+        client = hsm_a.client(CAP_MASTER, peer.node_id, dbid=9)
+        ch_a, tx1 = await DO.open_channel_v2(
+            peer, hsm_a, client, 1_000_000, opener_inputs,
+            funding_feerate=1000, lockin=False)
+        assert ch_a._v2_feerate == 1000
+
+        # too-small bump is refused locally (25/24 rule)
+        with pytest.raises(DO.DualOpenError, match="25/24"):
+            await DO.rbf_initiate(ch_a, opener_inputs, 1020)
+
+        tx2 = await DO.rbf_initiate(ch_a, opener_inputs, 2000)
+        await DO.lockin_v2(ch_a)
+        ch_b, tx_b1, tx_b2 = await asyncio.wait_for(fut, 120)
+
+        # both sides agree on the replacement
+        assert tx2.txid() == tx_b2.txid()
+        assert tx2.txid() != tx1.txid()
+        # the bump spends the SAME inputs and pays a higher fee
+        assert [(i.txid, i.vout) for i in tx2.inputs] == \
+            [(i.txid, i.vout) for i in tx1.inputs]
+        assert _fee_of(tx2, opener_inputs) > _fee_of(tx1, opener_inputs)
+        # channel now tracks the replacement outpoint, and works
+        assert ch_a.funding_txid == tx2.txid()
+        assert ch_b.funding_txid == tx2.txid()
+
+        preimage = b"\x55" * 32
+        h = hashlib.sha256(preimage).digest()
+        hid = await ch_a.offer_htlc(25_000_000, h, 500_000)
+        await ch_b.recv_update()
+        await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        await ch_b.fulfill_htlc(hid, preimage)
+        await ch_a.recv_update()
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+        assert ch_b.core.to_local_msat == 25_000_000
+
+        await na.close()
+        await nb.close()
+
+    run(body())
