@@ -230,12 +230,19 @@ async def amain(args) -> int:
 
         if onchain is not None:
             from .hsmd import CAP_SIGN_ONCHAIN
+            from ..plugins.txprepare import (TxPrepare,
+                                             attach_txprepare_commands)
             from ..wallet.walletrpc import attach_wallet_commands
 
             attach_wallet_commands(
                 rpc, onchain, hsm=hsm,
                 hsm_client=hsm.client(CAP_SIGN_ONCHAIN),
                 backend=chain_backend, topology=topology)
+            attach_txprepare_commands(
+                rpc, TxPrepare(onchain, hsm=hsm,
+                               hsm_client=hsm.client(CAP_SIGN_ONCHAIN),
+                               backend=chain_backend, topology=topology),
+                hsm=hsm)
         rune_secret = _hl.sha256(
             b"commando" + node_seckey.to_bytes(32, "big")).digest()[:16]
         commando = Commando(node, rpc, rune_secret)

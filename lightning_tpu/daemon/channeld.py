@@ -677,21 +677,12 @@ def _open_core(funding_sat: int, push_msat: int, local_is_funder: bool,
     )
 
 
-async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
-                       funding_sat: int, push_msat: int = 0,
-                       cfg: ChannelConfig | None = None,
-                       wallet=None, hsm_dbid: int = 0,
-                       onchain=None, chain_backend=None,
-                       topology=None) -> Channeld:
-    """Funder-side v1 open: open_channel → accept_channel →
-    funding_created → funding_signed → channel_ready (both ways).
-
-    With `onchain` (wallet.onchain.OnchainWallet) the funding tx spends
-    REAL tracked UTXOs — coin selection, change, hsm-signed inputs,
-    broadcast through `chain_backend` after the peer's funding_signed
-    verifies (never before: the reference refuses to put coins at risk
-    without the counter-signature, opening_control.c).  With `topology`
-    channel_ready waits for cfg.minimum_depth confirmations."""
+async def open_negotiate(peer: Peer, hsm: Hsm, client: HsmClient,
+                         funding_sat: int, push_msat: int = 0,
+                         cfg: ChannelConfig | None = None) -> Channeld:
+    """Funder-side v1 open, phase 1: open_channel → accept_channel.
+    Returns a Channeld ready for funding-tx construction (the caller
+    picks the outpoint — single open or multifundchannel batch)."""
     cfg = cfg or ChannelConfig()
     ch = Channeld(peer, hsm, client, funder=True, cfg=cfg)
     tmp_id = os.urandom(32)
@@ -728,6 +719,29 @@ async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
     ch.funding_sat = funding_sat
     ch.core = _open_core(funding_sat, push_msat, True, cfg,
                          acc.channel_reserve_satoshis)
+    ch._tmp_id = tmp_id
+    return ch
+
+
+async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
+                       funding_sat: int, push_msat: int = 0,
+                       cfg: ChannelConfig | None = None,
+                       wallet=None, hsm_dbid: int = 0,
+                       onchain=None, chain_backend=None,
+                       topology=None) -> Channeld:
+    """Funder-side v1 open: open_channel → accept_channel →
+    funding_created → funding_signed → channel_ready (both ways).
+
+    With `onchain` (wallet.onchain.OnchainWallet) the funding tx spends
+    REAL tracked UTXOs — coin selection, change, hsm-signed inputs,
+    broadcast through `chain_backend` after the peer's funding_signed
+    verifies (never before: the reference refuses to put coins at risk
+    without the counter-signature, opening_control.c).  With `topology`
+    channel_ready waits for cfg.minimum_depth confirmations."""
+    cfg = cfg or ChannelConfig()
+    ch = await open_negotiate(peer, hsm, client, funding_sat, push_msat,
+                              cfg)
+    tmp_id = ch._tmp_id
 
     picked = None
     if onchain is not None:
@@ -745,76 +759,95 @@ async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
             outputs=[T.TxOutput(funding_sat,
                                 SC.p2wsh(ch._funding_script()))],
         )
-    ch.funding_txid = funding_tx.txid()
-    ch.funding_outidx = 0
-    ch.channel_id = derive_channel_id(ch.funding_txid, 0)
-
     try:
-        # sign THEIR initial commitment (number 0)
-        fsig, hsigs = await asyncio.to_thread(ch._sign_remote, 0)
-        assert not hsigs  # no HTLCs at open
-        await peer.send(M.FundingCreated(
-            temporary_channel_id=tmp_id,
-            funding_txid=ch.funding_txid,
-            funding_output_index=0,
-            signature=fsig,
-        ))
-        fs = await peer.recv(M.FundingSigned, timeout=RECV_TIMEOUT)
-        if fs.channel_id != ch.channel_id:
-            raise ChannelError("funding_signed for wrong channel")
-        await asyncio.to_thread(ch._verify_local, 0, fs.signature, [])
+        await open_exchange_funding(ch, funding_tx.txid(), 0)
     except BaseException:
         # any failure before broadcast releases the reserved coins —
         # a failed open must not strand UTXOs for RESERVATION_BLOCKS
         if picked is not None:
             onchain.unreserve([u.outpoint for u in picked])
         raise
-
-    ch.core.transition(ChannelState.AWAITING_LOCKIN)
     if onchain is not None:
-        # counter-signature verified: NOW the coins may leave.  Sign our
-        # wallet inputs (batched through the hsm onchain door) and
-        # broadcast; the wallet tracks spend + change immediately.
-        from .hsmd import CAP_SIGN_ONCHAIN
+        await open_broadcast(hsm, onchain, chain_backend, funding_tx,
+                             picked)
+    await open_lockin(ch, topology=topology, wallet=wallet,
+                      hsm_dbid=hsm_dbid)
+    return ch
 
-        meta = onchain.utxo_meta(funding_tx)
-        hsm.sign_withdrawal(hsm.client(CAP_SIGN_ONCHAIN), funding_tx, meta)
-        if chain_backend is not None:
-            ok, err = await chain_backend.sendrawtransaction(
-                funding_tx.serialize())
-            if not ok:
-                onchain.unreserve([u.outpoint for u in picked])
-                raise ChannelError(f"funding broadcast failed: {err}")
-        onchain.mark_spent([u.outpoint for u in picked],
-                           ch.funding_txid)
-        onchain.add_unconfirmed_change(funding_tx)
+
+async def open_broadcast(hsm: Hsm, onchain, chain_backend, funding_tx,
+                         picked) -> None:
+    """Counter-signatures verified: NOW the coins may leave.  Sign our
+    wallet inputs (batched through the hsm onchain door), broadcast,
+    and track spend + change — shared by open_channel and
+    multifundchannel (one policy for unreserve-on-broadcast-failure)."""
+    from .hsmd import CAP_SIGN_ONCHAIN
+
+    meta = onchain.utxo_meta(funding_tx)
+    hsm.sign_withdrawal(hsm.client(CAP_SIGN_ONCHAIN), funding_tx, meta)
+    if chain_backend is not None:
+        ok, err = await chain_backend.sendrawtransaction(
+            funding_tx.serialize())
+        if not ok:
+            onchain.unreserve([u.outpoint for u in picked])
+            raise ChannelError(f"funding broadcast failed: {err}")
+    onchain.mark_spent([u.outpoint for u in picked], funding_tx.txid())
+    onchain.add_unconfirmed_change(funding_tx)
+
+
+async def open_exchange_funding(ch: Channeld, funding_txid: bytes,
+                                funding_outidx: int) -> None:
+    """Funder-side v1 open, phase 2: pin the funding outpoint, exchange
+    funding_created/funding_signed, verify the counter-signature."""
+    ch.funding_txid = funding_txid
+    ch.funding_outidx = funding_outidx
+    ch.channel_id = derive_channel_id(funding_txid, funding_outidx)
+    fsig, hsigs = await asyncio.to_thread(ch._sign_remote, 0)
+    assert not hsigs  # no HTLCs at open
+    await ch.peer.send(M.FundingCreated(
+        temporary_channel_id=ch._tmp_id,
+        funding_txid=funding_txid,
+        funding_output_index=funding_outidx,
+        signature=fsig,
+    ))
+    fs = await ch.peer.recv(M.FundingSigned, timeout=RECV_TIMEOUT)
+    if fs.channel_id != ch.channel_id:
+        raise ChannelError("funding_signed for wrong channel")
+    await asyncio.to_thread(ch._verify_local, 0, fs.signature, [])
+    ch.core.transition(ChannelState.AWAITING_LOCKIN)
+
+
+async def open_lockin(ch: Channeld, topology=None, wallet=None,
+                      hsm_dbid: int = 0) -> None:
+    """Funder-side v1 open, phase 3: depth gate + channel_ready both
+    ways, persist, account."""
     if topology is not None:
         # wait for funding depth (watch.c txwatch → lockin flow)
-        while topology.depth(ch.funding_txid) < cfg.minimum_depth:
+        while topology.depth(ch.funding_txid) < ch.cfg.minimum_depth:
             await asyncio.sleep(0.05)
-    await peer.send(M.ChannelReady(
+    await ch.peer.send(M.ChannelReady(
         channel_id=ch.channel_id,
         second_per_commitment_point=ref.pubkey_serialize(ch.our_point(1)),
     ))
-    cr = await peer.recv(M.ChannelReady, timeout=RECV_TIMEOUT)
+    cr = await ch.peer.recv(M.ChannelReady, timeout=RECV_TIMEOUT)
     ch.their_points[1] = ref.pubkey_parse(cr.second_per_commitment_point)
     ch.core.transition(ChannelState.NORMAL)
     if wallet is not None:
         ch.attach_wallet(wallet, hsm_dbid)
         ch._persist()
     log.info("channel %s open (funder), capacity %d sat",
-             ch.channel_id.hex()[:16], funding_sat)
+             ch.channel_id.hex()[:16], ch.funding_sat)
     from ..utils import events
 
     # bkpr: wallet funds move into the channel (channel_open mvt)
     events.emit("coin_movement", {
         "account": "wallet", "tag": "withdrawal",
-        "debit_msat": funding_sat * 1000,
+        "debit_msat": ch.funding_sat * 1000,
         "reference": ch.channel_id.hex()})
     events.emit("coin_movement", {
-        "account": "channel", "tag": "channel_open", "credit_msat": ch.core.to_local_msat,
+        "account": "channel", "tag": "channel_open",
+        "credit_msat": ch.core.to_local_msat,
         "reference": ch.channel_id.hex()})
-    return ch
 
 
 async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,

@@ -301,6 +301,82 @@ class ChannelManager:
                 "funding_txid": ch.funding_txid.hex(),
                 "outnum": ch.funding_outidx}
 
+    async def multifundchannel(self, destinations: list[dict]) -> dict:
+        """Open channels to several peers from ONE funding transaction
+        (plugins/spender/multifundchannel.c): negotiate every open
+        first, then build a single tx whose outputs fund them all."""
+        from ..btc import script as SC
+        from ..btc import tx as T
+        from .hsmd import CAP_SIGN_ONCHAIN
+
+        if self.onchain is None:
+            raise ManagerError("multifundchannel needs the wallet")
+        if not destinations:
+            raise ManagerError("multifundchannel needs destinations")
+        seen_ids = set()
+        dests = []
+        for d in destinations:
+            node_id = bytes.fromhex(d["id"])
+            if node_id in seen_ids:
+                # two channels on one connection would race the peer's
+                # single-consumer inbox during the concurrent phases
+                raise ManagerError(f"duplicate destination {d['id'][:16]}")
+            seen_ids.add(node_id)
+            peer = self.node.peers.get(node_id)
+            if peer is None:
+                raise ManagerError(f"peer {d['id'][:16]} not connected")
+            dests.append((peer, int(d["amount"])))
+
+        # phase 1: negotiate all opens (distinct peers → no inbox clash)
+        chans = []
+        for peer, amount in dests:
+            dbid = self._next_dbid
+            self._next_dbid += 1
+            client = self.hsm.client(CAP_MASTER, peer.node_id, dbid=dbid)
+            ch = await CD.open_negotiate(peer, self.hsm, client, amount)
+            ch._mf_dbid = dbid
+            chans.append((ch, amount))
+
+        # one tx funds them all; output i belongs to channel i
+        outs = [T.TxOutput(amount, SC.p2wsh(ch._funding_script()))
+                for ch, amount in chans]
+        tx, picked, _change = self.onchain.fund_tx(
+            outs, feerate_per_kw=chans[0][0].cfg.feerate_per_kw)
+        # run EVERY exchange to completion (return_exceptions): an early
+        # raise would leave sibling exchanges mid-protocol against a
+        # funding tx we are about to abandon
+        results = await asyncio.gather(*(
+            CD.open_exchange_funding(ch, tx.txid(), i)
+            for i, (ch, _a) in enumerate(chans)), return_exceptions=True)
+        failed = [r for r in results if isinstance(r, BaseException)]
+        if failed:
+            self.onchain.unreserve([u.outpoint for u in picked])
+            raise ManagerError(
+                f"{len(failed)} open(s) failed pre-broadcast: {failed[0]}")
+        await CD.open_broadcast(self.hsm, self.onchain,
+                                self.chain_backend, tx, picked)
+        # post-broadcast the coins are spent for good: channels that DO
+        # lock in must be served even if a sibling's lockin fails
+        results = await asyncio.gather(*(
+            CD.open_lockin(ch, topology=self.topology,
+                           wallet=self.wallet, hsm_dbid=ch._mf_dbid)
+            for ch, _a in chans), return_exceptions=True)
+        out, failures = [], []
+        for i, ((ch, _a), res) in enumerate(zip(chans, results)):
+            if isinstance(res, BaseException):
+                failures.append({"id": ch.peer.node_id.hex(),
+                                 "error": str(res)})
+                continue
+            self._spawn_loop(ch)
+            out.append({"id": ch.peer.node_id.hex(),
+                        "channel_id": ch.channel_id.hex(),
+                        "outnum": i})
+        result = {"tx": tx.serialize().hex(), "txid": tx.txid().hex(),
+                  "channel_ids": out}
+        if failures:
+            result["failed"] = failures
+        return result
+
     async def splice(self, target: str, add_sat: int) -> dict:
         """Splice-in: grow the channel with wallet coins (channeld/
         splice.c orchestration + spender/splice.c's funding role)."""
@@ -549,6 +625,9 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     async def splice(id: str, amount) -> dict:
         return await mgr.splice(id, int(amount))
 
+    async def multifundchannel(destinations: list) -> dict:
+        return await mgr.multifundchannel(destinations)
+
     async def pay(bolt11: str, amount_msat=None, retry_for: int = 60,
                   maxfeepercent=None) -> dict:
         return await mgr.pay(bolt11,
@@ -624,6 +703,7 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     rpc.register("fundchannel", fundchannel)
     rpc.register("close", close)
     rpc.register("splice", splice)
+    rpc.register("multifundchannel", multifundchannel)
     rpc.register("pay", pay)
     rpc.register("xpay", xpay)
     rpc.register("sendpay", sendpay)

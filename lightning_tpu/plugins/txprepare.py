@@ -1,0 +1,149 @@
+"""txprepare/txdiscard/txsend + multiwithdraw + recover + exposesecret.
+
+Parity targets: plugins/txprepare.c (prepare a fully-signed tx with
+reserved inputs, send or discard it later), plugins/spender's
+multiwithdraw (many destinations, ONE transaction), plugins/recover.c
+(kick off recovery from backup material) and plugins/exposesecret.c
+(guarded hsm_secret export for disaster backup).
+"""
+from __future__ import annotations
+
+import asyncio
+
+from ..btc import address as ADDR
+from ..btc.tx import Tx, TxOutput
+from ..wallet.onchain import OnchainWallet, WalletError
+
+
+class TxPrepare:
+    """Prepared-but-unsent transactions, inputs held reserved."""
+
+    def __init__(self, wallet: OnchainWallet, hsm=None, hsm_client=None,
+                 backend=None, topology=None):
+        self.wallet = wallet
+        self.hsm = hsm
+        self.hsm_client = hsm_client
+        self.backend = backend
+        self.topology = topology
+        self.prepared: dict[bytes, tuple[Tx, list]] = {}   # txid -> (tx, utxos)
+
+    def _feerate(self, feerate) -> int:
+        from ..wallet.walletrpc import _feerate_per_kw
+
+        return _feerate_per_kw(feerate, self.topology)
+
+    def _sign(self, tx: Tx) -> None:
+        meta = self.wallet.utxo_meta(tx)
+        if self.hsm is not None:
+            self.hsm.sign_withdrawal(self.hsm_client, tx, meta)
+        else:
+            from ..wallet.onchain import sign_wallet_inputs
+
+            sign_wallet_inputs(tx, meta, self.wallet.keyman)
+
+    def prepare(self, outputs: list[tuple[str, int]],
+                feerate=None) -> dict:
+        """outputs: [(address, sat)...] → signed tx, inputs reserved."""
+        outs = [TxOutput(int(sat),
+                         ADDR.to_scriptpubkey(addr, self.wallet.keyman.hrp))
+                for addr, sat in outputs]
+        tx, picked, _change = self.wallet.fund_tx(
+            outs, self._feerate(feerate))
+        self._sign(tx)
+        txid = tx.txid()
+        self.prepared[txid] = (tx, picked)
+        return {"txid": txid.hex(), "unsigned_tx": tx.serialize().hex(),
+                "psbt": ""}
+
+    def discard(self, txid_hex: str) -> dict:
+        txid = bytes.fromhex(txid_hex)
+        entry = self.prepared.pop(txid, None)
+        if entry is None:
+            raise WalletError(f"unknown prepared txid {txid_hex}")
+        _tx, picked = entry
+        self.wallet.unreserve([u.outpoint for u in picked])
+        return {"txid": txid_hex}
+
+    async def send(self, txid_hex: str) -> dict:
+        txid = bytes.fromhex(txid_hex)
+        entry = self.prepared.pop(txid, None)
+        if entry is None:
+            raise WalletError(f"unknown prepared txid {txid_hex}")
+        tx, picked = entry
+        raw = tx.serialize()
+        if self.backend is not None:
+            ok, err = await self.backend.sendrawtransaction(raw)
+            if not ok:
+                self.prepared[txid] = entry   # still discardable
+                raise WalletError(f"broadcast failed: {err}")
+        self.wallet.mark_spent([u.outpoint for u in picked], txid)
+        self.wallet.add_unconfirmed_change(tx)
+        return {"txid": txid_hex, "tx": raw.hex()}
+
+    async def multiwithdraw(self, outputs: list[tuple[str, int]],
+                            feerate=None) -> dict:
+        """Many destinations, one tx, broadcast now (spender role)."""
+        prep = self.prepare(outputs, feerate)
+        return await self.send(prep["txid"])
+
+
+def attach_txprepare_commands(rpc, prep: TxPrepare, hsm=None,
+                              hsm_secret_path: str | None = None) -> None:
+    def _parse_outputs(outputs) -> list[tuple[str, int]]:
+        out = []
+        for o in outputs:
+            if isinstance(o, dict):
+                ((addr, sat),) = o.items()
+            else:
+                addr, sat = o
+            out.append((addr, int(sat)))
+        return out
+
+    async def txprepare(outputs: list, feerate=None) -> dict:
+        return prep.prepare(_parse_outputs(outputs), feerate)
+
+    async def txdiscard(txid: str) -> dict:
+        return prep.discard(txid)
+
+    async def txsend(txid: str) -> dict:
+        return await prep.send(txid)
+
+    async def multiwithdraw(outputs: list, feerate=None) -> dict:
+        return await prep.multiwithdraw(_parse_outputs(outputs), feerate)
+
+    async def exposesecret(passphrase: str, identifier: str | None = None
+                           ) -> dict:
+        """Codex32-free variant of plugins/exposesecret.c: returns the
+        hsm secret hex, gated on an explicit passphrase ('expose') so
+        no RPC typo can leak it."""
+        if passphrase != "expose":
+            raise WalletError(
+                "exposesecret requires passphrase='expose' (this prints "
+                "your node's master secret)")
+        if hsm is None:
+            raise WalletError("no hsm loaded")
+        return {"hsm_secret": hsm._secret.hex()}
+
+    async def recover(hsmsecret: str) -> dict:
+        """plugins/recover.c role: validate recovery material and tell
+        the operator how to restart into recovery.  (A running node
+        cannot hot-swap its identity key; the reference also restarts.)"""
+        raw = bytes.fromhex(hsmsecret)
+        if len(raw) != 32:
+            raise WalletError("hsm_secret must be 32 bytes of hex")
+        matches = hsm is not None and raw == hsm._secret
+        return {
+            "valid": True,
+            "matches_running_node": matches,
+            "restart_with": "--data-dir <fresh-dir> after writing the "
+                            "secret to <fresh-dir>/hsm_secret; channel "
+                            "funds then recover via emergencyrecover "
+                            "from peer_storage backups",
+        }
+
+    rpc.register("txprepare", txprepare)
+    rpc.register("txdiscard", txdiscard)
+    rpc.register("txsend", txsend)
+    rpc.register("multiwithdraw", multiwithdraw)
+    rpc.register("exposesecret", exposesecret)
+    rpc.register("recover", recover)

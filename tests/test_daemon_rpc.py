@@ -68,6 +68,16 @@ class Stack:
             self.rpc, self.onchain, hsm=self.hsm,
             hsm_client=self.hsm.client(CAP_SIGN_ONCHAIN),
             backend=bitcoind, topology=self.topology)
+        from lightning_tpu.plugins.txprepare import (
+            TxPrepare, attach_txprepare_commands)
+
+        attach_txprepare_commands(
+            self.rpc, TxPrepare(self.onchain, hsm=self.hsm,
+                                hsm_client=self.hsm.client(
+                                    CAP_SIGN_ONCHAIN),
+                                backend=bitcoind,
+                                topology=self.topology),
+            hsm=self.hsm)
         messenger = OnionMessenger(self.node, self.hsm.node_key)
         offer_reg = OfferRegistry(self.wallet.db)
         svc = OffersService(messenger, offer_reg, self.invoices,

@@ -1,0 +1,136 @@
+"""Spender-family plugins over the daemon stacks: txprepare/txdiscard/
+txsend, multiwithdraw (one tx, many destinations), multifundchannel
+(one tx funds channels to TWO peers), recover + exposesecret guards.
+
+Parity: plugins/txprepare.c, plugins/spender/, plugins/recover.c,
+plugins/exposesecret.c.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lightning_tpu.btc import address as ADDR  # noqa: E402
+from lightning_tpu.btc.bip32 import ExtKey  # noqa: E402
+from lightning_tpu.chain.backend import FakeBitcoind  # noqa: E402
+from test_daemon_rpc import Stack, rpc_call  # noqa: E402
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 900))
+
+
+def test_txprepare_family(tmp_path):
+    async def body():
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x0a" * 32, bitcoind).start()
+        try:
+            await rpc_call(a.rpc.rpc_path, "dev-faucet",
+                           {"satoshi": 1_000_000})
+            dest1 = ADDR.p2wpkh(ExtKey.from_seed(b"\x91" * 32).pubkey)
+            dest2 = ADDR.p2wpkh(ExtKey.from_seed(b"\x92" * 32).pubkey)
+
+            # prepare reserves the inputs; a second prepare must not
+            # find them
+            prep = await rpc_call(a.rpc.rpc_path, "txprepare", {
+                "outputs": [{dest1: 200_000}]})
+            funds = await rpc_call(a.rpc.rpc_path, "listfunds")
+            assert all(o["reserved"] for o in funds["outputs"])
+            # discard releases them
+            await rpc_call(a.rpc.rpc_path, "txdiscard",
+                           {"txid": prep["txid"]})
+            funds = await rpc_call(a.rpc.rpc_path, "listfunds")
+            assert not any(o["reserved"] for o in funds["outputs"])
+
+            # prepare + send broadcasts the SAME txid
+            prep = await rpc_call(a.rpc.rpc_path, "txprepare", {
+                "outputs": [{dest1: 200_000}]})
+            sent = await rpc_call(a.rpc.rpc_path, "txsend",
+                                  {"txid": prep["txid"]})
+            assert sent["txid"] == prep["txid"]
+            assert bytes.fromhex(prep["txid"]) in bitcoind.mempool
+
+            # multiwithdraw: one tx, two destinations
+            bitcoind.generate(1)
+            await a.topology.sync_once()
+            multi = await rpc_call(a.rpc.rpc_path, "multiwithdraw", {
+                "outputs": [{dest1: 50_000}, {dest2: 60_000}]})
+            tx = bitcoind.mempool[bytes.fromhex(multi["txid"])]
+            spks = {o.script_pubkey for o in tx.outputs}
+            assert ADDR.to_scriptpubkey(dest1) in spks
+            assert ADDR.to_scriptpubkey(dest2) in spks
+
+            # exposesecret is passphrase-gated
+            try:
+                await rpc_call(a.rpc.rpc_path, "exposesecret",
+                               {"passphrase": "oops"})
+                raise AssertionError("gate did not hold")
+            except AssertionError as e:
+                if "gate" in str(e):
+                    raise
+            got = await rpc_call(a.rpc.rpc_path, "exposesecret",
+                                 {"passphrase": "expose"})
+            assert got["hsm_secret"] == (b"\x0a" * 32).hex()
+            rec = await rpc_call(a.rpc.rpc_path, "recover",
+                                 {"hsmsecret": got["hsm_secret"]})
+            assert rec["valid"] and rec["matches_running_node"]
+        finally:
+            await a.close()
+
+    run(body())
+
+
+def test_multifundchannel(tmp_path):
+    async def body():
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x0a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x0b" * 32, bitcoind).start()
+        c = await Stack(tmp_path, "c", b"\x0c" * 32, bitcoind).start()
+        try:
+            for st in (b, c):
+                port = await st.node.listen()
+                await a.node.connect("127.0.0.1", port, st.node.node_id)
+            await rpc_call(a.rpc.rpc_path, "dev-faucet",
+                           {"satoshi": 3_000_000})
+
+            task = asyncio.create_task(a.manager.multifundchannel([
+                {"id": b.node.node_id.hex(), "amount": 800_000},
+                {"id": c.node.node_id.hex(), "amount": 700_000},
+            ]))
+            while not bitcoind.mempool and not task.done():
+                await asyncio.sleep(0.05)
+            assert bitcoind.mempool or task.done()
+            funding = list(bitcoind.mempool.values())[0]
+            bitcoind.generate(1)
+            res = await asyncio.wait_for(task, 600)
+
+            # ONE tx, both channels on it, at the stated outnums
+            assert len(res["channel_ids"]) == 2
+            assert funding.txid().hex() == res["txid"]
+            assert funding.outputs[0].amount_sat == 800_000
+            assert funding.outputs[1].amount_sat == 700_000
+
+            chans = await rpc_call(a.rpc.rpc_path, "listpeerchannels")
+            assert len(chans["channels"]) == 2
+            assert all(ch["state"] == "NORMAL"
+                       for ch in chans["channels"])
+
+            # both channels pay
+            for st, label in ((b, "to-b"), (c, "to-c")):
+                inv = await rpc_call(st.rpc.rpc_path, "invoice", {
+                    "amount_msat": 11_000, "label": label,
+                    "description": "x"})
+                paid = await rpc_call(a.rpc.rpc_path, "pay",
+                                      {"bolt11": inv["bolt11"]})
+                assert paid["status"] == "complete"
+        finally:
+            await a.close()
+            await b.close()
+            await c.close()
+
+    run(body())
