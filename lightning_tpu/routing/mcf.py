@@ -24,12 +24,16 @@ Cost model (renepay mcf.c semantics, re-derived):
 """
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from ..gossip.gossmap import Gossmap, scid_parse
 from .dijkstra import BLOCKS_PER_YEAR, NoRoute, RouteHop, hop_fee_msat
+
+log = logging.getLogger("lightning_tpu.mcf")
+_warned_rounds = False
 
 NUM_PIECES = 4
 # slopes of the convex piecewise -log((c+1-x)/(c+1)) approximation,
@@ -195,10 +199,12 @@ def _shortest_path(arcs: Arcs, n_nodes: int, src: int, dst: int):
     dist = np.full(n_nodes, np.inf)
     pred = np.full(n_nodes, -1, np.int64)
     dist[src] = 0.0
+    converged = False
     for _ in range(MAX_ROUNDS):
         cand = dist[a_src] + a_cost
         better = cand < dist[a_dst] - 1e-9
         if not better.any():
+            converged = True
             break
         # scatter-min: lowest candidate per destination wins this round
         b_dst = a_dst[better]
@@ -210,6 +216,18 @@ def _shortest_path(arcs: Arcs, n_nodes: int, src: int, dst: int):
         upd = b_cand[first] < dist[b_dst[first]] - 1e-9
         dist[b_dst[first][upd]] = b_cand[first][upd]
         pred[b_dst[first][upd]] = b_arc[first][upd]
+    if not converged:
+        # the round cap truncated convergence: routes can be suboptimal
+        # (never incorrect — dist only improves).  The reference benches
+        # exactly this on 1M-channel graphs; don't hide the cap — but
+        # warn once (solve() calls this up to 4*max_parts times per
+        # payment; a warning per sweep would flood the routing hot loop)
+        global _warned_rounds
+        level = logging.DEBUG if _warned_rounds else logging.WARNING
+        _warned_rounds = True
+        log.log(level, "bellman-ford hit MAX_ROUNDS=%d before convergence "
+                "(%d nodes, %d arcs): path may be suboptimal",
+                MAX_ROUNDS, n_nodes, len(a_src))
     if not np.isfinite(dist[dst]):
         return None
     return pred

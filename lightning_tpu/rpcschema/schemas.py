@@ -1,0 +1,160 @@
+"""Declarative RPC command schemas — the single source of truth the
+typed client is GENERATED from.
+
+Parity target: doc/schemas/*.json + contrib/msggen (the reference
+generates cln-rpc's typed model and the grpc surface from its schema
+files; we generate lightning_tpu/clients/generated.py the same way —
+edit HERE, then `python -m lightning_tpu.rpcschema.codegen`).
+
+Types: "str" | "int" | "bool" | "hex" (hex-string) | "msat" (int msat)
+| "list" | "dict" | "any".  A trailing "?" marks optional params;
+result fields are documentation + dataclass members (responses may
+carry extra keys; generated classes keep them in `.extra`).
+"""
+
+COMMANDS: dict[str, dict] = {
+    "getinfo": {
+        "params": {},
+        "result": {"id": "hex", "version": "str", "num_peers": "int",
+                   "num_active_channels": "int", "blockheight": "int",
+                   "network": "str"},
+    },
+    "connect": {
+        "params": {"id": "str"},
+        "result": {"id": "hex", "features": "hex", "direction": "str"},
+    },
+    "listpeers": {
+        "params": {},
+        "result": {"peers": "list"},
+    },
+    "ping": {
+        "params": {"id": "hex", "len": "int?"},
+        "result": {"totlen": "int"},
+    },
+    "newaddr": {
+        "params": {"addresstype": "str?"},
+        "result": {"bech32": "str"},
+    },
+    "listfunds": {
+        "params": {"spent": "bool?"},
+        "result": {"outputs": "list", "channels": "list"},
+    },
+    "withdraw": {
+        "params": {"destination": "str", "satoshi": "any",
+                   "feerate": "any?", "minconf": "int?"},
+        "result": {"tx": "hex", "txid": "hex"},
+    },
+    "fundpsbt": {
+        "params": {"satoshi": "any", "feerate": "any?",
+                   "startweight": "int?", "reserve": "int?"},
+        "result": {"psbt": "str", "feerate_per_kw": "int",
+                   "excess_msat": "msat"},
+    },
+    "fundchannel": {
+        "params": {"id": "hex", "amount": "any", "push_msat": "int?",
+                   "announce": "bool?"},
+        "result": {"channel_id": "hex", "funding_txid": "hex",
+                   "outnum": "int"},
+    },
+    "multifundchannel": {
+        "params": {"destinations": "list"},
+        "result": {"tx": "hex", "txid": "hex", "channel_ids": "list"},
+    },
+    "splice": {
+        "params": {"id": "str", "amount": "any"},
+        "result": {"txid": "hex", "channel_id": "hex",
+                   "capacity_sat": "int"},
+    },
+    "close": {
+        "params": {"id": "str"},
+        "result": {"type": "str", "txid": "hex", "tx": "hex"},
+    },
+    "listpeerchannels": {
+        "params": {"id": "hex?"},
+        "result": {"channels": "list"},
+    },
+    "invoice": {
+        "params": {"amount_msat": "any", "label": "str",
+                   "description": "str", "expiry": "int?"},
+        "result": {"bolt11": "str", "payment_hash": "hex",
+                   "payment_secret": "hex", "expires_at": "int"},
+    },
+    "listinvoices": {
+        "params": {"label": "str?"},
+        "result": {"invoices": "list"},
+    },
+    "pay": {
+        "params": {"bolt11": "str", "amount_msat": "int?",
+                   "retry_for": "int?"},
+        "result": {"payment_preimage": "hex", "payment_hash": "hex",
+                   "amount_msat": "msat", "amount_sent_msat": "msat",
+                   "status": "str"},
+    },
+    "xpay": {
+        "params": {"invstring": "str", "amount_msat": "int?",
+                   "retry_for": "int?"},
+        "result": {"payment_preimage": "hex", "payment_hash": "hex",
+                   "amount_msat": "msat", "amount_sent_msat": "msat",
+                   "status": "str"},
+    },
+    "listpays": {
+        "params": {"bolt11": "str?"},
+        "result": {"pays": "list"},
+    },
+    "decode": {
+        "params": {"string": "str"},
+        "result": {"type": "str", "valid": "bool"},
+    },
+    "getroute": {
+        "params": {"id": "hex", "amount_msat": "int",
+                   "riskfactor": "int?", "cltv": "int?",
+                   "fromid": "hex?"},
+        "result": {"route": "list"},
+    },
+    "txprepare": {
+        "params": {"outputs": "list", "feerate": "any?"},
+        "result": {"txid": "hex", "unsigned_tx": "hex", "psbt": "str"},
+    },
+    "txsend": {
+        "params": {"txid": "hex"},
+        "result": {"txid": "hex", "tx": "hex"},
+    },
+    "txdiscard": {
+        "params": {"txid": "hex"},
+        "result": {"txid": "hex"},
+    },
+    "multiwithdraw": {
+        "params": {"outputs": "list", "feerate": "any?"},
+        "result": {"txid": "hex", "tx": "hex"},
+    },
+    "offer": {
+        "params": {"amount": "any", "description": "str?",
+                   "issuer": "str?", "label": "str?"},
+        "result": {"offer_id": "hex", "bolt12": "str", "active": "bool"},
+    },
+    "fetchinvoice": {
+        "params": {"offer": "str", "amount_msat": "int?",
+                   "quantity": "int?", "timeout": "int?"},
+        "result": {"invoice": "str", "amount_msat": "msat",
+                   "payment_hash": "hex"},
+    },
+    "listforwards": {
+        "params": {},
+        "result": {"forwards": "list"},
+    },
+    "stop": {
+        "params": {},
+        "result": {"result": "str"},
+    },
+}
+
+_PY_TYPES = {"str": "str", "int": "int", "bool": "bool", "hex": "str",
+             "msat": "int", "list": "list", "dict": "dict", "any": "object"}
+
+
+def py_type(t: str) -> str:
+    return _PY_TYPES[t.rstrip("?")]
+
+
+def is_optional(t: str) -> bool:
+    return t.endswith("?")

@@ -143,12 +143,27 @@ MIGRATIONS: list[str] = [
 
 class Db:
     """One node's database.  sqlite3 in WAL mode; every mutation goes
-    through transaction() so a crash can never observe a torn write."""
+    through transaction() so a crash can never observe a torn write.
+
+    db_write hook (the reference's special-cased synchronous plugin
+    hook, lightningd/plugin_hook.c): when set, EVERY data-modifying
+    statement is streamed to the hook BEFORE the transaction commits —
+    a raising hook vetoes the commit (rollback), so the replica can
+    never be missing a transaction the primary has durably applied; it
+    may only be AHEAD by one (crash between hook and commit), which a
+    replayer resolves via the monotone data_version.  data_version
+    itself is persisted in vars (the reference does the same) so it
+    survives restart, and the statement updating it rides the streamed
+    batch, keeping the replica's counter in lock-step."""
 
     def __init__(self, path: str):
         self.path = path
         self._local = threading.local()
+        self.db_write_hook = None    # fn(data_version, [(sql, None)])
+        self._version_lock = threading.Lock()
         self._migrate()
+        v = self.get_var("data_version")
+        self._data_version = int(v) if v is not None else 0
 
     @property
     def conn(self) -> sqlite3.Connection:
@@ -158,8 +173,50 @@ class Db:
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=FULL")
             conn.execute("PRAGMA foreign_keys=ON")
+            # trace is ALWAYS installed (cheap no-op while no hook is
+            # set) so a hook installed later covers every thread's
+            # already-open connection
+            conn.set_trace_callback(self._trace)
             self._local.conn = conn
         return conn
+
+    def set_db_write_hook(self, hook) -> None:
+        """hook(data_version, [(sql, None)]): called with the statement
+        batch of each transaction before it commits.  (sqlite's trace
+        callback delivers the EXPANDED sql — params already substituted
+        — which is exactly what a replica needs to re-execute.)"""
+        self.db_write_hook = hook
+
+    _MUTATING = ("INSERT", "UPDATE", "DELETE", "REPLACE", "CREATE",
+                 "ALTER", "DROP")
+
+    def _trace(self, sql: str) -> None:
+        if self.db_write_hook is None:
+            return
+        s = sql.lstrip()
+        if s[:7].upper().startswith(self._MUTATING):
+            pend = getattr(self._local, "pending_writes", None)
+            if pend is None:
+                pend = self._local.pending_writes = []
+            pend.append((sql, None))
+
+    def _flush_writes(self, conn) -> None:
+        """Stream this transaction's batch (pre-commit).  The version
+        bump is written INSIDE the transaction so the stream carries it
+        and the replica's counter stays in lock-step."""
+        pend = getattr(self._local, "pending_writes", None)
+        if not pend:
+            return
+        with self._version_lock:
+            self._data_version += 1
+            version = self._data_version
+        conn.execute(
+            "INSERT INTO vars (name, val) VALUES ('data_version', ?) "
+            "ON CONFLICT(name) DO UPDATE SET val=excluded.val",
+            (str(version),))
+        batch = list(self._local.pending_writes)
+        self._local.pending_writes = []
+        self.db_write_hook(version, batch)
 
     def _migrate(self) -> None:
         c = self.conn
@@ -182,9 +239,13 @@ class Db:
         c = self.conn
         try:
             yield c
+            if self.db_write_hook is not None:
+                self._flush_writes(c)   # pre-commit: hook can veto
             c.commit()
         except BaseException:
             c.rollback()
+            if getattr(self._local, "pending_writes", None):
+                self._local.pending_writes = []
             raise
 
     def get_var(self, name: str, default=None):

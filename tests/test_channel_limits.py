@@ -1,0 +1,85 @@
+"""Peer-abuse limits on the channel core: max_htlc_value_in_flight,
+max_accepted_htlcs, htlc_minimum, reserve floor, and the opener
+fee-affordability guard with its 2x fee-spike buffer — the boundaries
+channeld/full_channel.c enforces on every add."""
+from __future__ import annotations
+
+import pytest
+
+from lightning_tpu.channel.state import (ChannelCore, ChannelError,
+                                         ChannelState)
+
+H = b"\x42" * 32
+
+
+def _core(**kw) -> ChannelCore:
+    args = dict(funding_sat=1_000_000, to_local_msat=600_000_000,
+                to_remote_msat=400_000_000, feerate_per_kw=1000,
+                reserve_local_msat=10_000_000,
+                reserve_remote_msat=10_000_000,
+                state=ChannelState.NORMAL, anchors=True)
+    args.update(kw)
+    return ChannelCore(**args)
+
+
+def test_max_htlc_value_in_flight():
+    core = _core(max_htlc_value_in_flight_msat=50_000_000)
+    core.add_htlc(True, 30_000_000, H, 500)
+    core.add_htlc(True, 20_000_000, H, 500)
+    with pytest.raises(ChannelError, match="in_flight"):
+        core.add_htlc(True, 1_000_000, H, 500)
+
+
+def test_max_accepted_htlcs():
+    core = _core(max_accepted_htlcs=3)
+    for _ in range(3):
+        core.add_htlc(False, 1_000_000, H, 500)
+    with pytest.raises(ChannelError, match="max_accepted"):
+        core.add_htlc(False, 1_000_000, H, 500)
+
+
+def test_htlc_minimum():
+    core = _core(htlc_minimum_msat=5_000)
+    with pytest.raises(ChannelError, match="htlc_minimum"):
+        core.add_htlc(True, 4_999, H, 500)
+    core.add_htlc(True, 5_000, H, 500)
+
+
+def test_reserve_floor():
+    """An add may not dip the offerer below its channel reserve."""
+    core = _core(feerate_per_kw=0)   # isolate the reserve check
+    # local has 600k sat; reserve 10k sat → max offerable ≈ 590k sat
+    with pytest.raises(ChannelError, match="reserve"):
+        core.add_htlc(True, 595_000_000, H, 500)
+    core.add_htlc(True, 585_000_000, H, 500)
+
+
+def test_fee_spike_buffer():
+    """The OPENER adding an HTLC must afford the commitment fee at 2x
+    the current feerate (BOLT#2 recommendation the reference enforces);
+    a non-opener add is only checked at 1x."""
+    core = _core(feerate_per_kw=10_000,
+                 to_local_msat=30_000_000, to_remote_msat=970_000_000,
+                 reserve_local_msat=10_000_000,
+                 reserve_remote_msat=10_000_000)
+    # opener pays the fee: at 2x-feerate buffer this add is unaffordable
+    with pytest.raises(ChannelError, match="afford"):
+        core.add_htlc(True, 8_000_000, H, 500)
+    # the PEER adding the same amount is checked at 1x only — and the
+    # opener's balance is untouched by a remote add, so it passes
+    core2 = _core(feerate_per_kw=10_000,
+                  to_local_msat=970_000_000, to_remote_msat=30_000_000,
+                  reserve_local_msat=10_000_000,
+                  reserve_remote_msat=10_000_000)
+    core2.add_htlc(False, 8_000_000, H, 500)
+
+
+def test_dust_overflow_many_small_htlcs():
+    """Many small (trimmed) HTLCs still count against max_accepted and
+    in-flight caps — the reference's dust-exposure concern."""
+    core = _core(max_accepted_htlcs=30,
+                 max_htlc_value_in_flight_msat=2_000_000)
+    for _ in range(2):
+        core.add_htlc(False, 1_000_000, H, 500)
+    with pytest.raises(ChannelError):
+        core.add_htlc(False, 1_000_000, H, 500)

@@ -1,0 +1,87 @@
+"""db_write streaming hook: every committed statement replays into a
+shadow database that ends up byte-identical (the reference's db_write
+plugin hook + tests/plugins/dblog.py TEST_CHECK_DBSTMTS discipline)."""
+from __future__ import annotations
+
+import sqlite3
+
+from lightning_tpu.wallet.db import Db
+
+
+def _dump(conn) -> list[str]:
+    return [line for line in conn.iterdump()
+            if not line.startswith("BEGIN") and not line.startswith("COMMIT")]
+
+
+def test_db_write_stream_replicates(tmp_path):
+    primary = Db(str(tmp_path / "primary.sqlite3"))
+    replica = sqlite3.connect(str(tmp_path / "replica.sqlite3"))
+    # bootstrap the replica with the already-migrated schema, then let
+    # the stream carry everything that follows
+    for line in _dump(primary.conn):
+        replica.execute(line)
+    replica.commit()
+
+    versions = []
+
+    def hook(data_version: int, stmts: list) -> None:
+        versions.append(data_version)
+        for sql, _params in stmts:   # documented batch shape
+            replica.execute(sql)
+        replica.commit()
+
+    primary.set_db_write_hook(hook)
+
+    primary.set_var("alpha", b"\x01\x02")
+    with primary.transaction() as c:
+        c.execute("INSERT INTO invoices (label, payment_hash, preimage,"
+                  " amount_msat, bolt11, status, expires_at) VALUES"
+                  " (?,?,?,?,?,?,?)",
+                  ("L1", b"\x11" * 32, b"\x22" * 32, 5, "lnbc1", "unpaid",
+                   999))
+    with primary.transaction() as c:
+        c.execute("UPDATE invoices SET status='paid' WHERE label='L1'")
+    primary.set_var("alpha", b"\x03")
+
+    # monotone data_version per committed transaction
+    assert versions == list(range(1, len(versions) + 1))
+    assert len(versions) >= 4
+
+    # the replica is identical, content and schema
+    assert _dump(primary.conn) == _dump(replica)
+
+    # rolled-back transactions are NOT streamed
+    n_before = len(versions)
+    try:
+        with primary.transaction() as c:
+            c.execute("UPDATE invoices SET status='x' WHERE label='L1'")
+            raise RuntimeError("abort")
+    except RuntimeError:
+        pass
+    assert len(versions) == n_before
+    assert _dump(primary.conn) == _dump(replica)
+
+    # a raising hook VETOES the commit (the reference's synchronous
+    # db_write semantics): the primary must not diverge from a replica
+    # that refused the batch
+    def veto(_v, _stmts):
+        raise RuntimeError("replica refused")
+
+    primary.set_db_write_hook(veto)
+    try:
+        primary.set_var("beta", b"\x09")
+    except RuntimeError:
+        pass
+    primary.set_db_write_hook(hook)
+    assert primary.get_var("beta") is None
+    assert _dump(primary.conn) == _dump(replica)
+
+    # data_version survives restart (persisted in vars, like the
+    # reference) — the stream stays monotone across process lifetimes
+    last = versions[-1]
+    primary.close()
+    reopened = Db(str(tmp_path / "primary.sqlite3"))
+    reopened.set_db_write_hook(hook)
+    reopened.set_var("gamma", b"\x0a")
+    assert versions[-1] == last + 1
+    assert _dump(reopened.conn) == _dump(replica)

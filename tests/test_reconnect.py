@@ -49,7 +49,10 @@ async def _open_pair(tmp_path):
 async def _pay(a, b, label, msat=50_000):
     inv = await rpc_call(b.rpc.rpc_path, "invoice", {
         "amount_msat": msat, "label": label, "description": label})
-    return await rpc_call(a.rpc.rpc_path, "pay", {"bolt11": inv["bolt11"]})
+    # generous retry_for: under full-suite load a dance can stall on
+    # jit-compile contention well past the 60s default
+    return await rpc_call(a.rpc.rpc_path, "pay",
+                          {"bolt11": inv["bolt11"], "retry_for": 300})
 
 
 async def _wait_channels(mgr, n=1, timeout=30.0):

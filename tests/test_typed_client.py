@@ -1,0 +1,79 @@
+"""Schema-driven typed client: the generated artifact is current (the
+msggen no-drift rule) and drives a live daemon end-to-end with typed
+responses."""
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lightning_tpu.chain.backend import FakeBitcoind  # noqa: E402
+from lightning_tpu.clients.generated import (RpcCallError,  # noqa: E402
+                                             TypedLightningRpc)
+from lightning_tpu.rpcschema import codegen  # noqa: E402
+from test_daemon_rpc import Stack  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 600))
+
+
+def test_generated_client_is_current():
+    """Regenerating must be a no-op — schemas and artifact move
+    together (CI rule msggen enforces on model.rs)."""
+    with open(codegen.DEFAULT_OUT) as f:
+        on_disk = f.read()
+    assert on_disk == codegen.generate(), (
+        "clients/generated.py is stale: run "
+        "`python -m lightning_tpu.rpcschema.codegen`")
+
+
+def test_typed_client_drives_daemon(tmp_path):
+    async def body():
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x0a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x0b" * 32, bitcoind).start()
+        rpc_a = TypedLightningRpc(a.rpc.rpc_path)
+        rpc_b = TypedLightningRpc(b.rpc.rpc_path)
+        try:
+            port = await b.node.listen()
+            info_b = await rpc_b.getinfo()
+            assert info_b.num_peers == 0 and info_b.network == "regtest"
+
+            got = await rpc_a.connect(f"{info_b.id}@127.0.0.1:{port}")
+            assert got.id == info_b.id
+
+            await rpc_a.call_raw("dev-faucet", {"satoshi": 2_000_000})
+            funds = await rpc_a.listfunds()
+            assert funds.outputs[0]["status"] == "confirmed"
+
+            fund = asyncio.create_task(
+                rpc_a.fundchannel(info_b.id, 1_000_000))
+            while not bitcoind.mempool and not fund.done():
+                await asyncio.sleep(0.05)
+            if bitcoind.mempool:
+                bitcoind.generate(1)
+            opened = await asyncio.wait_for(fund, 600)
+            assert opened.outnum == 0
+
+            inv = await rpc_b.invoice(77_000, "typed", "typed client")
+            paid = await rpc_a.pay(inv.bolt11, retry_for=300)
+            assert paid.status == "complete"
+            assert paid.amount_msat == 77_000
+
+            # typed errors surface as RpcCallError with the code
+            with pytest.raises(RpcCallError):
+                await rpc_a.pay("lnbcnonsense")
+
+            closed = await rpc_a.close(opened.channel_id)
+            assert closed.type == "mutual"
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
