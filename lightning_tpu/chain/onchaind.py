@@ -203,7 +203,8 @@ class Onchaind:
 
     def __init__(self, state: ChannelOnchainState, hsm, hsm_client,
                  topology, backend, dest_spk: bytes,
-                 our_pcp: ref.Point | None = None):
+                 our_pcp: ref.Point | None = None,
+                 state_provider=None, dest_provider=None):
         self.st = state
         self.hsm = hsm
         self.client = hsm_client
@@ -211,6 +212,13 @@ class Onchaind:
         self.backend = backend
         self.dest_spk = dest_spk
         self.our_pcp = our_pcp
+        # refresh hook: the channel keeps REVOKING new commitments after
+        # arming, so the snapshot must be rebuilt at spend time or a
+        # post-arm cheat would classify as THEIRS instead of REVOKED
+        self.state_provider = state_provider
+        # lazy sweep-address derivation: most channels close mutually
+        # and should not burn a wallet address at arm time
+        self.dest_provider = dest_provider
         self.events: list[tuple[str, object]] = []
         self.claims: list[Claim] = []
         self.resolved = False
@@ -221,6 +229,11 @@ class Onchaind:
                                  self._on_funding_spent)
 
     async def _on_funding_spent(self, tx: T.Tx, height: int) -> None:
+        if self.state_provider is not None:
+            st, our_pcp = self.state_provider()
+            # the mutual-close set accumulates on the armed snapshot
+            st.mutual_close_txids |= self.st.mutual_close_txids
+            self.st, self.our_pcp = st, our_pcp
         kind, n = classify_spend(tx, self.st)
         self.events.append(("spend_classified", kind))
         log.info("funding %s spent at %d: %s (n=%s)",
@@ -228,6 +241,8 @@ class Onchaind:
         if kind == SpendClass.MUTUAL:
             self.resolved = True
             return
+        if self.dest_provider is not None and not self.dest_spk:
+            self.dest_spk = self.dest_provider()
         feerate = self.topo.feerate(6)
         self.claims = plan_claims(kind, tx, n if n is not None else 0,
                                   self.st, self.dest_spk, feerate,

@@ -43,6 +43,9 @@ class ChainTopology:
         self.poll_interval = poll_interval
         self.chain: list[BlockRecord] = []
         self.txs_seen: dict[bytes, tuple[Tx, int]] = {}  # txid -> (tx, height)
+        # outpoint -> (spending tx, height): lets a txo watch registered
+        # AFTER the spend confirmed still fire (restart/rescue path)
+        self.spends_seen: dict[tuple[bytes, int], tuple[Tx, int]] = {}
         self._tx_watches: dict[bytes, list] = {}
         self._txo_watches: dict[tuple[bytes, int], list] = {}
         self._block_cbs: list = []
@@ -71,6 +74,13 @@ class ChainTopology:
 
     def watch_outpoint(self, txid: bytes, vout: int, cb) -> None:
         self._txo_watches.setdefault((txid, vout), []).append(cb)
+        # already spent within the scanned window? fire retroactively —
+        # a channel restored in funding_spend_seen is watching exactly
+        # such an outpoint (beyond the scan window the operator must
+        # rescan, same as the reference's --rescan)
+        seen = self.spends_seen.get((txid, vout))
+        if seen is not None:
+            self._call_soon(cb, seen[0], seen[1])
 
     def on_block(self, cb) -> None:
         self._block_cbs.append(cb)
@@ -163,6 +173,7 @@ class ChainTopology:
             rec.txids.add(txid)
             self.txs_seen[txid] = (tx, height)
             for vin in tx.inputs:
+                self.spends_seen[(vin.txid, vin.vout)] = (tx, height)
                 for cb in self._txo_watches.get((vin.txid, vin.vout), []):
                     await self._call(cb, tx, height)
         # depth change fires every tx watch whose tx is confirmed
@@ -181,6 +192,10 @@ class ChainTopology:
         rec = self.chain.pop()
         for txid in rec.txids:
             self.txs_seen.pop(txid, None)
+        gone = [k for k, (_t, h) in self.spends_seen.items()
+                if h == rec.height]
+        for k in gone:
+            del self.spends_seen[k]
         log.info("reorg: removed tip %d (%s)", rec.height,
                  rec.hash.hex()[:16])
         for cb in self._reorg_cbs:

@@ -171,13 +171,19 @@ class Channeld:
     def our_point(self, n: int) -> ref.Point:
         return self.hsm.per_commitment_point(self.client, n)
 
+    def payment_basepoints(self) -> tuple[bytes, bytes]:
+        """(opener_payment_basepoint, accepter_payment_basepoint) — the
+        pair that obscures commitment numbers (BOLT#3).  The ONE place
+        the opener/accepter mapping lives (commitment building and
+        onchaind's spend classification must always agree on it)."""
+        ours = ref.pubkey_serialize(self.our_base.payment)
+        theirs = ref.pubkey_serialize(self.their_base.payment)
+        return (ours, theirs) if self.funder else (theirs, ours)
+
     def _params(self, local: bool) -> C.CommitmentParams:
         """CommitmentParams for building `local`'s or the remote's view."""
         opener_local = self.funder  # we are opener iff funder
-        our_pay = ref.pubkey_serialize(self.our_base.payment)
-        their_pay = ref.pubkey_serialize(self.their_base.payment)
-        opener_pay = our_pay if opener_local else their_pay
-        accepter_pay = their_pay if opener_local else our_pay
+        opener_pay, accepter_pay = self.payment_basepoints()
         return C.CommitmentParams(
             funding_txid=self.funding_txid,
             funding_output_index=self.funding_outidx,

@@ -29,6 +29,16 @@ class ManagerError(Exception):
     pass
 
 
+class _DeadPeer:
+    """Placeholder peer for channels restored only to arm onchaind —
+    the counterparty is gone; no traffic will ever flow."""
+
+    def __init__(self, node_id: bytes):
+        self.node_id = node_id
+        self.connected = False
+        self.inbox = None
+
+
 class ChannelManager:
     def __init__(self, node, hsm, wallet=None, onchain=None,
                  chain_backend=None, topology=None, invoices=None,
@@ -62,13 +72,100 @@ class ChannelManager:
         task = asyncio.get_running_loop().create_task(
             self._run_loop(ch))
         self.channels[ch.channel_id] = (ch, task)
+        self._arm_onchaind(ch)
+
+    def _arm_onchaind(self, ch) -> None:
+        """Watch the funding outpoint and resolve any unilateral spend
+        (onchain_control.c's arming role; the engine itself is
+        chain/onchaind.py).  Idempotent per CHANNEL ID: a reestablish
+        builds a fresh Channeld, and re-arming must repoint the ONE
+        existing watcher at it instead of stacking duplicate watches
+        that would broadcast conflicting sweeps."""
+        if self.topology is None or self.chain_backend is None \
+                or self.onchain is None:
+            return
+        from ..chain.onchaind import Onchaind
+
+        if not hasattr(self, "_onchainds"):
+            self._onchainds: dict[bytes, object] = {}
+        existing = self._onchainds.get(ch.channel_id)
+        if existing is not None:
+            existing.state_provider = \
+                lambda: self._onchain_state(ch)
+            ch._onchaind = existing
+            return
+        st, pcp = self._onchain_state(ch)
+
+        def dest_provider() -> bytes:
+            # derive the sweep address LAZILY: most channels close
+            # cooperatively and never need one
+            from ..btc import address as ADDR
+
+            return ADDR.to_scriptpubkey(
+                self.onchain.newaddr()["bech32"], self.onchain.keyman.hrp)
+
+        ocd = Onchaind(st, self.hsm, ch.client, self.topology,
+                       self.chain_backend, b"", our_pcp=pcp,
+                       state_provider=lambda: self._onchain_state(ch),
+                       dest_provider=dest_provider)
+        ocd.arm()
+        self._onchainds[ch.channel_id] = ocd
+        ch._onchaind = ocd
+
+    def _onchain_state(self, ch):
+        """Fresh onchaind snapshot from the LIVE channel (called at arm
+        time and again at spend time — revocations keep accruing)."""
+        import lightning_tpu.btc.keys as K
+        from ..chain.onchaind import ChannelOnchainState
+
+        n_local = ch.next_local_commit - 1
+        secrets: dict[int, int] = {}
+        revealed = ch._their_revoked_count()
+        for n in range(revealed):
+            s = ch.their_secrets.lookup(K.LARGEST_INDEX - n)
+            if s is not None:
+                secrets[n] = int.from_bytes(s, "big")
+        try:
+            our_commit_txid = ch._build(True, n_local)[0].txid()
+        except Exception:
+            # without it, OUR unilateral close classifies as UNKNOWN
+            # and the to_local sweep never happens — never hide this
+            log.exception("could not build our commitment %d for %s",
+                          n_local, ch.channel_id.hex()[:16])
+            our_commit_txid = None
+        st = ChannelOnchainState(
+            funding_txid=ch.funding_txid,
+            funding_output_index=ch.funding_outidx,
+            our_basepoints=ch.our_base,
+            their_basepoints=ch.their_base,
+            opener_payment_basepoint=self._payment_bp(ch, opener=True),
+            accepter_payment_basepoint=self._payment_bp(ch, opener=False),
+            to_self_delay=ch.delay_on_local,
+            their_to_self_delay=ch.delay_on_remote,
+            our_commitment_number=n_local,
+            their_commitment_number=ch.next_remote_commit - 1,
+            our_commitment_txid=our_commit_txid,
+            their_secrets=secrets,
+            anchors=ch.cfg.anchors,
+            dust_limit_sat=ch.cfg.dust_limit_sat,
+        )
+        return st, ch.our_point(n_local)
+
+    @staticmethod
+    def _payment_bp(ch, opener: bool) -> bytes:
+        opener_bp, accepter_bp = ch.payment_basepoints()
+        return opener_bp if opener else accepter_bp
 
     async def _run_loop(self, ch) -> None:
         try:
-            await CD.channel_loop(
+            tx = await CD.channel_loop(
                 ch, self.hsm.node_key, invoices=self.invoices,
                 htlc_sets=self.htlc_sets, relay=self.relay,
                 chain_backend=self.chain_backend, topology=self.topology)
+            ocd = getattr(ch, "_onchaind", None)
+            if tx is not None and ocd is not None:
+                # peer-initiated cooperative closes ALSO resolve here
+                ocd.st.mutual_close_txids.add(tx.txid())
         except (CD.ChannelError, ConnectionError, asyncio.TimeoutError,
                 asyncio.IncompleteReadError) as e:
             log.info("channel %s loop ended: %s",
@@ -261,6 +358,16 @@ class ChannelManager:
             return 0
         n = 0
         for row in self.wallet.list_channels():
+            if row["state"] in ("awaiting_unilateral",
+                                "funding_spend_seen"):
+                # onchaind_replay_channels (lightningd.c:1411): parked
+                # channels still need their funding-spend watch armed so
+                # the eventual unilateral close gets swept
+                ch = CD.restore_channeld(self.wallet, row,
+                                         _DeadPeer(row["peer_node_id"]),
+                                         self.hsm)
+                self._arm_onchaind(ch)
+                continue
             if row["state"] not in ("normal", "shutting_down"):
                 continue
             peer = self.node.peers.get(row["peer_node_id"])
@@ -439,6 +546,11 @@ class ChannelManager:
         ch.peer.inbox.put_nowait(_CloseCommand(done=fut))
         tx = await asyncio.wait_for(fut, 120)
         raw = tx.serialize()
+        ocd = getattr(ch, "_onchaind", None)
+        if ocd is not None:
+            # register BEFORE broadcast: the poll loop must never see
+            # the confirming block while the txid is still unknown
+            ocd.st.mutual_close_txids.add(tx.txid())
         if self.chain_backend is not None:
             await self.chain_backend.sendrawtransaction(raw)
         return {"type": "mutual", "txid": tx.txid().hex(),
