@@ -76,9 +76,17 @@ async def amain(args) -> int:
     logging.getLogger("lightning_tpu.lightningd").info(
         "server started, node_id %s", node.node_id.hex())
 
+    wss = None
     if args.listen is not None:
         port = await node.listen(args.bind, args.listen)
         print(f"listening {args.bind}:{port}", flush=True)
+        if args.wss_port is not None:
+            from .wssproxy import WssProxy
+
+            wss = WssProxy(args.bind, port, host=args.bind,
+                           port=args.wss_port)
+            wport = await wss.start()
+            print(f"wss-proxy {args.bind}:{wport}", flush=True)
 
     gossmap_ref = {"map": None}
     store_idx = None
@@ -292,11 +300,15 @@ async def amain(args) -> int:
             print(f"connect failed: {type(e).__name__}: {e}", file=sys.stderr)
             if rpc is not None:
                 await rpc.close()
+            if wss is not None:
+                await wss.close()
             await node.close()
             return 1
         if not args.stay:
             if rpc is not None:
                 await rpc.close()
+            if wss is not None:
+                await wss.close()
             await node.close()
             return 0
 
@@ -307,6 +319,8 @@ async def amain(args) -> int:
         pass
     if rpc is not None:
         await rpc.close()
+    if wss is not None:
+        await wss.close()
     if gossipd is not None:
         await gossipd.close()
     if topology is not None:
@@ -327,6 +341,9 @@ def main() -> int:
                    help="BIP39 mnemonic to derive a NEW hsm_secret from "
                         "(with LIGHTNING_TPU_HSM_PASSPHRASE as the "
                         "BIP39/encryption passphrase)")
+    p.add_argument("--wss-port", type=int, default=None,
+                   help="serve a WebSocket proxy to the TCP listener on "
+                        "this port (0 = ephemeral; needs --listen)")
     p.add_argument("--rest-port", type=int, default=None,
                    help="serve the clnrest-style HTTP API on this port "
                         "(0 = ephemeral; requires --rpc-file)")
