@@ -220,6 +220,12 @@ async def amain(args) -> int:
             backup = PeerStorageService(node, hsm._secret, wallet=wallet)
             attach_backup_commands(rpc, backup)
 
+        if db is not None:
+            from ..plugins.datastore import (Datastore,
+                                             attach_datastore_commands)
+
+            attach_datastore_commands(rpc, Datastore(db))
+
         from ..plugins.autoclean import Autoclean, attach_autoclean_commands
         from ..plugins.sqlrpc import attach_sql_command
 

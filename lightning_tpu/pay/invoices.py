@@ -66,8 +66,26 @@ class InvoiceRegistry:
         # offers service hook: fn(local_offer_id) once a bolt12 invoice
         # settles (single-use offers are spent by payment)
         self.on_bolt12_paid = None
+        # waitinvoice/waitanyinvoice wake signal: waiters re-check their
+        # own condition on every registry change (settle/delete/expire),
+        # so cursors and deletions are always honored
+        # (invoices.c wait machinery + the pay_index cursor)
+        self._change_ev = None
         if db is not None:
             self._load()
+
+    def _signal(self) -> None:
+        ev = self._change_ev
+        if ev is not None:
+            ev.set()
+            self._change_ev = None
+
+    def _change_event(self):
+        import asyncio
+
+        if self._change_ev is None:
+            self._change_ev = asyncio.Event()
+        return self._change_ev
 
     # -- persistence ------------------------------------------------------
 
@@ -232,6 +250,79 @@ class InvoiceRegistry:
             "reference": payment_hash.hex(), "timestamp": rec.paid_at})
         if rec.local_offer_id is not None and self.on_bolt12_paid:
             self.on_bolt12_paid(rec.local_offer_id)
+        self._signal()
+
+    # -- waiting (invoices.c waitany/waitinvoice) -------------------------
+
+    async def _await_change(self, deadline) -> None:
+        import asyncio
+
+        ev = self._change_event()
+        if deadline is None:
+            await ev.wait()
+            return
+        remaining = deadline - asyncio.get_running_loop().time()
+        if remaining <= 0:
+            raise asyncio.TimeoutError
+        await asyncio.wait_for(ev.wait(), remaining)
+
+    def _deadline(self, timeout):
+        import asyncio
+
+        return None if timeout is None else \
+            asyncio.get_running_loop().time() + timeout
+
+    async def wait_any(self, lastpay_index: int = 0,
+                       timeout: float | None = None) -> InvoiceRecord:
+        """Resolve with the next invoice whose pay_index exceeds the
+        cursor (already-paid ones resolve immediately).  The condition
+        is re-checked on every registry change, so a cursor beyond the
+        current counter keeps waiting (never returns a stale index),
+        and the timeout is a DEADLINE across wakeups."""
+        deadline = self._deadline(timeout)
+        while True:
+            paid = [r for r in self.by_label.values()
+                    if r.pay_index is not None
+                    and r.pay_index > lastpay_index]
+            if paid:
+                return min(paid, key=lambda r: r.pay_index)
+            await self._await_change(deadline)
+
+    async def wait_for_label(self, label: str,
+                             timeout: float | None = None
+                             ) -> InvoiceRecord:
+        import time as _time
+
+        if label not in self.by_label:
+            raise InvoiceError(f"unknown invoice {label!r}")
+        deadline = self._deadline(timeout)
+        while True:
+            rec = self.by_label.get(label)
+            if rec is None:
+                raise InvoiceError(f"invoice {label!r} was deleted")
+            if rec.status == "paid":
+                return rec
+            if rec.status == "expired" or _time.time() > rec.expires_at:
+                raise InvoiceError(f"invoice {label!r} expired")
+            await self._await_change(deadline)
+
+    def delete(self, label: str, status: str) -> dict:
+        """status is REQUIRED (invoices.c): deleting without asserting
+        the expected state races a concurrent payment and could destroy
+        a just-paid record."""
+        rec = self.by_label.get(label)
+        if rec is None:
+            raise InvoiceError(f"unknown invoice {label!r}")
+        if rec.status != status:
+            raise InvoiceError(
+                f"invoice is {rec.status}, not {status}")
+        del self.by_label[label]
+        self.by_hash.pop(rec.payment_hash, None)
+        if self.db is not None:
+            with self.db.transaction() as c:
+                c.execute("DELETE FROM invoices WHERE label=?", (label,))
+        self._signal()   # wake waiters so they see the deletion
+        return rec.to_rpc()
 
     # -- queries ----------------------------------------------------------
 
@@ -244,7 +335,11 @@ class InvoiceRegistry:
 
     def _expire_now(self) -> None:
         t = time.time()
+        changed = False
         for rec in self.by_label.values():
             if rec.status == "unpaid" and t > rec.expires_at:
                 rec.status = "expired"
                 self._save(rec)
+                changed = True
+        if changed:
+            self._signal()

@@ -368,6 +368,21 @@ def attach_offers_commands(rpc, service: OffersService,
     async def listinvoices(label: str | None = None) -> dict:
         return {"invoices": invoices.listinvoices(label)}
 
+    async def waitinvoice(label: str, timeout: int = 600) -> dict:
+        rec = await invoices.wait_for_label(label, timeout=timeout)
+        return rec.to_rpc()
+
+    async def waitanyinvoice(lastpay_index: int = 0,
+                             timeout: int = 600) -> dict:
+        rec = await invoices.wait_any(int(lastpay_index),
+                                      timeout=timeout)
+        return rec.to_rpc()
+
+    async def delinvoice(label: str, status: str) -> dict:
+        # status is required: an unguarded delete races concurrent
+        # payment and could erase a just-paid record (invoices.c)
+        return invoices.delete(label, status)
+
     async def decode(string: str) -> dict:
         """bolt11 / bolt12 decoder (plugins/offers.c decode command)."""
         from ..bolt import bolt11 as B11
@@ -397,7 +412,8 @@ def attach_offers_commands(rpc, service: OffersService,
                 "min_final_cltv_expiry": inv11.min_final_cltv}
 
     for fn in (offer, listoffers, disableoffer, fetchinvoice, invoice,
-               listinvoices, decode):
+               listinvoices, waitinvoice, waitanyinvoice, delinvoice,
+               decode):
         rpc.register(fn.__name__, fn)
 
 

@@ -1,0 +1,141 @@
+"""Key-path datastore RPC: datastore/listdatastore/deldatastore.
+
+Parity target: lightningd/datastore.c + wallet/datastore.c — an
+append-or-replace hierarchical key store plugins use for persistent
+state, with generation counters for optimistic concurrency
+(must_replace/must_create, generation guards)."""
+from __future__ import annotations
+
+
+class DatastoreError(Exception):
+    pass
+
+
+MIGRATION = """CREATE TABLE IF NOT EXISTS datastore (
+    key TEXT PRIMARY KEY,
+    data BLOB NOT NULL,
+    generation INTEGER NOT NULL DEFAULT 0
+)"""
+
+
+def _key_str(key) -> str:
+    """Keys are hierarchical arrays stored as their JSON encoding — a
+    separator-based join would let ['a\\x00b'] collide with ['a','b']
+    (datastore.c stores the array; a single string is a one-element
+    path)."""
+    import json
+
+    if isinstance(key, str):
+        key = [key]
+    return json.dumps([str(k) for k in key])
+
+
+def _key_list(key_str: str) -> list[str]:
+    import json
+
+    return json.loads(key_str)
+
+
+class Datastore:
+    def __init__(self, db):
+        self.db = db
+        with db.transaction() as c:
+            c.execute(MIGRATION)
+
+    def set(self, key, data: bytes, mode: str = "must-create",
+            generation: int | None = None) -> dict:
+        ks = _key_str(key)
+        row = self.db.conn.execute(
+            "SELECT generation FROM datastore WHERE key=?",
+            (ks,)).fetchone()
+        if mode == "must-create" and row is not None:
+            raise DatastoreError(f"key {key!r} already exists")
+        if mode == "must-replace" and row is None:
+            raise DatastoreError(f"key {key!r} does not exist")
+        if generation is not None:
+            if row is None or row[0] != generation:
+                raise DatastoreError(
+                    f"generation {generation} does not match "
+                    f"{row[0] if row else None}")
+        gen = (row[0] + 1) if row is not None else 0
+        if mode == "create-or-append" and row is not None:
+            old = self.db.conn.execute(
+                "SELECT data FROM datastore WHERE key=?",
+                (ks,)).fetchone()[0]
+            data = bytes(old) + data
+        with self.db.transaction() as c:
+            c.execute(
+                "INSERT INTO datastore (key, data, generation) VALUES"
+                " (?,?,?) ON CONFLICT(key) DO UPDATE SET"
+                " data=excluded.data, generation=excluded.generation",
+                (ks, data, gen))
+        return {"key": _key_list(ks), "generation": gen,
+                "hex": data.hex()}
+
+    def list(self, key=None) -> list[dict]:
+        """datastore.c listing semantics: entries AT the key (with
+        data) plus the key's immediate CHILD nodes — interior nodes
+        appear once, without data, so callers can walk the hierarchy
+        level by level."""
+        rows = self.db.conn.execute(
+            "SELECT key, data, generation FROM datastore ORDER BY key"
+        ).fetchall()
+        prefix = _key_list(_key_str(key)) if key else []
+        out, interior_seen = [], set()
+        for ks, data, gen in rows:
+            kl = _key_list(ks)
+            if kl[:len(prefix)] != prefix:
+                continue
+            if len(kl) == len(prefix) and prefix:
+                # exact match: the entry itself, with data
+                out.append({"key": kl, "generation": gen,
+                            "hex": bytes(data).hex()})
+            elif len(kl) == len(prefix) + 1:
+                # immediate child leaf: with data
+                out.append({"key": kl, "generation": gen,
+                            "hex": bytes(data).hex()})
+            elif len(kl) > len(prefix) + 1:
+                # deeper: surface the immediate child as an interior
+                # node (no data), once
+                child = tuple(kl[:len(prefix) + 1])
+                if child not in interior_seen:
+                    interior_seen.add(child)
+                    out.append({"key": list(child)})
+        return out
+
+    def delete(self, key, generation: int | None = None) -> dict:
+        ks = _key_str(key)
+        row = self.db.conn.execute(
+            "SELECT data, generation FROM datastore WHERE key=?",
+            (ks,)).fetchone()
+        if row is None:
+            raise DatastoreError(f"key {key!r} does not exist")
+        if generation is not None and row[1] != generation:
+            raise DatastoreError(
+                f"generation {generation} does not match {row[1]}")
+        with self.db.transaction() as c:
+            c.execute("DELETE FROM datastore WHERE key=?", (ks,))
+        return {"key": _key_list(ks), "generation": row[1],
+                "hex": bytes(row[0]).hex()}
+
+
+def attach_datastore_commands(rpc, store: Datastore) -> None:
+    async def datastore(key, string: str | None = None,
+                        hex: str | None = None,  # noqa: A002
+                        mode: str = "must-create",
+                        generation: int | None = None) -> dict:
+        if (string is None) == (hex is None):
+            raise DatastoreError("pass exactly one of string/hex")
+        data = string.encode() if string is not None \
+            else bytes.fromhex(hex)
+        return store.set(key, data, mode=mode, generation=generation)
+
+    async def listdatastore(key=None) -> dict:
+        return {"datastore": store.list(key)}
+
+    async def deldatastore(key, generation: int | None = None) -> dict:
+        return store.delete(key, generation=generation)
+
+    rpc.register("datastore", datastore)
+    rpc.register("listdatastore", listdatastore)
+    rpc.register("deldatastore", deldatastore)

@@ -138,6 +138,33 @@ COMMANDS: dict[str, dict] = {
         "result": {"invoice": "str", "amount_msat": "msat",
                    "payment_hash": "hex"},
     },
+    "waitinvoice": {
+        "params": {"label": "str", "timeout": "int?"},
+        "result": {"label": "str", "status": "str",
+                   "payment_hash": "hex"},
+    },
+    "waitanyinvoice": {
+        "params": {"lastpay_index": "int?", "timeout": "int?"},
+        "result": {"label": "str", "status": "str",
+                   "pay_index": "int"},
+    },
+    "delinvoice": {
+        "params": {"label": "str", "status": "str?"},
+        "result": {"label": "str", "status": "str"},
+    },
+    "datastore": {
+        "params": {"key": "any", "string": "str?", "hex": "hex?",
+                   "mode": "str?", "generation": "int?"},
+        "result": {"key": "list", "generation": "int", "hex": "hex"},
+    },
+    "listdatastore": {
+        "params": {"key": "any?"},
+        "result": {"datastore": "list"},
+    },
+    "deldatastore": {
+        "params": {"key": "any", "generation": "int?"},
+        "result": {"key": "list", "generation": "int", "hex": "hex"},
+    },
     "listforwards": {
         "params": {},
         "result": {"forwards": "list"},

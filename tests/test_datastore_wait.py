@@ -1,0 +1,109 @@
+"""datastore RPC semantics + waitinvoice/waitanyinvoice/delinvoice
+(lightningd/datastore.c + invoices.c wait machinery parity)."""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lightning_tpu.pay.invoices import InvoiceError, InvoiceRegistry
+from lightning_tpu.plugins.datastore import Datastore, DatastoreError
+from lightning_tpu.wallet.db import Db
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60))
+
+
+def test_datastore_modes_and_generations(tmp_path):
+    db = Db(str(tmp_path / "d.sqlite3"))
+    ds = Datastore(db)
+
+    got = ds.set(["a", "b"], b"\x01\x02")
+    assert got == {"key": ["a", "b"], "generation": 0, "hex": "0102"}
+    with pytest.raises(DatastoreError, match="exists"):
+        ds.set(["a", "b"], b"\x03")
+    got = ds.set(["a", "b"], b"\x03", mode="must-replace")
+    assert got["generation"] == 1
+    with pytest.raises(DatastoreError, match="generation"):
+        ds.set(["a", "b"], b"\x04", mode="must-replace", generation=0)
+    got = ds.set(["a", "b"], b"\x04\x05", mode="create-or-append",
+                 generation=1)
+    assert got["hex"] == "030405"
+    ds.set(["a", "c", "deep"], b"\x06")
+    ds.set(["z"], b"\x07")
+
+    # listing at a key: the entry itself + immediate children; deeper
+    # levels surface as interior nodes WITHOUT data (datastore.c walk)
+    got = ds.list(["a"])
+    assert {tuple(d["key"]) for d in got} == {("a", "b"), ("a", "c")}
+    assert [d for d in got if d["key"] == ["a", "c"]][0].get("hex") is None
+    # top level: leaves with data, interiors without
+    top = ds.list()
+    assert {tuple(d["key"]) for d in top} == {("a",), ("z",)}
+
+    # NUL inside a key element must NOT collide with a nested path
+    ds.set(["a\x00b"], b"\x08")
+    assert ds.list(["a\x00b"])[0]["hex"] == "08"
+    assert {tuple(d["key"]) for d in ds.list(["a"])} == \
+        {("a", "b"), ("a", "c")}
+
+    # persistence across reopen
+    db.close()
+    ds2 = Datastore(Db(str(tmp_path / "d.sqlite3")))
+    assert ds2.list(["a", "b"])[0]["hex"] == "030405"
+
+    gone = ds2.delete(["a", "b"], generation=2)
+    assert gone["hex"] == "030405"
+    with pytest.raises(DatastoreError, match="exist"):
+        ds2.delete(["a", "b"])
+
+
+def test_waitinvoice_and_delinvoice(tmp_path):
+    async def body():
+        inv = InvoiceRegistry(0x1234)
+        r1 = inv.create("one", 1_000, "x")
+        r2 = inv.create("two", 2_000, "y")
+
+        waiter = asyncio.create_task(inv.wait_for_label("two", timeout=10))
+        anywaiter = asyncio.create_task(inv.wait_any(0, timeout=10))
+        # a cursor beyond the counter must keep waiting even as other
+        # invoices settle (the stale-index contract violation)
+        future_cursor = asyncio.create_task(inv.wait_any(100, timeout=1))
+        await asyncio.sleep(0.05)
+        # settling ONE resolves waitany but NOT the label waiter
+        inv.settle(r1.payment_hash, 1_000)
+        got_any = await anywaiter
+        assert got_any.label == "one"
+        assert not waiter.done()
+        inv.settle(r2.payment_hash, 2_000)
+        got = await waiter
+        assert got.label == "two" and got.status == "paid"
+        with pytest.raises(asyncio.TimeoutError):
+            await future_cursor
+
+        # waitany with a cursor returns the NEXT paid invoice at once
+        got = await inv.wait_any(got_any.pay_index, timeout=1)
+        assert got.label == "two"
+
+        # delinvoice REQUIRES the status to match
+        with pytest.raises(InvoiceError, match="paid"):
+            inv.delete("two", "unpaid")
+        gone = inv.delete("two", "paid")
+        assert gone["label"] == "two"
+        assert inv.listinvoices("two") == []
+
+        # deleting wakes a parked label-waiter with a proper error
+        r3 = inv.create("three", 3_000, "z")
+        w3 = asyncio.create_task(inv.wait_for_label("three", timeout=10))
+        await asyncio.sleep(0.05)
+        inv.delete("three", "unpaid")
+        with pytest.raises(InvoiceError, match="deleted"):
+            await w3
+
+        # waiting on an expired invoice fails fast, not at timeout
+        rec = inv.create("old", 1_000, "x", expiry=0)
+        await asyncio.sleep(0.01)
+        with pytest.raises(InvoiceError, match="expired"):
+            await inv.wait_for_label("old", timeout=30)
+    run(body())
