@@ -674,7 +674,74 @@ class ChannelManager:
             "status": "complete",
         }
 
+    async def keysend(self, dest: bytes, amount_msat: int,
+                      timeout: float = 60.0) -> dict:
+        """Spontaneous payment: the preimage rides the onion
+        (plugins/keysend.c).  Direct peers only for now (routed keysend
+        needs per-hop payloads like pay, same machinery)."""
+        import os as _os
+
+        from ..bolt import onion_payload as OP
+        from ..bolt import sphinx as SX
+
+        ch = None
+        for cand, _t in self.channels.values():
+            if cand.peer.node_id == dest:
+                ch = cand
+                break
+        if ch is None:
+            raise ManagerError("keysend target is not a direct peer")
+        preimage = _os.urandom(32)
+        payment_hash = hashlib.sha256(preimage).digest()
+        blockheight = self.topology.height if self.topology is not None \
+            and self.topology.height > 0 else 0
+        cltv = blockheight + 18
+        onion, _ = OP.build_route_onion(
+            [dest], [OP.HopPayload(amount_msat, cltv,
+                                   keysend_preimage=preimage)],
+            payment_hash, SX.random_session_key())
+        pay_id = self._record_payment_raw(
+            payment_hash, dest, amount_msat, amount_msat,
+            int(time.time()))
+        try:
+            got_preimage, reason = await self.sendpay_direct(
+                ch, amount_msat, payment_hash, onion, cltv, timeout)
+        except Exception as e:
+            self._resolve_payment(pay_id, None, failure=str(e))
+            raise
+        if got_preimage != preimage:
+            why = (f"downstream error {reason[:16].hex()}..."
+                   if reason else "recipient rejected")
+            self._resolve_payment(pay_id, None, failure=why)
+            raise ManagerError(f"keysend failed ({why})")
+        self._resolve_payment(pay_id, preimage)
+        return {"payment_hash": payment_hash.hex(),
+                "payment_preimage": preimage.hex(),
+                "amount_msat": amount_msat, "status": "complete",
+                "destination": dest.hex()}
+
+    def listhtlcs(self) -> list[dict]:
+        out = []
+        for ch, _t in self.channels.values():
+            for (by_us, hid), lh in ch.core.htlcs.items():
+                out.append({
+                    "short_channel_id": str(ch.scid) if ch.scid else None,
+                    "id": hid,
+                    "direction": "out" if by_us else "in",
+                    "amount_msat": lh.htlc.amount_msat,
+                    "payment_hash": lh.htlc.payment_hash.hex(),
+                    "expiry": lh.htlc.cltv_expiry,
+                    "state": lh.state.name,
+                })
+        return out
+
     def _record_payment(self, inv, bolt11_str, amount, sent, created):
+        return self._record_payment_raw(inv.payment_hash, inv.payee,
+                                        amount, sent, created,
+                                        bolt11=bolt11_str)
+
+    def _record_payment_raw(self, payment_hash, destination, amount,
+                            sent, created, bolt11=None):
         if self.wallet is None:
             return None
         with self.wallet.db.transaction() as c:
@@ -682,7 +749,7 @@ class ChannelManager:
                 "INSERT INTO payments (payment_hash, destination,"
                 " amount_msat, amount_sent_msat, bolt11, status,"
                 " created_at) VALUES (?,?,?,?,?,'pending',?)",
-                (inv.payment_hash, inv.payee, amount, sent, bolt11_str,
+                (payment_hash, destination, amount, sent, bolt11,
                  created))
             return cur.lastrowid
 
@@ -812,6 +879,15 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
             chans = [c for c in chans if c["peer_id"] == id]
         return {"channels": chans}
 
+    async def keysend(destination: str, amount_msat,
+                      retry_for: int = 60) -> dict:
+        return await mgr.keysend(bytes.fromhex(destination),
+                                 int(amount_msat),
+                                 timeout=float(retry_for))
+
+    async def listhtlcs() -> dict:
+        return {"htlcs": mgr.listhtlcs()}
+
     rpc.register("fundchannel", fundchannel)
     rpc.register("close", close)
     rpc.register("splice", splice)
@@ -823,3 +899,5 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     rpc.register("listpays", listpays)
     rpc.register("listsendpays", listsendpays)
     rpc.register("listpeerchannels", listpeerchannels)
+    rpc.register("keysend", keysend)
+    rpc.register("listhtlcs", listhtlcs)

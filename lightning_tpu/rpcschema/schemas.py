@@ -165,6 +165,17 @@ COMMANDS: dict[str, dict] = {
         "params": {"key": "any", "generation": "int?"},
         "result": {"key": "list", "generation": "int", "hex": "hex"},
     },
+    "keysend": {
+        "params": {"destination": "hex", "amount_msat": "any",
+                   "retry_for": "int?"},
+        "result": {"payment_hash": "hex", "payment_preimage": "hex",
+                   "amount_msat": "msat", "status": "str",
+                   "destination": "hex"},
+    },
+    "listhtlcs": {
+        "params": {},
+        "result": {"htlcs": "list"},
+    },
     "listforwards": {
         "params": {},
         "result": {"forwards": "list"},

@@ -208,3 +208,59 @@ def test_connect_fund_invoice_pay_close(tmp_path):
             await b.close()
 
     run(body())
+
+
+def test_keysend_and_listhtlcs(tmp_path):
+    """Spontaneous payment over RPC: the preimage rides the onion and
+    the recipient books income with no invoice (plugins/keysend.c)."""
+    async def body():
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x0a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x0b" * 32, bitcoind).start()
+        try:
+            port = await b.node.listen()
+            info_b = await rpc_call(b.rpc.rpc_path, "getinfo")
+            await rpc_call(a.rpc.rpc_path, "connect", {
+                "id": f"{info_b['id']}@127.0.0.1:{port}"})
+            await rpc_call(a.rpc.rpc_path, "dev-faucet",
+                           {"satoshi": 2_000_000})
+            fund = asyncio.create_task(rpc_call(a.rpc.rpc_path,
+                                                "fundchannel", {
+                "id": info_b["id"], "amount": 1_000_000}))
+            while not bitcoind.mempool and not fund.done():
+                await asyncio.sleep(0.05)
+            if bitcoind.mempool:
+                bitcoind.generate(1)
+            await asyncio.wait_for(fund, 600)
+
+            sent = await rpc_call(a.rpc.rpc_path, "keysend", {
+                "destination": info_b["id"], "amount_msat": 12_345_000,
+                "retry_for": 300})
+            assert sent["status"] == "complete"
+            # the preimage resolves at fulfill receipt; the balance
+            # lands when the removal dance settles moments later
+            for _ in range(200):
+                chans_b = await rpc_call(b.rpc.rpc_path,
+                                         "listpeerchannels")
+                if chans_b["channels"][0]["to_us_msat"] == 12_345_000:
+                    break
+                await asyncio.sleep(0.1)
+            assert chans_b["channels"][0]["to_us_msat"] == 12_345_000
+            # no HTLCs left in flight once the final revoke lands
+            for _ in range(200):
+                htlcs = await rpc_call(a.rpc.rpc_path, "listhtlcs")
+                if not htlcs["htlcs"]:
+                    break
+                await asyncio.sleep(0.1)
+            assert htlcs["htlcs"] == []
+            # and the keysend shows in the payments log
+            pays = await rpc_call(a.rpc.rpc_path, "listpays")
+            assert any(p["status"] == "complete"
+                       and p["payment_hash"] == sent["payment_hash"]
+                       for p in pays["pays"])
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
