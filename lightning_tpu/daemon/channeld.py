@@ -1328,6 +1328,16 @@ async def channel_loop(ch: Channeld, node_privkey: int,
                         fut.set_result((msg.payment_preimage, None))
                     else:
                         fut.set_result((None, msg.reason))
+                if isinstance(msg, M.UpdateFulfillHtlc) \
+                        and ch.wallet is not None:
+                    # a fulfill is PROOF the payment succeeded even when
+                    # no waiter is attached (e.g. the originating RPC
+                    # timed out across a crash and the retransmission
+                    # journal completed the HTLC after reestablish) —
+                    # the payments row must never stay 'failed' with
+                    # the preimage in hand
+                    _reconcile_payment(ch.wallet,
+                                       msg.payment_preimage)
                 if relay is not None:
                     cb = relay.pending.pop((id(ch), msg.id), None)
                     if cb is not None:
@@ -1387,6 +1397,21 @@ async def _quiesce(ch, node_privkey: int | None = None,
             await ch.handle_commit_msg(m2)
         else:
             ch.apply_update(m2)
+
+
+def _reconcile_payment(wallet, preimage: bytes) -> None:
+    """Mark an outgoing payment complete by its preimage (the fulfill
+    is cryptographic proof; wallet_payment state repair on the
+    journal-replay path)."""
+    import time as _time
+
+    payment_hash = hashlib.sha256(preimage).digest()
+    with wallet.db.transaction() as c:
+        c.execute(
+            "UPDATE payments SET status='complete', preimage=?,"
+            " completed_at=COALESCE(completed_at, ?), failure=NULL"
+            " WHERE payment_hash=? AND status != 'complete'",
+            (preimage, int(_time.time()), payment_hash))
 
 
 def _unknown_details(lh) -> bytes:

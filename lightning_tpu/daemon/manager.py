@@ -763,9 +763,13 @@ class ChannelManager:
                     " completed_at=? WHERE id=?",
                     (preimage, int(time.time()), pay_id))
             else:
+                # only a PENDING row may fail: the fulfill can race the
+                # RPC timeout (journal replay after reconnect), and a
+                # completed payment must never be re-marked failed —
+                # the preimage is proof
                 c.execute(
                     "UPDATE payments SET status='failed', failure=?,"
-                    " completed_at=? WHERE id=?",
+                    " completed_at=? WHERE id=? AND status='pending'",
                     (failure, int(time.time()), pay_id))
 
     def listpays(self) -> list[dict]:

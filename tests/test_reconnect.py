@@ -115,6 +115,17 @@ def test_reconnect_mid_dance_replays_journal(tmp_path):
                     break
                 await asyncio.sleep(0.1)
             assert got["invoices"][0]["status"] == "paid"
+            # the payments row self-repairs on the replayed fulfill:
+            # the RPC saw a timeout, but the preimage arrived later
+            for _ in range(100):
+                pays = await rpc_call(a.rpc.rpc_path, "listpays")
+                mine = [p for p in pays["pays"]
+                        if p.get("bolt11") == inv["bolt11"]]
+                if mine and mine[0]["status"] == "complete":
+                    break
+                await asyncio.sleep(0.1)
+            assert mine and mine[0]["status"] == "complete"
+            assert "preimage" in mine[0]
             # and the channel still works both ways
             paid = await _pay(a, b, "post-replay")
             assert paid["status"] == "complete"
