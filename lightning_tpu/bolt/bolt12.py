@@ -183,10 +183,17 @@ def _paths_bytes(paths: list[BlindedPath]) -> bytes:
 
 
 def _paths_parse(v: bytes) -> list[BlindedPath]:
+    from .blindedpath import BlindedPathError
+
     out, off = [], 0
-    while off < len(v):
-        p, off = BlindedPath.parse(v, off)
-        out.append(p)
+    try:
+        while off < len(v):
+            p, off = BlindedPath.parse(v, off)
+            out.append(p)
+    except (BlindedPathError, IndexError) as e:
+        # attacker-controlled bytes: surface OUR error type, never the
+        # path codec's (callers catch Bolt12Error; fuzz finding)
+        raise Bolt12Error(f"bad blinded path: {e}") from None
     return out
 
 
