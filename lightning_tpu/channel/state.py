@@ -214,9 +214,14 @@ class ChannelCore:
     state: ChannelState = ChannelState.NORMAL
     htlcs: dict = field(default_factory=dict)  # (offered_by_us, id) -> LiveHtlc
     next_htlc_id: dict = field(default_factory=lambda: {True: 0, False: 0})
-    # pre-update_fee rate while the change is uncommitted (reverted by
-    # forget_uncommitted on reconnect; cleared once a commit covers it)
-    _fee_before_uncommitted: int | None = None
+    # pre-update_fee rate while the change is uncommitted, tagged with
+    # who sent the update_fee: (old_rate, from_local).  Reverted by
+    # forget_uncommitted on reconnect; cleared only by the commit that
+    # actually covers it — OUR send_commit for a fee we sent, the
+    # peer's commitment_signed for a fee we received.  A peer commit
+    # that merely CROSSED our outgoing update_fee does not cover it
+    # (same per-side rule as the HTLC state tables above).
+    _fee_before_uncommitted: tuple | None = None
 
     def __post_init__(self):
         if self.reserve_local_msat is None:
@@ -326,7 +331,7 @@ class ChannelCore:
         # change: an uncommitted update_fee is forgotten on reconnect
         # (BOLT#2), and forgetting must roll the rate back too
         if self._fee_before_uncommitted is None:
-            self._fee_before_uncommitted = self.feerate_per_kw
+            self._fee_before_uncommitted = (self.feerate_per_kw, from_local)
         self.feerate_per_kw = feerate_per_kw
 
     # -- commitment flow events -------------------------------------------
@@ -347,7 +352,9 @@ class ChannelCore:
 
     def send_commit(self) -> list[LiveHtlc]:
         changed = self._apply(_ON_SEND_COMMIT)
-        self._fee_before_uncommitted = None  # fee change now committed
+        if self._fee_before_uncommitted is not None \
+                and self._fee_before_uncommitted[1]:
+            self._fee_before_uncommitted = None  # our fee now committed
         if not changed:
             # BOLT#2: MUST NOT send commitment_signed with no changes —
             # callers decide; we surface it
@@ -360,7 +367,9 @@ class ChannelCore:
         return changed
 
     def recv_commit(self) -> list[LiveHtlc]:
-        self._fee_before_uncommitted = None  # fee change now committed
+        if self._fee_before_uncommitted is not None \
+                and not self._fee_before_uncommitted[1]:
+            self._fee_before_uncommitted = None  # their fee now committed
         return self._apply(_ON_RECV_COMMIT)
 
     def send_revoke(self) -> list[LiveHtlc]:
@@ -396,7 +405,7 @@ class ChannelCore:
                 # rolling back to the lowest dropped one is exact
                 self.next_htlc_id[by_us] = min(back)
         if self._fee_before_uncommitted is not None:
-            self.feerate_per_kw = self._fee_before_uncommitted
+            self.feerate_per_kw = self._fee_before_uncommitted[0]
             self._fee_before_uncommitted = None
         return dropped
 

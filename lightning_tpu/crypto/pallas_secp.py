@@ -268,12 +268,24 @@ def dual_mul_pallas(u1, u2, qx, qy, tile: int = 512,
     Returns a projective point as (B, 20) tuples."""
     from . import secp256k1 as S
 
-    B = u1.shape[0]
+    B0 = u1.shape[0]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if B % tile != 0:
-        tile = B if B < tile else max(
-            t for t in (128, 256, 512) if B % t == 0)
+    if B0 % tile != 0:
+        divs = [t for t in (128, 256, 512) if B0 % t == 0]
+        if B0 < tile:
+            tile = B0
+        elif divs:
+            tile = max(divs)
+        else:
+            # awkward batch (e.g. 600): pad to the next tile multiple
+            # with zeros — the RCB formulas are complete (no divisions),
+            # so garbage lanes are arithmetically safe — and slice the
+            # tail back off at the end.
+            pad = tile - (B0 % tile)
+            u1, u2, qx, qy = (jnp.pad(a, ((0, pad), (0, 0)))
+                              for a in (u1, u2, qx, qy))
+    B = u1.shape[0]
     d1 = jnp.flip(S._digits4(u1), axis=-1)   # (B, 64) MSB-first
     d2 = jnp.flip(S._digits4(u2), axis=-1)
     qtab = S._build_window(qx, qy)           # (B, 16, 3, NLIMBS)
@@ -292,4 +304,4 @@ def dual_mul_pallas(u1, u2, qx, qy, tile: int = 512,
         out_shape=[jax.ShapeDtypeStruct((NLIMBS, B), jnp.uint32)] * 3,
         interpret=interpret,
     )(qsx, qsy, qsz, gsx, gsy, gsz)
-    return ox.T, oy.T, oz.T
+    return ox.T[:B0], oy.T[:B0], oz.T[:B0]

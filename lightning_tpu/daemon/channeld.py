@@ -144,6 +144,11 @@ class Channeld:
         # can replay the exact bytes.
         self.retransmit: list[bytes] = []
         self.retransmit_sealed = False
+        # splice inflight (the reference's channel_funding_inflights):
+        # persisted BEFORE our tx_signatures leave the node, cleared
+        # only on splice_locked switch or proven non-broadcastability.
+        # JSON-able dict, see splice.py _make_inflight.
+        self.inflight: dict | None = None
 
     def attach_wallet(self, wallet, hsm_dbid: int) -> None:
         self.wallet = wallet
@@ -585,12 +590,18 @@ class Channeld:
             raise ChannelError("reestablish for unknown channel")
 
         # --- data-loss detection (we are the stale side) ---------------
-        if (theirs.next_commitment_number > self.next_remote_commit
-                or theirs.next_revocation_number > our_revealed):
+        # Park ONLY when the peer's next_revocation_number is ahead of
+        # what we have revealed: the proof at next_revocation_number-1 is
+        # then a secret we have NOT yet given out, so possessing it really
+        # does prove the peer saw a newer state (BOLT#2 option_data_loss_
+        # protect; channeld.c peer_reconnect).  An inflated
+        # next_commitment_number alone proves nothing — the secret at
+        # our_revealed-1 is public to the peer from normal operation, so
+        # accepting it here would let any peer freeze our funds remotely.
+        if theirs.next_revocation_number > our_revealed:
             proof = theirs.your_last_per_commitment_secret
             n_proof = theirs.next_revocation_number - 1
-            if n_proof >= 0 and proof == self.hsm.per_commitment_secret(
-                    self.client, n_proof):
+            if proof == self.hsm.per_commitment_secret(self.client, n_proof):
                 # peer proved it has state beyond ours: broadcasting our
                 # stale commitment would be a cheat — park and wait for
                 # THEIR unilateral close
@@ -601,6 +612,14 @@ class Channeld:
                     "unilateral close")
             raise ChannelError(
                 "peer claims state beyond ours without a valid proof")
+        if theirs.next_commitment_number > self.next_remote_commit:
+            # commitment-count ahead but revocation count normal: no
+            # possible honest history produces this without the peer also
+            # holding an unrevealed secret of ours — plain protocol error,
+            # never a park.
+            raise ChannelError(
+                "peer claims commitment number beyond ours without "
+                "matching revocation state")
         if theirs.next_commitment_number < self.next_remote_commit - 1 \
                 or theirs.next_revocation_number < our_revealed - 1:
             # the PEER lost more than one step: its own data-loss logic

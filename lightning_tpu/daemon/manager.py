@@ -29,6 +29,12 @@ class ManagerError(Exception):
     pass
 
 
+# channel states a reconnecting peer may reestablish into.  A hard crash
+# mid-splice leaves "awaiting_splice" + a persisted inflight; the
+# channel is still live on the old funding and must come back.
+_RESTORABLE = ("normal", "shutting_down", "awaiting_splice")
+
+
 class _DeadPeer:
     """Placeholder peer for channels restored only to arm onchaind —
     the counterparty is gone; no traffic will ever flow."""
@@ -209,6 +215,7 @@ class ChannelManager:
                 except CD.ChannelError as e:
                     log.warning("inbound reestablish failed: %s", e)
                     continue
+                await self._maybe_resume_splice(ch)
                 self._spawn_loop(ch)
             elif isinstance(first, WM.OpenChannel2):
                 from . import dualopend as DO
@@ -253,10 +260,34 @@ class ChannelManager:
         for row in self.wallet.list_channels():
             if row["channel_id"] == channel_id \
                     and row["peer_node_id"] == peer.node_id \
-                    and row["state"] in ("normal", "shutting_down"):
+                    and row["state"] in _RESTORABLE:
                 return CD.restore_channeld(self.wallet, row, peer,
                                            self.hsm)
         return None
+
+    async def _maybe_resume_splice(self, ch) -> None:
+        """Finish a splice whose inflight survived a crash between
+        tx_signatures and splice_locked (the reference re-arms
+        channel_funding_inflights at startup).  Runs BEFORE the channel
+        loop takes the single-consumer inbox.  A peer that does not
+        enter its own resume in time is not fatal: the inflight stays
+        persisted and the channel serves on the old funding."""
+        if getattr(ch, "inflight", None) is None:
+            return
+        from ..channel.state import ChannelState
+        from . import splice as SP
+        try:
+            await asyncio.wait_for(
+                SP.resume_splice(ch, chain_backend=self.chain_backend,
+                                 topology=self.topology), 60)
+            log.info("resumed splice for %s", ch.channel_id.hex()[:16])
+        except (asyncio.TimeoutError, CD.ChannelError,
+                ConnectionError) as e:
+            log.warning("splice resume for %s did not complete: %s",
+                        ch.channel_id.hex()[:16], e)
+            if ch.core.state is ChannelState.AWAITING_SPLICE:
+                ch.core.transition(ChannelState.NORMAL)
+                ch._persist()
 
     # -- reconnect lifecycle (connectd.c:86) ---------------------------
 
@@ -313,7 +344,7 @@ class ChannelManager:
             return True
         if self.wallet is not None:
             return any(r["peer_node_id"] == node_id
-                       and r["state"] in ("normal", "shutting_down")
+                       and r["state"] in _RESTORABLE
                        for r in self.wallet.list_channels())
         return False
 
@@ -330,7 +361,7 @@ class ChannelManager:
             return 0
         rows = [r for r in self.wallet.list_channels()
                 if r["peer_node_id"] == peer.node_id
-                and r["state"] in ("normal", "shutting_down")]
+                and r["state"] in _RESTORABLE]
         if len(rows) > 1:
             log.warning("peer %s has %d live channels; serving the first "
                         "(single-consumer inbox)", peer.node_id.hex()[:16],
@@ -347,6 +378,7 @@ class ChannelManager:
                 log.warning("reestablish with %s failed: %s",
                             peer.node_id.hex()[:16], e)
                 continue
+            await self._maybe_resume_splice(ch)
             self._spawn_loop(ch)
             return 1
         return 0
@@ -368,7 +400,7 @@ class ChannelManager:
                                          self.hsm)
                 self._arm_onchaind(ch)
                 continue
-            if row["state"] not in ("normal", "shutting_down"):
+            if row["state"] not in _RESTORABLE:
                 continue
             peer = self.node.peers.get(row["peer_node_id"])
             if peer is None:
@@ -380,6 +412,7 @@ class ChannelManager:
                 log.warning("reestablish failed for %s: %s",
                             row["channel_id"].hex()[:16], e)
                 continue
+            await self._maybe_resume_splice(ch)
             self._spawn_loop(ch)
             n += 1
         return n

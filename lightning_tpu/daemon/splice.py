@@ -64,9 +64,12 @@ def _staged(ch: Channeld, tx: T.Tx, fund_idx: int, new_sat: int):
 
 
 async def _inflight_commitments(ch: Channeld, tx: T.Tx, fund_idx: int,
-                                new_sat: int) -> None:
+                                new_sat: int) -> M.CommitmentSigned:
     """Sign/verify the inflight commitment pair on the NEW funding at
-    the current indices (no revocation — splice.c inflight rules)."""
+    the current indices (no revocation — splice.c inflight rules).
+    Returns the peer's commitment_signed: it must be PERSISTED before
+    our tx_signatures leave, or a crash loses the only signature that
+    lets us force-close on the new funding."""
     with _staged(ch, tx, fund_idx, new_sat):
         fsig, hsigs = await asyncio.to_thread(
             ch._sign_remote, ch.next_remote_commit - 1)
@@ -77,6 +80,29 @@ async def _inflight_commitments(ch: Channeld, tx: T.Tx, fund_idx: int,
         await asyncio.to_thread(
             ch._verify_local, ch.next_local_commit - 1, cs.signature,
             cs.htlc_signatures)
+        return cs
+
+
+def _make_inflight(ch: Channeld, tx: T.Tx, fund_idx: int, new_sat: int,
+                   our_add_sat: int, their_add_sat: int,
+                   cs: M.CommitmentSigned) -> None:
+    """Write-ahead the splice inflight (wallet_channel_insert_inflight
+    role): everything needed after a crash to recognise the splice tx on
+    chain, switch onto the new funding, or force-close on it with the
+    peer's inflight commitment signature."""
+    ch.inflight = {
+        "new_txid": tx.txid().hex(),
+        "fund_idx": fund_idx,
+        "new_sat": new_sat,
+        "our_add_sat": our_add_sat,
+        "their_add_sat": their_add_sat,
+        "their_commit_sig": cs.signature.hex(),
+        "their_htlc_sigs": [s.hex() for s in cs.htlc_signatures],
+        "tx": tx.serialize().hex(),   # updated with witnesses once signed
+        "ours_sent": False,           # our tx_signatures left the node
+        "signed": False,              # both sides' witnesses assembled
+    }
+    ch._persist()
 
 
 def _shared_input_sig(ch: Channeld, tx: T.Tx, shared_idx: int,
@@ -116,6 +142,11 @@ async def _exchange_sigs(ch: Channeld, tx: T.Tx, con: _Construction,
         stacks.extend(ws)
 
     async def send():
+        # write-ahead: once these bytes leave, the peer can complete the
+        # 2-of-2 and broadcast — the inflight must already be durable
+        if ch.inflight is not None:
+            ch.inflight["ours_sent"] = True
+            ch._persist()
         await ch.peer.send(M.TxSignatures(
             channel_id=ch.channel_id, txid=tx.txid(),
             witnesses=_pack_witnesses(stacks)))
@@ -142,6 +173,10 @@ async def _exchange_sigs(ch: Channeld, tx: T.Tx, con: _Construction,
         tx.inputs[1 + order.index(serial)].witness = stack
     for serial, stack in zip(my_serials, stacks[1:]):
         tx.inputs[1 + order.index(serial)].witness = stack
+    if ch.inflight is not None:
+        ch.inflight["tx"] = tx.serialize().hex()
+        ch.inflight["signed"] = True
+        ch._persist()
 
 
 def _sign_our_inputs_shifted(tx, con, our_inputs, my_serials, shift: int):
@@ -196,18 +231,26 @@ async def _locked_and_switch(ch: Channeld, tx: T.Tx, fund_idx: int,
     sl = await ch.peer.recv(M.SpliceLocked, timeout=RECV_TIMEOUT)
     if sl.splice_txid != tx.txid():
         raise SpliceError("splice_locked for wrong txid")
-    # the switch: channel now lives on the new funding
+    _switch_to(ch, tx.txid(), fund_idx, our_add_sat, their_add_sat)
+
+
+def _switch_to(ch: Channeld, txid: bytes, fund_idx: int,
+               our_add_sat: int, their_add_sat: int) -> None:
+    """The switch: channel now lives on the new funding; the inflight is
+    consumed in the SAME persisted snapshot."""
     new_sat = ch.funding_sat + our_add_sat + their_add_sat
-    ch.funding_txid = tx.txid()
+    ch.funding_txid = txid
     ch.funding_outidx = fund_idx
     ch.funding_sat = new_sat
     ch.core.funding_sat = new_sat
     ch.core.to_local_msat += our_add_sat * 1000
     ch.core.to_remote_msat += their_add_sat * 1000
-    ch.core.transition(ChannelState.NORMAL)
+    if ch.core.state is not ChannelState.NORMAL:
+        ch.core.transition(ChannelState.NORMAL)
+    ch.inflight = None
     ch._persist()
     log.info("channel %s spliced to %d sat (txid %s)",
-             ch.channel_id.hex()[:16], new_sat, tx.txid().hex()[:16])
+             ch.channel_id.hex()[:16], new_sat, txid.hex()[:16])
 
 
 SPLICE_FEERATE = 1000
@@ -267,7 +310,8 @@ async def splice_initiate(ch: Channeld, add_sat: int,
             raise SpliceError("funding output amount mismatch")
 
         old_sat = ch.funding_sat
-        await _inflight_commitments(ch, tx, fund_idx, new_sat)
+        cs = await _inflight_commitments(ch, tx, fund_idx, new_sat)
+        _make_inflight(ch, tx, fund_idx, new_sat, add_sat, their_add, cs)
         await _exchange_sigs(ch, tx, con, inputs, my_serials,
                              shared_idx=0, old_sat=old_sat,
                              we_initiate=True)
@@ -283,10 +327,47 @@ async def splice_initiate(ch: Channeld, add_sat: int,
 def _rollback_splice_state(ch: Channeld) -> None:
     """A failed splice must not strand the channel in AWAITING_SPLICE —
     the old funding is untouched, so NORMAL operation (and close) must
-    keep working."""
+    keep working.
+
+    The inflight is dropped ONLY if our tx_signatures never left the
+    node: the peer then lacks our half of the 2-of-2 on the old funding,
+    so the splice tx is provably unbroadcastable.  Once `ours_sent`, the
+    peer may broadcast at any time — the inflight record (new outpoint +
+    peer's inflight commitment sig) must survive until the splice either
+    locks in (resume_splice) or its input is spent another way."""
+    if ch.inflight is not None and not ch.inflight.get("ours_sent"):
+        ch.inflight = None
     if ch.core.state is ChannelState.AWAITING_SPLICE:
         ch.core.transition(ChannelState.NORMAL)
-        ch._persist()
+    ch._persist()
+
+
+async def resume_splice(ch: Channeld, chain_backend=None, topology=None,
+                        min_depth: int = 1) -> T.Tx | None:
+    """Complete a splice from a persisted inflight after a crash between
+    tx_signatures and splice_locked (the reference re-arms inflights
+    from channel_funding_inflights on startup).  Call after the channel
+    is restored and reestablished.  Rebroadcasts the fully-signed splice
+    tx if we hold it, waits for depth, re-runs the splice_locked
+    exchange, and switches onto the new funding."""
+    inf = ch.inflight
+    if inf is None:
+        return None
+    tx = T.Tx.parse(bytes.fromhex(inf["tx"]))
+    if chain_backend is not None and inf.get("signed"):
+        # idempotent: already-known/confirmed tx errors are fine
+        await chain_backend.sendrawtransaction(tx.serialize())
+    if topology is not None:
+        while topology.depth(tx.txid()) < min_depth:
+            await asyncio.sleep(0.05)
+    await ch.peer.send(M.SpliceLocked(channel_id=ch.channel_id,
+                                      splice_txid=tx.txid()))
+    sl = await ch.peer.recv(M.SpliceLocked, timeout=RECV_TIMEOUT)
+    if sl.splice_txid != tx.txid():
+        raise SpliceError("splice_locked for wrong txid")
+    _switch_to(ch, tx.txid(), inf["fund_idx"],
+               inf["our_add_sat"], inf["their_add_sat"])
+    return tx
 
 
 async def splice_accept(ch: Channeld, first_stfu: M.Stfu,
@@ -321,7 +402,9 @@ async def splice_accept(ch: Channeld, first_stfu: M.Stfu,
             raise SpliceError("funding output amount mismatch")
 
         old_sat = ch.funding_sat
-        await _inflight_commitments(ch, tx, fund_idx, new_sat)
+        cs = await _inflight_commitments(ch, tx, fund_idx, new_sat)
+        _make_inflight(ch, tx, fund_idx, new_sat, contribute_sat,
+                       si.funding_contribution_satoshis, cs)
         await _exchange_sigs(ch, tx, con, inputs, my_serials,
                              shared_idx=0, old_sat=old_sat,
                              we_initiate=False)

@@ -138,6 +138,13 @@ MIGRATIONS: list[str] = [
     # retransmission; channeld.c peer_reconnect).  Format: 1 sealed
     # byte + repeated [u32-be length][raw wire msg].
     "ALTER TABLE channels ADD COLUMN retransmit BLOB NOT NULL DEFAULT x''",
+    # 13: splice inflight — persisted BEFORE our tx_signatures leave, so
+    # a crash between signature exchange and splice_locked can never
+    # lose the new funding outpoint or the peer's inflight commitment
+    # signature (the reference's channel_funding_inflights table,
+    # wallet/wallet.c wallet_channel_insert_inflight).  JSON blob; empty
+    # = no inflight.
+    "ALTER TABLE channels ADD COLUMN inflight BLOB NOT NULL DEFAULT x''",
 ]
 
 
@@ -207,16 +214,22 @@ class Db:
         pend = getattr(self._local, "pending_writes", None)
         if not pend:
             return
+        # The lock spans hook delivery so streams leave in version
+        # order, and the in-memory counter is only advanced AFTER the
+        # hook accepts: a vetoing (raising) hook rolls back the
+        # transaction including the vars row, and the next committed
+        # transaction must reuse this version number — a skipped number
+        # would desync the replica's lock-step counter forever.
         with self._version_lock:
-            self._data_version += 1
-            version = self._data_version
-        conn.execute(
-            "INSERT INTO vars (name, val) VALUES ('data_version', ?) "
-            "ON CONFLICT(name) DO UPDATE SET val=excluded.val",
-            (str(version),))
-        batch = list(self._local.pending_writes)
-        self._local.pending_writes = []
-        self.db_write_hook(version, batch)
+            version = self._data_version + 1
+            conn.execute(
+                "INSERT INTO vars (name, val) VALUES ('data_version', ?) "
+                "ON CONFLICT(name) DO UPDATE SET val=excluded.val",
+                (str(version),))
+            batch = list(self._local.pending_writes)
+            self._local.pending_writes = []
+            self.db_write_hook(version, batch)
+            self._data_version = version
 
     def _migrate(self) -> None:
         c = self.conn

@@ -88,6 +88,8 @@ class Wallet:
             their_shutdown_script=ch.their_shutdown_script,
             retransmit=_pack_retransmit(ch.retransmit_sealed,
                                         ch.retransmit),
+            inflight=(json.dumps(ch.inflight).encode()
+                      if getattr(ch, "inflight", None) else b""),
         )
         with self.db.transaction() as c:
             if getattr(ch, "wallet_id", None) is None:
@@ -164,6 +166,8 @@ class Wallet:
         ch.their_shutdown_script = row["their_shutdown_script"]
         ch.retransmit_sealed, ch.retransmit = _unpack_retransmit(
             row.get("retransmit") or b"")
+        raw_inflight = row.get("inflight") or b""
+        ch.inflight = json.loads(raw_inflight) if raw_inflight else None
         ch.core = ChannelCore(
             funding_sat=row["funding_sat"],
             to_local_msat=row["to_local_msat"],

@@ -178,6 +178,60 @@ def test_lost_revoke_and_ack(tmp_path):
     run(phase2())
 
 
+def test_inflated_commitment_number_is_not_data_loss(tmp_path):
+    """A malicious peer inflating next_commitment_number while its
+    next_revocation_number (and thus its 'proof' secret) matches what we
+    already revealed must NOT park us in AWAITING_UNILATERAL: that secret
+    is public to every peer from normal operation, so it proves nothing.
+    Plain ChannelError, state untouched (round-3 advisor high finding)."""
+
+    async def phase1():
+        na, nb, wa, wb, ch_a, ch_b = await _open_pair(tmp_path)
+        hid = await ch_a.offer_htlc(10_000_000, PAYHASH, 500_000)
+        await ch_b.recv_update()
+        await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        await _teardown(na, nb, wa, wb)
+        return hid
+
+    run(phase1())
+
+    async def phase2():
+        na, nb, wa, wb, ch_a, ch_b = await _restore_pair(tmp_path)
+        state_before = ch_a.core.state
+
+        orig = ch_b.peer.send
+
+        async def send(msg):
+            if isinstance(msg, M.ChannelReestablish):
+                # lie: claim 5 commitments beyond reality, but with the
+                # honest revocation count + the honestly-known secret
+                msg.next_commitment_number += 5
+            await orig(msg)
+
+        ch_b.peer.send = send
+
+        async def a_side():
+            with pytest.raises(CD.ChannelError) as ei:
+                await ch_a.reestablish()
+            assert not isinstance(ei.value, CD.DataLossError)
+
+        async def b_side():
+            try:
+                await ch_b.reestablish()
+            except Exception:
+                pass
+
+        await asyncio.gather(a_side(), b_side())
+        # funds-freeze refused: no park, nothing persisted as parked
+        assert ch_a.core.state is not ChannelState.AWAITING_UNILATERAL
+        assert ch_a.core.state is state_before
+        assert wa.list_channels()[0]["state"] != "awaiting_unilateral"
+        await _teardown(na, nb, wa, wb)
+
+    run(phase2())
+
+
 def test_data_loss_protection(tmp_path):
     """Restore one side from a STALE snapshot (two dances behind): the
     stale side must verify the peer's proof, refuse to broadcast, and

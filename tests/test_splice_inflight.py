@@ -1,0 +1,149 @@
+"""Splice inflight durability: a crash between tx_signatures and
+splice_locked must not lose the new funding outpoint or the peer's
+inflight commitment signature — either side may still broadcast the
+fully-signed splice tx.  Models the reference's
+channel_funding_inflights write-ahead (wallet/wallet.c
+wallet_channel_insert_inflight) and its startup re-arm.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lightning_tpu.btc import tx as T  # noqa: E402
+from lightning_tpu.channel.state import ChannelState  # noqa: E402
+from lightning_tpu.crypto import ref_python as ref  # noqa: E402
+from lightning_tpu.daemon import dualopend as DO  # noqa: E402
+from lightning_tpu.daemon import splice as SP  # noqa: E402
+from lightning_tpu.wire import messages as M  # noqa: E402
+from test_reestablish import (FUND, SendCrash, _open_pair,  # noqa: E402
+                              _restore_pair, _teardown, crash_on_send, run)
+
+ADD = 500_000
+
+
+def funding_input(salt: int, amount_sat: int) -> DO.FundingInput:
+    privkey = int.from_bytes(bytes([salt]) * 32, "big") % ref.N or 7
+    pub = ref.pubkey_serialize(ref.pubkey_create(privkey))
+    h = hashlib.new("ripemd160", hashlib.sha256(pub).digest()).digest()
+    prev = T.Tx(
+        inputs=[T.TxInput(txid=bytes([salt + 1]) * 32, vout=0)],
+        outputs=[T.TxOutput(amount_sat=amount_sat,
+                            script_pubkey=b"\x00\x14" + h)],
+    )
+    return DO.FundingInput(prevtx=prev, vout=0, privkey=privkey)
+
+
+def test_splice_inflight_survives_crash(tmp_path):
+    """Crash BOTH sides at the splice_locked send (after tx_signatures
+    are exchanged): the persisted inflight must survive restart, and
+    resume_splice must complete the switch onto the new funding."""
+
+    async def phase1():
+        na, nb, wa, wb, ch_a, ch_b = await _open_pair(tmp_path)
+        crash_on_send(ch_a.peer, M.SpliceLocked)
+        crash_on_send(ch_b.peer, M.SpliceLocked)
+
+        async def a_side():
+            with pytest.raises(SendCrash):
+                await SP.splice_initiate(
+                    ch_a, ADD, [funding_input(0x51, ADD + 2_000)])
+
+        async def b_side():
+            stfu = await ch_b.peer.recv(M.Stfu, timeout=60)
+            with pytest.raises(SendCrash):
+                await SP.splice_accept(ch_b, stfu)
+
+        await asyncio.gather(a_side(), b_side())
+        # write-ahead held: both sides persisted a SIGNED inflight
+        for w in (wa, wb):
+            raw = w.list_channels()[0]["inflight"]
+            assert raw, "inflight lost"
+            inf = json.loads(raw)
+            assert inf["ours_sent"] and inf["signed"]
+            assert inf["new_sat"] == FUND + ADD
+            assert len(bytes.fromhex(inf["their_commit_sig"])) == 64
+        await _teardown(na, nb, wa, wb)
+
+    run(phase1())
+
+    async def phase2():
+        na, nb, wa, wb, ch_a, ch_b = await _restore_pair(tmp_path)
+        assert ch_a.inflight is not None and ch_b.inflight is not None
+        await asyncio.gather(ch_a.reestablish(), ch_b.reestablish())
+        txs = await asyncio.gather(
+            SP.resume_splice(ch_a), SP.resume_splice(ch_b))
+        assert txs[0].txid() == txs[1].txid()
+        for ch in (ch_a, ch_b):
+            assert ch.inflight is None
+            assert ch.funding_sat == FUND + ADD
+            assert ch.funding_txid == txs[0].txid()
+            assert ch.core.state is ChannelState.NORMAL
+        assert ch_a.core.to_local_msat == (FUND + ADD) * 1000 \
+            - ch_a.core.to_remote_msat
+        # the switch snapshot consumed the inflight in the db too
+        assert not wa.list_channels()[0]["inflight"]
+        assert not wb.list_channels()[0]["inflight"]
+        # channel still works after the resumed splice
+        preimage = b"\x66" * 32
+        payhash = hashlib.sha256(preimage).digest()
+        hid = await ch_a.offer_htlc(25_000_000, payhash, 500_000)
+        await ch_b.recv_update()
+        await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        await ch_b.fulfill_htlc(hid, preimage)
+        await ch_a.recv_update()
+        await asyncio.gather(ch_b.commit(), ch_a.handle_commit())
+        await asyncio.gather(ch_a.commit(), ch_b.handle_commit())
+        assert ch_b.core.to_local_msat == 25_000_000
+        await _teardown(na, nb, wa, wb)
+
+    run(phase2())
+
+
+def test_aborted_splice_inflight_disposition(tmp_path):
+    """Initiator 'crashes' at its tx_signatures send: its write-ahead
+    already marked ours_sent (a crash after the TCP write must be
+    indistinguishable), so ITS inflight survives; the acceptor, whose
+    signatures provably never left, must drop its inflight — the splice
+    tx can never be assembled by anyone.  Both channels stay NORMAL on
+    the old funding."""
+
+    async def body():
+        na, nb, wa, wb, ch_a, ch_b = await _open_pair(tmp_path)
+        crash_on_send(ch_a.peer, M.TxSignatures)
+
+        async def b_side():
+            stfu = await ch_b.peer.recv(M.Stfu, timeout=60)
+            await SP.splice_accept(ch_b, stfu)
+
+        b_task = asyncio.create_task(b_side())
+        with pytest.raises(SendCrash):
+            await SP.splice_initiate(
+                ch_a, ADD, [funding_input(0x52, ADD + 2_000)])
+        await asyncio.sleep(0.1)
+        b_task.cancel()
+        try:
+            await b_task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+        # A: conservative keep (ours_sent marked pre-send, unsigned)
+        inf_a = json.loads(wa.list_channels()[0]["inflight"])
+        assert inf_a["ours_sent"] and not inf_a["signed"]
+        # B: provably unbroadcastable -> dropped
+        assert ch_b.inflight is None
+        assert not wb.list_channels()[0]["inflight"]
+        for ch in (ch_a, ch_b):
+            assert ch.core.state is ChannelState.NORMAL
+            assert ch.funding_sat == FUND
+        await _teardown(na, nb, wa, wb)
+
+    run(body())
