@@ -186,6 +186,10 @@ class ChainTopology:
                 await self._call(cb, tx, h, height - h + 1)
         for cb in self._block_cbs:
             await self._call(cb, height, block)
+        from ..utils import events
+
+        events.emit("block_added", {"height": height,
+                                    "hash": bhash.hex()})
 
     async def _remove_tip(self) -> None:
         """chaintopology.c:1050 remove_tip: rewind one block."""

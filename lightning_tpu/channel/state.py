@@ -237,7 +237,14 @@ class ChannelCore:
     def transition(self, new: ChannelState):
         if new not in _LIFECYCLE[self.state]:
             raise ChannelError(f"illegal transition {self.state} → {new}")
-        self.state = new
+        old, self.state = self.state, new
+        from ..utils import events
+
+        # channel_state_changed notification (lightningd/notification.c;
+        # notify_tag is set by channeld once the channel_id exists)
+        events.emit("channel_state_changed", {
+            "channel_id": getattr(self, "notify_tag", None),
+            "old_state": old.name, "new_state": new.name})
 
     # -- HTLC add/remove --------------------------------------------------
 

@@ -261,6 +261,214 @@ def _select_shared_planes(tab, digits_msb):
     return sel[0], sel[1], sel[2]
 
 
+def _shape_batch(u1, u2, qx, qy, tile: int):
+    """Shared batch-shaping for every pallas engine: pick a supported
+    tile or pad the batch to the next tile multiple (zeros are safe —
+    the RCB formulas are complete, no divisions).  Returns the possibly
+    padded operands + the tile; callers slice outputs back to B0."""
+    B0 = u1.shape[0]
+    if B0 % tile != 0:
+        divs = [t for t in (128, 256, 512) if B0 % t == 0]
+        if B0 < tile:
+            tile = B0
+        elif divs:
+            tile = max(divs)
+        else:
+            pad = tile - (B0 % tile)
+            u1, u2, qx, qy = (jnp.pad(a, ((0, pad), (0, 0)))
+                              for a in (u1, u2, qx, qy))
+    return u1, u2, qx, qy, tile
+
+
+def _dual_mul_kernel_v2(d2, qtx, qty, qtz, gsx, gsy, gsz, ox, oy, oz):
+    """v2 grid step: the per-element Q window table lives in VMEM for
+    the whole window scan (its BlockSpec index is constant across the
+    window grid dim, so Mosaic fetches it ONCE per batch tile) and the
+    16-way selection happens in-kernel.  This removes the dominant HBM
+    cost of v1 — streaming three pre-selected (64, NLIMBS, B) Q planes,
+    ~120 KB/element — and replaces it with a one-time ~4 KB/element
+    table fetch.  G selection stays in XLA: its planes are shared-table
+    picks and stream at 1/8 the Q volume."""
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _init():
+        shape = ox.shape
+        row = lax.broadcasted_iota(jnp.uint32, shape, 0)
+        ox[...] = jnp.zeros(shape, jnp.uint32)
+        oy[...] = jnp.where(row == 0, jnp.uint32(1), jnp.uint32(0))
+        oz[...] = jnp.zeros(shape, jnp.uint32)
+
+    acc = (ox[...], oy[...], oz[...])
+    for _ in range(4):                       # WINDOW doublings
+        acc = point_doubleT(acc)
+    acc = point_addT(acc, _sel16T(d2[...], qtx, qty, qtz))
+    acc = point_addT(acc, (gsx[0], gsy[0], gsz[0]))
+    ox[...], oy[...], oz[...] = acc
+
+
+def dual_mul_pallas_v2(u1, u2, qx, qy, tile: int = 512,
+                       interpret: bool | None = None):
+    """v2 of dual_mul_pallas: identical math, in-kernel Q-table
+    selection (see _dual_mul_kernel_v2).  Same drop-in signature."""
+    from . import secp256k1 as S
+
+    B0 = u1.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    u1, u2, qx, qy, tile = _shape_batch(u1, u2, qx, qy, tile)
+    B = u1.shape[0]
+    d1 = jnp.flip(S._digits4(u1), axis=-1)   # (B, 64) MSB-first
+    d2 = jnp.flip(S._digits4(u2), axis=-1).astype(jnp.uint32)
+    qtab = S._build_window(qx, qy)           # (B, 16, 3, NLIMBS)
+    qt = jnp.transpose(qtab, (1, 2, 3, 0))   # (16, 3, NLIMBS, B)
+    gtab = jnp.asarray(S._g_window_proj())   # (16, 3, NLIMBS)
+    gsx, gsy, gsz = _select_shared_planes(gtab, d1)
+
+    nb = B // tile
+    tab_spec = pl.BlockSpec((16, NLIMBS, tile), lambda b, w: (0, 0, b))
+    dig_spec = pl.BlockSpec((1, tile), lambda b, w: (w, b))
+    g_spec = pl.BlockSpec((1, NLIMBS, tile), lambda b, w: (w, 0, b))
+    out_spec = pl.BlockSpec((NLIMBS, tile), lambda b, w: (0, b))
+    ox, oy, oz = pl.pallas_call(
+        _dual_mul_kernel_v2,
+        grid=(nb, 64),
+        in_specs=[dig_spec] + [tab_spec] * 3 + [g_spec] * 3,
+        out_specs=[out_spec] * 3,
+        out_shape=[jax.ShapeDtypeStruct((NLIMBS, B), jnp.uint32)] * 3,
+        interpret=interpret,
+    )(d2.T, qt[:, 0], qt[:, 1], qt[:, 2], gsx, gsy, gsz)
+    return ox.T[:B0], oy.T[:B0], oz.T[:B0]
+
+
+def _sel16T(d, tx, ty, tz):
+    """In-kernel 16-way one-hot select: d (1, tile) digits against three
+    (16, NLIMBS, tile) table coords → a (NLIMBS, tile) point."""
+    sx = sy = sz = None
+    for v in range(16):
+        m = (d == jnp.uint32(v)).astype(jnp.uint32)   # (1, tile)
+        ax, ay, az = tx[v] * m, ty[v] * m, tz[v] * m
+        sx = ax if sx is None else sx + ax
+        sy = ay if sy is None else sy + ay
+        sz = az if sz is None else sz + az
+    return sx, sy, sz
+
+
+def _dual_mul_kernel_glv(d2l, d2h, qlx, qly, qlz, qhx, qhy, qhz,
+                         g1x, g1y, g1z, g2x, g2y, g2z, ox, oy, oz):
+    """GLV grid step (33 windows instead of 64): acc = 16·acc + Qlo_sel
+    + Qhi_sel + Glo + Ghi.  Both per-element tables (Q and φQ, signs
+    pre-applied in XLA) are VMEM-resident across the whole scan; the
+    pre-selected/pre-signed G planes stream.  Pure arithmetic — no signs
+    or φ in-kernel."""
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _init():
+        shape = ox.shape
+        row = lax.broadcasted_iota(jnp.uint32, shape, 0)
+        ox[...] = jnp.zeros(shape, jnp.uint32)
+        oy[...] = jnp.where(row == 0, jnp.uint32(1), jnp.uint32(0))
+        oz[...] = jnp.zeros(shape, jnp.uint32)
+
+    acc = (ox[...], oy[...], oz[...])
+    for _ in range(4):
+        acc = point_doubleT(acc)
+    acc = point_addT(acc, _sel16T(d2l[...], qlx, qly, qlz))
+    acc = point_addT(acc, _sel16T(d2h[...], qhx, qhy, qhz))
+    acc = point_addT(acc, (g1x[0], g1y[0], g1z[0]))
+    acc = point_addT(acc, (g2x[0], g2y[0], g2z[0]))
+    ox[...], oy[...], oz[...] = acc
+
+
+def _select_signed_shared_planes(tab32, digits_msb):
+    """Signed shared table (32, 3, NLIMBS) — entries 16..31 are the
+    Y-negated twins — selected by digit+16·sign → three (W, NLIMBS, B)
+    planes."""
+    nv = tab32.shape[0]
+    oh = (digits_msb[..., None]
+          == jnp.arange(nv, dtype=digits_msb.dtype)).astype(jnp.uint32)
+    sel = jnp.einsum("bwv,vcl->cwlb", oh, tab32,
+                     preferred_element_type=jnp.uint32)
+    return sel[0], sel[1], sel[2]
+
+
+@functools.lru_cache(maxsize=2)
+def _signed_g_tables():
+    """(32, 3, NLIMBS) signed window tables for G and φ(G): entry v is
+    v·P, entry 16+v is v·(-P) (Y negated mod p, exact host ints)."""
+    from . import ref_python as ref
+    from .glv import _g_phi_window_proj
+
+    from . import secp256k1 as S
+
+    def signed(tab16):
+        out = np.zeros((32, 3, NLIMBS), np.uint32)
+        out[:16] = tab16
+        out[16:] = tab16
+        for v in range(1, 16):
+            y = F.limbs_to_int(tab16[v, 1])
+            out[16 + v, 1] = F.int_to_limbs((ref.P - y) % ref.P)
+        return out
+
+    return signed(S._g_window_proj()), signed(_g_phi_window_proj())
+
+
+def dual_mul_pallas_glv(u1, u2, qx, qy, tile: int = 512,
+                        interpret: bool | None = None):
+    """GLV + fused-kernel dual mul: 33-window scan, VMEM-resident signed
+    Q/φQ tables, streamed signed G planes.  Drop-in for dual_mul."""
+    from . import glv as GLV
+    from . import secp256k1 as S
+
+    B0 = u1.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    u1, u2, qx, qy, tile = _shape_batch(u1, u2, qx, qy, tile)
+    B = u1.shape[0]
+
+    m1l, s1l, m1h, s1h = GLV.split(u1)
+    m2l, s2l, m2h, s2h = GLV.split(u2)
+    d1l = jnp.flip(GLV.digits4(m1l), axis=-1)     # (B, 33) MSB-first
+    d1h = jnp.flip(GLV.digits4(m1h), axis=-1)
+    d2l = jnp.flip(GLV.digits4(m2l), axis=-1).astype(jnp.uint32)
+    d2h = jnp.flip(GLV.digits4(m2h), axis=-1).astype(jnp.uint32)
+
+    # per-element tables with φ and signs pre-applied (XLA side)
+    qtab = S._build_window(qx, qy)                # (B, 16, 3, NLIMBS)
+    tx, ty, tz = qtab[:, :, 0], qtab[:, :, 1], qtab[:, :, 2]
+    beta = jnp.asarray(F.int_to_limbs(GLV.BETA))
+    ty_neg = F.sub(F.FP, jnp.zeros_like(ty), ty)
+    ty_lo = jnp.where(s2l[:, None, None], ty_neg, ty)
+    ty_hi = jnp.where(s2h[:, None, None], ty_neg, ty)
+    tx_hi = F.mul(F.FP, tx, beta)
+    to_planes = lambda a: jnp.transpose(a, (1, 2, 0))   # (16, NLIMBS, B)
+    qlo = (to_planes(tx), to_planes(ty_lo), to_planes(tz))
+    qhi = (to_planes(tx_hi), to_planes(ty_hi), to_planes(tz))
+
+    gt, gpt = _signed_g_tables()
+    sd1l = d1l + 16 * s1l[:, None].astype(d1l.dtype)
+    sd1h = d1h + 16 * s1h[:, None].astype(d1h.dtype)
+    g1 = _select_signed_shared_planes(jnp.asarray(gt), sd1l)
+    g2 = _select_signed_shared_planes(jnp.asarray(gpt), sd1h)
+
+    nb = B // tile
+    ndw = GLV.NDIGITS_GLV
+    tab_spec = pl.BlockSpec((16, NLIMBS, tile), lambda b, w: (0, 0, b))
+    dig_spec = pl.BlockSpec((1, tile), lambda b, w: (w, b))
+    g_spec = pl.BlockSpec((1, NLIMBS, tile), lambda b, w: (w, 0, b))
+    out_spec = pl.BlockSpec((NLIMBS, tile), lambda b, w: (0, b))
+    ox, oy, oz = pl.pallas_call(
+        _dual_mul_kernel_glv,
+        grid=(nb, ndw),
+        in_specs=[dig_spec] * 2 + [tab_spec] * 6 + [g_spec] * 6,
+        out_specs=[out_spec] * 3,
+        out_shape=[jax.ShapeDtypeStruct((NLIMBS, B), jnp.uint32)] * 3,
+        interpret=interpret,
+    )(d2l.T, d2h.T, *qlo, *qhi, *g1, *g2)
+    return ox.T[:B0], oy.T[:B0], oz.T[:B0]
+
+
 def dual_mul_pallas(u1, u2, qx, qy, tile: int = 512,
                     interpret: bool | None = None):
     """Drop-in twin of secp256k1.dual_mul: u1·G + u2·Q, batched.
@@ -271,20 +479,7 @@ def dual_mul_pallas(u1, u2, qx, qy, tile: int = 512,
     B0 = u1.shape[0]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if B0 % tile != 0:
-        divs = [t for t in (128, 256, 512) if B0 % t == 0]
-        if B0 < tile:
-            tile = B0
-        elif divs:
-            tile = max(divs)
-        else:
-            # awkward batch (e.g. 600): pad to the next tile multiple
-            # with zeros — the RCB formulas are complete (no divisions),
-            # so garbage lanes are arithmetically safe — and slice the
-            # tail back off at the end.
-            pad = tile - (B0 % tile)
-            u1, u2, qx, qy = (jnp.pad(a, ((0, pad), (0, 0)))
-                              for a in (u1, u2, qx, qy))
+    u1, u2, qx, qy, tile = _shape_batch(u1, u2, qx, qy, tile)
     B = u1.shape[0]
     d1 = jnp.flip(S._digits4(u1), axis=-1)   # (B, 64) MSB-first
     d2 = jnp.flip(S._digits4(u2), axis=-1)

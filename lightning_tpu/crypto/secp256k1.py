@@ -436,9 +436,37 @@ def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:
     return np.pad(a, [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
 
 
-@functools.lru_cache(maxsize=2)
-def _jit_verify():
-    return jax.jit(ecdsa_verify_kernel)
+def resolve_dual_mul(name: str | None = None):
+    """Select the u1·G+u2·Q engine by name (or the
+    LIGHTNING_TPU_DUAL_MUL env var).  Variants, all bit-identical
+    (tests pin them to the exact-int oracle):
+
+      xla        — the 64-window lax.scan below
+      glv        — GLV endomorphism split, 33-window scan (crypto.glv)
+      pallas     — fused Mosaic kernel, streamed pre-selected planes
+      pallas_v2  — fused kernel, VMEM-resident tables
+      pallas_glv — GLV + VMEM-resident tables (fewest HBM bytes + FLOPs)
+    """
+    import os
+
+    name = name or os.environ.get("LIGHTNING_TPU_DUAL_MUL", "glv")
+    if name in ("xla", "scan"):
+        return None                      # kernel default
+    if name == "glv":
+        from .glv import dual_mul_glv
+        return dual_mul_glv
+    from . import pallas_secp as PS
+
+    return {"pallas": PS.dual_mul_pallas,
+            "pallas_v2": PS.dual_mul_pallas_v2,
+            "pallas_glv": PS.dual_mul_pallas_glv}[name]
+
+
+@functools.lru_cache(maxsize=8)
+def _jit_verify(impl_name: str | None = None):
+    impl = resolve_dual_mul(impl_name)
+    return jax.jit(functools.partial(ecdsa_verify_kernel,
+                                     dual_mul_impl=impl))
 
 
 def ecdsa_verify_batch(msg_hashes: np.ndarray, sigs64: np.ndarray,

@@ -197,6 +197,15 @@ async def amain(args) -> int:
         RPC.attach_core_commands(rpc, node, gossmap_ref,
                                  stop_event=stop_event,
                                  manager=manager, topology=topology)
+        RPC.attach_utility_commands(rpc, node, hsm=hsm,
+                                    topology=topology, relay=relay_svc,
+                                    wallet=wallet, gossipd=gossipd)
+        # forward every notification topic to opted-in rpc clients
+        # (lightningd `notifications` command semantics)
+        from ..utils import events as _evbridge
+
+        _evbridge.subscribe_all(
+            lambda t, p, _r=rpc: _r.notify_clients(t, p))
         if manager is not None:
             from .manager import attach_manager_commands
 
@@ -260,10 +269,93 @@ async def amain(args) -> int:
         rune_secret = _hl.sha256(
             b"commando" + node_seckey.to_bytes(32, "big")).digest()[:16]
         commando = Commando(node, rpc, rune_secret)
-        attach_commando_commands(rpc, commando)
+        attach_commando_commands(rpc, commando, db=db)
 
         await rpc.start()
         print(f"rpc ready {rpc_path}", flush=True)
+
+        # plugin host (lightningd/plugin.c spawn + plugin_control.c
+        # `plugin` command): external processes reached over stdio
+        # JSON-RPC, their rpcmethods proxied into this server, hooks
+        # fired from the live paths via daemon.hooks
+        from ..plugins.host import PluginHost
+        from ..utils import events as EV
+
+        plugin_host = PluginHost(rpc=rpc, init_options=dict(
+            getattr(args.cfg, "plugin_options", {}) or {}),
+            lightning_dir=args.data_dir or ".", rpc_file=rpc_path)
+        node.plugin_host = plugin_host
+
+        def _bridge(topic, payload, _h=plugin_host):
+            _h.notify(topic, payload)
+
+        EV.subscribe_all(_bridge)
+
+        def _rearm_db_write(_p=None):
+            """Stream committed transactions to db_write subscribers.
+            On-loop writes (the norm: channeld persists from the event
+            loop) are delivered as an ordered async stream; off-loop
+            writes get synchronous veto semantics — the reference's
+            hook is fully synchronous because its daemon is
+            single-threaded, which an asyncio node cannot replicate
+            without deadlocking the loop on its own plugin pipe."""
+            if db is None:
+                return
+            if not plugin_host.hooks.get("db_write"):
+                if db.db_write_hook is not None and \
+                        getattr(db.db_write_hook, "_plugin_bridge", False):
+                    db.set_db_write_hook(None)
+                return
+            loop = asyncio.get_running_loop()
+
+            def _db_write(version, batch, _h=plugin_host):
+                coro = _h.call_hook("db_write", {
+                    "data_version": version,
+                    "writes": [sql for sql, _ in batch]})
+                try:
+                    asyncio.get_running_loop()
+                    loop.create_task(coro)
+                except RuntimeError:
+                    res = asyncio.run_coroutine_threadsafe(
+                        coro, loop).result(30)
+                    if isinstance(res, dict) and \
+                            res.get("result") == "fail":
+                        raise RuntimeError("db_write vetoed by plugin")
+
+            _db_write._plugin_bridge = True
+            db.set_db_write_hook(_db_write)
+
+        plugin_host.on_crash = _rearm_db_write
+
+        async def plugin_cmd(subcommand: str = "list",
+                             plugin: str | None = None) -> dict:
+            if subcommand == "start":
+                if not plugin:
+                    raise ValueError("plugin start needs a path")
+                await plugin_host.start_plugin(plugin)
+                _rearm_db_write()
+            elif subcommand == "stop":
+                if not plugin:
+                    raise ValueError("plugin stop needs a name")
+                await plugin_host.stop_plugin(plugin)
+                _rearm_db_write()
+            elif subcommand != "list":
+                raise ValueError(f"unknown subcommand {subcommand!r}")
+            return {"plugins": [
+                {"name": p.name, "active": p.alive,
+                 "dynamic": p.manifest.dynamic}
+                for p in plugin_host.plugins.values()]}
+
+        rpc.register("plugin", plugin_cmd)
+
+        for ppath in (args.plugin or []):
+            try:
+                await plugin_host.start_plugin(ppath)
+                print(f"plugin {ppath} active", flush=True)
+            except Exception as e:
+                print(f"plugin {ppath} failed: {type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
+        _rearm_db_write()
 
         if args.rest_port is not None:
             from .rest import RestServer
@@ -323,6 +415,11 @@ async def amain(args) -> int:
         await stop_event.wait()
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
+    from ..utils import events as _EV
+
+    _EV.emit("shutdown", {})
+    if node.plugin_host is not None:
+        await node.plugin_host.close()
     if rpc is not None:
         await rpc.close()
     if wss is not None:
@@ -356,6 +453,10 @@ def main() -> int:
     p.add_argument("--rpc-file", default=None,
                    help="unix socket path for JSON-RPC (default: "
                         "<data-dir>/lightning-rpc)")
+    p.add_argument("--plugin", action="append", default=[],
+                   metavar="PATH",
+                   help="spawn an executable plugin at startup "
+                        "(repeatable; lightningd --plugin semantics)")
     p.add_argument("--gossip-store", default=None,
                    help="gossip_store file to build the routing graph from")
     p.add_argument("--bitcoind-rpc", default=None,

@@ -35,6 +35,7 @@ from ..channel.state import (
 )
 from ..crypto import ref_python as ref
 from ..wire import messages as M
+from . import hooks as HK
 from .hsmd import CAP_SIGN_COMMITMENT, Hsm, HsmClient
 from .peer import Peer
 
@@ -827,6 +828,7 @@ async def open_exchange_funding(ch: Channeld, funding_txid: bytes,
     ch.funding_txid = funding_txid
     ch.funding_outidx = funding_outidx
     ch.channel_id = derive_channel_id(funding_txid, funding_outidx)
+    ch.core.notify_tag = ch.channel_id.hex()
     fsig, hsigs = await asyncio.to_thread(ch._sign_remote, 0)
     assert not hsigs  # no HTLCs at open
     await ch.peer.send(M.FundingCreated(
@@ -873,6 +875,10 @@ async def open_lockin(ch: Channeld, topology=None, wallet=None,
         "account": "channel", "tag": "channel_open",
         "credit_msat": ch.core.to_local_msat,
         "reference": ch.channel_id.hex()})
+    events.emit("channel_opened", {
+        "id": ch.peer.node_id.hex(), "channel_id": ch.channel_id.hex(),
+        "funding_msat": ch.funding_sat * 1000,
+        "funding_txid": ch.funding_txid.hex()})
 
 
 async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
@@ -884,6 +890,20 @@ async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
     cfg = cfg or ChannelConfig()
     oc = first_msg if first_msg is not None else \
         await peer.recv(M.OpenChannel, timeout=RECV_TIMEOUT)
+    # openchannel hook (lightningd/opening_control.c openchannel_hook):
+    # plugins may reject an inbound v1 open before we commit any state
+    if HK.active(peer, "openchannel"):
+        hres = await HK.call(peer, "openchannel", {"openchannel": {
+            "id": peer.node_id.hex(),
+            "funding_satoshis": oc.funding_satoshis,
+            "push_msat": oc.push_msat,
+            "dust_limit_satoshis": oc.dust_limit_satoshis,
+            "feerate_per_kw": oc.feerate_per_kw,
+            "to_self_delay": oc.to_self_delay,
+        }})
+        if hres.get("result") == "reject":
+            raise ChannelError("open rejected by plugin: "
+                               + str(hres.get("error_message", "")))
     ch = Channeld(peer, hsm, client, funder=False, cfg=cfg)
     ch.their_base = _parse_basepoints(oc)
     ch.their_funding_pub = oc.funding_pubkey
@@ -922,6 +942,7 @@ async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
     ch.funding_outidx = fc.funding_output_index
     ch.channel_id = derive_channel_id(fc.funding_txid,
                                       fc.funding_output_index)
+    ch.core.notify_tag = ch.channel_id.hex()
     # their sig is on OUR initial commitment
     await asyncio.to_thread(ch._verify_local, 0, fc.signature, [])
     fsig, hsigs = await asyncio.to_thread(ch._sign_remote, 0)
@@ -946,6 +967,12 @@ async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
         ch._persist()
     log.info("channel %s open (fundee), capacity %d sat",
              ch.channel_id.hex()[:16], oc.funding_satoshis)
+    from ..utils import events
+
+    events.emit("channel_opened", {
+        "id": peer.node_id.hex(), "channel_id": ch.channel_id.hex(),
+        "funding_msat": ch.funding_sat * 1000,
+        "funding_txid": ch.funding_txid.hex()})
     return ch
 
 
@@ -963,7 +990,7 @@ FINAL_INCORRECT_CLTV_EXPIRY = 18
 
 
 def classify_incoming(lh, node_privkey: int, invoices=None,
-                      blockheight: int = 0):
+                      blockheight: int = 0, ctx: dict | None = None):
     """Peel an incoming HTLC's onion and decide its fate
     (plugins/keysend.c + lightningd/invoice.c `invoice_payment` +
     lightningd/peer_htlcs.c semantics).
@@ -995,6 +1022,8 @@ def classify_incoming(lh, node_privkey: int, invoices=None,
         # sphinx-level failure: no shared secret exists to encrypt with —
         # BOLT#2 says report it as malformed with the onion's hash
         return ("malformed", INVALID_ONION_HMAC)
+    if ctx is not None:
+        ctx["shared_secret"] = peeled_raw.shared_secret
     try:
         payload = OP.HopPayload.parse(peeled_raw.payload)
         if peeled_raw.is_final != payload.is_final:
@@ -1005,6 +1034,8 @@ def classify_incoming(lh, node_privkey: int, invoices=None,
         failmsg = INVALID_ONION_PAYLOAD.to_bytes(2, "big")
         return ("fail", SX.create_error_onion(peeled_raw.shared_secret,
                                               failmsg))
+    if ctx is not None:
+        ctx["payload"] = payload
 
     if not payload.is_final:
         nxt = (peeled_raw.next_packet.serialize()
@@ -1274,13 +1305,81 @@ async def channel_loop(ch: Channeld, node_privkey: int,
                 if (by_us or lh.preimage is not None
                         or lh.fail_reason is not None or hid in handled):
                     continue
+                hctx: dict = {}
                 verdict, data = classify_incoming(lh, node_privkey,
-                                                  invoices)
+                                                  invoices, ctx=hctx)
+                # htlc_accepted hook (plugin_hook.h:118; hooks fire for
+                # every decodable incoming HTLC and may resolve with a
+                # preimage, fail with a BOLT#4 failure_message, or
+                # continue).  Malformed onions never reach plugins.
+                ss_hook = hctx.get("shared_secret")
+                if verdict != "malformed" \
+                        and HK.active(ch.peer, "htlc_accepted"):
+                    pl = hctx.get("payload")
+                    hres = await HK.call(ch.peer, "htlc_accepted", {
+                        "htlc": {
+                            "id": hid,
+                            "amount_msat": lh.htlc.amount_msat,
+                            "cltv_expiry": lh.htlc.cltv_expiry,
+                            "payment_hash": lh.htlc.payment_hash.hex(),
+                        },
+                        "onion": {
+                            "forward_msat": getattr(
+                                pl, "amt_to_forward_msat", None),
+                            "outgoing_cltv_value": getattr(
+                                pl, "outgoing_cltv", None),
+                            "short_channel_id": getattr(
+                                pl, "short_channel_id", None),
+                            "shared_secret": ss_hook.hex()
+                            if ss_hook else None,
+                        },
+                    })
+                    try:
+                        if hres.get("result") == "resolve" \
+                                and hres.get("payment_key"):
+                            pk = bytes.fromhex(hres["payment_key"])
+                            if len(pk) != 32:
+                                raise ValueError("payment_key not 32B")
+                            verdict, data = "fulfill", pk
+                        elif hres.get("result") == "fail":
+                            # default = the reference's hook fallback,
+                            # temporary_node_failure (NODE|2): carries
+                            # no data fields, so a bare code is valid
+                            fm = bytes.fromhex(
+                                hres.get("failure_message") or "2002")
+                            data = SX.create_error_onion(ss_hook, fm)
+                            verdict = "fail"
+                    except (ValueError, TypeError) as e:
+                        # malformed plugin output must not kill the
+                        # channel loop; treat as continue
+                        log.warning("htlc_accepted hook returned "
+                                    "malformed result: %s", e)
                 try:
                     if verdict == "fulfill":
+                        settle_invoice = (
+                            invoices is not None
+                            and lh.htlc.payment_hash in invoices.by_hash)
+                        if settle_invoice \
+                                and HK.active(ch.peer, "invoice_payment"):
+                            # invoice.c invoice_payment_hook: plugins may
+                            # reject BEFORE the preimage is released
+                            ires = await HK.call(
+                                ch.peer, "invoice_payment", {
+                                "payment": {
+                                    "preimage": data.hex(),
+                                    "msat": lh.htlc.amount_msat,
+                                    "payment_hash":
+                                        lh.htlc.payment_hash.hex(),
+                                }})
+                            if ires.get("result") == "reject":
+                                await ch.fail_htlc(
+                                    hid, SX.create_error_onion(
+                                        ss_hook, _unknown_details(lh)))
+                                resolved = True
+                                handled.add(hid)
+                                continue
                         await ch.fulfill_htlc(hid, data)
-                        if invoices is not None and \
-                                lh.htlc.payment_hash in invoices.by_hash:
+                        if settle_invoice:
                             invoices.settle(lh.htlc.payment_hash,
                                             lh.htlc.amount_msat)
                         else:

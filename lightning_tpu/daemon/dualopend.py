@@ -405,6 +405,22 @@ async def accept_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
     our_inputs = our_inputs or []
     oc = first_msg if first_msg is not None else \
         await peer.recv(M.OpenChannel2, timeout=RECV_TIMEOUT)
+    # openchannel2 hook (dualopend → lightningd openchannel2_hook):
+    # plugins may reject, or bid their own contribution (funder plugin
+    # semantics — the reference's funder implements its policy THROUGH
+    # this hook)
+    from . import hooks as HK
+
+    if HK.active(peer, "openchannel2"):
+        hres = await HK.call(peer, "openchannel2", {"openchannel2": {
+            "id": peer.node_id.hex(),
+            "their_funding_msat": oc.funding_satoshis * 1000,
+            "feerate_per_kw": oc.funding_feerate_perkw,
+            "to_self_delay": oc.to_self_delay,
+        }})
+        if hres.get("result") == "reject":
+            raise DualOpenError("open rejected by plugin: "
+                                + str(hres.get("error_message", "")))
     in_total = sum(fi.amount_sat for fi in our_inputs)
     if in_total < contribute_sat:
         raise DualOpenError("inputs do not cover contribution")

@@ -63,6 +63,9 @@ class ChannelManager:
         self.funder_policy = funder_policy
         # channel_id -> (Channeld, loop task)
         self.channels: dict[bytes, tuple] = {}
+        # peer_id -> Channeld awaiting fundchannel_complete
+        self._pending_opens: dict[bytes, object] = {}
+        self._bg_tasks: set = set()   # strong refs for spawned tasks
         self._next_dbid = 1
         self._load_next_dbid()
 
@@ -272,22 +275,39 @@ class ChannelManager:
         loop takes the single-consumer inbox.  A peer that does not
         enter its own resume in time is not fatal: the inflight stays
         persisted and the channel serves on the old funding."""
-        if getattr(ch, "inflight", None) is None:
+        inf = getattr(ch, "inflight", None)
+        if inf is None:
             return
         from ..channel.state import ChannelState
         from . import splice as SP
+
+        # an UNSIGNED inflight can only complete if the PEER holds the
+        # fully-signed tx and broadcasts it — without a chain view we
+        # could never see that, and sending splice_locked for an
+        # unconfirmed tx the peer may not know is a protocol violation
+        if not inf.get("signed") and self.topology is None:
+            return
+        attempts = inf.get("resume_attempts", 0)
+        if attempts >= 3:
+            # likely a dead splice (peer provably dropped its side);
+            # keep the record for forensics but stop burning reconnects
+            log.info("splice inflight for %s parked after %d failed "
+                     "resumes", ch.channel_id.hex()[:16], attempts)
+            return
         try:
             await asyncio.wait_for(
                 SP.resume_splice(ch, chain_backend=self.chain_backend,
-                                 topology=self.topology), 60)
+                                 topology=self.topology),
+                60 if inf.get("signed") else 10)
             log.info("resumed splice for %s", ch.channel_id.hex()[:16])
         except (asyncio.TimeoutError, CD.ChannelError,
                 ConnectionError) as e:
             log.warning("splice resume for %s did not complete: %s",
                         ch.channel_id.hex()[:16], e)
+            inf["resume_attempts"] = attempts + 1
             if ch.core.state is ChannelState.AWAITING_SPLICE:
                 ch.core.transition(ChannelState.NORMAL)
-                ch._persist()
+            ch._persist()
 
     # -- reconnect lifecycle (connectd.c:86) ---------------------------
 
@@ -440,6 +460,83 @@ class ChannelManager:
         return {"channel_id": ch.channel_id.hex(),
                 "funding_txid": ch.funding_txid.hex(),
                 "outnum": ch.funding_outidx}
+
+    # -- split-phase v1 open (lightningd/opening_control.c
+    #    json_fundchannel_start/complete/cancel): the CALLER constructs
+    #    and broadcasts the funding tx; we only see its outpoint --------
+
+    async def fundchannel_start(self, peer_id: bytes, amount_sat: int,
+                                push_msat: int = 0) -> dict:
+        from ..btc import address as ADDR
+        from ..btc import script as SC
+
+        peer = self.node.peers.get(peer_id)
+        if peer is None:
+            raise ManagerError(f"peer {peer_id.hex()[:16]} not connected")
+        if peer_id in self._pending_opens:
+            raise ManagerError("open already in progress with this peer")
+        dbid = self._next_dbid
+        self._next_dbid += 1
+        client = self.hsm.client(CAP_MASTER, peer_id, dbid=dbid)
+        ch = await CD.open_negotiate(peer, self.hsm, client,
+                                     int(amount_sat), push_msat=push_msat)
+        ch._fcs_dbid = dbid
+        spk = SC.p2wsh(ch._funding_script())
+        self._pending_opens[peer_id] = ch
+        return {"funding_address": ADDR.from_scriptpubkey(spk),
+                "scriptpubkey": spk.hex(),
+                "warning_usage": "fundchannel_complete before "
+                                 "broadcasting, or funds may be lost"}
+
+    async def fundchannel_complete(self, peer_id: bytes,
+                                   psbt: str) -> dict:
+        import base64
+
+        from ..btc import script as SC
+        from ..btc.psbt import Psbt
+
+        ch = self._pending_opens.get(peer_id)
+        if ch is None:
+            raise ManagerError("no open in progress with this peer")
+        tx = Psbt.parse(base64.b64decode(psbt)).tx
+        spk = SC.p2wsh(ch._funding_script())
+        matches = [i for i, o in enumerate(tx.outputs)
+                   if o.script_pubkey == spk]
+        if len(matches) != 1:
+            raise ManagerError(
+                f"psbt has {len(matches)} outputs paying the funding "
+                "address (need exactly 1)")
+        await CD.open_exchange_funding(ch, tx.txid(), matches[0])
+        del self._pending_opens[peer_id]
+
+        async def _lockin():
+            try:
+                await CD.open_lockin(ch, topology=self.topology,
+                                     wallet=self.wallet,
+                                     hsm_dbid=ch._fcs_dbid)
+                self._spawn_loop(ch)
+            except Exception as e:
+                log.warning("fundchannel_start lockin failed for %s: %s",
+                            ch.channel_id.hex()[:16], e)
+
+        task = asyncio.get_running_loop().create_task(_lockin())
+        # asyncio holds only weak refs to tasks: anchor it or GC can
+        # drop the lockin mid-await (same pattern as node._peer_tasks)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return {"channel_id": ch.channel_id.hex(),
+                "commitments_secured": True}
+
+    async def fundchannel_cancel(self, peer_id: bytes) -> dict:
+        ch = self._pending_opens.pop(peer_id, None)
+        if ch is None:
+            raise ManagerError("no open in progress with this peer")
+        try:
+            await ch.peer.send_error(b"open cancelled",
+                                     ch._tmp_id)
+        except Exception:
+            pass
+        return {"cancelled": "Channel open canceled"}
 
     async def multifundchannel(self, destinations: list[dict]) -> dict:
         """Open channels to several peers from ONE funding transaction
@@ -925,6 +1022,75 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     async def listhtlcs() -> dict:
         return {"htlcs": mgr.listhtlcs()}
 
+    async def fundchannel_start(id: str, amount, push_msat: int = 0,
+                                announce: bool = True) -> dict:
+        return await mgr.fundchannel_start(bytes.fromhex(id), int(amount),
+                                           push_msat=int(push_msat))
+
+    async def fundchannel_complete(id: str, psbt: str) -> dict:
+        return await mgr.fundchannel_complete(bytes.fromhex(id), psbt)
+
+    async def fundchannel_cancel(id: str) -> dict:
+        return await mgr.fundchannel_cancel(bytes.fromhex(id))
+
+    async def renepay(invstring: str, amount_msat=None,
+                      retry_for: int = 60) -> dict:
+        """Pickhardt-payments front door: the reliability cost model is
+        folded into the shared MCF solver (routing/mcf.py), so renepay
+        rides the same engine as xpay."""
+        return await xpay(invstring, amount_msat=amount_msat,
+                          retry_for=retry_for)
+
+    async def renepaystatus(invstring: str | None = None) -> dict:
+        pays = mgr.listpays()
+        if invstring is not None:
+            pays = [p for p in pays if p.get("bolt11") == invstring]
+        return {"paystatus": pays}
+
+    async def createonion(hops: list, assocdata: str,
+                          session_key: str | None = None) -> dict:
+        """Build a sphinx onion from explicit per-hop payloads
+        (lightningd/pay.c json_createonion)."""
+        from ..bolt import sphinx as SX
+
+        sk = int(session_key, 16) if session_key \
+            else SX.random_session_key()
+        path = [bytes.fromhex(h["pubkey"]) for h in hops]
+        payloads = [bytes.fromhex(h["payload"]) for h in hops]
+        pkt, shared = SX.create_onion(path, payloads,
+                                      bytes.fromhex(assocdata), sk)
+        return {"onion": pkt.serialize().hex(),
+                "shared_secrets": [s.hex() for s in shared]}
+
+    async def sendonion(onion: str, first_hop: dict, payment_hash: str,
+                        amount_msat=None, shared_secrets: list
+                        | None = None) -> dict:
+        """Dispatch a caller-built onion (pay plugin's low-level door)."""
+        ph = bytes.fromhex(payment_hash)
+        first_id = bytes.fromhex(first_hop["id"])
+        ch = None
+        for cand, _t in mgr.channels.values():
+            if cand.peer.node_id == first_id:
+                ch = cand
+                break
+        if ch is None:
+            raise ManagerError("first hop is not a connected channel")
+        fut = asyncio.get_running_loop().create_future()
+        mgr._pending_sendpays = getattr(mgr, "_pending_sendpays", {})
+        mgr._pending_sendpays[ph] = fut
+        ch.peer.inbox.put_nowait(_PayCommand(
+            amount_msat=int(first_hop["amount_msat"]),
+            payment_hash=ph, cltv_expiry=int(first_hop["delay"]),
+            onion=bytes.fromhex(onion), done=fut))
+        return {"payment_hash": payment_hash, "status": "pending"}
+
+    rpc.register("fundchannel_start", fundchannel_start)
+    rpc.register("fundchannel_complete", fundchannel_complete)
+    rpc.register("fundchannel_cancel", fundchannel_cancel)
+    rpc.register("renepay", renepay)
+    rpc.register("renepaystatus", renepaystatus)
+    rpc.register("createonion", createonion)
+    rpc.register("sendonion", sendonion)
     rpc.register("fundchannel", fundchannel)
     rpc.register("close", close)
     rpc.register("splice", splice)

@@ -46,6 +46,7 @@ class LightningNode:
         # connectd.c:86 schedule_reconnect_if_important)
         self.on_peer_gone = None
         self.addresses: dict[bytes, tuple[str, int]] = {}  # last good addr
+        self.plugin_host = None  # set by daemon assembly (hooks.py anchor)
         self._server: asyncio.AbstractServer | None = None
         self._peer_tasks: set[asyncio.Task] = set()
         self.closing = False
@@ -119,11 +120,32 @@ class LightningNode:
         if old is not None:
             # reference drops the old connection in favor of the new one
             await old.disconnect()
+        # peer_connected hook (connectd → lightningd peer_connected_hook,
+        # lightningd/peer_control.c): plugins may disconnect the peer
+        # before any channel machinery sees it
+        from . import hooks as HK
+
+        if HK.active(self, "peer_connected"):
+            hres = await HK.call(self, "peer_connected", {"peer": {
+                "id": node_id.hex(),
+                "direction": "in" if incoming else "out",
+                "features": their_features.hex()}})
+            if hres.get("result") == "disconnect":
+                await stream.send_msg(M.Error(
+                    channel_id=b"\x00" * 32,
+                    data=str(hres.get("error_message",
+                                      "rejected by plugin")).encode(),
+                ).serialize())
+                raise _InitError("peer rejected by plugin")
         peer = Peer(self, stream, node_id, their_features, incoming)
         self.peers[node_id] = peer
         peer.start_pump()
         log.info("peer %s %s", node_id.hex()[:16],
                  "connected in" if incoming else "connected out")
+        from ..utils import events
+
+        events.emit("connect", {"id": node_id.hex(),
+                                "direction": "in" if incoming else "out"})
         if self.on_peer is not None and incoming:
             task = asyncio.get_running_loop().create_task(self.on_peer(peer))
             self._peer_tasks.add(task)
@@ -149,6 +171,9 @@ class LightningNode:
     def _peer_gone(self, peer: Peer) -> None:
         if self.peers.get(peer.node_id) is peer:
             del self.peers[peer.node_id]
+            from ..utils import events
+
+            events.emit("disconnect", {"id": peer.node_id.hex()})
             if self.on_peer_gone is not None and not self.closing:
                 task = asyncio.get_running_loop().create_task(
                     self.on_peer_gone(peer))

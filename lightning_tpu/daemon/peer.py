@@ -166,7 +166,20 @@ class Peer:
                     f"unknown message type {t}".encode()
                 )
                 await self.disconnect()
-            return  # unknown odd: ignore
+                return
+            # unknown odd: custommsg hook + notification
+            # (lightningd custommsg_hook; sendcustommsg counterpart)
+            from . import hooks as HKP
+
+            if HKP.active(self, "custommsg"):
+                await HKP.call(self, "custommsg", {
+                    "peer_id": self.node_id.hex(),
+                    "payload": raw.hex()})
+            from ..utils import events as _ev
+
+            _ev.emit("custommsg", {"peer_id": self.node_id.hex(),
+                                   "payload": raw.hex()})
+            return
         try:
             msg = cls.parse(raw)
         except codec.WireError as e:

@@ -117,24 +117,32 @@ class Relay:
 
         entry = {
             "in_channel": getattr(in_ch, "scid", None),
+            "in_htlc_id": in_hid,
             "out_channel": payload.short_channel_id,
             "in_msat": inc.amount_msat, "out_msat": fwd_amt,
             "fee_msat": fee, "status": "offered",
             "payment_hash": inc.payment_hash.hex(),
         }
         self.forwards.append(entry)
+        from ..utils import events
+
+        events.emit("forward_event", dict(entry))
 
         def on_result(preimage: bytes | None = None,
                       downstream_reason: bytes | None = None,
                       local_code: int | None = None) -> None:
             from .channeld import _Resolve
 
+            from ..utils import events
+
             if preimage is not None:
                 entry["status"] = "settled"
+                events.emit("forward_event", dict(entry))
                 in_ch.peer.inbox.put_nowait(
                     _Resolve(in_hid, preimage=preimage))
                 return
             entry["status"] = "failed"
+            events.emit("forward_event", dict(entry))
             if downstream_reason is not None:
                 # add our obfuscation layer on the way back (BOLT#4
                 # returning-errors; onionreply wrap semantics)

@@ -57,6 +57,28 @@ def _sign_bulk(hashes: list[bytes], keys: list[int], rng,
     return out
 
 
+def make_signed_batch(n: int, rng: np.random.Generator | None = None):
+    """n signed channel_update-sized messages for kernel-only benches.
+    Returns (rows, n_blocks, sigs, pubs): rows are sha-padded signed
+    regions in the (n, MAX_BLOCKS*64) layout verify_items consumes."""
+    from ..utils import native
+    from .verify import MAX_BLOCKS
+
+    rng = rng or np.random.default_rng(0)
+    keys = _rand_scalars(rng, n)
+    pubs = S.derive_pubkeys(
+        np.stack([F.int_to_limbs(k) for k in keys]).astype(np.uint32))
+    msg_len = 130           # typical channel_update signed-region size
+    raw = rng.integers(0, 256, n * msg_len).astype(np.uint8)
+    offs = (np.arange(n, dtype=np.int64) * msg_len)
+    lens = np.full(n, msg_len, np.int64)
+    rows, nb = native.sha256_pack(raw, offs, lens, MAX_BLOCKS)
+    hashes = [_sha256d(raw[i * msg_len:(i + 1) * msg_len].tobytes())
+              for i in range(n)]
+    sigs = _sign_bulk(hashes, keys, rng, min(SIGN_BUCKET, max(64, n)))
+    return rows, nb, sigs, np.asarray(pubs)
+
+
 def make_network_store(
     path: str,
     n_channels: int,

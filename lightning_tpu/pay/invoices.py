@@ -155,6 +155,11 @@ class InvoiceRegistry:
         self.by_hash[payment_hash] = rec
         self.by_label[label] = rec
         self._save(rec)
+        from ..utils import events
+
+        events.emit("invoice_creation", {
+            "label": label, "amount_msat": amount_msat,
+            "payment_hash": payment_hash.hex()})
         return rec
 
     def create_bolt12(self, label: str, amount_msat: int,
@@ -248,6 +253,10 @@ class InvoiceRegistry:
             "account": "channel", "tag": "invoice",
             "credit_msat": amount_msat,
             "reference": payment_hash.hex(), "timestamp": rec.paid_at})
+        events.emit("invoice_payment", {
+            "label": rec.label, "msat": amount_msat,
+            "payment_hash": payment_hash.hex(),
+            "preimage": rec.preimage.hex()})
         if rec.local_offer_id is not None and self.on_bolt12_paid:
             self.on_bolt12_paid(rec.local_offer_id)
         self._signal()
@@ -321,6 +330,10 @@ class InvoiceRegistry:
         if self.db is not None:
             with self.db.transaction() as c:
                 c.execute("DELETE FROM invoices WHERE label=?", (label,))
+        from ..utils import events
+
+        events.emit("invoice_deleted", {
+            "label": label, "payment_hash": rec.payment_hash.hex()})
         self._signal()   # wake waiters so they see the deletion
         return rec.to_rpc()
 

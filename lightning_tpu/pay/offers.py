@@ -211,6 +211,17 @@ class OffersService:
         amount = invreq.amount_msat
         if amount is None:
             amount = (offer.amount_msat or 0) * (invreq.quantity or 1)
+        return self.mint_for_invreq(invreq, amount,
+                                    local_offer_id=invreq.offer.offer_id())
+
+    def mint_for_invreq(self, invreq: B12.InvoiceRequest, amount: int,
+                        label: str | None = None,
+                        local_offer_id: bytes | None = None
+                        ) -> B12.Invoice12:
+        """Mint + register a bolt12 invoice answering an invoice_request
+        — shared by the onion-message responder (make_invoice, offer
+        known+validated) and `sendinvoice` (out-of-band invreq with no
+        published offer; lightningd/invoicerequest.c json_sendinvoice)."""
         preimage = os.urandom(32)
         payment_hash = hashlib.sha256(preimage).digest()
         node_id = ref.pubkey_serialize(ref.pubkey_create(self.node_seckey))
@@ -228,9 +239,9 @@ class OffersService:
             blindedpay=[(0, 0, self.invoices.min_final_cltv, 0,
                          21_000_000 * 100_000_000 * 1000, b"")])
         inv.sign(self.node_seckey)
-        label = f"bolt12-{payment_hash[:8].hex()}"
+        label = label or f"bolt12-{payment_hash[:8].hex()}"
         self.invoices.create_bolt12(label, amount, payment_hash, preimage,
-                                    inv.encode(), invreq.offer.offer_id(),
+                                    inv.encode(), local_offer_id,
                                     payment_secret=cookie)
         return inv
 
@@ -411,10 +422,121 @@ def attach_offers_commands(rpc, service: OffersService,
                 "payment_hash": inv11.payment_hash.hex(),
                 "min_final_cltv_expiry": inv11.min_final_cltv}
 
+    async def decodepay(bolt11: str) -> dict:
+        """Deprecated alias kept for pre-`decode` tooling."""
+        return await decode(bolt11)
+
+    async def createinvoice(invstring: str, label: str,
+                            preimage: str) -> dict:
+        """Sign a caller-constructed BOLT11 with the node key and save
+        it under `label` with the caller's preimage
+        (lightningd/invoice.c json_createinvoice)."""
+        import hashlib as _h
+
+        from ..bolt import bolt11 as B11
+
+        pre = bytes.fromhex(preimage)
+        inv = B11.decode(invstring, check_sig=False)
+        if inv.payment_hash != _h.sha256(pre).digest():
+            raise ValueError("preimage does not match payment_hash")
+        signed = B11.encode(inv, invoices.node_seckey)
+        rec = invoices.create_bolt12(
+            label, inv.amount_msat, inv.payment_hash, pre, signed,
+            payment_secret=inv.payment_secret or b"",
+            expiry=max(1, inv.expires_at - int(__import__("time").time())))
+        return rec.to_rpc()
+
+    async def signinvoice(invstring: str) -> dict:
+        """Re-sign someone else's BOLT11 with OUR node key
+        (lightningd/invoice.c json_signinvoice)."""
+        from ..bolt import bolt11 as B11
+
+        inv = B11.decode(invstring, check_sig=False)
+        inv.payee = None   # recovered from the new signature
+        return {"bolt11": B11.encode(inv, invoices.node_seckey)}
+
+    # -- invoice_request family (reference: lightningd/invoicerequest.c
+    #    + plugins/offers: withdraw/refund flows) ------------------------
+    _invreqs: dict[bytes, dict] = {}
+
+    async def invoicerequest(amount_msat: int, description: str,
+                             issuer: str | None = None,
+                             label: str | None = None,
+                             single_use: bool = True) -> dict:
+        import hashlib as _h
+        import os as _os
+
+        from ..crypto import ref_python as _ref
+
+        payer_key = invoices.node_seckey
+        o = B12.Offer(description=description, issuer=issuer)
+        r = B12.InvoiceRequest(
+            offer=o, metadata=_os.urandom(16),
+            payer_id=_ref.pubkey_serialize(_ref.pubkey_create(payer_key)),
+            amount_msat=int(amount_msat))
+        r.sign(payer_key)
+        bolt12 = r.encode()
+        invreq_id = _h.sha256(r.serialize()).digest()
+        _invreqs[invreq_id] = {
+            "invreq_id": invreq_id.hex(), "bolt12": bolt12,
+            "active": True, "single_use": bool(single_use),
+            "used": False, "label": label}
+        return dict(_invreqs[invreq_id])
+
+    async def listinvoicerequests(invreq_id: str | None = None) -> dict:
+        rows = list(_invreqs.values())
+        if invreq_id is not None:
+            rows = [r for r in rows if r["invreq_id"] == invreq_id]
+        return {"invoicerequests": rows}
+
+    async def disableinvoicerequest(invreq_id: str) -> dict:
+        row = _invreqs.get(bytes.fromhex(invreq_id))
+        if row is None:
+            raise KeyError(f"unknown invoice_request {invreq_id}")
+        row["active"] = False
+        return dict(row)
+
+    async def sendinvoice(invreq: str, label: str,
+                          amount_msat: int | None = None) -> dict:
+        """Answer an out-of-band invoice_request with a freshly minted
+        BOLT12 invoice registered under `label` (the reference also
+        pushes it over onion messaging when the invreq carries a reply
+        path; an out-of-band string has none)."""
+        _hrp, raw = B12.decode_string(invreq)
+        req = B12.InvoiceRequest.parse(raw)
+        if not req.check_signature():
+            raise B12.Bolt12Error("bad invoice_request signature")
+        amount = int(amount_msat) if amount_msat is not None \
+            else req.amount_msat
+        if amount is None:
+            raise B12.Bolt12Error("invoice_request carries no amount")
+        inv12 = service.mint_for_invreq(req, amount, label=label)
+        return {"bolt12": inv12.encode(),
+                "payment_hash": inv12.payment_hash.hex(),
+                "amount_msat": inv12.amount_msat, "label": label}
+
+    async def sendonionmessage(node_ids: list,
+                               content: dict | None = None) -> dict:
+        """Send an onion message along a path of node ids; the first
+        must be a connected peer (lightningd/onion_message.c
+        json_sendonionmessage/injectonionmessage role)."""
+        path_nodes = [bytes.fromhex(n) for n in node_ids]
+        bp = BP.create_path(path_nodes,
+                            [BP.EncryptedData() for _ in path_nodes])
+        tlvs = {int(k): bytes.fromhex(v)
+                for k, v in (content or {}).items()}
+        ok = await service.messenger.send(bp, tlvs)
+        if not ok:
+            raise OffersError("first hop not connected")
+        return {"sent": True}
+
     for fn in (offer, listoffers, disableoffer, fetchinvoice, invoice,
                listinvoices, waitinvoice, waitanyinvoice, delinvoice,
-               decode):
+               decode, createinvoice, signinvoice, invoicerequest,
+               listinvoicerequests, disableinvoicerequest, sendinvoice,
+               sendonionmessage):
         rpc.register(fn.__name__, fn)
+    rpc.register("decodepay", decodepay, deprecated=True)
 
 
 def _direct_path(issuer_id: bytes) -> BP.BlindedPath:

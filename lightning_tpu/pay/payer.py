@@ -273,6 +273,10 @@ async def pay_mpp_direct(ch, invoice_str: str, parts: int = 2,
 
 def _record_payment(wallet, inv, bolt11_str, amount, amount_sent,
                     created) -> int | None:
+    from ..utils import events
+
+    events.emit("sendpay_created", {
+        "payment_hash": inv.payment_hash.hex(), "amount_msat": amount})
     if wallet is None:
         return None
     with wallet.db.transaction():
@@ -301,6 +305,12 @@ def _settle_payment(wallet, pay_id, preimage: bytes,
             events.emit("coin_movement", {
                 "account": "channel", "tag": "invoice_fee",
                 "debit_msat": fee, "reference": ref_hex})
+    from ..utils import events
+
+    events.emit("sendpay_success", {
+        "payment_hash": payment_hash.hex() if payment_hash else None,
+        "amount_msat": amount_msat, "amount_sent_msat": amount_sent_msat,
+        "status": "complete"})
     if wallet is None or pay_id is None:
         return
     with wallet.db.transaction():
@@ -311,6 +321,9 @@ def _settle_payment(wallet, pay_id, preimage: bytes,
 
 
 def _fail_payment(wallet, pay_id, why: str) -> None:
+    from ..utils import events
+
+    events.emit("sendpay_failure", {"status": "failed", "failure": why})
     if wallet is None or pay_id is None:
         return
     with wallet.db.transaction():

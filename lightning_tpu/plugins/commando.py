@@ -66,8 +66,16 @@ class Commando:
             rune.add_restriction(Restriction.from_str(r))
         return rune.encode()
 
+    # set by attach_commando_commands: fn(rune_str) -> bool.  Lives on
+    # the Commando object so the PEER command path enforces revocation
+    # too, not just the local checkrune RPC.
+    blacklist_check = None
+
     def check_rune(self, rune_str: str, method: str, params: dict,
                    peer_id: bytes) -> str | None:
+        if self.blacklist_check is not None \
+                and self.blacklist_check(rune_str):
+            return "blacklisted"
         try:
             rune = Rune.decode(rune_str)
         except RuneError as e:
@@ -222,17 +230,82 @@ def _err(code: int, message: str) -> dict:
     return {"error": {"code": code, "message": message}}
 
 
-def attach_commando_commands(rpc, commando: Commando) -> None:
+def attach_commando_commands(rpc, commando: Commando, db=None) -> None:
     """createrune / checkrune / commando RPC entries
-    (lightningd/runes.c + plugins/commando.c surfaces)."""
+    (lightningd/runes.c + plugins/commando.c surfaces).  `db` persists
+    the rune registry + blacklist across restarts."""
+
+    # created-rune registry (lightningd/runes.c keeps them in the db;
+    # persisted through the vars table when a db is attached so
+    # blacklists survive restarts and unique ids are never reused)
+    import json as _json
+
+    store: dict[int, dict] = {}
+    blacklist: list[tuple[int, int]] = []
+    if db is not None:
+        raw = db.get_var("runes")
+        if raw:
+            saved = _json.loads(raw)
+            store.update({int(k): v for k, v in saved["store"].items()})
+            blacklist.extend(tuple(b) for b in saved["blacklist"])
+
+    def _save() -> None:
+        if db is not None:
+            db.set_var("runes", _json.dumps(
+                {"store": store, "blacklist": blacklist}))
 
     async def createrune(restrictions: list[str] | None = None) -> dict:
         r = commando.create_rune(restrictions)
-        return {"rune": r, "unique_id": None}
+        uid = max(store, default=-1) + 1
+        store[uid] = {"rune": r, "unique_id": uid,
+                      "restrictions": restrictions or []}
+        _save()
+        return {"rune": r, "unique_id": uid}
+
+    async def showrunes(rune: str | None = None) -> dict:
+        rows = [dict(v, blacklisted=any(a <= k <= b
+                                        for a, b in blacklist))
+                for k, v in store.items()
+                if rune is None or v["rune"] == rune]
+        return {"runes": rows}
+
+    async def blacklistrune(start: int, end: int | None = None) -> dict:
+        blacklist.append((int(start), int(end if end is not None
+                                          else start)))
+        _save()
+        return {"blacklist": [{"start": a, "end": b}
+                              for a, b in blacklist]}
+
+    def _is_blacklisted(rune_str: str) -> bool:
+        """True for a blacklisted minted rune OR any restricted
+        derivative of one (derivation only ever APPENDS restrictions,
+        so the parent's restriction list is a prefix of the child's).
+        Note: blacklisting an unrestricted master rune therefore
+        revokes every rune — the only sound reading, since all runes
+        derive from it."""
+        try:
+            cand = [r.encode() for r in Rune.decode(rune_str).restrictions]
+        except Exception:
+            return False
+        for uid, v in store.items():
+            if not any(a <= uid <= b for a, b in blacklist):
+                continue
+            try:
+                prs = [r.encode()
+                       for r in Rune.decode(v["rune"]).restrictions]
+            except Exception:
+                continue
+            if cand[:len(prs)] == prs:
+                return True
+        return False
+
+    commando.blacklist_check = _is_blacklisted
 
     async def checkrune(rune: str, method: str = "",
                         params: dict | None = None,
                         nodeid: str = "") -> dict:
+        if _is_blacklisted(rune):
+            raise RpcError(COMMANDO_ERROR, "rune rejected: blacklisted")
         why = commando.check_rune(rune, method, params or {},
                                   bytes.fromhex(nodeid) if nodeid else b"")
         if why is not None:
@@ -251,3 +324,10 @@ def attach_commando_commands(rpc, commando: Commando) -> None:
     rpc.register("createrune", createrune)
     rpc.register("checkrune", checkrune)
     rpc.register("commando", commando_cmd)
+    rpc.register("showrunes", showrunes)
+    rpc.register("blacklistrune", blacklistrune)
+    # the commando plugin's pre-rename names (deprecated in the
+    # reference too: plugins/commando.c)
+    rpc.register("commando-rune", createrune, deprecated=True)
+    rpc.register("commando-listrunes", showrunes, deprecated=True)
+    rpc.register("commando-blacklist", blacklistrune, deprecated=True)

@@ -59,6 +59,35 @@ class Layers:
     disabled: set = field(default_factory=set)
     biases: dict = field(default_factory=dict)
     reserved: dict = field(default_factory=dict)
+    # askrene-inform-channel constraints: observed liquidity bounds
+    # (askrene/reserve.c constraint semantics): (scid, dir) ->
+    # {"max": msat|None, "min": msat|None, "ts": unix}.  `max` caps the
+    # usable capacity (a payment of max+1 failed there); `min` is
+    # advisory knowledge that at least that much passed.
+    knowledge: dict = field(default_factory=dict)
+
+    def inform(self, scid: int, direction: int, *,
+               max_msat: int | None = None, min_msat: int | None = None,
+               ts: float | None = None) -> None:
+        import time as _t
+
+        k = self.knowledge.setdefault(
+            (scid, direction), {"max": None, "min": None, "ts": 0})
+        if max_msat is not None:
+            k["max"] = max_msat if k["max"] is None \
+                else min(k["max"], max_msat)
+        if min_msat is not None:
+            k["min"] = min_msat if k["min"] is None \
+                else max(k["min"], min_msat)
+        k["ts"] = ts if ts is not None else _t.time()
+
+    def age(self, cutoff_ts: float) -> int:
+        """Drop constraints learned before cutoff (askrene-age)."""
+        old = [k for k, v in self.knowledge.items()
+               if v["ts"] < cutoff_ts]
+        for k in old:
+            del self.knowledge[k]
+        return len(old)
 
     def reserve(self, scid: int, direction: int, amount_msat: int) -> None:
         key = (scid, direction)
@@ -127,6 +156,15 @@ def build_arcs(g: Gossmap, amount_msat: int, layers: Layers | None = None,
                 (layers.reserved.get((int(s), d), 0) for s in g.scids[idx]),
                 np.int64, len(idx))
             cap = np.maximum(cap - res, 0)
+        if layers.knowledge:
+            def _kmax(s):
+                k = layers.knowledge.get((int(s), d))
+                m = None if k is None else k.get("max")
+                return (1 << 62) if m is None else m   # 0 IS a constraint
+
+            kmax = np.fromiter((_kmax(s) for s in g.scids[idx]),
+                               np.int64, len(idx))
+            cap = np.minimum(cap, kmax)
 
         fee_ppm = g.fee_ppm[d, idx].astype(np.float64)
         base = g.fee_base_msat[d, idx].astype(np.float64)
@@ -405,6 +443,44 @@ def attach_routing_commands(rpc, gossmap_ref: dict,
     per-channel bias/disable layers (askrene.c commands, flattened to a
     single default layer)."""
     layers = layers if layers is not None else Layers()
+    # named layers (askrene-create-layer ...); "" = the default layer
+    named: dict[str, Layers] = {"": layers}
+
+    def _layer(name: str | None) -> Layers:
+        if not name:
+            return layers
+        if name not in named:
+            from ..daemon.jsonrpc import RpcError
+
+            raise RpcError(-1, f"unknown layer {name!r}")
+        return named[name]
+
+    def _merged(names: list[str] | None) -> Layers:
+        """Union of the default layer and the requested named layers —
+        what getroutes actually solves against (askrene.c applies the
+        request's layer list on top of the base topology)."""
+        use = [layers] + [_layer(n) for n in (names or []) if n]
+        if len(use) == 1:
+            return layers
+        out = Layers()
+        for ly in use:
+            out.disabled |= ly.disabled
+            for k, v in ly.biases.items():
+                out.biases[k] = out.biases.get(k, 0) + v
+            for k, v in ly.reserved.items():
+                out.reserved[k] = out.reserved.get(k, 0) + v
+            for k, v in ly.knowledge.items():
+                cur = out.knowledge.get(k)
+                if cur is None:
+                    out.knowledge[k] = dict(v)
+                else:
+                    if v["max"] is not None:
+                        cur["max"] = v["max"] if cur["max"] is None \
+                            else min(cur["max"], v["max"])
+                    if v["min"] is not None:
+                        cur["min"] = v["min"] if cur["min"] is None \
+                            else max(cur["min"], v["min"])
+        return out
 
     def _map() -> Gossmap:
         g = gossmap_ref.get("map")
@@ -417,35 +493,104 @@ def attach_routing_commands(rpc, gossmap_ref: dict,
     async def getroutes_cmd(source: str, destination: str,
                             amount_msat: int, maxfee_msat: int | None = None,
                             final_cltv: int = 18,
-                            max_parts: int = MAX_PARTS) -> dict:
+                            max_parts: int = MAX_PARTS,
+                            layers: list | None = None) -> dict:
+        # the parameter shadows the attach-scope default Layers on
+        # purpose; _merged closes over the outer one
+        use = _merged(layers)
         res = getroutes(_map(), bytes.fromhex(source),
                         bytes.fromhex(destination), int(amount_msat),
-                        layers=layers, maxfee_msat=maxfee_msat,
+                        layers=use, maxfee_msat=maxfee_msat,
                         final_cltv=final_cltv, max_parts=max_parts)
         return res
 
-    async def askrene_reserve(path: list) -> dict:
+    async def askrene_reserve(path: list, layer: str = "") -> dict:
+        ly = _layer(layer)
         for h in path:
-            layers.reserve(scid_parse(h["short_channel_id"]),
-                           int(h["direction"]), int(h["amount_msat"]))
+            ly.reserve(scid_parse(h["short_channel_id"]),
+                       int(h["direction"]), int(h["amount_msat"]))
         return {"reserved": len(path)}
 
-    async def askrene_unreserve(path: list) -> dict:
+    async def askrene_unreserve(path: list, layer: str = "") -> dict:
+        ly = _layer(layer)
         for h in path:
-            layers.unreserve(scid_parse(h["short_channel_id"]),
-                             int(h["direction"]), int(h["amount_msat"]))
+            ly.unreserve(scid_parse(h["short_channel_id"]),
+                         int(h["direction"]), int(h["amount_msat"]))
         return {"unreserved": len(path)}
 
-    async def askrene_bias_channel(short_channel_id, bias: int) -> dict:
-        layers.biases[scid_parse(short_channel_id)] = float(bias)
-        return {"biases": len(layers.biases)}
+    async def askrene_bias_channel(short_channel_id, bias: int,
+                                   layer: str = "") -> dict:
+        _layer(layer).biases[scid_parse(short_channel_id)] = float(bias)
+        return {"biases": len(_layer(layer).biases)}
 
-    async def askrene_disable_channel(short_channel_id) -> dict:
-        layers.disabled.add(scid_parse(short_channel_id))
-        return {"disabled": len(layers.disabled)}
+    async def askrene_disable_channel(short_channel_id,
+                                      layer: str = "") -> dict:
+        _layer(layer).disabled.add(scid_parse(short_channel_id))
+        return {"disabled": len(_layer(layer).disabled)}
 
-    rpc.register("getroutes", getroutes_cmd)
-    rpc.register("askrene-reserve", askrene_reserve)
-    rpc.register("askrene-unreserve", askrene_unreserve)
-    rpc.register("askrene-bias-channel", askrene_bias_channel)
-    rpc.register("askrene-disable-channel", askrene_disable_channel)
+    async def askrene_create_layer(layer: str,
+                                   persistent: bool = False) -> dict:
+        if not layer:
+            raise ValueError("layer name required")
+        if layer not in named:
+            named[layer] = Layers()
+        return {"layers": [{"layer": layer, "persistent": persistent}]}
+
+    async def askrene_remove_layer(layer: str) -> dict:
+        if layer == "":
+            raise ValueError("cannot remove the default layer")
+        named.pop(layer, None)
+        return {}
+
+    async def askrene_listlayers(layer: str | None = None) -> dict:
+        names = [layer] if layer else list(named)
+        out = []
+        for n in names:
+            ly = _layer(n)
+            out.append({
+                "layer": n,
+                "disabled_channels": len(ly.disabled),
+                "biases": len(ly.biases),
+                "constraints": len(ly.knowledge),
+                "reservations": len(ly.reserved)})
+        return {"layers": out}
+
+    async def askrene_inform_channel(short_channel_id, direction: int,
+                                     layer: str = "",
+                                     amount_msat: int | None = None,
+                                     inform: str = "unconstrained") -> dict:
+        """Record observed liquidity (askrene.c json_askrene_inform_
+        channel): `constrained` = amount failed there (caps capacity),
+        `unconstrained` = amount passed, `succeeded` = flow settled."""
+        ly = _layer(layer)
+        scid = scid_parse(short_channel_id)
+        if inform == "constrained":
+            ly.inform(scid, int(direction),
+                      max_msat=max(0, int(amount_msat or 0) - 1))
+        elif inform in ("unconstrained", "succeeded"):
+            ly.inform(scid, int(direction), min_msat=int(amount_msat or 0))
+        else:
+            raise ValueError(f"unknown inform mode {inform!r}")
+        return {"constraints": [{
+            "short_channel_id_dir": f"{short_channel_id}/{direction}",
+            **{k: v for k, v in
+               ly.knowledge[(scid, int(direction))].items()
+               if k != "ts"}}]}
+
+    async def askrene_age(layer: str = "", cutoff: float = 0) -> dict:
+        removed = _layer(layer).age(float(cutoff))
+        return {"layer": layer, "num_removed": removed}
+
+    for name, fn in [
+        ("getroutes", getroutes_cmd),
+        ("askrene-reserve", askrene_reserve),
+        ("askrene-unreserve", askrene_unreserve),
+        ("askrene-bias-channel", askrene_bias_channel),
+        ("askrene-disable-channel", askrene_disable_channel),
+        ("askrene-create-layer", askrene_create_layer),
+        ("askrene-remove-layer", askrene_remove_layer),
+        ("askrene-listlayers", askrene_listlayers),
+        ("askrene-inform-channel", askrene_inform_channel),
+        ("askrene-age", askrene_age),
+    ]:
+        rpc.register(name, fn)

@@ -184,6 +184,398 @@ COMMANDS: dict[str, dict] = {
         "params": {},
         "result": {"result": "str"},
     },
+    "help": {
+        "params": {},
+        "result": {"help": "list"},
+    },
+    "check": {
+        "params": {"command_to_check": "str"},
+        "result": {"command_to_check": "str"},
+    },
+    "notifications": {
+        "params": {"enable": "bool?"},
+        "result": {},
+    },
+    "deprecations": {
+        "params": {"enable": "bool?"},
+        "result": {},
+    },
+    "disconnect": {
+        "params": {"id": "hex", "force": "bool?"},
+        "result": {},
+    },
+    "sendcustommsg": {
+        "params": {"node_id": "hex", "msg": "hex"},
+        "result": {"status": "str"},
+    },
+    "waitblockheight": {
+        "params": {"blockheight": "int", "timeout": "int?"},
+        "result": {"blockheight": "int"},
+    },
+    "feerates": {
+        "params": {"style": "str?"},
+        "result": {"perkw": "dict"},
+    },
+    "parsefeerate": {
+        "params": {"feerate_string": "any"},
+        "result": {"perkw": "int"},
+    },
+    "signmessage": {
+        "params": {"message": "str"},
+        "result": {"signature": "hex", "recid": "hex", "zbase": "str"},
+    },
+    "checkmessage": {
+        "params": {"message": "str", "zbase": "str", "pubkey": "hex?"},
+        "result": {"pubkey": "hex", "verified": "bool"},
+    },
+    "makesecret": {
+        "params": {"hex": "hex?", "string": "str?"},
+        "result": {"secret": "hex"},
+    },
+    "addgossip": {
+        "params": {"message": "hex"},
+        "result": {},
+    },
+    "listclosedchannels": {
+        "params": {"id": "hex?"},
+        "result": {"closedchannels": "list"},
+    },
+    "delforward": {
+        "params": {"in_channel": "any?", "in_htlc_id": "int?",
+                   "status": "str?"},
+        "result": {"deleted": "int"},
+    },
+    "delpay": {
+        "params": {"payment_hash": "hex", "status": "str"},
+        "result": {"payments": "list"},
+    },
+    "wait": {
+        "params": {"subsystem": "str", "indexname": "str",
+                   "nextvalue": "int"},
+        "result": {"subsystem": "str"},
+    },
+    "preapproveinvoice": {
+        "params": {"bolt11": "str"},
+        "result": {},
+    },
+    "preapprovekeysend": {
+        "params": {"destination": "hex", "payment_hash": "hex",
+                   "amount_msat": "msat"},
+        "result": {},
+    },
+    "upgradewallet": {
+        "params": {"reserved_ok": "bool?"},
+        "result": {"upgraded_outs": "int"},
+    },
+    "listconfigs": {
+        "params": {"config": "str?"},
+        "result": {"configs": "dict"},
+    },
+    "setconfig": {
+        "params": {"config": "str", "val": "any?"},
+        "result": {"config": "dict"},
+    },
+    "getlog": {
+        "params": {"level": "str?"},
+        "result": {"log": "list"},
+    },
+    "listnodes": {
+        "params": {},
+        "result": {"nodes": "list"},
+    },
+    "listchannels": {
+        "params": {},
+        "result": {"channels": "list"},
+    },
+    "loadgossip": {
+        "params": {"path": "str"},
+        "result": {"channels": "int", "nodes": "int"},
+    },
+    "plugin": {
+        "params": {"subcommand": "str?", "plugin": "str?"},
+        "result": {"plugins": "list"},
+    },
+    "fundchannel_start": {
+        "params": {"id": "hex", "amount": "any", "push_msat": "int?",
+                   "announce": "bool?"},
+        "result": {"funding_address": "str", "scriptpubkey": "hex"},
+    },
+    "fundchannel_complete": {
+        "params": {"id": "hex", "psbt": "str"},
+        "result": {"channel_id": "hex", "commitments_secured": "bool"},
+    },
+    "fundchannel_cancel": {
+        "params": {"id": "hex"},
+        "result": {"cancelled": "str"},
+    },
+    "renepay": {
+        "params": {"invstring": "str", "amount_msat": "int?",
+                   "retry_for": "int?"},
+        "result": {"payment_preimage": "hex", "payment_hash": "hex",
+                   "status": "str"},
+    },
+    "renepaystatus": {
+        "params": {"invstring": "str?"},
+        "result": {"paystatus": "list"},
+    },
+    "createonion": {
+        "params": {"hops": "list", "assocdata": "hex",
+                   "session_key": "hex?"},
+        "result": {"onion": "hex", "shared_secrets": "list"},
+    },
+    "sendonion": {
+        "params": {"onion": "hex", "first_hop": "dict",
+                   "payment_hash": "hex", "amount_msat": "int?",
+                   "shared_secrets": "list?"},
+        "result": {"payment_hash": "hex", "status": "str"},
+    },
+    "sendpay": {
+        "params": {"route": "list", "payment_hash": "hex",
+                   "payment_secret": "hex?", "amount_msat": "int?"},
+        "result": {"payment_hash": "hex", "status": "str"},
+    },
+    "waitsendpay": {
+        "params": {"payment_hash": "hex", "timeout": "int?"},
+        "result": {"payment_hash": "hex", "status": "str",
+                   "payment_preimage": "hex"},
+    },
+    "listsendpays": {
+        "params": {"bolt11": "str?"},
+        "result": {"payments": "list"},
+    },
+    "setchannel": {
+        "params": {"feebase": "int?", "feeppm": "int?",
+                   "cltv_delta": "int?"},
+        "result": {"fee_base_msat": "msat",
+                   "fee_proportional_millionths": "int",
+                   "cltv_delta": "int"},
+    },
+    "createinvoice": {
+        "params": {"invstring": "str", "label": "str", "preimage": "hex"},
+        "result": {"label": "str", "bolt11": "str",
+                   "payment_hash": "hex", "status": "str"},
+    },
+    "signinvoice": {
+        "params": {"invstring": "str"},
+        "result": {"bolt11": "str"},
+    },
+    "decodepay": {
+        "params": {"bolt11": "str"},
+        "result": {"type": "str", "valid": "bool"},
+    },
+    "invoicerequest": {
+        "params": {"amount_msat": "msat", "description": "str",
+                   "issuer": "str?", "label": "str?",
+                   "single_use": "bool?"},
+        "result": {"invreq_id": "hex", "bolt12": "str",
+                   "active": "bool", "single_use": "bool",
+                   "used": "bool"},
+    },
+    "listinvoicerequests": {
+        "params": {"invreq_id": "hex?"},
+        "result": {"invoicerequests": "list"},
+    },
+    "disableinvoicerequest": {
+        "params": {"invreq_id": "hex"},
+        "result": {"invreq_id": "hex", "active": "bool"},
+    },
+    "sendinvoice": {
+        "params": {"invreq": "str", "label": "str",
+                   "amount_msat": "int?"},
+        "result": {"bolt12": "str", "payment_hash": "hex",
+                   "amount_msat": "msat", "label": "str"},
+    },
+    "sendonionmessage": {
+        "params": {"node_ids": "list", "content": "dict?"},
+        "result": {"sent": "bool"},
+    },
+    "listoffers": {
+        "params": {},
+        "result": {"offers": "list"},
+    },
+    "disableoffer": {
+        "params": {"offer_id": "hex"},
+        "result": {"offer_id": "hex", "active": "bool"},
+    },
+    "signpsbt": {
+        "params": {"psbt": "str", "signonly": "list?"},
+        "result": {"signed_psbt": "str"},
+    },
+    "sendpsbt": {
+        "params": {"psbt": "str", "reserve": "bool?"},
+        "result": {"tx": "hex", "txid": "hex"},
+    },
+    "utxopsbt": {
+        "params": {"satoshi": "any", "feerate": "any?",
+                   "startweight": "int?", "utxos": "list?",
+                   "reserve": "int?", "reservedok": "bool?"},
+        "result": {"psbt": "str", "feerate_per_kw": "int",
+                   "excess_msat": "msat"},
+    },
+    "addpsbtoutput": {
+        "params": {"satoshi": "int", "psbt": "str?",
+                   "destination": "str?"},
+        "result": {"psbt": "str", "outnum": "int"},
+    },
+    "listtransactions": {
+        "params": {},
+        "result": {"transactions": "list"},
+    },
+    "listaddresses": {
+        "params": {},
+        "result": {"addresses": "list"},
+    },
+    "reserveinputs": {
+        "params": {"psbt": "str?", "outpoints": "list?",
+                   "exclusive": "bool?", "reserve": "int?"},
+        "result": {"reservations": "list"},
+    },
+    "unreserveinputs": {
+        "params": {"psbt": "str?", "outpoints": "list?"},
+        "result": {"reservations": "list"},
+    },
+    "createrune": {
+        "params": {"restrictions": "list?"},
+        "result": {"rune": "str", "unique_id": "int"},
+    },
+    "checkrune": {
+        "params": {"rune": "str", "method": "str?", "params": "dict?",
+                   "nodeid": "hex?"},
+        "result": {"valid": "bool"},
+    },
+    "showrunes": {
+        "params": {"rune": "str?"},
+        "result": {"runes": "list"},
+    },
+    "blacklistrune": {
+        "params": {"start": "int", "end": "int?"},
+        "result": {"blacklist": "list"},
+    },
+    "commando": {
+        "params": {"peer_id": "hex", "method": "str",
+                   "params": "dict?", "rune": "str?"},
+        "result": {},
+    },
+    "commando-rune": {
+        "params": {"restrictions": "list?"},
+        "result": {"rune": "str", "unique_id": "int"},
+    },
+    "commando-listrunes": {
+        "params": {"rune": "str?"},
+        "result": {"runes": "list"},
+    },
+    "commando-blacklist": {
+        "params": {"start": "int", "end": "int?"},
+        "result": {"blacklist": "list"},
+    },
+    "getroutes": {
+        "params": {"source": "hex", "destination": "hex",
+                   "amount_msat": "msat", "maxfee_msat": "int?",
+                   "final_cltv": "int?", "max_parts": "int?",
+                   "layers": "list?"},
+        "result": {"routes": "list"},
+    },
+    "askrene-reserve": {
+        "params": {"path": "list", "layer": "str?"},
+        "result": {"reserved": "int"},
+    },
+    "askrene-unreserve": {
+        "params": {"path": "list", "layer": "str?"},
+        "result": {"unreserved": "int"},
+    },
+    "askrene-bias-channel": {
+        "params": {"short_channel_id": "any", "bias": "int",
+                   "layer": "str?"},
+        "result": {"biases": "int"},
+    },
+    "askrene-disable-channel": {
+        "params": {"short_channel_id": "any", "layer": "str?"},
+        "result": {"disabled": "int"},
+    },
+    "askrene-create-layer": {
+        "params": {"layer": "str", "persistent": "bool?"},
+        "result": {"layers": "list"},
+    },
+    "askrene-remove-layer": {
+        "params": {"layer": "str"},
+        "result": {},
+    },
+    "askrene-listlayers": {
+        "params": {"layer": "str?"},
+        "result": {"layers": "list"},
+    },
+    "askrene-inform-channel": {
+        "params": {"short_channel_id": "any", "direction": "int",
+                   "layer": "str?", "amount_msat": "int?",
+                   "inform": "str?"},
+        "result": {"constraints": "list"},
+    },
+    "askrene-age": {
+        "params": {"layer": "str?", "cutoff": "any?"},
+        "result": {"layer": "str", "num_removed": "int"},
+    },
+    "autoclean-configure": {
+        "params": {"subsystem": "str?", "age": "int?"},
+        "result": {"autoclean": "dict"},
+    },
+    "autoclean-once": {
+        "params": {"subsystem": "str?", "age": "int?"},
+        "result": {"autoclean": "dict"},
+    },
+    "autoclean-status": {
+        "params": {},
+        "result": {"autoclean": "dict"},
+    },
+    "bkpr-listaccountevents": {
+        "params": {"account": "str?"},
+        "result": {"events": "list"},
+    },
+    "bkpr-listbalances": {
+        "params": {},
+        "result": {"accounts": "list"},
+    },
+    "bkpr-listincome": {
+        "params": {},
+        "result": {"income_events": "list"},
+    },
+    "sql": {
+        "params": {"query": "str"},
+        "result": {"rows": "list"},
+    },
+    "staticbackup": {
+        "params": {},
+        "result": {"scb": "hex"},
+    },
+    "emergencyrecover": {
+        "params": {"scb": "hex?"},
+        "result": {"stubs": "list"},
+    },
+    "getemergencyrecoverdata": {
+        "params": {},
+        "result": {"filedata": "hex"},
+    },
+    "recover": {
+        "params": {"hsmsecret": "str?"},
+        "result": {},
+    },
+    "exposesecret": {
+        "params": {"passphrase": "str?"},
+        "result": {},
+    },
+    "funderupdate": {
+        "params": {"policy": "str?", "policy_mod": "int?",
+                   "min_their_funding_msat": "int?",
+                   "max_their_funding_msat": "int?"},
+        "result": {"policy": "str"},
+    },
+    "dev-faucet": {
+        "params": {"satoshi": "int"},
+        "result": {},
+    },
+    "dev-generate": {
+        "params": {"blocks": "int?"},
+        "result": {},
+    },
 }
 
 _PY_TYPES = {"str": "str", "int": "int", "bool": "bool", "hex": "str",

@@ -14,10 +14,22 @@ import logging
 log = logging.getLogger("lightning_tpu.events")
 
 _subscribers: dict[str, list] = {}
+_wildcard: list = []
 
 
 def subscribe(topic: str, fn) -> None:
     _subscribers.setdefault(topic, []).append(fn)
+
+
+def subscribe_all(fn) -> None:
+    """fn(topic, payload) for EVERY emission — the PluginHost bridge
+    (notification.c fan-out to plugin subscriptions)."""
+    _wildcard.append(fn)
+
+
+def unsubscribe_all(fn) -> None:
+    if fn in _wildcard:
+        _wildcard.remove(fn)
 
 
 def unsubscribe(topic: str, fn) -> None:
@@ -32,8 +44,14 @@ def emit(topic: str, payload: dict) -> None:
             fn(payload)
         except Exception:
             log.exception("subscriber for %s failed", topic)
+    for fn in list(_wildcard):
+        try:
+            fn(topic, payload)
+        except Exception:
+            log.exception("wildcard subscriber failed on %s", topic)
 
 
 def reset() -> None:
     """Test isolation helper."""
     _subscribers.clear()
+    _wildcard.clear()

@@ -1,0 +1,116 @@
+"""zbase32 codec + recoverable message signatures.
+
+Parity targets: common/bech32_util? no — the reference's signmessage
+plugin uses zbase32 (plugins/... via common/utils; see
+doc/schemas/lightning-signmessage.json): sign
+sha256d("Lightning Signed Message:" || msg) with a RECOVERABLE compact
+signature (65 bytes: recid+31 || r || s) and emit it zbase32-encoded.
+checkmessage recovers the public key and compares.
+"""
+from __future__ import annotations
+
+import hashlib
+
+from ..crypto import ref_python as ref
+
+_ALPHA = "ybndrfg8ejkmcpqxot1uwisza345h769"
+_REV = {c: i for i, c in enumerate(_ALPHA)}
+
+MSG_PREFIX = b"Lightning Signed Message:"
+
+
+def encode(data: bytes) -> str:
+    out = []
+    bits = 0
+    acc = 0
+    for b in data:
+        acc = (acc << 8) | b
+        bits += 8
+        while bits >= 5:
+            bits -= 5
+            out.append(_ALPHA[(acc >> bits) & 31])
+    if bits:
+        out.append(_ALPHA[(acc << (5 - bits)) & 31])
+    return "".join(out)
+
+
+def decode(s: str) -> bytes:
+    acc = 0
+    bits = 0
+    out = bytearray()
+    for c in s:
+        if c not in _REV:
+            raise ValueError(f"invalid zbase32 char {c!r}")
+        acc = (acc << 5) | _REV[c]
+        bits += 5
+        if bits >= 8:
+            bits -= 8
+            out.append((acc >> bits) & 0xFF)
+    return bytes(out)
+
+
+def _msg_hash(message: str) -> bytes:
+    h = hashlib.sha256(MSG_PREFIX + message.encode()).digest()
+    return hashlib.sha256(h).digest()
+
+
+def _recover(z: int, r: int, s: int, recid: int) -> ref.Point | None:
+    """Standard ECDSA public-key recovery (SEC1 4.1.6)."""
+    if not (1 <= r < ref.N and 1 <= s < ref.N):
+        return None
+    x = r + (recid >> 1) * ref.N
+    if x >= ref.P:
+        return None
+    # lift x to a curve point with y parity = recid & 1
+    y2 = (pow(x, 3, ref.P) + 7) % ref.P
+    y = pow(y2, (ref.P + 1) // 4, ref.P)
+    if y * y % ref.P != y2:
+        return None
+    if (y & 1) != (recid & 1):
+        y = ref.P - y
+    R = ref.Point(x, y)
+    rinv = ref.fe_inv(r, ref.N)
+    # Q = r^-1 (sR - zG)
+    sR = ref.point_mul(s, R)
+    zG = ref.point_mul(z % ref.N, ref.G)
+    neg_zG = ref.Point(zG.x, (ref.P - zG.y) % ref.P) \
+        if not zG.inf else zG
+    Q = ref.point_mul(rinv, ref.point_add(sR, neg_zG))
+    if Q.inf:
+        return None
+    return Q
+
+
+def sign_message(message: str, seckey: int) -> tuple[str, bytes, bytes]:
+    """Returns (zbase, signature65, recid_byte) for the given node key."""
+    h = _msg_hash(message)
+    r, s = ref.ecdsa_sign(h, seckey)
+    z = int.from_bytes(h, "big")
+    pub = ref.pubkey_create(seckey)
+    recid = None
+    for cand in range(4):
+        q = _recover(z, r, s, cand)
+        if q is not None and q.x == pub.x and q.y == pub.y:
+            recid = cand
+            break
+    assert recid is not None, "unrecoverable signature"
+    sig65 = bytes([recid + 31]) + r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    return encode(sig65), sig65, bytes([recid + 31])
+
+
+def check_message(message: str, zbase: str) -> bytes | None:
+    """Recover the signer's compressed pubkey, or None if invalid."""
+    try:
+        sig = decode(zbase)
+    except ValueError:
+        return None
+    if len(sig) != 65 or not 31 <= sig[0] <= 34:
+        return None
+    recid = sig[0] - 31
+    r = int.from_bytes(sig[1:33], "big")
+    s = int.from_bytes(sig[33:], "big")
+    h = _msg_hash(message)
+    q = _recover(int.from_bytes(h, "big"), r, s, recid)
+    if q is None or not ref.ecdsa_verify(h, r, s, q):
+        return None
+    return ref.pubkey_serialize(q)

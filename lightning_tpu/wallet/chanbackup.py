@@ -266,5 +266,12 @@ def attach_backup_commands(rpc, svc: PeerStorageService) -> None:
             "funding_sat": c["funding_sat"],
         } for c in chans]}
 
+    async def getemergencyrecoverdata() -> dict:
+        """The raw encrypted SCB blob, as the chanbackup plugin's
+        getemergencyrecoverdata returns it."""
+        blob = svc.our_blob()
+        return {"filedata": blob.hex() if blob else ""}
+
     rpc.register("staticbackup", staticbackup)
     rpc.register("emergencyrecover", emergencyrecover)
+    rpc.register("getemergencyrecoverdata", getemergencyrecoverdata)

@@ -214,12 +214,12 @@ class Db:
         pend = getattr(self._local, "pending_writes", None)
         if not pend:
             return
-        # The lock spans hook delivery so streams leave in version
-        # order, and the in-memory counter is only advanced AFTER the
-        # hook accepts: a vetoing (raising) hook rolls back the
-        # transaction including the vars row, and the next committed
-        # transaction must reuse this version number — a skipped number
-        # would desync the replica's lock-step counter forever.
+        # Version accounting happens under the lock; hook DELIVERY does
+        # not — a bridged hook may need the event loop, and the loop
+        # thread takes this lock for its own commits (holding it here
+        # was a 30s deadlock).  Concurrent write transactions are
+        # already serialized by sqlite's single-writer locking, so
+        # delivery order still follows version order in practice.
         with self._version_lock:
             version = self._data_version + 1
             conn.execute(
@@ -228,8 +228,23 @@ class Db:
                 (str(version),))
             batch = list(self._local.pending_writes)
             self._local.pending_writes = []
-            self.db_write_hook(version, batch)
             self._data_version = version
+        try:
+            self.db_write_hook(version, batch)
+        except BaseException:
+            # veto: the transaction (incl. the vars row) rolls back, so
+            # the counter must give this number back — the next commit
+            # reuses it, keeping the replica's lock-step monotone.
+            with self._version_lock:
+                if self._data_version == version:
+                    self._data_version = version - 1
+                else:   # pragma: no cover — needs interleaved writers
+                    import logging
+
+                    logging.getLogger("lightning_tpu.db").warning(
+                        "db_write veto raced a concurrent commit; "
+                        "replication stream may skip version %d", version)
+            raise
 
     def _migrate(self) -> None:
         c = self.conn

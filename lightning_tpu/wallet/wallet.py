@@ -181,6 +181,7 @@ class Wallet:
         )
         ch.core.next_htlc_id = {True: row["next_htlc_id_ours"],
                                 False: row["next_htlc_id_theirs"]}
+        ch.core.notify_tag = row["channel_id"].hex()
         for h in self.db.conn.execute(
             "SELECT offered_by_us, htlc_id, amount_msat, payment_hash, "
             "cltv_expiry, hstate, preimage, fail_reason, onion FROM htlcs "

@@ -188,6 +188,133 @@ def attach_wallet_commands(rpc, wallet: OnchainWallet, hsm=None,
         wallet.add_unconfirmed_change(tx)
         return {"tx": raw.hex(), "txid": txid.hex()}
 
+    async def signpsbt(psbt: str, signonly: list | None = None) -> dict:
+        """Sign every PSBT input the wallet owns (walletrpc.c
+        json_signpsbt; the HSM signs when attached)."""
+        p = Psbt.parse(base64.b64decode(psbt))
+        tx = p.tx
+        meta = wallet.utxo_meta(tx)
+        if signonly is not None:
+            meta = [m if i in signonly else None
+                    for i, m in enumerate(meta)]
+        if not any(m is not None for m in meta):
+            raise WalletError("no wallet inputs to sign")
+        if hsm is not None:
+            hsm.sign_withdrawal(hsm_client, tx, meta)
+        else:
+            from .onchain import sign_wallet_inputs
+
+            sign_wallet_inputs(tx, meta, wallet.keyman)
+        for i, vin in enumerate(tx.inputs):
+            if vin.witness:
+                p.inputs[i].final_witness = list(vin.witness)
+                vin.witness = []
+        return {"signed_psbt": base64.b64encode(p.serialize()).decode()}
+
+    async def sendpsbt(psbt: str, reserve: bool = False) -> dict:
+        """Finalize + extract + broadcast (walletrpc.c json_sendpsbt)."""
+        p = Psbt.parse(base64.b64decode(psbt))
+        p.finalize()
+        tx = p.extract()
+        raw = tx.serialize()
+        if backend is not None:
+            ok, err = await backend.sendrawtransaction(raw)
+            if not ok:
+                raise WalletError(f"sendrawtransaction failed: {err}")
+        txid = tx.txid()
+        ours = [i for i, m in enumerate(wallet.utxo_meta(tx))
+                if m is not None]
+        if ours:
+            wallet.mark_spent(
+                [(tx.inputs[i].txid, tx.inputs[i].vout) for i in ours],
+                txid)
+        wallet.add_unconfirmed_change(tx)
+        return {"tx": raw.hex(), "txid": txid.hex()}
+
+    async def utxopsbt(satoshi, feerate=None, startweight: int = 0,
+                       utxos: list | None = None, reserve: int = 72,
+                       reservedok: bool = False) -> dict:
+        """fundpsbt from CALLER-CHOSEN utxos (walletrpc.c
+        json_utxopsbt)."""
+        from ..btc.tx import TxInput
+        from .onchain import OnchainWallet as _W
+
+        per_kw = _feerate_per_kw(feerate, topology)
+        pts = _parse_outpoints(utxos or [])
+        if not pts:
+            raise WalletError("utxos required")
+        rows = []
+        for t, v in pts:
+            row = wallet.db.conn.execute(
+                "SELECT amount_sat, status FROM outputs"
+                " WHERE txid=? AND vout=?", (t, v)).fetchone()
+            if row is None:
+                raise WalletError(f"unknown utxo {t.hex()}:{v}")
+            if row[1] != "available" and not reservedok:
+                raise WalletError(f"utxo {t.hex()}:{v} is {row[1]}")
+            rows.append(row[0])
+        tx = Tx(version=2)
+        for t, v in pts:
+            tx.inputs.append(TxInput(t, v, sequence=0xFFFFFFFD))
+        weight = (4 + 1 + 1 + 4 + 2) * 4 + startweight \
+            + len(pts) * _W._input_weight()
+        fee = per_kw * weight // 1000
+        total = sum(rows)
+        want = 0 if satoshi == "all" else int(satoshi)
+        if total < want + fee:
+            raise WalletError(
+                f"utxos total {total} < amount {want} + fee {fee}")
+        wallet.reserve(pts, blocks=reserve)
+        excess = total - fee if satoshi == "all" else total - want - fee
+        return {"psbt": _to_psbt(tx, wallet), "feerate_per_kw": per_kw,
+                "excess_msat": excess * 1000,
+                "reservations": [
+                    {"txid": t.hex(), "vout": v, "reserved": True}
+                    for t, v in pts]}
+
+    async def addpsbtoutput(satoshi: int, psbt: str | None = None,
+                            destination: str | None = None) -> dict:
+        """Append an output paying us (or `destination`) to a PSBT,
+        creating one if absent (walletrpc.c json_addpsbtoutput)."""
+        if psbt is not None:
+            p = Psbt.parse(base64.b64decode(psbt))
+        else:
+            p = Psbt.from_tx(Tx(version=2))
+        if destination is not None:
+            spk = ADDR.to_scriptpubkey(destination, wallet.keyman.hrp)
+        else:
+            addr = wallet.newaddr()
+            spk = ADDR.to_scriptpubkey(addr["bech32"], wallet.keyman.hrp)
+        p.tx.outputs.append(TxOutput(int(satoshi), spk))
+        p.outputs.append({})
+        return {"psbt": base64.b64encode(p.serialize()).decode(),
+                "outnum": len(p.tx.outputs) - 1,
+                "estimated_added_weight": (8 + 1 + len(spk)) * 4}
+
+    async def listtransactions() -> dict:
+        """Wallet-relevant transactions from the outputs table
+        (walletrpc.c json_listtransactions scope)."""
+        txs: dict[bytes, dict] = {}
+        for r in wallet.db.conn.execute(
+                "SELECT txid, vout, amount_sat, confirmation_height,"
+                " spending_txid, spent_height FROM outputs"):
+            txid = bytes(r[0])
+            e = txs.setdefault(txid, {
+                "hash": txid.hex(),
+                "blockheight": r[3] or 0, "outputs": []})
+            e["outputs"].append({"index": r[1], "amount_msat": r[2] * 1000})
+            if r[4] is not None:
+                txs.setdefault(bytes(r[4]), {
+                    "hash": bytes(r[4]).hex(),
+                    "blockheight": r[5] or 0, "outputs": []})
+        return {"transactions": sorted(txs.values(),
+                                       key=lambda t: t["blockheight"])}
+
+    rpc.register("signpsbt", signpsbt)
+    rpc.register("sendpsbt", sendpsbt)
+    rpc.register("utxopsbt", utxopsbt)
+    rpc.register("addpsbtoutput", addpsbtoutput)
+    rpc.register("listtransactions", listtransactions)
     rpc.register("newaddr", newaddr)
     rpc.register("listaddresses", listaddresses)
     rpc.register("listfunds", listfunds)

@@ -1,0 +1,83 @@
+#!/usr/bin/env python3
+"""External hook/notification plugin for the live-path wiring tests.
+
+Registers the money-path hooks (htlc_accepted, invoice_payment,
+peer_connected, openchannel) and a set of notification subscriptions;
+everything it sees is appended as JSON lines to $HOOK_PLUGIN_NOTIFY_FILE
+so the test can assert delivery.  HTLCs of exactly 31337000 msat are
+failed with temporary_node_failure (0x2002) — the test's proof that an
+external process can veto a payment in flight.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from lightning_tpu.plugins.libplugin import Plugin  # noqa: E402
+
+p = Plugin()
+
+REJECT_MSAT = 31_337_000
+
+
+def _record(kind, payload):
+    path = os.environ.get("HOOK_PLUGIN_NOTIFY_FILE")
+    if path:
+        with open(path, "a") as f:
+            f.write(json.dumps({"kind": kind, "payload": payload}) + "\n")
+
+
+@p.method("hookinfo")
+def hookinfo():
+    """Proof the plugin's rpcmethod is proxied through the node."""
+    return {"plugin": "hook_plugin", "pid": os.getpid()}
+
+
+@p.hook("peer_connected")
+def on_peer_connected(peer=None, **kw):
+    _record("hook:peer_connected", peer)
+    return {"result": "continue"}
+
+
+@p.hook("openchannel")
+def on_openchannel(openchannel=None, **kw):
+    _record("hook:openchannel", openchannel)
+    return {"result": "continue"}
+
+
+@p.hook("htlc_accepted")
+def on_htlc_accepted(onion=None, htlc=None, **kw):
+    _record("hook:htlc_accepted", htlc)
+    if htlc and htlc.get("amount_msat") == REJECT_MSAT:
+        return {"result": "fail", "failure_message": "2002"}
+    return {"result": "continue"}
+
+
+@p.hook("invoice_payment")
+def on_invoice_payment(payment=None, **kw):
+    _record("hook:invoice_payment", payment)
+    return {"result": "continue"}
+
+
+@p.hook("db_write")
+def on_db_write(data_version=None, writes=None, **kw):
+    _record("hook:db_write", {"data_version": data_version,
+                              "n_writes": len(writes or [])})
+    return {"result": "continue"}
+
+
+for _topic in ("connect", "disconnect", "channel_opened",
+               "channel_state_changed", "invoice_creation",
+               "invoice_payment", "forward_event", "sendpay_success",
+               "sendpay_failure", "block_added", "coin_movement",
+               "shutdown"):
+    def _make(topic):
+        def _on(**kw):
+            _record(f"notify:{topic}", kw.get(topic))
+        return _on
+    p.subs[_topic] = _make(_topic)
+
+
+if __name__ == "__main__":
+    p.run()

@@ -1,0 +1,172 @@
+"""Plugin hooks fired from LIVE daemon paths (round-3 verdict #2).
+
+Two layers:
+* in-process 2-node stack — an EXTERNAL plugin process on the payee
+  vetoes an HTLC via htlc_accepted, passes others, and receives the
+  notification stream (connect, channel_opened, invoice_payment, ...);
+* subprocess daemon — `python -m lightning_tpu.daemon --plugin ...`
+  spawns the plugin at startup, proxies its rpcmethod, and serves
+  `plugin list` (lightningd/plugin.c + plugin_control.c parity).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lightning_tpu.chain.backend import FakeBitcoind  # noqa: E402
+from lightning_tpu.plugins.host import PluginHost  # noqa: E402
+from lightning_tpu.utils import events  # noqa: E402
+from test_daemon_rpc import Stack, rpc_call  # noqa: E402
+
+PLUGIN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "plugins", "hook_plugin.py")
+REJECT_MSAT = 31_337_000
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 900))
+
+
+def _lines(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_external_plugin_vetoes_htlc_and_gets_notifications(
+        tmp_path, monkeypatch):
+    notify_file = str(tmp_path / "notify.jsonl")
+    monkeypatch.setenv("HOOK_PLUGIN_NOTIFY_FILE", notify_file)
+
+    async def body():
+        events.reset()
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x0a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x0b" * 32, bitcoind).start()
+        host = PluginHost(rpc=b.rpc, lightning_dir=str(tmp_path),
+                          rpc_file=b.rpc.rpc_path)
+        b.node.plugin_host = host
+        events.subscribe_all(lambda t, pl: host.notify(t, pl))
+        try:
+            await host.start_plugin(PLUGIN)
+
+            port = await b.node.listen()
+            await a.node.connect("127.0.0.1", port, b.node.node_id)
+            await rpc_call(a.rpc.rpc_path, "dev-faucet",
+                           {"satoshi": 2_000_000})
+            fund = asyncio.create_task(
+                a.manager.fundchannel(b.node.node_id, 1_000_000))
+            while not bitcoind.mempool and not fund.done():
+                await asyncio.sleep(0.05)
+            if bitcoind.mempool:
+                bitcoind.generate(1)
+            await asyncio.wait_for(fund, 600)
+
+            # the plugin's rpcmethod is proxied through B's rpc server
+            info = await rpc_call(b.rpc.rpc_path, "hookinfo")
+            assert info["plugin"] == "hook_plugin"
+
+            # payment 1: the magic amount — plugin MUST veto it
+            inv = await rpc_call(b.rpc.rpc_path, "invoice", {
+                "amount_msat": REJECT_MSAT, "label": "veto",
+                "description": "x"})
+            with pytest.raises(AssertionError) as ei:
+                await rpc_call(a.rpc.rpc_path, "pay",
+                               {"bolt11": inv["bolt11"]})
+            assert "TEMPORARY_NODE_FAILURE" in str(ei.value) \
+                or "2002" in str(ei.value).lower() \
+                or "failed" in str(ei.value).lower()
+            # the invoice is NOT paid
+            lst = await rpc_call(b.rpc.rpc_path, "listinvoices",
+                                 {"label": "veto"})
+            assert lst["invoices"][0]["status"] != "paid"
+
+            # payment 2: a normal amount — continue + invoice_payment
+            inv2 = await rpc_call(b.rpc.rpc_path, "invoice", {
+                "amount_msat": 40_000, "label": "ok", "description": "x"})
+            paid = await rpc_call(a.rpc.rpc_path, "pay",
+                                  {"bolt11": inv2["bolt11"]})
+            assert paid["status"] == "complete"
+
+            await asyncio.sleep(0.3)    # let notifications drain
+            kinds = [rec["kind"] for rec in _lines(notify_file)]
+            assert "hook:peer_connected" in kinds
+            assert "hook:openchannel" in kinds
+            assert kinds.count("hook:htlc_accepted") >= 2
+            assert "hook:invoice_payment" in kinds
+            assert "notify:connect" in kinds
+            assert "notify:channel_opened" in kinds
+            assert "notify:channel_state_changed" in kinds
+            assert "notify:invoice_creation" in kinds
+            assert "notify:invoice_payment" in kinds
+            assert "notify:coin_movement" in kinds
+            assert "notify:block_added" in kinds
+        finally:
+            await host.close()
+            events.reset()
+            await a.close()
+            await b.close()
+
+    run(body())
+
+
+def test_daemon_spawns_plugin_from_cli(tmp_path):
+    """The real daemon entry point: --plugin spawns at startup, the
+    manifest rpcmethod is served, `plugin list` works, `plugin stop`
+    removes it."""
+    data = tmp_path / "node"
+    rpc_path = str(tmp_path / "rpc.sock")
+    env = dict(os.environ, HOOK_PLUGIN_NOTIFY_FILE=str(
+        tmp_path / "n.jsonl"))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightning_tpu.daemon", "--cpu",
+         "--data-dir", str(data), "--listen", "0",
+         "--rpc-file", rpc_path, "--plugin", PLUGIN],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    try:
+        ready = plugin_ok = False
+        for _ in range(600):
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if "rpc ready" in line:
+                ready = True
+            if "plugin" in line and "active" in line:
+                plugin_ok = True
+            if ready and plugin_ok:
+                break
+        assert ready, "daemon rpc never came up"
+        assert plugin_ok, "plugin never activated"
+
+        async def drive():
+            info = await rpc_call(rpc_path, "hookinfo")
+            assert info["plugin"] == "hook_plugin"
+            lst = await rpc_call(rpc_path, "plugin", {})
+            assert any(p["name"] == "hook_plugin.py" and p["active"]
+                       for p in lst["plugins"])
+            await rpc_call(rpc_path, "plugin", {
+                "subcommand": "stop", "plugin": "hook_plugin.py"})
+            lst = await rpc_call(rpc_path, "plugin", {})
+            assert not any(p["active"] for p in lst["plugins"])
+            await rpc_call(rpc_path, "stop")
+
+        asyncio.run(asyncio.wait_for(drive(), 120))
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+
+    run  # silence unused warnings in some linters
