@@ -103,6 +103,7 @@ async def amain(args) -> int:
     # live gossipd: ingest from peers, serve BOLT#7 queries, stream out
     # (gossip_init, lightningd.c:1375 — previously only tests wired this)
     gossipd = None
+    seeker = None
     if args.data_dir:
         from ..gossip.gossipd import Gossipd
 
@@ -113,6 +114,12 @@ async def amain(args) -> int:
         gossipd.start()
         if loaded:
             print(f"gossipd: {loaded} records from {gpath}", flush=True)
+        # autonomous seeker: full-sync on startup, then rotate peers and
+        # probe for gaps with backoff (gossipd/seeker.c)
+        from ..gossip.seeker import Seeker
+
+        seeker = Seeker(gossipd)
+        seeker.start()
 
     # invoice registry + onion messaging + BOLT#12 offers ride the node
     # identity key (lightningd: invoice.c / onion_message.c / offers
@@ -175,7 +182,7 @@ async def amain(args) -> int:
             chain_backend=chain_backend, topology=topology,
             invoices=invoices, relay=relay_svc,
             htlc_sets=HtlcSets(invoices), gossmap_ref=gossmap_ref,
-            funder_policy=funder_policy)
+            funder_policy=funder_policy, gossipd=gossipd)
         restored = await manager.restore_all()
         if restored:
             print(f"restored {restored} live channel(s)", flush=True)
@@ -273,6 +280,13 @@ async def amain(args) -> int:
 
         await rpc.start()
         print(f"rpc ready {rpc_path}", flush=True)
+
+        if args.bin_rpc_file:
+            from .binrpc import BinRpcServer
+
+            binrpc = BinRpcServer(rpc, args.bin_rpc_file)
+            await binrpc.start()
+            print(f"binrpc ready {args.bin_rpc_file}", flush=True)
 
         # plugin host (lightningd/plugin.c spawn + plugin_control.c
         # `plugin` command): external processes reached over stdio
@@ -424,6 +438,8 @@ async def amain(args) -> int:
         await rpc.close()
     if wss is not None:
         await wss.close()
+    if seeker is not None:
+        await seeker.close()
     if gossipd is not None:
         await gossipd.close()
     if topology is not None:
@@ -457,6 +473,9 @@ def main() -> int:
                    metavar="PATH",
                    help="spawn an executable plugin at startup "
                         "(repeatable; lightningd --plugin semantics)")
+    p.add_argument("--bin-rpc-file", default=None, metavar="PATH",
+                   help="serve the generated protobuf API on this unix "
+                        "socket (cln-grpc-equivalent surface)")
     p.add_argument("--gossip-store", default=None,
                    help="gossip_store file to build the routing graph from")
     p.add_argument("--bitcoind-rpc", default=None,

@@ -36,7 +36,7 @@ from ..channel.state import (
 from ..crypto import ref_python as ref
 from ..wire import messages as M
 from . import hooks as HK
-from .hsmd import CAP_SIGN_COMMITMENT, Hsm, HsmClient
+from .hsmd import CAP_SIGN_COMMITMENT, Hsm, HsmClient, HsmError
 from .peer import Peer
 
 log = logging.getLogger("lightning_tpu.channeld")
@@ -74,6 +74,7 @@ class ChannelConfig:
     feerate_per_kw: int = 2500
     minimum_depth: int = 1
     anchors: bool = True
+    announce: bool = True   # BOLT#2 channel_flags bit 0
 
     def reserve(self, funding_sat: int) -> int:
         if self.channel_reserve_sat is not None:
@@ -150,6 +151,8 @@ class Channeld:
         # only on splice_locked switch or proven non-broadcastability.
         # JSON-able dict, see splice.py _make_inflight.
         self.inflight: dict | None = None
+        # BOLT#2 announce_channel bit (negotiated at open, persisted)
+        self.announce = False
 
     def attach_wallet(self, wallet, hsm_dbid: int) -> None:
         self.wallet = wallet
@@ -731,8 +734,9 @@ async def open_negotiate(peer: Peer, hsm: Hsm, client: HsmClient,
             ch.our_base.delayed_payment),
         htlc_basepoint=ref.pubkey_serialize(ch.our_base.htlc),
         first_per_commitment_point=ref.pubkey_serialize(first_point),
-        channel_flags=0,
+        channel_flags=1 if cfg.announce else 0,
     ))
+    ch.announce = cfg.announce
     acc = await peer.recv(M.AcceptChannel, timeout=RECV_TIMEOUT)
     if acc.temporary_channel_id != tmp_id:
         raise ChannelError("accept_channel for wrong channel")
@@ -793,6 +797,12 @@ async def open_channel(peer: Peer, hsm: Hsm, client: HsmClient,
         if picked is not None:
             onchain.unreserve([u.outpoint for u in picked])
         raise
+    # write-ahead BEFORE the coins leave: a crash between broadcast and
+    # lockin must never lose the channel record (opening_control.c
+    # commits the channel at funding_signed receipt, before broadcast)
+    if wallet is not None:
+        ch.attach_wallet(wallet, hsm_dbid)
+        ch._persist()
     if onchain is not None:
         await open_broadcast(hsm, onchain, chain_backend, funding_tx,
                              picked)
@@ -917,6 +927,7 @@ async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
     if not 253 <= oc.feerate_per_kw <= max(cfg.feerate_per_kw * 10, 50_000):
         raise ChannelError(f"unacceptable feerate {oc.feerate_per_kw}")
     cfg.feerate_per_kw = oc.feerate_per_kw
+    ch.announce = bool(oc.channel_flags & 1)
     ch.core = _open_core(oc.funding_satoshis, oc.push_msat, False, cfg,
                          oc.channel_reserve_satoshis)
 
@@ -947,10 +958,15 @@ async def accept_channel(peer: Peer, hsm: Hsm, client: HsmClient,
     await asyncio.to_thread(ch._verify_local, 0, fc.signature, [])
     fsig, hsigs = await asyncio.to_thread(ch._sign_remote, 0)
     assert not hsigs
+    ch.core.transition(ChannelState.AWAITING_LOCKIN)
+    # write-ahead: once funding_signed leaves, the funder can broadcast
+    # — the channel record must already be durable on OUR side too
+    if wallet is not None:
+        ch.attach_wallet(wallet, hsm_dbid)
+        ch._persist()
     await peer.send(M.FundingSigned(
         channel_id=ch.channel_id, signature=fsig,
     ))
-    ch.core.transition(ChannelState.AWAITING_LOCKIN)
     if topology is not None:
         # the fundee ALSO waits for its own view of funding depth
         while topology.depth(ch.funding_txid) < cfg.minimum_depth:
@@ -1099,6 +1115,121 @@ def classify_incoming(lh, node_privkey: int, invoices=None,
     return ("fail", SX.create_error_onion(peeled_raw.shared_secret, failmsg))
 
 
+# ---------------------------------------------------------------------------
+# Own-channel gossip origination (channeld → gossipd announcement path:
+# channeld.c send_channel_announce_sigs + gossipd/gossmap_manage.c:687)
+
+ANNOUNCE_DEPTH = 6   # BOLT#7: funding must be 6 deep before announcing
+
+
+def _ann_order(ch) -> tuple[bytes, bytes, bytes, bytes, bool]:
+    """(node_id_1, node_id_2, bitcoin_key_1, bitcoin_key_2, we_are_1) —
+    BOLT#7 orders by lexical node id; bitcoin keys follow node order."""
+    ours = ch.peer.node.node_id
+    theirs = ch.peer.node_id
+    if ours < theirs:
+        return ours, theirs, ch.our_funding_pub, ch.their_funding_pub, True
+    return theirs, ours, ch.their_funding_pub, ch.our_funding_pub, False
+
+
+def _unsigned_ca(ch):
+    from ..gossip import wire as gwire
+    from .relay import derive_scid
+
+    n1, n2, b1, b2, _ = _ann_order(ch)
+    return gwire.ChannelAnnouncement(
+        short_channel_id=derive_scid(ch.funding_txid, ch.funding_outidx),
+        node_id_1=n1, node_id_2=n2, bitcoin_key_1=b1, bitcoin_key_2=b2)
+
+
+def _our_channel_update(ch, relay) -> bytes:
+    """Build + sign OUR direction's channel_update (channeld.c
+    send_channel_update; direction = our position in node order)."""
+    import time as _time
+
+    from ..gossip import wire as gwire
+    from .relay import derive_scid
+
+    _n1, _n2, _b1, _b2, we_are_1 = _ann_order(ch)
+    pol = relay.policy if relay is not None else None
+    cu = gwire.ChannelUpdate(
+        short_channel_id=derive_scid(ch.funding_txid, ch.funding_outidx),
+        timestamp=int(_time.time()),
+        channel_flags=0 if we_are_1 else 1,
+        cltv_expiry_delta=pol.cltv_delta if pol else 34,
+        fee_base_msat=pol.fee_base_msat if pol else 1000,
+        fee_proportional_millionths=pol.fee_ppm if pol else 10,
+        htlc_maximum_msat=ch.funding_sat * 1000,
+    )
+    h = hashlib.sha256(
+        hashlib.sha256(cu.signed_region()).digest()).digest()
+    r, s = ch.hsm.sign_node_announcement_hash(ch.client, h)
+    cu.signature = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    return cu.serialize()
+
+
+async def _ann_sig_raw_handler(peer, raw: bytes) -> None:
+    """Node-level intercept for announcement_signatures: stash on the
+    owning channel so nested recv()s can never drop the peer's one and
+    only send (peers transmit it once per connection)."""
+    try:
+        msg = M.AnnouncementSignatures.parse(raw)
+    except Exception:
+        return
+    ch = getattr(peer, "_ann_channels", {}).get(msg.channel_id)
+    if ch is not None:
+        ch._ann_pending = msg
+        # wake an idle channel loop; a nested recv just drops the poke
+        # (harmless — the stash survives until the next top-level pass)
+        peer.inbox.put_nowait(_AnnPoke())
+
+
+async def send_announcement_sigs(ch) -> None:
+    """Post-lockin: offer our announcement_signatures (BOLT#7 6-deep
+    gate is the topology's job; callers gate on announce-ability)."""
+    ca = _unsigned_ca(ch)
+    h = hashlib.sha256(
+        hashlib.sha256(ca.signed_region()).digest()).digest()
+    node_sig, btc_sig = ch.hsm.sign_channel_announcement(ch.client, h)
+    ch._ann_ours = (node_sig, btc_sig)
+    await ch.peer.send(M.AnnouncementSignatures(
+        channel_id=ch.channel_id,
+        short_channel_id=ca.short_channel_id,
+        node_signature=node_sig, bitcoin_signature=btc_sig))
+
+
+async def handle_announcement_sigs(ch, msg, gossipd, relay) -> None:
+    """Peer's half arrived: assemble the fully-signed channel_
+    announcement + our channel_update and inject both into gossipd —
+    which verifies them with the batched kernel, persists to the store,
+    and streams to filtered peers (gossmap_manage.c:687 role)."""
+    if getattr(ch, "_ann_ours", None) is None:
+        await send_announcement_sigs(ch)
+    ca = _unsigned_ca(ch)
+    _n1, _n2, _b1, _b2, we_are_1 = _ann_order(ch)
+    ours_n, ours_b = ch._ann_ours
+    if we_are_1:
+        ca.node_signature_1, ca.bitcoin_signature_1 = ours_n, ours_b
+        ca.node_signature_2 = msg.node_signature
+        ca.bitcoin_signature_2 = msg.bitcoin_signature
+    else:
+        ca.node_signature_2, ca.bitcoin_signature_2 = ours_n, ours_b
+        ca.node_signature_1 = msg.node_signature
+        ca.bitcoin_signature_1 = msg.bitcoin_signature
+    if gossipd is not None:
+        await gossipd.ingest.submit(ca.serialize(), source=None)
+        await gossipd.ingest.submit(_our_channel_update(ch, relay),
+                                    source=None)
+    log.info("channel %s announced (scid %x)",
+             ch.channel_id.hex()[:16], ca.short_channel_id)
+
+
+@dataclass
+class _AnnPoke:
+    """Inbox wake-up after _ann_sig_raw_handler stashed the peer's
+    announcement_signatures; carries nothing."""
+
+
 @dataclass
 class _Resolve:
     """In-loop sentinel: settle an incoming HTLC we previously held
@@ -1154,7 +1285,8 @@ async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
 
 async def channel_loop(ch: Channeld, node_privkey: int,
                        invoices=None, htlc_sets=None, relay=None,
-                       chain_backend=None, topology=None) -> T.Tx:
+                       chain_backend=None, topology=None,
+                       gossipd=None) -> T.Tx:
     """Serve one OPEN channel until cooperative close: apply updates,
     answer commitment dances, fulfill keysend/invoice HTLCs addressed to
     us (MPP parts held in htlc_sets until their set completes), hand
@@ -1167,6 +1299,37 @@ async def channel_loop(ch: Channeld, node_privkey: int,
     handled: set[int] = set()
     if relay is not None and ch.scid is None:
         relay.register_channel(ch)
+    if gossipd is not None and getattr(ch, "announce", False) \
+            and getattr(ch, "_ann_ours", None) is None:
+        # public channel: offer announcement_signatures once the loop
+        # owns the inbox (channeld.c channel_announce_sigs path).
+        # BOLT#7: MUST NOT send before the funding tx is ANNOUNCE_DEPTH
+        # deep — with a chain view, wait for depth in a side task (the
+        # manager cancels it when the loop dies).
+        async def _announce_when_deep():
+            try:
+                if topology is not None:
+                    while topology.depth(ch.funding_txid) < ANNOUNCE_DEPTH:
+                        if not ch.peer.connected:
+                            return
+                        await asyncio.sleep(0.25)
+                await send_announcement_sigs(ch)
+            except (HsmError, ChannelError, ConnectionError) as e:
+                log.warning("announcement sigs failed: %s", e)
+
+        ch._ann_task = asyncio.get_running_loop().create_task(
+            _announce_when_deep())
+        # the peer may answer while we are deep in a nested sub-flow
+        # (lockin recv, a commitment dance, a splice) — Peer.recv DROPS
+        # non-matching messages, so a raw handler stashes the peer's
+        # half on the channel; the loop consumes it at the next top-
+        # level iteration instead of losing it for the connection.
+        ann_map = getattr(ch.peer, "_ann_channels", None)
+        if ann_map is None:
+            ann_map = ch.peer._ann_channels = {}
+        ann_map[ch.channel_id] = ch
+        ch.peer.node.raw_handlers[M.AnnouncementSignatures.TYPE] = \
+            _ann_sig_raw_handler
 
     def _mpp_callbacks(hid: int, shared_secret: bytes):
         # set completion/timeout may fire from ANOTHER channel's task or
@@ -1192,12 +1355,27 @@ async def channel_loop(ch: Channeld, node_privkey: int,
     originated: dict[int, object] = {}
 
     while True:
+        pend = getattr(ch, "_ann_pending", None)
+        if pend is not None:
+            ch._ann_pending = None
+            if not getattr(ch, "announce", False):
+                log.warning("peer sent announcement_signatures for a "
+                            "PRIVATE channel %s; ignoring",
+                            ch.channel_id.hex()[:16])
+            else:
+                try:
+                    await handle_announcement_sigs(ch, pend, gossipd,
+                                                   relay)
+                except Exception:
+                    log.exception("announcement assembly failed")
         msg = await ch.peer.recv(
             M.UpdateAddHtlc, M.UpdateFulfillHtlc, M.UpdateFailHtlc,
             M.UpdateFee, M.CommitmentSigned, M.Shutdown, M.Stfu,
             _Resolve, _RelayOffer, _PayCommand, _CloseCommand,
-            _SpliceCommand, timeout=RECV_TIMEOUT,
+            _SpliceCommand, _AnnPoke, timeout=RECV_TIMEOUT,
         )
+        if isinstance(msg, _AnnPoke):
+            continue                 # stash handled at the loop top
         if isinstance(msg, M.Stfu):
             # peer initiates quiescence → a splice is coming
             from . import splice as SPL

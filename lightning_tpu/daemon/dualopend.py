@@ -348,8 +348,9 @@ async def open_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
         htlc_basepoint=ref.pubkey_serialize(ch.our_base.htlc),
         first_per_commitment_point=ref.pubkey_serialize(ch.our_point(0)),
         second_per_commitment_point=ref.pubkey_serialize(ch.our_point(1)),
-        channel_flags=1,
+        channel_flags=1 if cfg.announce else 0,
     ))
+    ch.announce = cfg.announce
     acc = await peer.recv(M.AcceptChannel2, timeout=RECV_TIMEOUT)
     ch.their_base = _parse_basepoints(acc)
     ch.their_funding_pub = acc.funding_pubkey
@@ -425,6 +426,7 @@ async def accept_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
     if in_total < contribute_sat:
         raise DualOpenError("inputs do not cover contribution")
     ch = Channeld(peer, hsm, client, funder=False, cfg=cfg)
+    ch.announce = bool(oc.channel_flags & 1)
     ch.their_base = _parse_basepoints(oc)
     ch.their_funding_pub = oc.funding_pubkey
     ch.their_points[0] = ref.pubkey_parse(oc.first_per_commitment_point)

@@ -94,6 +94,18 @@ class Hsm:
         client._need(CAP_SIGN_GOSSIP)
         return ref.ecdsa_sign(h32, self.node_key)
 
+    def sign_channel_announcement(self, client: HsmClient,
+                                  h32: bytes) -> tuple[bytes, bytes]:
+        """(node_signature, bitcoin_signature) over a channel_
+        announcement hash — node identity key + the channel's funding
+        key (hsmd_cannouncement_sig_req, hsmd/libhsmd.c)."""
+        client._need(CAP_SIGN_GOSSIP)
+        secs = self.channel_secrets(client)
+        nr, ns = ref.ecdsa_sign(h32, self.node_key)
+        br, bs = ref.ecdsa_sign(h32, secs.funding)
+        return (nr.to_bytes(32, "big") + ns.to_bytes(32, "big"),
+                br.to_bytes(32, "big") + bs.to_bytes(32, "big"))
+
     # -- channel-level ops ------------------------------------------------
 
     def channel_secrets(self, client: HsmClient) -> K.BaseSecrets:

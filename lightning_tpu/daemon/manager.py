@@ -30,9 +30,12 @@ class ManagerError(Exception):
 
 
 # channel states a reconnecting peer may reestablish into.  A hard crash
-# mid-splice leaves "awaiting_splice" + a persisted inflight; the
-# channel is still live on the old funding and must come back.
-_RESTORABLE = ("normal", "shutting_down", "awaiting_splice")
+# mid-splice leaves "awaiting_splice" + a persisted inflight; a crash
+# between funding_signed and lockin leaves "awaiting_lockin" — both are
+# live channels that must come back (the write-ahead records exist
+# precisely so these crashes lose nothing).
+_RESTORABLE = ("normal", "shutting_down", "awaiting_splice",
+               "awaiting_lockin")
 
 
 class _DeadPeer:
@@ -49,7 +52,7 @@ class ChannelManager:
     def __init__(self, node, hsm, wallet=None, onchain=None,
                  chain_backend=None, topology=None, invoices=None,
                  relay=None, htlc_sets=None, gossmap_ref=None,
-                 funder_policy=None):
+                 funder_policy=None, gossipd=None):
         self.node = node
         self.hsm = hsm
         self.wallet = wallet
@@ -61,6 +64,7 @@ class ChannelManager:
         self.htlc_sets = htlc_sets
         self.gossmap_ref = gossmap_ref or {"map": None}
         self.funder_policy = funder_policy
+        self.gossipd = gossipd   # own-channel gossip origination
         # channel_id -> (Channeld, loop task)
         self.channels: dict[bytes, tuple] = {}
         # peer_id -> Channeld awaiting fundchannel_complete
@@ -170,7 +174,8 @@ class ChannelManager:
             tx = await CD.channel_loop(
                 ch, self.hsm.node_key, invoices=self.invoices,
                 htlc_sets=self.htlc_sets, relay=self.relay,
-                chain_backend=self.chain_backend, topology=self.topology)
+                chain_backend=self.chain_backend, topology=self.topology,
+                gossipd=self.gossipd)
             ocd = getattr(ch, "_onchaind", None)
             if tx is not None and ocd is not None:
                 # peer-initiated cooperative closes ALSO resolve here
@@ -185,6 +190,12 @@ class ChannelManager:
             log.exception("channel %s loop crashed",
                           ch.channel_id.hex()[:16])
         finally:
+            # a depth-waiting announcement task must die with the loop:
+            # it would otherwise poll forever (or announce a closed
+            # channel once depth is finally reached)
+            ann = getattr(ch, "_ann_task", None)
+            if ann is not None:
+                ann.cancel()
             # pop only OUR registration: a reestablish may have replaced
             # this entry with a fresh Channeld under the same channel_id,
             # and a dying old loop must not evict its successor
@@ -218,6 +229,7 @@ class ChannelManager:
                 except CD.ChannelError as e:
                     log.warning("inbound reestablish failed: %s", e)
                     continue
+                await self._maybe_complete_lockin(ch)
                 await self._maybe_resume_splice(ch)
                 self._spawn_loop(ch)
             elif isinstance(first, WM.OpenChannel2):
@@ -267,6 +279,26 @@ class ChannelManager:
                 return CD.restore_channeld(self.wallet, row, peer,
                                            self.hsm)
         return None
+
+    async def _maybe_complete_lockin(self, ch) -> None:
+        """Finish an open interrupted between funding_signed and
+        channel_ready: wait for depth and re-run the channel_ready
+        exchange (BOLT#2: on reconnect before channel_ready, both sides
+        retransmit it; lightningd re-arms the lockin watch at load)."""
+        from ..channel.state import ChannelState
+
+        if ch.core.state is not ChannelState.AWAITING_LOCKIN:
+            return
+        try:
+            await asyncio.wait_for(CD.open_lockin(
+                ch, topology=self.topology, wallet=self.wallet,
+                hsm_dbid=ch.hsm_dbid), 60)
+            log.info("completed lockin for %s after restart",
+                     ch.channel_id.hex()[:16])
+        except (asyncio.TimeoutError, CD.ChannelError,
+                ConnectionError) as e:
+            log.warning("lockin completion for %s failed: %s",
+                        ch.channel_id.hex()[:16], e)
 
     async def _maybe_resume_splice(self, ch) -> None:
         """Finish a splice whose inflight survived a crash between
@@ -398,6 +430,7 @@ class ChannelManager:
                 log.warning("reestablish with %s failed: %s",
                             peer.node_id.hex()[:16], e)
                 continue
+            await self._maybe_complete_lockin(ch)
             await self._maybe_resume_splice(ch)
             self._spawn_loop(ch)
             return 1
@@ -432,6 +465,7 @@ class ChannelManager:
                 log.warning("reestablish failed for %s: %s",
                             row["channel_id"].hex()[:16], e)
                 continue
+            await self._maybe_complete_lockin(ch)
             await self._maybe_resume_splice(ch)
             self._spawn_loop(ch)
             n += 1
@@ -440,7 +474,8 @@ class ChannelManager:
     # -- RPC: channels -------------------------------------------------
 
     async def fundchannel(self, peer_id: bytes, amount_sat: int,
-                          push_msat: int = 0) -> dict:
+                          push_msat: int = 0,
+                          announce: bool = True) -> dict:
         peer = self.node.peers.get(peer_id)
         if peer is None:
             raise ManagerError(f"peer {peer_id.hex()[:16]} not connected")
@@ -454,6 +489,7 @@ class ChannelManager:
         client = self.hsm.client(CAP_MASTER, peer_id, dbid=dbid)
         ch = await CD.open_channel(
             peer, self.hsm, client, amount_sat, push_msat=push_msat,
+            cfg=CD.ChannelConfig(announce=announce),
             wallet=self.wallet, hsm_dbid=dbid, onchain=self.onchain,
             chain_backend=self.chain_backend, topology=self.topology)
         self._spawn_loop(ch)
@@ -466,7 +502,8 @@ class ChannelManager:
     #    and broadcasts the funding tx; we only see its outpoint --------
 
     async def fundchannel_start(self, peer_id: bytes, amount_sat: int,
-                                push_msat: int = 0) -> dict:
+                                push_msat: int = 0,
+                                announce: bool = True) -> dict:
         from ..btc import address as ADDR
         from ..btc import script as SC
 
@@ -478,8 +515,9 @@ class ChannelManager:
         dbid = self._next_dbid
         self._next_dbid += 1
         client = self.hsm.client(CAP_MASTER, peer_id, dbid=dbid)
-        ch = await CD.open_negotiate(peer, self.hsm, client,
-                                     int(amount_sat), push_msat=push_msat)
+        ch = await CD.open_negotiate(
+            peer, self.hsm, client, int(amount_sat), push_msat=push_msat,
+            cfg=CD.ChannelConfig(announce=announce))
         ch._fcs_dbid = dbid
         spk = SC.p2wsh(ch._funding_script())
         self._pending_opens[peer_id] = ch
@@ -930,7 +968,8 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     async def fundchannel(id: str, amount, push_msat: int = 0,
                           announce: bool = True) -> dict:
         return await mgr.fundchannel(bytes.fromhex(id), int(amount),
-                                     push_msat=int(push_msat))
+                                     push_msat=int(push_msat),
+                                     announce=bool(announce))
 
     async def close(id: str) -> dict:
         return await mgr.close(id)
@@ -1025,7 +1064,8 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     async def fundchannel_start(id: str, amount, push_msat: int = 0,
                                 announce: bool = True) -> dict:
         return await mgr.fundchannel_start(bytes.fromhex(id), int(amount),
-                                           push_msat=int(push_msat))
+                                           push_msat=int(push_msat),
+                                           announce=bool(announce))
 
     async def fundchannel_complete(id: str, psbt: str) -> dict:
         return await mgr.fundchannel_complete(bytes.fromhex(id), psbt)

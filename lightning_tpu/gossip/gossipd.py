@@ -69,6 +69,12 @@ class Gossipd:
         self.node_msgs: dict[bytes, bytes] = {}  # node_id -> na raw
         self.filters: dict[bytes, tuple[int, int]] = {}  # peer -> (t0, dt)
         self._synced: dict[bytes, asyncio.Event] = {}
+        # we sent THEM a filter — keyed by the Peer OBJECT (WeakSet):
+        # filter state is per-connection (BOLT#7), so a reconnect's new
+        # Peer must get a fresh filter or the remote streams us nothing
+        import weakref
+
+        self._filter_sent = weakref.WeakSet()
 
         for t in (gwire.MSG_CHANNEL_ANNOUNCEMENT,
                   gwire.MSG_NODE_ANNOUNCEMENT, gwire.MSG_CHANNEL_UPDATE):
@@ -232,13 +238,19 @@ class Gossipd:
                         backfill_from: int = 0,
                         timeout: float = 30.0) -> int:
         """Catch up from one peer: set a timestamp filter, learn its scid
-        set, fetch the ones we don't know.  Returns #scids requested."""
+        set, fetch the ones we don't know.  Returns #scids requested.
+
+        The filter is sent once per peer connection: re-sending it makes
+        the peer re-backfill its whole store (our _on_filter streams the
+        full backlog), which a periodic seeker probe must not trigger."""
         evt = asyncio.Event()
         self._synced[peer.node_id] = evt
         self._requested = 0
-        await peer.send(M.GossipTimestampFilter(
-            chain_hash=self.chain_hash, first_timestamp=backfill_from,
-            timestamp_range=0xFFFFFFFF))
+        if peer not in self._filter_sent:
+            self._filter_sent.add(peer)
+            await peer.send(M.GossipTimestampFilter(
+                chain_hash=self.chain_hash, first_timestamp=backfill_from,
+                timestamp_range=0xFFFFFFFF))
         await peer.send(M.QueryChannelRange(
             chain_hash=self.chain_hash, first_blocknum=first_blocknum,
             number_of_blocks=number_of_blocks))

@@ -133,6 +133,37 @@ class StoreWriter:
         self.close()
 
 
+def mark_deleted(path: str, scids: set[int]) -> int:
+    """Set FLAG_DELETED in place on every channel_announcement /
+    channel_update whose scid is in `scids` (the reference's
+    gossip_store_del flag flip, gossipd/gossip_store.c).  The crc covers
+    (timestamp, msg) only, so flag flips never invalidate records.
+    Returns the number of records flagged."""
+    from . import wire as gwire
+
+    idx = load_store(path)
+    n = 0
+    with open(path, "r+b") as f:
+        for i in range(len(idx)):
+            if idx.flags[i] & FLAG_DELETED:
+                continue
+            if idx.types[i] not in (gwire.MSG_CHANNEL_ANNOUNCEMENT,
+                                    gwire.MSG_CHANNEL_UPDATE):
+                continue
+            try:
+                p = gwire.parse_gossip(idx.message(i))
+            except Exception:
+                continue
+            if p.short_channel_id in scids:
+                f.seek(int(idx.offsets[i]) - 12)
+                f.write((int(idx.flags[i])
+                         | FLAG_DELETED).to_bytes(2, "big"))
+                n += 1
+        f.flush()
+        os.fsync(f.fileno())
+    return n
+
+
 def compact_store(src: str, dst: str) -> int:
     """Rewrite the store dropping deleted records (the reference runs this
     as a dedicated subdaemon, gossipd/compactd.c).  Returns record count."""

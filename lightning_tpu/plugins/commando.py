@@ -304,8 +304,8 @@ def attach_commando_commands(rpc, commando: Commando, db=None) -> None:
     async def checkrune(rune: str, method: str = "",
                         params: dict | None = None,
                         nodeid: str = "") -> dict:
-        if _is_blacklisted(rune):
-            raise RpcError(COMMANDO_ERROR, "rune rejected: blacklisted")
+        # commando.check_rune consults the blacklist itself (via
+        # blacklist_check below) — no separate scan here
         why = commando.check_rune(rune, method, params or {},
                                   bytes.fromhex(nodeid) if nodeid else b"")
         if why is not None:

@@ -145,6 +145,10 @@ MIGRATIONS: list[str] = [
     # wallet/wallet.c wallet_channel_insert_inflight).  JSON blob; empty
     # = no inflight.
     "ALTER TABLE channels ADD COLUMN inflight BLOB NOT NULL DEFAULT x''",
+    # 14: BOLT#2 announce_channel bit — a restored channel must keep its
+    # public/private nature (re-announcing a private channel on restart
+    # would leak it; forgetting a public one breaks re-announcement)
+    "ALTER TABLE channels ADD COLUMN announce INTEGER NOT NULL DEFAULT 0",
 ]
 
 

@@ -90,6 +90,7 @@ class Wallet:
                                         ch.retransmit),
             inflight=(json.dumps(ch.inflight).encode()
                       if getattr(ch, "inflight", None) else b""),
+            announce=int(getattr(ch, "announce", False)),
         )
         with self.db.transaction() as c:
             if getattr(ch, "wallet_id", None) is None:
@@ -168,6 +169,7 @@ class Wallet:
             row.get("retransmit") or b"")
         raw_inflight = row.get("inflight") or b""
         ch.inflight = json.loads(raw_inflight) if raw_inflight else None
+        ch.announce = bool(row.get("announce", 0))
         ch.core = ChannelCore(
             funding_sat=row["funding_sat"],
             to_local_msat=row["to_local_msat"],

@@ -1,0 +1,86 @@
+"""Generated protobuf transport (cln-grpc-equivalent): the full
+schema'd surface served over length-prefixed protobuf frames, driven by
+the generic binary client — covering the typed-client invoice/pay flow
+end-to-end over the new transport (round-3 verdict #8)."""
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lightning_tpu.chain.backend import FakeBitcoind  # noqa: E402
+from lightning_tpu.daemon.binrpc import (BinRpcClient,  # noqa: E402
+                                         BinRpcServer)
+from lightning_tpu.rpcschema.protogen import generate_proto  # noqa: E402
+from test_daemon_rpc import Stack  # noqa: E402
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 900))
+
+
+def test_generated_proto_is_current():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lightning_tpu", "clients",
+        "lightning.proto")
+    with open(path) as f:
+        assert f.read() == generate_proto(), (
+            "lightning.proto is stale — run "
+            "`python -m lightning_tpu.rpcschema.protogen`")
+
+
+def test_invoice_pay_flow_over_binary_transport(tmp_path):
+    async def body():
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x0a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x0b" * 32, bitcoind).start()
+        sa = BinRpcServer(a.rpc, str(tmp_path / "a.binrpc"))
+        sb = BinRpcServer(b.rpc, str(tmp_path / "b.binrpc"))
+        await sa.start()
+        await sb.start()
+        ca = await BinRpcClient(sa.path).connect()
+        cb = await BinRpcClient(sb.path).connect()
+        try:
+            info_b = await cb.call("getinfo")
+            assert len(info_b["id"]) == 66
+            port = await b.node.listen()
+            got = await ca.call(
+                "connect", id=f"{info_b['id']}@127.0.0.1:{port}")
+            assert got["id"] == info_b["id"]
+
+            await ca.call("dev-faucet", satoshi=2_000_000)
+            fund = asyncio.create_task(
+                a.manager.fundchannel(b.node.node_id, 1_000_000))
+            while not bitcoind.mempool and not fund.done():
+                await asyncio.sleep(0.05)
+            if bitcoind.mempool:
+                bitcoind.generate(1)
+            await asyncio.wait_for(fund, 600)
+
+            inv = await cb.call("invoice", amount_msat=42_000,
+                                label="bin", description="x")
+            assert inv["bolt11"].startswith("lnbcrt")
+            paid = await ca.call("pay", bolt11=inv["bolt11"])
+            assert paid["status"] == "complete"
+            lst = await cb.call("listinvoices", label="bin")
+            assert lst["invoices"][0]["status"] == "paid"
+
+            # error path: unknown peer must come back as a clean error
+            with pytest.raises(RuntimeError):
+                await ca.call("ping", id="02" + "11" * 32)
+            # and the connection survives the error
+            assert (await ca.call("getinfo"))["num_peers"] >= 1
+        finally:
+            await ca.close()
+            await cb.close()
+            await sa.close()
+            await sb.close()
+            await a.close()
+            await b.close()
+
+    run(body())

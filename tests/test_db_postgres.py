@@ -1,0 +1,86 @@
+"""Postgres driver: dialect rewriting (db_postgres.c +
+devtools/sql-rewrite.py parity) and the full Db surface — migrations,
+wallet channel persistence round-trip, db_write streaming — proven
+against the in-process emulation (the environment ships no postgres
+server; the emulation REJECTS sqlite-dialect leakage, so every
+statement demonstrably went through the rewriter)."""
+from __future__ import annotations
+
+import pytest
+
+from lightning_tpu.wallet import db_postgres as PG
+
+
+def test_rewrite_rules():
+    assert PG.rewrite("SELECT * FROM t WHERE a=? AND b=?") == \
+        "SELECT * FROM t WHERE a=$1 AND b=$2"
+    assert PG.rewrite("CREATE TABLE x (r BLOB NOT NULL)") == \
+        "CREATE TABLE x (r BYTEA NOT NULL)"
+    assert PG.rewrite("CREATE TABLE y (id INTEGER PRIMARY KEY)") == \
+        "CREATE TABLE y (id BIGSERIAL PRIMARY KEY)"
+    assert PG.rewrite("ALTER TABLE c ADD COLUMN r BLOB DEFAULT x''") == \
+        "ALTER TABLE c ADD COLUMN r BYTEA DEFAULT decode('', 'hex')"
+    assert PG.rewrite("PRAGMA journal_mode=WAL") == ""
+    # ? inside a string literal is NOT a parameter
+    assert PG.rewrite("INSERT INTO t VALUES ('a?b', ?)") == \
+        "INSERT INTO t VALUES ('a?b', $1)"
+
+
+def test_emulation_rejects_sqlite_dialect():
+    be = PG.EmulatedPostgres()
+    with pytest.raises(PG.DbUnavailable):
+        be.execute("SELECT ?", (1,))
+    with pytest.raises(PG.DbUnavailable):
+        be.execute("CREATE TABLE t (b BLOB)")
+
+
+def test_migrations_and_vars_round_trip():
+    db = PG.PostgresDb(backend=PG.EmulatedPostgres())
+    assert db.get_var("nothing", "dflt") == "dflt"
+    db.set_var("k", "v1")
+    db.set_var("k", "v2")
+    assert db.get_var("k") == "v2"
+    # all MIGRATIONS applied: the channels table exists with migration-13
+    # and -14 columns
+    with db.transaction() as c:
+        c.execute(
+            "INSERT INTO channels (peer_node_id, hsm_dbid, funder,"
+            " channel_id, funding_txid, funding_outidx, funding_sat,"
+            " state, to_local_msat, to_remote_msat, feerate_per_kw,"
+            " opener_is_local, anchors, reserve_local_msat,"
+            " reserve_remote_msat, next_local_commit, next_remote_commit,"
+            " delay_on_local, delay_on_remote, their_dust_limit,"
+            " their_funding_pub, their_basepoints, their_points,"
+            " their_last_secret, inflight, announce)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,"
+            "?,?)",
+            (b"\x02" * 33, 1, 1, b"\xaa" * 32, b"\xbb" * 32, 0, 12345,
+             "normal", 12345000, 0, 253, 1, 1, 546000, 546000, 1, 1,
+             144, 144, 546, b"\x03" * 33, b"\x02" * 165, b"{}",
+             b"\x00" * 32, b"", 1))
+    row = db.conn.execute(
+        "SELECT funding_sat, state, announce FROM channels").fetchone()
+    assert row == (12345, "normal", 1)
+    db.close()
+
+
+def test_db_write_hook_streams_and_vetoes():
+    db = PG.PostgresDb(backend=PG.EmulatedPostgres())
+    seen = []
+    db.set_db_write_hook(lambda v, batch: seen.append((v, batch)))
+    db.set_var("a", "1")
+    assert seen and seen[-1][0] == 1
+    assert any("INSERT INTO vars" in s for s, _ in seen[-1][1])
+
+    def veto(v, batch):
+        raise RuntimeError("no")
+
+    db.set_db_write_hook(veto)
+    with pytest.raises(RuntimeError):
+        db.set_var("a", "2")
+    db.set_db_write_hook(lambda v, batch: seen.append((v, batch)))
+    db.set_var("a", "3")
+    # the vetoed version number was reused — no gap in the stream
+    assert seen[-1][0] == 2
+    assert db.get_var("a") == "3"
+    db.close()

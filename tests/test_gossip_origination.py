@@ -1,0 +1,112 @@
+"""Own-channel gossip origination end-to-end (round-3 verdict #7):
+opening a public channel exchanges announcement_signatures, the
+assembled channel_announcement + channel_update pass the ingest's
+batched verification on BOTH endpoints, and a THIRD node that syncs
+gossip routes through the new channel with no manual topology help.
+
+Reference path: channeld.c send_channel_announce_sigs → gossipd
+gossmap_manage.c:687."""
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lightning_tpu.chain.backend import FakeBitcoind  # noqa: E402
+from lightning_tpu.daemon.node import LightningNode  # noqa: E402
+from lightning_tpu.daemon.relay import derive_scid  # noqa: E402
+from lightning_tpu.gossip import gossipd as GD  # noqa: E402
+from lightning_tpu.gossip import gossmap as GM  # noqa: E402
+from lightning_tpu.gossip import store as gstore  # noqa: E402
+from lightning_tpu.routing import dijkstra as DJ  # noqa: E402
+from test_daemon_rpc import Stack, rpc_call  # noqa: E402
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 900))
+
+
+async def _wait(cond, timeout=90.0):
+    for _ in range(int(timeout / 0.05)):
+        if cond():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def test_public_channel_announces_and_routes(tmp_path):
+    async def body():
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x0a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x0b" * 32, bitcoind).start()
+        ga = GD.Gossipd(a.node, str(tmp_path / "ga.gs"), flush_ms=1.0)
+        gb = GD.Gossipd(b.node, str(tmp_path / "gb.gs"), flush_ms=1.0)
+        a.manager.gossipd = ga
+        b.manager.gossipd = gb
+        ga.start()
+        gb.start()
+        nd = LightningNode(privkey=0xD111)
+        gd = GD.Gossipd(nd, str(tmp_path / "gd.gs"), flush_ms=1.0)
+        gd.start()
+        try:
+            port = await b.node.listen()
+            await a.node.connect("127.0.0.1", port, b.node.node_id)
+            await rpc_call(a.rpc.rpc_path, "dev-faucet",
+                           {"satoshi": 2_000_000})
+            fund = asyncio.create_task(
+                a.manager.fundchannel(b.node.node_id, 1_000_000))
+            while not bitcoind.mempool and not fund.done():
+                await asyncio.sleep(0.05)
+            if bitcoind.mempool:
+                # 6 blocks: the BOLT#7 announcement depth gate
+                bitcoind.generate(6)
+            opened = await asyncio.wait_for(fund, 600)
+            scid = derive_scid(
+                bytes.fromhex(opened["funding_txid"]), opened["outnum"])
+
+            # both endpoints assemble + verify + persist the CA and
+            # their own CU via their ingest pipelines
+            ok = await _wait(lambda: scid in ga.ingest.channels
+                             and scid in gb.ingest.channels)
+            assert ok, (
+                f"announcement never landed: scid={scid:#x} "
+                f"A={set(ga.ingest.channels)} {vars(ga.ingest.stats)} "
+                f"B={set(gb.ingest.channels)} {vars(gb.ingest.stats)}")
+            ok = await _wait(lambda: (scid, 0) in ga.ingest.updates
+                             or (scid, 1) in ga.ingest.updates)
+            assert ok, f"own channel_update never accepted: " \
+                       f"{vars(ga.ingest.stats)}"
+
+            # third node: sync from BOTH endpoints (gets the CA and the
+            # two directions' updates), then route purely from gossip
+            pa = await a.node.listen()
+            pb2 = await b.node.listen()
+            peer_da = await nd.connect("127.0.0.1", pa, a.node.node_id)
+            peer_db = await nd.connect("127.0.0.1", pb2, b.node.node_id)
+            await gd.sync_with(peer_da, timeout=60)
+            await gd.sync_with(peer_db, timeout=60)
+            ok = await _wait(
+                lambda: scid in gd.ingest.channels
+                and (scid, 0) in gd.ingest.updates
+                and (scid, 1) in gd.ingest.updates)
+            assert ok, (
+                f"third node view incomplete: {set(gd.ingest.channels)} "
+                f"{set(gd.ingest.updates)}")
+            await gd.ingest.drain()
+
+            g = GM.from_store(gstore.load_store(str(tmp_path / "gd.gs")))
+            hops = DJ.getroute(g, a.node.node_id, b.node.node_id,
+                               50_000, final_cltv=18)
+            assert [h.scid for h in hops] == [scid]
+            assert hops[0].node_id == b.node.node_id
+        finally:
+            for g_ in (ga, gb, gd):
+                await g_.close()
+            await nd.close()
+            await a.close()
+            await b.close()
+
+    run(body())

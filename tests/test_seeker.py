@@ -1,0 +1,174 @@
+"""Autonomous seeker (gossipd/seeker.c parity): a BLANK node converges
+to the network view with NO manual sync_with, peers are rotated, probes
+back off while current, a big gap escalates to a full re-sync, and
+stale channels get pruned."""
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+from lightning_tpu.daemon.node import LightningNode
+from lightning_tpu.gossip import gossipd as GD
+from lightning_tpu.gossip import seeker as SK
+from lightning_tpu.gossip import store as gstore
+from tests.test_gossipd import SCID_A, SCID_B, seed_store
+from tests.test_ingest import K1, K2, make_ca, make_cu
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _warm_verify_kernels():
+    """Trace+load the bucket-64 hash/verify programs BEFORE the timed
+    convergence windows: the first ingest flush otherwise pays ~20s of
+    jax tracing inside its worker thread (once per process)."""
+    import jax.numpy as jnp
+
+    from lightning_tpu.crypto import field as F
+    from lightning_tpu.crypto import secp256k1 as S
+    from lightning_tpu.gossip import verify
+
+    B = 64
+    z = jnp.zeros((B, F.NLIMBS), jnp.uint32)
+    par = jnp.zeros(B, jnp.uint32)
+    blocks = jnp.zeros((B, verify.MAX_BLOCKS, 16), jnp.uint32)
+    nb = jnp.ones(B, jnp.int32)
+    zz = verify._jit_hash()(blocks, nb)
+    np.asarray(S._jit_verify()(zz, z, z, z, par))
+
+
+async def _wait(cond, timeout=60.0):
+    for _ in range(int(timeout / 0.05)):
+        if cond():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def test_blank_node_converges_autonomously(tmp_path):
+    """3 nodes: A and B hold the seeded view; C starts blank, connects
+    to both, and its Seeker pulls the full view with no manual calls."""
+
+    async def body():
+        na = LightningNode(privkey=0xA111)
+        nb = LightningNode(privkey=0xB222)
+        nc = LightningNode(privkey=0xC333)
+        seed = str(tmp_path / "seed.gs")
+        seed_store(seed)
+        ga = GD.Gossipd(na, str(tmp_path / "a.gs"), flush_ms=1.0)
+        ga.load_existing(seed)
+        gb = GD.Gossipd(nb, str(tmp_path / "b.gs"), flush_ms=1.0)
+        gb.load_existing(seed)
+        gc = GD.Gossipd(nc, str(tmp_path / "c.gs"), flush_ms=1.0)
+        for g in (ga, gb, gc):
+            g.start()
+        sk = SK.Seeker(gc, interval=0.2, rng=random.Random(7),
+                clock=lambda: 200.0)  # seed ts ~100: defuse prune
+        try:
+            pa = await na.listen()
+            pb = await nb.listen()
+            await nc.connect("127.0.0.1", pa, na.node_id)
+            await nc.connect("127.0.0.1", pb, nb.node_id)
+            sk.start()
+            ok = await _wait(
+                lambda: set(gc.ingest.channels) == {SCID_A, SCID_B})
+            assert ok, f"C never converged: {set(gc.ingest.channels)}"
+            assert sk.stats["full_syncs"] >= 1
+            # steady state: probes continue and back off
+            ok = await _wait(lambda: sk.stats["probes"] >= 2, timeout=30)
+            assert ok
+            assert sk.backoff > 1   # nothing new → backing off
+            assert sk._rotation >= 2   # both peers were consulted
+        finally:
+            await sk.close()
+            for g in (ga, gb, gc):
+                await g.close()
+            for n in (na, nb, nc):
+                await n.close()
+
+    run(body())
+
+
+def test_probe_gap_escalates_to_full_sync(tmp_path):
+    """A channel appearing on the serving node AFTER the initial sync
+    is found by a later probe; a LARGE batch of unknown scids flips the
+    seeker back to the full-sync state."""
+
+    async def body():
+        na = LightningNode(privkey=0xA444)
+        nc = LightningNode(privkey=0xC555)
+        seed = str(tmp_path / "seed.gs")
+        seed_store(seed)
+        ga = GD.Gossipd(na, str(tmp_path / "a.gs"), flush_ms=1.0)
+        ga.load_existing(seed)
+        gc = GD.Gossipd(nc, str(tmp_path / "c.gs"), flush_ms=1.0)
+        ga.start()
+        gc.start()
+        sk = SK.Seeker(gc, interval=0.2, rng=random.Random(3),
+                clock=lambda: 200.0)  # seed ts ~100: defuse prune
+        try:
+            pa = await na.listen()
+            await nc.connect("127.0.0.1", pa, na.node_id)
+            await sk.tick()        # startup full sync
+            ok = await _wait(
+                lambda: set(gc.ingest.channels) == {SCID_A, SCID_B})
+            assert ok, f"initial sync incomplete: {set(gc.ingest.channels)}"
+            assert sk.state == "probing"
+
+            # many new channels appear on A in a block cluster; pin the
+            # probe window onto it (the randomness is the rng's job, the
+            # state machine's reaction is what this test checks)
+            new_scids = [(549_500 + i % 32) << 40 | (100 + i) << 16
+                         for i in range(SK.FULL_SYNC_THRESHOLD)]
+            for s in new_scids:
+                raw = make_ca(K1, K2, s)
+                ga.ingest.channels[s] = (None, None)
+                ga.msgs.setdefault(s, {})["ca"] = raw
+
+            class _Pin:
+                def randrange(self, lo, hi):
+                    return 549_000       # window covers the cluster
+
+            sk.rng = _Pin()
+            await sk.tick()
+            assert sk.state == "startup", "probe did not escalate"
+            await sk.tick()        # the escalated full sync
+            ok = await _wait(
+                lambda: set(new_scids) <= set(gc.ingest.channels))
+            assert ok, "escalated sync did not deliver the gap"
+        finally:
+            await sk.close()
+            await ga.close()
+            await gc.close()
+            await na.close()
+            await nc.close()
+
+    run(body())
+
+
+def test_prune_stale_channels(tmp_path):
+    async def body():
+        n = LightningNode(privkey=0xD666)
+        g = GD.Gossipd(n, str(tmp_path / "d.gs"), flush_ms=1.0)
+        now = 1_700_000_000.0
+        sk = SK.Seeker(g, clock=lambda: now)
+        # one fresh channel, one stale, one with no update at all
+        g.ingest.channels[SCID_A] = (None, None)
+        g.ingest.updates[(SCID_A, 0)] = int(now - 100)
+        g.ingest.channels[SCID_B] = (None, None)
+        g.ingest.updates[(SCID_B, 0)] = int(now - SK.PRUNE_AGE - 10)
+        scid_c = 7 << 40
+        g.ingest.channels[scid_c] = (None, None)
+
+        assert sk.prune_stale() == 1
+        assert SCID_A in g.ingest.channels
+        assert SCID_B not in g.ingest.channels      # stale → gone
+        assert scid_c in g.ingest.channels          # updateless → kept
+        assert (SCID_B, 0) not in g.ingest.updates
+        await g.close()
+        await n.close()
+
+    run(body())
