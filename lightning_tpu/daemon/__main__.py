@@ -76,10 +76,28 @@ async def amain(args) -> int:
     logging.getLogger("lightning_tpu.lightningd").info(
         "server started, node_id %s", node.node_id.hex())
 
+    if args.proxy:
+        host, _, p_ = args.proxy.rpartition(":")
+        node.tor_proxy = (host, int(p_))
+        print(f"socks5 proxy {args.proxy}", flush=True)
+
     wss = None
+    tor_ctl = None
     if args.listen is not None:
         port = await node.listen(args.bind, args.listen)
         print(f"listening {args.bind}:{port}", flush=True)
+        if args.tor_control:
+            from .tor import TorController, TorError
+
+            th, _, tp = args.tor_control.rpartition(":")
+            try:
+                tor_ctl = await TorController(
+                    th, int(tp), password=args.tor_password).connect()
+                await tor_ctl.authenticate()
+                svc = await tor_ctl.add_onion(9735, args.bind, port)
+                print(f"tor hidden service {svc['onion']}", flush=True)
+            except (TorError, OSError, asyncio.TimeoutError) as e:
+                print(f"tor autoservice failed: {e}", file=sys.stderr)
         if args.wss_port is not None:
             from .wssproxy import WssProxy
 
@@ -273,6 +291,14 @@ async def amain(args) -> int:
                                hsm_client=hsm.client(CAP_SIGN_ONCHAIN),
                                backend=chain_backend, topology=topology),
                 hsm=hsm)
+        from ..plugins.lsps import LspsService, attach_lsps_commands
+
+        lsps = LspsService(node, invoices=invoices, manager=manager,
+                           lsp_enabled=args.lsp_service)
+        attach_lsps_commands(rpc, lsps)
+        if args.lsp_service:
+            print("lsps service enabled (LSPS0/1/2)", flush=True)
+
         rune_secret = _hl.sha256(
             b"commando" + node_seckey.to_bytes(32, "big")).digest()[:16]
         commando = Commando(node, rpc, rune_secret)
@@ -438,6 +464,8 @@ async def amain(args) -> int:
         await rpc.close()
     if wss is not None:
         await wss.close()
+    if tor_ctl is not None:
+        await tor_ctl.close()
     if seeker is not None:
         await seeker.close()
     if gossipd is not None:
@@ -476,6 +504,17 @@ def main() -> int:
     p.add_argument("--bin-rpc-file", default=None, metavar="PATH",
                    help="serve the generated protobuf API on this unix "
                         "socket (cln-grpc-equivalent surface)")
+    p.add_argument("--proxy", default=None, metavar="HOST:PORT",
+                   help="SOCKS5 proxy for outbound dials (tor; .onion "
+                        "targets require it)")
+    p.add_argument("--tor-control", default=None, metavar="HOST:PORT",
+                   help="tor control port for autotor hidden-service "
+                        "provisioning (with --listen)")
+    p.add_argument("--tor-password", default=None,
+                   help="control-port password (cookie auth otherwise)")
+    p.add_argument("--lsp-service", action="store_true",
+                   help="serve LSPS0/1/2 liquidity requests from peers "
+                        "(sell channels for fees)")
     p.add_argument("--gossip-store", default=None,
                    help="gossip_store file to build the routing graph from")
     p.add_argument("--bitcoind-rpc", default=None,

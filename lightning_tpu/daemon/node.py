@@ -47,6 +47,7 @@ class LightningNode:
         self.on_peer_gone = None
         self.addresses: dict[bytes, tuple[str, int]] = {}  # last good addr
         self.plugin_host = None  # set by daemon assembly (hooks.py anchor)
+        self.tor_proxy: tuple[str, int] | None = None  # SOCKS5 (h, p)
         self._server: asyncio.AbstractServer | None = None
         self._peer_tasks: set[asyncio.Task] = set()
         self.closing = False
@@ -86,9 +87,22 @@ class LightningNode:
 
     async def connect(self, host: str, port: int, node_id: bytes,
                       timeout: float = 30.0) -> Peer:
-        """Dial, handshake, exchange init.  Returns the live Peer."""
+        """Dial, handshake, exchange init.  Returns the live Peer.
+        With tor_proxy set (or always for .onion targets) the TCP dial
+        rides SOCKS5 (connectd/tor.c)."""
+        open_conn = None
+        if self.tor_proxy is not None or host.endswith(".onion"):
+            if self.tor_proxy is None:
+                raise ConnectionError(
+                    f"{host} needs a tor proxy (none configured)")
+            from . import tor as TOR
+
+            ph, pp = self.tor_proxy
+            open_conn = (lambda h, p:
+                         TOR.socks5_connect(ph, pp, h, p))
         stream = await asyncio.wait_for(
-            connect_noise(host, port, self.keypair, node_id), timeout
+            connect_noise(host, port, self.keypair, node_id,
+                          open_conn=open_conn), timeout
         )
         try:
             peer = await self._setup_peer(stream, incoming=False)

@@ -55,10 +55,16 @@ class NoiseStream:
 
 async def connect_noise(host: str, port: int, local: noise.Keypair,
                         remote_pub: bytes,
-                        ephemeral: noise.Keypair | None = None) -> NoiseStream:
+                        ephemeral: noise.Keypair | None = None,
+                        open_conn=None) -> NoiseStream:
     """Dial a peer and run the initiator side of the 3-act handshake
-    (connectd/connectd.c:793 connection_out)."""
-    reader, writer = await asyncio.open_connection(host, port)
+    (connectd/connectd.c:793 connection_out).  open_conn: alternative
+    async (host, port) -> (reader, writer) dialer — the SOCKS5/tor path
+    (connectd/tor.c) plugs in here."""
+    if open_conn is not None:
+        reader, writer = await open_conn(host, port)
+    else:
+        reader, writer = await asyncio.open_connection(host, port)
     try:
         e = ephemeral or random_keypair()
         act1, on_act2 = noise.initiator_handshake(

@@ -56,7 +56,10 @@ class Gossipd:
     def __init__(self, node, store_path: str,
                  chain_hash: bytes = gwire.MAINNET_CHAIN_HASH,
                  utxo_check=None, flush_ms: float = 2.0,
-                 flush_size: int = 256, bucket: int = 64):
+                 flush_size: int = 256, bucket: int | None = None):
+        from . import verify as _gv
+
+        bucket = bucket if bucket is not None else _gv.DEFAULT_BUCKET
         self.node = node
         self.chain_hash = chain_hash
         self.ingest = GossipIngest(

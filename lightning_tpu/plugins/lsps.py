@@ -1,0 +1,389 @@
+"""LSPS liquidity marketplace protocols (LSPS0/1/2).
+
+Parity target: /root/reference/plugins/lsps-plugin (~8k LoC Rust:
+LSPS0 transport, LSPS1 channel purchase, LSPS2 JIT channels), per the
+LSP-spec repo the reference implements.
+
+* LSPS0: JSON-RPC 2.0 carried in custommsg frames of type 37913 —
+  requests flow client→LSP, responses LSP→client, ids correlate.
+* LSPS1: `lsps1.get_info` advertises the LSP's channel menu;
+  `lsps1.create_order` quotes a REAL bolt11 invoice (minted through the
+  node's invoice registry); once the client pays it (the
+  invoice_payment event), the LSP OPENS the ordered channel through the
+  channel manager.  `lsps1.get_order` reports lifecycle state.
+* LSPS2: `lsps2.get_info` serves the opening_fee_params menu (with the
+  spec's promise HMAC so `lsps2.buy` can verify the client echoes an
+  unmodified menu entry); `buy` registers a JIT scid the client may put
+  in route hints.  (Interception-on-first-HTLC rides the relay's
+  unknown-scid path; the order registry exposes `jit_scids` for it.)
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac as hmac_mod
+import json
+import logging
+import os
+import time
+
+log = logging.getLogger("lightning_tpu.lsps")
+
+LSPS_MESSAGE_TYPE = 37913          # LSPS0: a single odd custommsg type
+
+# LSPS0 error codes (JSON-RPC + spec-assigned)
+ERR_PARSE = -32700
+ERR_METHOD = -32601
+ERR_INVALID_PARAMS = -32602
+ERR_CLIENT_REJECTED = 1            # LSPS0.client_rejected
+ERR_OPTION_MISMATCH = 100          # LSPS1.option_mismatch
+
+
+def _frame(obj: dict) -> bytes:
+    return LSPS_MESSAGE_TYPE.to_bytes(2, "big") + json.dumps(obj).encode()
+
+
+class LspsService:
+    """Both halves of LSPS0 on one node: serve requests when acting as
+    the LSP, correlate responses when acting as the client."""
+
+    def __init__(self, node, invoices=None, manager=None,
+                 lsp_enabled: bool = False):
+        self.node = node
+        self.invoices = invoices
+        self.manager = manager
+        self.lsp_enabled = lsp_enabled
+        # responses correlate on (peer_id, id) with UNGUESSABLE ids:
+        # keyed by id alone, any connected peer could forge a response
+        # to a request we sent someone else (e.g. swap in its own
+        # invoice for an order we placed with a real LSP)
+        self._pending: dict[tuple[bytes, str], asyncio.Future] = {}
+        self.orders: dict[str, dict] = {}         # order_id -> order
+        self._orders_by_hash: dict[str, dict] = {}  # payment_hash index
+        self.jit_scids: dict[int, dict] = {}      # LSPS2 registrations
+        self._menu_secret = os.urandom(32)
+        # unauthenticated-peer resource bounds (orders mint REAL
+        # invoices; without caps a peer loop grows them without end)
+        self.max_orders_per_peer = 16
+        self.max_jit_per_peer = 16
+        node.raw_handlers[LSPS_MESSAGE_TYPE] = self._on_frame
+        if invoices is not None:
+            from ..utils import events
+
+            events.subscribe("invoice_payment", self._on_invoice_paid)
+
+    # -- LSPS0 transport ---------------------------------------------------
+
+    async def _on_frame(self, peer, raw: bytes) -> None:
+        try:
+            msg = json.loads(raw[2:])
+        except json.JSONDecodeError:
+            return
+        if "method" in msg:
+            if not self.lsp_enabled:
+                return                 # we are not an LSP: ignore
+            resp = await self._serve(peer, msg)
+            if resp is not None:
+                await peer.send_raw(_frame(resp))
+        else:
+            fut = self._pending.pop(
+                (peer.node_id, str(msg.get("id"))), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+
+    async def request(self, peer, method: str, params: dict | None = None,
+                      timeout: float = 30.0) -> dict:
+        """Client side: one LSPS0 request/response round trip."""
+        rid = os.urandom(16).hex()
+        key = (peer.node_id, rid)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[key] = fut
+        try:
+            await peer.send_raw(_frame({
+                "jsonrpc": "2.0", "id": rid, "method": method,
+                "params": params or {}}))
+            msg = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(key, None)
+        if "error" in msg:
+            raise LspsError(msg["error"].get("code", -1),
+                            msg["error"].get("message", ""))
+        return msg.get("result", {})
+
+    # -- LSP-side dispatch -------------------------------------------------
+
+    async def _serve(self, peer, msg: dict) -> dict | None:
+        rid = msg.get("id")
+        method = msg.get("method", "")
+        params = msg.get("params") or {}
+        handler = {
+            "lsps0.list_protocols": self._lsps0_list_protocols,
+            "lsps1.get_info": self._lsps1_get_info,
+            "lsps1.create_order": self._lsps1_create_order,
+            "lsps1.get_order": self._lsps1_get_order,
+            "lsps2.get_info": self._lsps2_get_info,
+            "lsps2.buy": self._lsps2_buy,
+        }.get(method)
+        if handler is None:
+            return _err(rid, ERR_METHOD, f"unknown method {method}")
+        try:
+            result = await handler(peer, params)
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+        except LspsError as e:
+            return _err(rid, e.code, str(e))
+        except Exception as e:
+            log.exception("lsps %s failed", method)
+            return _err(rid, -32603, f"{type(e).__name__}: {e}")
+
+    async def _lsps0_list_protocols(self, peer, params) -> dict:
+        return {"protocols": [1, 2]}
+
+    # -- LSPS1: channel purchase ------------------------------------------
+
+    OPTIONS = {
+        "min_initial_client_balance_sat": "0",
+        "max_initial_client_balance_sat": "0",
+        "min_initial_lsp_balance_sat": "10000",
+        "max_initial_lsp_balance_sat": "16777215",
+        "min_channel_balance_sat": "10000",
+        "max_channel_balance_sat": "16777215",
+        "min_funding_confirms_within_blocks": 6,
+        "min_required_channel_confirmations": 1,
+        "supports_zero_channel_reserve": False,
+        "max_channel_expiry_blocks": 52560,
+    }
+    FEE_BASE_SAT = 1000
+    FEE_PPM = 2000                 # 0.2% of the ordered capacity
+
+    async def _lsps1_get_info(self, peer, params) -> dict:
+        return {"options": dict(self.OPTIONS)}
+
+    async def _lsps1_create_order(self, peer, params) -> dict:
+        lsp_sat = int(params.get("lsp_balance_sat", 0))
+        client_sat = int(params.get("client_balance_sat", 0))
+        if client_sat != 0:
+            raise LspsError(ERR_OPTION_MISMATCH,
+                            "client_balance_sat must be 0")
+        lo = int(self.OPTIONS["min_initial_lsp_balance_sat"])
+        hi = int(self.OPTIONS["max_initial_lsp_balance_sat"])
+        if not lo <= lsp_sat <= hi:
+            raise LspsError(ERR_OPTION_MISMATCH,
+                            f"lsp_balance_sat outside [{lo}, {hi}]")
+        if self.invoices is None:
+            raise LspsError(-32603, "LSP has no invoice backend")
+        self._evict_stale_orders()
+        mine = [o for o in self.orders.values()
+                if o["client_node_id"] == peer.node_id.hex()]
+        if len(mine) >= self.max_orders_per_peer:
+            raise LspsError(ERR_CLIENT_REJECTED,
+                            "too many open orders for this peer")
+        fee_sat = self.FEE_BASE_SAT + lsp_sat * self.FEE_PPM // 1_000_000
+        order_id = os.urandom(16).hex()
+        rec = self.invoices.create(
+            f"lsps1-{order_id}", fee_sat * 1000,
+            f"LSPS1 channel order {order_id}", expiry=3600)
+        order = {
+            "order_id": order_id,
+            "client_node_id": peer.node_id.hex(),
+            "lsp_balance_sat": str(lsp_sat),
+            "client_balance_sat": "0",
+            "announce_channel": bool(params.get("announce_channel",
+                                                False)),
+            "order_state": "CREATED",
+            "created_at": int(time.time()),
+            "payment": {
+                "bolt11": {
+                    "state": "EXPECT_PAYMENT",
+                    "invoice": rec.bolt11,
+                    "fee_total_sat": str(fee_sat),
+                    "order_total_sat": str(fee_sat),
+                },
+            },
+            "channel": None,
+        }
+        order["_expires_at"] = int(time.time()) + 3600
+        self.orders[order_id] = order
+        self._orders_by_hash[rec.payment_hash.hex()] = order
+        return {k: v for k, v in order.items() if not k.startswith("_")}
+
+    def _evict_stale_orders(self) -> None:
+        now = int(time.time())
+        dead = [oid for oid, o in self.orders.items()
+                if o["order_state"] == "CREATED"
+                and o.get("_expires_at", 0) < now]
+        for oid in dead:
+            o = self.orders.pop(oid)
+            o["order_state"] = "EXPIRED"
+            self._orders_by_hash = {
+                h: v for h, v in self._orders_by_hash.items()
+                if v is not o}
+
+    async def _lsps1_get_order(self, peer, params) -> dict:
+        order = self.orders.get(str(params.get("order_id", "")))
+        if order is None \
+                or order["client_node_id"] != peer.node_id.hex():
+            # not-yours == not-found: order ids must not be an oracle
+            raise LspsError(101, "order not found")
+        return {k: v for k, v in order.items() if not k.startswith("_")}
+
+    def _on_invoice_paid(self, payload: dict) -> None:
+        order = self._orders_by_hash.get(payload.get("payment_hash", ""))
+        if order is None or order["order_state"] != "CREATED":
+            return
+        order["order_state"] = "COMPLETED"
+        order["payment"]["bolt11"]["state"] = "PAID"
+        if self.manager is None:
+            return
+
+        async def _open():
+            try:
+                client_id = bytes.fromhex(order["client_node_id"])
+                node = self.manager.node
+                peer = node.peers.get(client_id)
+                if peer is None or peer.incoming:
+                    # dial the client OURSELVES (LSPs do): the client's
+                    # outbound connection serves no inbound opens — the
+                    # fresh dial is inbound on THEIR side, so their
+                    # channel acceptor answers it
+                    addr = node.addresses.get(client_id)
+                    if addr is None:
+                        raise RuntimeError(
+                            "no dialable address for the client")
+                    await node.connect(addr[0], addr[1], client_id)
+                got = await self.manager.fundchannel(
+                    client_id,
+                    int(order["lsp_balance_sat"]),
+                    announce=order["announce_channel"])
+                order["channel"] = {
+                    "funding_outpoint":
+                        f"{got['funding_txid']}:{got['outnum']}",
+                    "funded_at": int(time.time()),
+                    "expires_at": int(time.time()) + 52560 * 600,
+                }
+            except Exception as e:
+                order["order_state"] = "FAILED"
+                log.warning("LSPS1 order %s channel open failed: %s",
+                            order["order_id"], e)
+
+        task = asyncio.get_running_loop().create_task(_open())
+        self._bg = getattr(self, "_bg", set())
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
+
+    # -- LSPS2: JIT channels ----------------------------------------------
+
+    def _promise(self, fee_params: dict) -> str:
+        blob = json.dumps(fee_params, sort_keys=True).encode()
+        return hmac_mod.new(self._menu_secret, blob,
+                            hashlib.sha256).hexdigest()
+
+    async def _lsps2_get_info(self, peer, params) -> dict:
+        menu = {
+            "min_fee_msat": "10000",
+            "proportional": 2000,
+            "valid_until": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + 3600)),
+            "min_lifetime": 1008,
+            "max_client_to_self_delay": 2016,
+            "min_payment_size_msat": "1000",
+            "max_payment_size_msat": "4000000000",
+        }
+        menu["promise"] = self._promise(
+            {k: menu[k] for k in sorted(menu) if k != "promise"})
+        return {"opening_fee_params_menu": [menu]}
+
+    async def _lsps2_buy(self, peer, params) -> dict:
+        fp = dict(params.get("opening_fee_params") or {})
+        promise = fp.pop("promise", "")
+        if not hmac_mod.compare_digest(
+                promise, self._promise({k: fp[k] for k in sorted(fp)})):
+            raise LspsError(2, "invalid opening_fee_params promise")
+        try:
+            valid_until = time.mktime(time.strptime(
+                fp.get("valid_until", ""), "%Y-%m-%dT%H:%M:%SZ"))
+        except ValueError:
+            raise LspsError(2, "malformed valid_until")
+        if valid_until < time.mktime(time.gmtime()):
+            raise LspsError(2, "opening_fee_params expired")
+        mine = sum(1 for v in self.jit_scids.values()
+                   if v["client_node_id"] == peer.node_id.hex())
+        if mine >= self.max_jit_per_peer:
+            raise LspsError(ERR_CLIENT_REJECTED,
+                            "too many JIT registrations for this peer")
+        scid = int.from_bytes(os.urandom(6), "big") << 16
+        self.jit_scids[scid] = {
+            "client_node_id": peer.node_id.hex(),
+            "opening_fee_params": fp,
+            "created_at": int(time.time()),
+        }
+        return {
+            "jit_channel_scid": _scid_str(scid),
+            "lsp_cltv_expiry_delta": 144,
+            "client_trusts_lsp": False,
+        }
+
+
+def _scid_str(scid: int) -> str:
+    return f"{scid >> 40}x{(scid >> 16) & 0xFFFFFF}x{scid & 0xFFFF}"
+
+
+def _err(rid, code: int, message: str) -> dict:
+    return {"jsonrpc": "2.0", "id": rid,
+            "error": {"code": code, "message": message}}
+
+
+class LspsError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def attach_lsps_commands(rpc, svc: LspsService) -> None:
+    """Client-side RPC doors (the reference exposes lsps1-* through its
+    plugin): drive an LSP purchase from this node."""
+
+    async def lsps_listprotocols(peer_id: str) -> dict:
+        return await svc.request(_peer(svc, peer_id),
+                                 "lsps0.list_protocols")
+
+    async def lsps1_getinfo(peer_id: str) -> dict:
+        return await svc.request(_peer(svc, peer_id), "lsps1.get_info")
+
+    async def lsps1_createorder(peer_id: str, lsp_balance_sat,
+                                announce_channel: bool = False) -> dict:
+        return await svc.request(
+            _peer(svc, peer_id), "lsps1.create_order",
+            {"lsp_balance_sat": str(int(lsp_balance_sat)),
+             "client_balance_sat": "0",
+             "announce_channel": bool(announce_channel)})
+
+    async def lsps1_getorder(peer_id: str, order_id: str) -> dict:
+        return await svc.request(_peer(svc, peer_id), "lsps1.get_order",
+                                 {"order_id": order_id})
+
+    async def lsps2_getinfo(peer_id: str) -> dict:
+        return await svc.request(_peer(svc, peer_id), "lsps2.get_info")
+
+    async def lsps2_buy(peer_id: str, opening_fee_params: dict,
+                        payment_size_msat=None) -> dict:
+        params = {"opening_fee_params": opening_fee_params}
+        if payment_size_msat is not None:
+            params["payment_size_msat"] = str(payment_size_msat)
+        return await svc.request(_peer(svc, peer_id), "lsps2.buy", params)
+
+    for name, fn in [
+        ("lsps-listprotocols", lsps_listprotocols),
+        ("lsps1-getinfo", lsps1_getinfo),
+        ("lsps1-createorder", lsps1_createorder),
+        ("lsps1-getorder", lsps1_getorder),
+        ("lsps2-getinfo", lsps2_getinfo),
+        ("lsps2-buy", lsps2_buy),
+    ]:
+        rpc.register(name, fn)
+
+
+def _peer(svc: LspsService, peer_id: str):
+    peer = svc.node.peers.get(bytes.fromhex(peer_id))
+    if peer is None:
+        raise ValueError(f"peer {peer_id} not connected")
+    return peer

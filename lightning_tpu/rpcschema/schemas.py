@@ -576,6 +576,36 @@ COMMANDS: dict[str, dict] = {
         "params": {"blocks": "int?"},
         "result": {},
     },
+    "lsps-listprotocols": {
+        "params": {"peer_id": "hex"},
+        "result": {"protocols": "list"},
+    },
+    "lsps1-getinfo": {
+        "params": {"peer_id": "hex"},
+        "result": {"options": "dict"},
+    },
+    "lsps1-createorder": {
+        "params": {"peer_id": "hex", "lsp_balance_sat": "any",
+                   "announce_channel": "bool?"},
+        "result": {"order_id": "str", "order_state": "str",
+                   "payment": "dict"},
+    },
+    "lsps1-getorder": {
+        "params": {"peer_id": "hex", "order_id": "str"},
+        "result": {"order_id": "str", "order_state": "str",
+                   "payment": "dict", "channel": "dict"},
+    },
+    "lsps2-getinfo": {
+        "params": {"peer_id": "hex"},
+        "result": {"opening_fee_params_menu": "list"},
+    },
+    "lsps2-buy": {
+        "params": {"peer_id": "hex", "opening_fee_params": "dict",
+                   "payment_size_msat": "any?"},
+        "result": {"jit_channel_scid": "str",
+                   "lsp_cltv_expiry_delta": "int",
+                   "client_trusts_lsp": "bool"},
+    },
 }
 
 _PY_TYPES = {"str": "str", "int": "int", "bool": "bool", "hex": "str",

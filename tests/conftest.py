@@ -11,6 +11,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# small EC buckets: protocol tests check ONE signature at a time, and on
+# this 1-core CPU box the pad lanes of the production 64-bucket are pure
+# waste (measured: the bucket dominates suite wall-clock).  Must be set
+# before lightning_tpu.crypto.secp256k1 imports.
+os.environ.setdefault("LIGHTNING_TPU_VERIFY_BUCKET", "8")
+os.environ.setdefault("LIGHTNING_TPU_SIGN_BUCKET", "8")
+
 from lightning_tpu.utils.jaxcfg import force_cpu, setup_cache
 
 force_cpu(n_devices=8)

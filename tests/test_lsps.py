@@ -1,0 +1,163 @@
+"""LSPS liquidity protocols end-to-end (plugins/lsps-plugin parity):
+LSPS0 JSON-RPC over custommsg type 37913, an LSPS1 channel purchase
+whose order invoice is REAL and whose payment makes the LSP open the
+ordered channel, and LSPS2's promise-guarded fee menu."""
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lightning_tpu.chain.backend import FakeBitcoind  # noqa: E402
+from lightning_tpu.plugins import lsps as LSPS  # noqa: E402
+from lightning_tpu.utils import events  # noqa: E402
+from test_daemon_rpc import Stack, rpc_call  # noqa: E402
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 900))
+
+
+async def _wait(cond, timeout=60.0):
+    for _ in range(int(timeout / 0.05)):
+        if cond():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def test_lsps1_purchase_opens_real_channel(tmp_path):
+    """A (client) buys inbound liquidity from B (LSP): the order mints a
+    real invoice, A pays it over an existing channel, and B opens the
+    ordered channel back to A."""
+
+    async def body():
+        events.reset()
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x0a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x0b" * 32, bitcoind).start()
+        svc_a = LSPS.LspsService(a.node)                 # client half
+        svc_b = LSPS.LspsService(b.node, invoices=b.invoices,
+                                 manager=b.manager, lsp_enabled=True)
+        try:
+            port = await b.node.listen()
+            pa = await a.node.listen()
+            await a.node.connect("127.0.0.1", port, b.node.node_id)
+            # the LSP needs A dialable to open the ordered channel back
+            b.node.addresses[a.node.node_id] = ("127.0.0.1", pa)
+            # fee channel A→B + on-chain funds for the LSP's open
+            await rpc_call(a.rpc.rpc_path, "dev-faucet",
+                           {"satoshi": 1_000_000})
+            await rpc_call(b.rpc.rpc_path, "dev-faucet",
+                           {"satoshi": 1_000_000})
+            fund = asyncio.create_task(
+                a.manager.fundchannel(b.node.node_id, 200_000))
+            while not bitcoind.mempool and not fund.done():
+                await asyncio.sleep(0.05)
+            if bitcoind.mempool:
+                bitcoind.generate(1)
+            await asyncio.wait_for(fund, 600)
+
+            peer = a.node.peers[b.node.node_id]
+            protos = await svc_a.request(peer, "lsps0.list_protocols")
+            assert protos["protocols"] == [1, 2]
+
+            info = await svc_a.request(peer, "lsps1.get_info")
+            lo = int(info["options"]["min_initial_lsp_balance_sat"])
+
+            # out-of-range order → spec error code 100
+            with pytest.raises(LSPS.LspsError) as ei:
+                await svc_a.request(peer, "lsps1.create_order", {
+                    "lsp_balance_sat": str(lo - 1),
+                    "client_balance_sat": "0"})
+            assert ei.value.code == 100
+
+            order = await svc_a.request(peer, "lsps1.create_order", {
+                "lsp_balance_sat": "50000", "client_balance_sat": "0"})
+            assert order["order_state"] == "CREATED"
+            bolt11 = order["payment"]["bolt11"]["invoice"]
+            fee_sat = int(order["payment"]["bolt11"]["fee_total_sat"])
+            assert fee_sat == 1000 + 50_000 * 2000 // 1_000_000
+
+            # pay the order over the existing fee channel
+            paid = await rpc_call(a.rpc.rpc_path, "pay",
+                                  {"bolt11": bolt11})
+            assert paid["status"] == "complete"
+
+            # the LSP now opens the 50k channel back to A
+            while not bitcoind.mempool:
+                await asyncio.sleep(0.05)
+            bitcoind.generate(1)
+            ok = await _wait(lambda: svc_b.orders[order["order_id"]]
+                             ["order_state"] == "COMPLETED")
+            assert ok
+            ok = await _wait(lambda: svc_b.orders[order["order_id"]]
+                             .get("channel") is not None)
+            assert ok, "LSP never opened the ordered channel"
+            # the LSP's fresh dial replaced the old connection — query
+            # the order over the NEW link
+            ok = await _wait(
+                lambda: (p := a.node.peers.get(b.node.node_id))
+                is not None and p.connected)
+            assert ok
+            peer = a.node.peers[b.node.node_id]
+            got = await svc_a.request(peer, "lsps1.get_order",
+                                      {"order_id": order["order_id"]})
+            assert got["payment"]["bolt11"]["state"] == "PAID"
+            # the LSP dialed the client fresh (dropping the client's
+            # outbound link per BOLT#1 dedup), so assert the ORDERED
+            # channel specifically
+            chans = await rpc_call(b.rpc.rpc_path, "listpeerchannels")
+            assert any(c["total_msat"] == 50_000_000
+                       for c in chans["channels"])
+        finally:
+            events.reset()
+            await a.close()
+            await b.close()
+
+    run(body())
+
+
+def test_lsps2_menu_promise(tmp_path):
+    async def body():
+        events.reset()
+        bitcoind = FakeBitcoind()
+        a = await Stack(tmp_path, "a", b"\x0a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x0b" * 32, bitcoind).start()
+        svc_a = LSPS.LspsService(a.node)
+        svc_b = LSPS.LspsService(b.node, invoices=b.invoices,
+                                 manager=b.manager, lsp_enabled=True)
+        try:
+            port = await b.node.listen()
+            await a.node.connect("127.0.0.1", port, b.node.node_id)
+            peer = a.node.peers[b.node.node_id]
+            info = await svc_a.request(peer, "lsps2.get_info")
+            menu = info["opening_fee_params_menu"][0]
+            bought = await svc_a.request(peer, "lsps2.buy", {
+                "opening_fee_params": menu})
+            assert "x" in bought["jit_channel_scid"]
+            assert len(svc_b.jit_scids) == 1
+
+            # tampered fee params (promise no longer matches) → error 2
+            evil = dict(menu, min_fee_msat="1")
+            with pytest.raises(LSPS.LspsError) as ei:
+                await svc_a.request(peer, "lsps2.buy",
+                                    {"opening_fee_params": evil})
+            assert ei.value.code == 2
+
+            # a non-LSP node ignores requests entirely
+            peer_ba = b.node.peers[a.node.node_id]
+            with pytest.raises(asyncio.TimeoutError):
+                await svc_b.request(peer_ba, "lsps1.get_info",
+                                    timeout=1.0)
+        finally:
+            events.reset()
+            await a.close()
+            await b.close()
+
+    run(body())

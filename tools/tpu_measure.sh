@@ -1,0 +1,67 @@
+#!/bin/bash
+# One-command TPU measurement session for the verify-throughput target.
+#
+# Run when the axon tunnel (127.0.0.1:8083) is alive.  Everything is
+# SERIALIZED (the tunneled TPU is single-tenant: a second process's
+# backend init hangs), and all timing inside bench.py is readback-based
+# (block_until_ready does not block on this backend).
+#
+#   bash tools/tpu_measure.sh            # full session (~30-45 min)
+#   bash tools/tpu_measure.sh sweep      # kernel sweep only
+#
+# Outputs append to bench_tpu_session.log; bench.py also refreshes
+# bench_last_tpu.json (picked up as last_measured_tpu metadata by every
+# later run, including cpu-fallback driver rounds).
+set -u
+cd "$(dirname "$0")/.."
+LOG=bench_tpu_session.log
+stamp() { date "+%Y-%m-%d %H:%M:%S"; }
+
+probe() {
+  timeout 3 bash -c 'echo > /dev/tcp/127.0.0.1/8083' 2>/dev/null
+}
+
+if ! probe; then
+  echo "$(stamp) tunnel DOWN — aborting" | tee -a "$LOG"
+  exit 1
+fi
+echo "=== $(stamp) TPU measurement session ===" | tee -a "$LOG"
+
+echo "--- kernel sweep (impl x bucket, kernel-only, readback-timed)" \
+  | tee -a "$LOG"
+BENCH_IMPLS=xla,glv,pallas,pallas_v2,pallas_glv \
+BENCH_BUCKETS=4096,8192,16384 \
+  timeout 2400 python bench.py --sweep 2>>"$LOG" | tee -a "$LOG"
+
+[ "${1:-}" = "sweep" ] && exit 0
+
+# pick the best impl from the sweep record for the e2e runs
+BEST=$(python - <<'EOF'
+import json
+try:
+    rec = json.load(open("bench_last_tpu.json"))
+    print(rec.get("sweep_best", {}).get("impl", "glv"))
+except Exception:
+    print("glv")
+EOF
+)
+BBKT=$(python - <<'EOF'
+import json
+try:
+    rec = json.load(open("bench_last_tpu.json"))
+    print(rec.get("sweep_best", {}).get("bucket", 8192))
+except Exception:
+    print(8192)
+EOF
+)
+echo "--- best impl: $BEST bucket $BBKT" | tee -a "$LOG"
+
+for CH in 25000 100000; do
+  echo "--- e2e store replay, $CH channels ($BEST)" | tee -a "$LOG"
+  LIGHTNING_TPU_DUAL_MUL=$BEST BENCH_BUCKET=$BBKT BENCH_CHANNELS=$CH \
+  BENCH_DEADLINE=3000 timeout 3100 python bench.py 2>>"$LOG" \
+    | tee -a "$LOG"
+done
+
+echo "=== $(stamp) session done — update BENCH_NOTES.md ===" \
+  | tee -a "$LOG"
