@@ -291,6 +291,16 @@ async def amain(args) -> int:
                                hsm_client=hsm.client(CAP_SIGN_ONCHAIN),
                                backend=chain_backend, topology=topology),
                 hsm=hsm)
+        from ..plugins.currencyrate import (CurrencyRate, StaticSource,
+                                            attach_currency_commands)
+
+        import json as _json
+
+        static_rates = _json.loads(
+            _os.environ.get("LIGHTNING_TPU_FIAT_RATES", "{}"))
+        attach_currency_commands(
+            rpc, CurrencyRate([StaticSource(static_rates)]))
+
         from ..plugins.lsps import LspsService, attach_lsps_commands
 
         lsps = LspsService(node, invoices=invoices, manager=manager,
@@ -388,7 +398,13 @@ async def amain(args) -> int:
 
         rpc.register("plugin", plugin_cmd)
 
-        for ppath in (args.plugin or []):
+        # --plugin args + reckless-enabled plugins (tools/reckless role)
+        reckless_plugins = []
+        if args.data_dir:
+            from ..reckless import enabled_plugins
+
+            reckless_plugins = enabled_plugins(args.data_dir)
+        for ppath in list(args.plugin or []) + reckless_plugins:
             try:
                 await plugin_host.start_plugin(ppath)
                 print(f"plugin {ppath} active", flush=True)

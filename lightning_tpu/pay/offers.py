@@ -358,6 +358,17 @@ def attach_offers_commands(rpc, service: OffersService,
                            quantity: int | None = None,
                            payer_note: str | None = None,
                            timeout: float = 30.0) -> dict:
+        if "@" in offer and not offer.startswith("lno1"):
+            # BIP-353 payment address: resolve user@domain → lno offer
+            # (reference: fetchinvoice's bip353 path)
+            from ..utils import bip353
+
+            uri = await bip353.resolve(offer)
+            if "lno" not in uri:
+                raise OffersError(
+                    f"{offer} resolves to no BOLT#12 offer "
+                    f"(has: {sorted(set(uri) - {'dns_name'})})")
+            offer = uri["lno"]
         o = B12.Offer.decode(offer)
         inv = await fetcher.fetch(o, amount_msat=amount_msat,
                                   quantity=quantity, payer_note=payer_note,

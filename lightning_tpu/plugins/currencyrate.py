@@ -1,0 +1,149 @@
+"""Fiat currency rates: currencyconvert / currencyrates.
+
+Parity target: /root/reference/plugins/currencyrate (queries several
+public tickers over HTTPS and serves median rates).  This environment
+has zero egress, so the source list is pluggable: the `http` source
+speaks real HTTP/1.1 over asyncio streams (tested against an
+in-process server; point it at a ticker when egress exists), and the
+`static` source serves operator-configured rates (the offline
+fallback).  Medianing across sources matches the reference.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import statistics
+
+log = logging.getLogger("lightning_tpu.currencyrate")
+
+MSAT_PER_BTC = 100_000_000_000
+
+
+class RateError(Exception):
+    pass
+
+
+async def http_get_json(host: str, port: int, path: str,
+                        timeout: float = 10.0, tls: bool = False) -> dict:
+    """Minimal HTTP/1.1 GET → parsed JSON body (Content-Length or
+    close-delimited)."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port, ssl=tls), timeout)
+    try:
+        writer.write((f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+                      "Connection: close\r\n\r\n").encode())
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(-1), timeout)
+    finally:
+        writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = head.split(b"\r\n", 1)[0].split()
+    if len(status) < 2 or not status[1].startswith(b"2"):
+        raise RateError(f"http status {status[1:2]}")
+    if b"chunked" in head.lower():
+        # dechunk (tickers often stream chunked)
+        out, rest = bytearray(), body
+        while rest:
+            ln, _, rest = rest.partition(b"\r\n")
+            n = int(ln, 16)
+            if n == 0:
+                break
+            out += rest[:n]
+            rest = rest[n + 2:]
+        body = bytes(out)
+    return json.loads(body)
+
+
+class Source:
+    """One rate source; subclasses return BTC price in `currency`."""
+
+    name = "source"
+
+    async def rate(self, currency: str) -> float:
+        raise NotImplementedError
+
+
+class StaticSource(Source):
+    """Operator-configured rates (the zero-egress fallback)."""
+
+    name = "static"
+
+    def __init__(self, rates: dict[str, float] | None = None):
+        self.rates = {k.upper(): float(v)
+                      for k, v in (rates or {}).items()}
+
+    async def rate(self, currency: str) -> float:
+        r = self.rates.get(currency.upper())
+        if r is None:
+            raise RateError(f"no static rate for {currency}")
+        return r
+
+
+class HttpJsonSource(Source):
+    """GET {host}{path_template} and walk `field_path` into the JSON
+    (e.g. coingecko: path /api/v3/simple/price?ids=bitcoin&
+    vs_currencies={currency}, fields ["bitcoin", "{currency}"])."""
+
+    def __init__(self, name: str, host: str, port: int,
+                 path_template: str, field_path: list[str],
+                 tls: bool = True):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.path_template = path_template
+        self.field_path = field_path
+        self.tls = tls
+
+    async def rate(self, currency: str) -> float:
+        cur = currency.lower()
+        data = await http_get_json(
+            self.host, self.port,
+            self.path_template.format(currency=cur), tls=self.tls)
+        for key in self.field_path:
+            data = data[key.format(currency=cur)]
+        return float(data)
+
+
+class CurrencyRate:
+    def __init__(self, sources: list[Source] | None = None):
+        self.sources = sources if sources is not None \
+            else [StaticSource()]
+
+    async def rates(self, currency: str) -> dict[str, float]:
+        """Every source's quote (the reference's listrates shape)."""
+        out: dict[str, float] = {}
+        results = await asyncio.gather(
+            *(s.rate(currency) for s in self.sources),
+            return_exceptions=True)
+        for s, r in zip(self.sources, results):
+            if isinstance(r, BaseException):
+                log.info("rate source %s failed: %s", s.name, r)
+            else:
+                out[s.name] = r
+        return out
+
+    async def convert(self, amount: float, currency: str) -> int:
+        """amount in `currency` → msat via the MEDIAN across sources
+        (currencyrate's aggregation rule)."""
+        rates = await self.rates(currency)
+        if not rates:
+            raise RateError(f"no source could quote {currency}")
+        price = statistics.median(rates.values())   # currency per BTC
+        return round(amount / price * MSAT_PER_BTC)
+
+
+def attach_currency_commands(rpc, svc: CurrencyRate) -> None:
+    async def currencyconvert(amount, currency: str) -> dict:
+        msat = await svc.convert(float(amount), currency)
+        return {"msat": msat}
+
+    async def currencyrates(currency: str) -> dict:
+        rates = await svc.rates(currency)
+        if not rates:
+            raise RateError(f"no source could quote {currency}")
+        return {"rates": rates,
+                "median": statistics.median(rates.values())}
+
+    rpc.register("currencyconvert", currencyconvert)
+    rpc.register("currencyrates", currencyrates)

@@ -1,0 +1,182 @@
+"""reckless — the plugin package manager CLI.
+
+Parity target: /root/reference/tools/reckless (install/uninstall/
+enable/disable/list against a lightning-dir).  Sources are local
+directories or git URLs (git clone; the reference also searches github
+indexes, which needs egress).  Installed plugins live under
+<lightning-dir>/reckless/<name>/ and enabled ones are listed in
+<lightning-dir>/reckless/reckless.conf as `plugin=<path>` lines, which
+the daemon loads at startup (daemon/__main__.py).
+
+Usage:
+  python -m lightning_tpu.reckless -l DIR install <path-or-git-url>
+  python -m lightning_tpu.reckless -l DIR enable|disable <name>
+  python -m lightning_tpu.reckless -l DIR uninstall <name>
+  python -m lightning_tpu.reckless -l DIR list
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import stat
+import subprocess
+import sys
+
+
+class RecklessError(Exception):
+    pass
+
+
+def _root(lightning_dir: str) -> str:
+    p = os.path.join(lightning_dir, "reckless")
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+def _conf_path(lightning_dir: str) -> str:
+    return os.path.join(_root(lightning_dir), "reckless.conf")
+
+
+def _read_conf(lightning_dir: str) -> list[str]:
+    try:
+        with open(_conf_path(lightning_dir)) as f:
+            return [line.strip() for line in f if line.strip()]
+    except FileNotFoundError:
+        return []
+
+
+def _write_conf(lightning_dir: str, lines: list[str]) -> None:
+    with open(_conf_path(lightning_dir), "w") as f:
+        f.write("\n".join(lines) + ("\n" if lines else ""))
+
+
+def _entrypoint(plugin_dir: str, name: str) -> str:
+    """The executable the daemon will spawn: <name>.py, <name>, or a
+    single executable file in the directory."""
+    for cand in (f"{name}.py", name):
+        p = os.path.join(plugin_dir, cand)
+        if os.path.isfile(p):
+            return p
+    execs = [os.path.join(plugin_dir, f) for f in os.listdir(plugin_dir)
+             if os.path.isfile(os.path.join(plugin_dir, f))
+             and os.access(os.path.join(plugin_dir, f), os.X_OK)]
+    if len(execs) == 1:
+        return execs[0]
+    pys = [os.path.join(plugin_dir, f) for f in os.listdir(plugin_dir)
+           if f.endswith(".py") and not f.startswith("_")]
+    if len(pys) == 1:
+        return pys[0]
+    raise RecklessError(
+        f"cannot determine entrypoint for {name} "
+        f"(no {name}.py/{name}, {len(execs)} executables, "
+        f"{len(pys)} python files)")
+
+
+def install(lightning_dir: str, source: str) -> dict:
+    name = os.path.basename(source.rstrip("/")).removesuffix(".git")
+    dest = os.path.join(_root(lightning_dir), name)
+    if os.path.exists(dest):
+        raise RecklessError(f"{name} already installed")
+    if os.path.isdir(source):
+        shutil.copytree(source, dest)
+    else:
+        r = subprocess.run(["git", "clone", "--depth", "1", source,
+                            dest], capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RecklessError(f"git clone failed: "
+                                f"{r.stderr.strip()[:200]}")
+    entry = _entrypoint(dest, name)
+    os.chmod(entry, os.stat(entry).st_mode | stat.S_IXUSR)
+    return {"name": name, "path": dest, "entrypoint": entry,
+            "enabled": False}
+
+
+def uninstall(lightning_dir: str, name: str) -> dict:
+    disable(lightning_dir, name, missing_ok=True)
+    dest = os.path.join(_root(lightning_dir), name)
+    if not os.path.isdir(dest):
+        raise RecklessError(f"{name} is not installed")
+    shutil.rmtree(dest)
+    return {"name": name, "uninstalled": True}
+
+
+def enable(lightning_dir: str, name: str) -> dict:
+    dest = os.path.join(_root(lightning_dir), name)
+    if not os.path.isdir(dest):
+        raise RecklessError(f"{name} is not installed")
+    entry = _entrypoint(dest, name)
+    lines = _read_conf(lightning_dir)
+    want = f"plugin={entry}"
+    if want not in lines:
+        lines.append(want)
+        _write_conf(lightning_dir, lines)
+    return {"name": name, "entrypoint": entry, "enabled": True}
+
+
+def disable(lightning_dir: str, name: str,
+            missing_ok: bool = False) -> dict:
+    dest = os.path.join(_root(lightning_dir), name)
+    lines = _read_conf(lightning_dir)
+    kept = [line for line in lines
+            if not line.startswith("plugin=")
+            or os.path.dirname(line.split("=", 1)[1]) != dest]
+    if len(kept) == len(lines) and not missing_ok:
+        raise RecklessError(f"{name} is not enabled")
+    _write_conf(lightning_dir, kept)
+    return {"name": name, "enabled": False}
+
+
+def list_installed(lightning_dir: str) -> list[dict]:
+    root = _root(lightning_dir)
+    enabled_dirs = {
+        os.path.dirname(line.split("=", 1)[1])
+        for line in _read_conf(lightning_dir)
+        if line.startswith("plugin=")}
+    out = []
+    for name in sorted(os.listdir(root)):
+        p = os.path.join(root, name)
+        if os.path.isdir(p):
+            out.append({"name": name, "path": p,
+                        "enabled": p in enabled_dirs})
+    return out
+
+
+def enabled_plugins(lightning_dir: str) -> list[str]:
+    """Entrypoints the daemon should spawn (reckless.conf contents)."""
+    return [line.split("=", 1)[1]
+            for line in _read_conf(lightning_dir)
+            if line.startswith("plugin=")]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="reckless")
+    p.add_argument("-l", "--lightning-dir", required=True)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("install").add_argument("source")
+    sub.add_parser("uninstall").add_argument("name")
+    sub.add_parser("enable").add_argument("name")
+    sub.add_parser("disable").add_argument("name")
+    sub.add_parser("list")
+    args = p.parse_args(argv)
+    try:
+        if args.cmd == "install":
+            out = install(args.lightning_dir, args.source)
+        elif args.cmd == "uninstall":
+            out = uninstall(args.lightning_dir, args.name)
+        elif args.cmd == "enable":
+            out = enable(args.lightning_dir, args.name)
+        elif args.cmd == "disable":
+            out = disable(args.lightning_dir, args.name)
+        else:
+            out = list_installed(args.lightning_dir)
+    except RecklessError as e:
+        print(f"reckless: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -576,6 +576,14 @@ COMMANDS: dict[str, dict] = {
         "params": {"blocks": "int?"},
         "result": {},
     },
+    "currencyconvert": {
+        "params": {"amount": "any", "currency": "str"},
+        "result": {"msat": "msat"},
+    },
+    "currencyrates": {
+        "params": {"currency": "str"},
+        "result": {"rates": "dict", "median": "any"},
+    },
     "lsps-listprotocols": {
         "params": {"peer_id": "hex"},
         "result": {"protocols": "list"},

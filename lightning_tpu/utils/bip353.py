@@ -1,0 +1,171 @@
+"""BIP-353 DNS payment instructions: ₿user@domain → BOLT#12 offer.
+
+Parity target: the reference's bip353 resolution inside its fetchinvoice
+path (plugins/fetchinvoice + the bundled dnssec-prover): a payment
+address `user@domain` resolves the TXT record at
+`user.user._bitcoin-payment.domain`, whose concatenated strings form a
+`bitcoin:` URI carrying an `lno=` offer (and/or on-chain fallbacks).
+
+This implementation includes a small RFC1035 DNS client (UDP, TXT
+queries, TCP-sized answers out of scope) with a PLUGGABLE resolver so
+tests inject records and deployments can route through a trusted
+resolver.  DNSSEC proof verification — the reference vendors a prover —
+is NOT implemented; stated plainly: resolution here trusts the
+configured resolver, so treat results accordingly.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+import secrets
+
+TXT = 16
+CLASS_IN = 1
+
+
+class Bip353Error(Exception):
+    pass
+
+
+def parse_address(addr: str) -> tuple[str, str]:
+    """`₿user@domain` (the ₿ prefix is optional per BIP-353)."""
+    addr = addr.strip()
+    if addr.startswith("₿"):
+        addr = addr[1:]
+    m = re.fullmatch(r"([a-zA-Z0-9._~!$&'()*+,;=:-]+)@"
+                     r"([a-zA-Z0-9.-]+)", addr)
+    if m is None:
+        raise Bip353Error(f"not a BIP-353 address: {addr!r}")
+    return m.group(1), m.group(2)
+
+
+def query_name(user: str, domain: str) -> str:
+    return f"{user}.user._bitcoin-payment.{domain}"
+
+
+def _encode_name(name: str) -> bytes:
+    out = bytearray()
+    for label in name.rstrip(".").split("."):
+        raw = label.encode("idna") if not label.isascii() \
+            else label.encode()
+        if not 0 < len(raw) < 64:
+            raise Bip353Error(f"bad DNS label {label!r}")
+        out.append(len(raw))
+        out += raw
+    out.append(0)
+    return bytes(out)
+
+
+def build_txt_query(name: str, txid: int) -> bytes:
+    hdr = txid.to_bytes(2, "big") + b"\x01\x00" + b"\x00\x01" \
+        + b"\x00\x00" * 3
+    return hdr + _encode_name(name) + TXT.to_bytes(2, "big") \
+        + CLASS_IN.to_bytes(2, "big")
+
+
+def _skip_name(buf: bytes, off: int) -> int:
+    while True:
+        ln = buf[off]
+        if ln == 0:
+            return off + 1
+        if ln & 0xC0 == 0xC0:      # compression pointer
+            return off + 2
+        off += 1 + ln
+
+
+def parse_txt_response(buf: bytes, txid: int) -> list[bytes]:
+    """All TXT rdata strings (concatenated per record, RFC7208-style)."""
+    if len(buf) < 12 or int.from_bytes(buf[:2], "big") != txid:
+        raise Bip353Error("DNS response id mismatch")
+    if buf[3] & 0x0F != 0:
+        raise Bip353Error(f"DNS rcode {buf[3] & 0x0F}")
+    qd = int.from_bytes(buf[4:6], "big")
+    an = int.from_bytes(buf[6:8], "big")
+    off = 12
+    for _ in range(qd):
+        off = _skip_name(buf, off) + 4
+    out = []
+    for _ in range(an):
+        off = _skip_name(buf, off)
+        rtype = int.from_bytes(buf[off:off + 2], "big")
+        rdlen = int.from_bytes(buf[off + 8:off + 10], "big")
+        rdata = buf[off + 10:off + 10 + rdlen]
+        off += 10 + rdlen
+        if rtype != TXT:
+            continue
+        parts, p = [], 0
+        while p < len(rdata):
+            ln = rdata[p]
+            parts.append(rdata[p + 1:p + 1 + ln])
+            p += 1 + ln
+        out.append(b"".join(parts))
+    return out
+
+
+async def udp_txt_resolver(name: str,
+                           server: str | None = None,
+                           timeout: float = 5.0) -> list[bytes]:
+    """Minimal RFC1035 TXT query over UDP (the pluggable default)."""
+    server = server or os.environ.get("LIGHTNING_TPU_DNS", "127.0.0.53")
+    port = 53
+    if ":" in server:
+        server, _, p = server.rpartition(":")
+        port = int(p)
+    txid = secrets.randbits(16)
+    query = build_txt_query(name, txid)
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class _Proto(asyncio.DatagramProtocol):
+        def datagram_received(self, data, addr):
+            if not fut.done():
+                fut.set_result(data)
+
+        def error_received(self, exc):
+            if not fut.done():
+                fut.set_exception(exc)
+
+    transport, _ = await loop.create_datagram_endpoint(
+        _Proto, remote_addr=(server, port))
+    try:
+        transport.sendto(query)
+        data = await asyncio.wait_for(fut, timeout)
+    finally:
+        transport.close()
+    return parse_txt_response(data, txid)
+
+
+def parse_bitcoin_uri(txt: str) -> dict:
+    """bitcoin:[address]?key=value&... → {address?, lno?, sp?, ...}."""
+    if not txt.lower().startswith("bitcoin:"):
+        raise Bip353Error("TXT record is not a bitcoin: URI")
+    rest = txt[len("bitcoin:"):]
+    addr, _, qs = rest.partition("?")
+    out: dict = {}
+    if addr:
+        out["address"] = addr
+    for kv in qs.split("&"):
+        if not kv:
+            continue
+        k, _, v = kv.partition("=")
+        out[k.lower()] = v
+    return out
+
+
+async def resolve(address: str, resolver=None) -> dict:
+    """user@domain → parsed payment instructions.  resolver:
+    async (dns_name) -> list[bytes] (default: udp_txt_resolver)."""
+    user, domain = parse_address(address)
+    name = query_name(user, domain)
+    resolver = resolver or udp_txt_resolver
+    records = await resolver(name)
+    for rec in records:
+        try:
+            uri = parse_bitcoin_uri(rec.decode("utf-8", "strict"))
+        except (Bip353Error, UnicodeDecodeError):
+            continue
+        if "lno" in uri or "address" in uri or "sp" in uri:
+            uri["dns_name"] = name
+            return uri
+    raise Bip353Error(f"no payment instructions at {name}")

@@ -1,0 +1,114 @@
+"""BIP-353 DNS payment instructions: address parsing, the RFC1035 TXT
+wire round trip against an in-process UDP DNS server, and resolution
+into a bitcoin: URI carrying an lno offer (reference: fetchinvoice's
+bip353 path; DNSSEC proving is documented as out of scope)."""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lightning_tpu.utils import bip353 as B
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def test_parse_address():
+    assert B.parse_address("alice@example.com") == ("alice",
+                                                    "example.com")
+    assert B.parse_address("₿bob@pay.me") == ("bob", "pay.me")
+    assert B.query_name("alice", "example.com") == \
+        "alice.user._bitcoin-payment.example.com"
+    with pytest.raises(B.Bip353Error):
+        B.parse_address("not-an-address")
+
+
+def test_bitcoin_uri_parse():
+    uri = B.parse_bitcoin_uri(
+        "bitcoin:bc1qxyz?lno=lno1abc&amount=0.1")
+    assert uri == {"address": "bc1qxyz", "lno": "lno1abc",
+                   "amount": "0.1"}
+    with pytest.raises(B.Bip353Error):
+        B.parse_bitcoin_uri("http://example.com")
+
+
+class MockDns(asyncio.DatagramProtocol):
+    """Answers TXT queries with configured records, splitting long
+    values into 255-byte character-strings like real servers do."""
+
+    def __init__(self, records: dict[str, list[str]]):
+        self.records = records
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def datagram_received(self, data, addr):
+        txid = data[:2]
+        # parse qname
+        off = 12
+        labels = []
+        while data[off]:
+            ln = data[off]
+            labels.append(data[off + 1:off + 1 + ln].decode())
+            off += 1 + ln
+        name = ".".join(labels)
+        q_end = off + 1 + 4
+        answers = b""
+        count = 0
+        for val in self.records.get(name, []):
+            raw = val.encode()
+            rdata = b"".join(
+                bytes([len(raw[i:i + 255])]) + raw[i:i + 255]
+                for i in range(0, len(raw), 255))
+            answers += (b"\xc0\x0c" + (16).to_bytes(2, "big")
+                        + (1).to_bytes(2, "big") + (60).to_bytes(4, "big")
+                        + len(rdata).to_bytes(2, "big") + rdata)
+            count += 1
+        hdr = (txid + b"\x81\x80" + b"\x00\x01"
+               + count.to_bytes(2, "big") + b"\x00\x00" * 2)
+        self.transport.sendto(hdr + data[12:q_end] + answers, addr)
+
+
+def test_udp_resolver_round_trip():
+    long_offer = "lno1" + "q" * 400       # forces multi-string TXT
+
+    async def body():
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            lambda: MockDns({
+                "alice.user._bitcoin-payment.example.com":
+                    [f"bitcoin:?lno={long_offer}"],
+            }),
+            local_addr=("127.0.0.1", 0))
+        port = transport.get_extra_info("sockname")[1]
+        try:
+            uri = await B.resolve(
+                "₿alice@example.com",
+                resolver=lambda n: B.udp_txt_resolver(
+                    n, server=f"127.0.0.1:{port}"))
+            assert uri["lno"] == long_offer
+            assert uri["dns_name"].startswith("alice.user.")
+            with pytest.raises(B.Bip353Error):
+                await B.resolve(
+                    "missing@example.com",
+                    resolver=lambda n: B.udp_txt_resolver(
+                        n, server=f"127.0.0.1:{port}"))
+        finally:
+            transport.close()
+
+    run(body())
+
+
+def test_resolve_with_injected_resolver():
+    async def fake(name):
+        assert name == "bob.user._bitcoin-payment.pay.me"
+        return [b"junk not a uri",
+                b"bitcoin:?lno=lno1realoffer"]
+
+    async def body():
+        uri = await B.resolve("bob@pay.me", resolver=fake)
+        assert uri["lno"] == "lno1realoffer"
+
+    run(body())

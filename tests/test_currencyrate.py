@@ -1,0 +1,87 @@
+"""currencyrate plugin: static + real-HTTP sources, median
+aggregation, msat conversion (reference plugins/currencyrate; egress-
+free — the http source is driven against an in-process server)."""
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from lightning_tpu.plugins import currencyrate as CR
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+async def _http_server(payloads: dict[str, object]):
+    async def handle(r, w):
+        try:
+            line = (await r.readline()).decode()
+            path = line.split()[1]
+            while (await r.readline()).strip():
+                pass
+            body = json.dumps(payloads.get(path, {})).encode()
+            w.write(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                    + str(len(body)).encode() + b"\r\n\r\n" + body)
+            await w.drain()
+        finally:
+            w.close()
+
+    srv = await asyncio.start_server(handle, "127.0.0.1", 0)
+    return srv, srv.sockets[0].getsockname()[1]
+
+
+def test_static_and_http_median():
+    async def body():
+        srv, port = await _http_server({
+            "/price?c=usd": {"bitcoin": {"usd": 70000.0}},
+        })
+        svc = CR.CurrencyRate([
+            CR.StaticSource({"USD": 60000.0}),
+            CR.HttpJsonSource("mock", "127.0.0.1", port,
+                              "/price?c={currency}",
+                              ["bitcoin", "{currency}"], tls=False),
+            CR.StaticSource({}),          # failing source is skipped
+        ])
+        rates = await svc.rates("USD")
+        assert rates == {"static": 60000.0, "mock": 70000.0}
+        # median of [60000, 70000] = 65000 → $65 = 0.001 BTC
+        msat = await svc.convert(65.0, "USD")
+        assert msat == 100_000_000   # 0.001 BTC in msat
+        srv.close()
+
+    run(body())
+
+
+def test_no_sources_errors():
+    async def body():
+        svc = CR.CurrencyRate([CR.StaticSource({})])
+        with pytest.raises(CR.RateError):
+            await svc.convert(10, "EUR")
+
+    run(body())
+
+
+def test_chunked_http_body():
+    async def body():
+        async def handle(r, w):
+            await r.readline()
+            while (await r.readline()).strip():
+                pass
+            body_ = json.dumps({"rate": 50000.0}).encode()
+            w.write(b"HTTP/1.1 200 OK\r\n"
+                    b"Transfer-Encoding: chunked\r\n\r\n"
+                    + hex(len(body_))[2:].encode() + b"\r\n"
+                    + body_ + b"\r\n0\r\n\r\n")
+            await w.drain()
+            w.close()
+
+        srv = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        got = await CR.http_get_json("127.0.0.1", port, "/x", tls=False)
+        assert got == {"rate": 50000.0}
+        srv.close()
+
+    run(body())

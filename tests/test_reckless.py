@@ -1,0 +1,115 @@
+"""reckless plugin manager (tools/reckless parity): install from a
+local dir and a git repo, enable/disable via reckless.conf, and the
+daemon auto-loading an enabled plugin at startup."""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lightning_tpu import reckless as RK  # noqa: E402
+from test_daemon_rpc import rpc_call  # noqa: E402
+
+PLUGIN_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "plugins")
+
+
+def _make_source(tmp_path, name="hookpl"):
+    src = tmp_path / f"src-{name}"
+    src.mkdir()
+    with open(os.path.join(PLUGIN_SRC, "hook_plugin.py")) as f:
+        body = f.read()
+    (src / f"{name}.py").write_text(body)
+    return str(src)
+
+
+def test_install_enable_disable_cycle(tmp_path):
+    ldir = str(tmp_path / "node")
+    src = _make_source(tmp_path)
+    got = RK.install(ldir, src)
+    assert got["name"] == "src-hookpl"
+    assert os.path.isfile(got["entrypoint"])
+    with pytest.raises(RK.RecklessError):
+        RK.install(ldir, src)                 # duplicate
+
+    assert RK.list_installed(ldir) == [
+        {"name": "src-hookpl", "path": got["path"], "enabled": False}]
+    RK.enable(ldir, "src-hookpl")
+    assert RK.enabled_plugins(ldir) == [got["entrypoint"]]
+    assert RK.list_installed(ldir)[0]["enabled"] is True
+    RK.disable(ldir, "src-hookpl")
+    assert RK.enabled_plugins(ldir) == []
+    RK.uninstall(ldir, "src-hookpl")
+    assert RK.list_installed(ldir) == []
+
+
+def test_install_from_git(tmp_path):
+    src = _make_source(tmp_path, "gitpl")
+    subprocess.run(["git", "init", "-q", src], check=True)
+    subprocess.run(["git", "-C", src, "add", "-A"], check=True)
+    subprocess.run(["git", "-C", src, "-c", "user.email=t@t",
+                    "-c", "user.name=t", "commit", "-qm", "x"],
+                   check=True)
+    ldir = str(tmp_path / "node")
+    got = RK.install(ldir, src)
+    assert got["name"] == "src-gitpl"
+    assert got["entrypoint"].endswith("gitpl.py")
+    assert os.path.isfile(got["entrypoint"])
+
+
+def test_cli_and_daemon_autoload(tmp_path):
+    ldir = str(tmp_path / "node")
+    os.makedirs(ldir)
+    src = _make_source(tmp_path)
+    env = dict(os.environ,
+               HOOK_PLUGIN_NOTIFY_FILE=str(tmp_path / "n.jsonl"))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for cmd in (["install", src], ["enable", "src-hookpl"]):
+        r = subprocess.run(
+            [sys.executable, "-m", "lightning_tpu.reckless",
+             "-l", ldir] + cmd,
+            capture_output=True, text=True, cwd=repo)
+        assert r.returncode == 0, r.stderr
+    listed = json.loads(subprocess.run(
+        [sys.executable, "-m", "lightning_tpu.reckless", "-l", ldir,
+         "list"], capture_output=True, text=True, cwd=repo).stdout)
+    assert listed[0]["enabled"] is True
+
+    rpc_path = str(tmp_path / "rpc.sock")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightning_tpu.daemon", "--cpu",
+         "--data-dir", ldir, "--listen", "0", "--rpc-file", rpc_path],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=repo)
+    try:
+        ready = loaded = False
+        for _ in range(600):
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if "rpc ready" in line:
+                ready = True
+            if "src-hookpl" in line and "active" in line:
+                loaded = True
+            if ready and loaded:
+                break
+        assert ready and loaded, "reckless-enabled plugin never loaded"
+
+        async def drive():
+            info = await rpc_call(rpc_path, "hookinfo")
+            assert info["plugin"] == "hook_plugin"
+            await rpc_call(rpc_path, "stop")
+
+        asyncio.run(asyncio.wait_for(drive(), 60))
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
