@@ -26,7 +26,8 @@ _REV = {c: i for i, c in enumerate(CHARSET)}
 CURRENCIES = ("lnbcrt", "lntbs", "lntb", "lnbc", "lnsb")
 # msat per unit for each multiplier: amounts are `number × multiplier`
 # BTC, 1 BTC = 10^11 msat; `p` (pico) is 0.1 msat so the digit string must
-# end in 0 (BOLT#11: "the last decimal MUST be 0")
+# end in 0 (BOLT#11: "If the `p` multiplier is used the last decimal of
+# `amount` MUST be `0`.")
 MULTIPLIERS = {"m": 10 ** 8, "u": 10 ** 5, "n": 10 ** 2}
 DEFAULT_EXPIRY = 3600
 DEFAULT_MIN_FINAL_CLTV = 18

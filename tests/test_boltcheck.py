@@ -1,0 +1,28 @@
+"""Spec-quote traceability gate (reference: `make bolt-check`,
+/root/reference/Makefile check-bolt target + devtools/check_quotes.py).
+
+Every ``BOLT#N: "..."`` quote in the tree must be verbatim spec text
+(checked against doc/bolt_extracts/), and every citation must name a
+real BOLT."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bolt_citations_verified():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "boltcheck.py"),
+         "--report"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert "all citations well-formed" in proc.stdout
+
+
+def test_extracts_present_for_all_core_bolts():
+    for bolt in (1, 2, 3, 4, 5, 7, 8, 9, 11, 12):
+        path = os.path.join(REPO, "doc", "bolt_extracts",
+                            f"bolt{bolt}.txt")
+        assert os.path.exists(path), f"missing spec extracts for {bolt}"
+        assert os.path.getsize(path) > 200

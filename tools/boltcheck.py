@@ -1,0 +1,145 @@
+#!/usr/bin/env python3
+"""BOLT spec-quote traceability checker (reference parity:
+/root/reference/devtools/check_quotes.py + Makefile bolt-check target).
+
+Scans the repo's Python sources for BOLT citations and machine-checks
+them:
+
+* every ``BOLT#N`` cites a real BOLT number;
+* every *quoted* citation — ``BOLT#N: "spec text..."`` inside a comment
+  or docstring — must match the spec verbatim (whitespace-collapsed
+  substring of ``doc/bolt_extracts/boltN.txt``, which vendors public
+  lightning-rfc requirement text; spec prose is public standard data,
+  not reference code);
+* ``--report`` prints a per-BOLT citation coverage map.
+
+Exit status is non-zero on any malformed citation or unverifiable
+quote, so the test suite can gate on it (tests/test_boltcheck.py).
+"""
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import re
+import sys
+import tokenize
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXTRACTS = os.path.join(REPO, "doc", "bolt_extracts")
+VALID_BOLTS = {1, 2, 3, 4, 5, 7, 8, 9, 10, 11, 12}
+
+CITE_RE = re.compile(r"BOLT\s?#(\d+)")
+QUOTE_RE = re.compile(r'BOLT\s?#(\d+):\s*"([^"]+)"', re.S)
+
+
+def collapse(s: str) -> str:
+    return " ".join(s.split())
+
+
+def load_extracts() -> dict[int, str]:
+    out = {}
+    for bolt in VALID_BOLTS:
+        path = os.path.join(EXTRACTS, f"bolt{bolt}.txt")
+        if os.path.exists(path):
+            with open(path) as f:
+                # one collapsed blob per line; also keep a joined form so
+                # a quote spanning two adjacent requirement blobs matches
+                out[bolt] = f.read()
+    return out
+
+
+def iter_citation_text(path: str):
+    """Yield (lineno, text) units to scan: comment runs and docstrings.
+
+    Comments are stripped of their leading ``#`` and consecutive comment
+    lines are joined, so a quote wrapped across comment lines checks as
+    one string — same approach as the reference checker's continuation
+    handling (devtools/check_quotes.py get_quotes)."""
+    with open(path, "rb") as f:
+        try:
+            toks = list(tokenize.tokenize(f.readline))
+        except (tokenize.TokenError, SyntaxError):
+            return
+    run: list[str] = []
+    run_line = 0
+    for tok in toks:
+        if tok.type == tokenize.COMMENT:
+            if not run:
+                run_line = tok.start[0]
+            run.append(tok.string.lstrip("#").strip())
+        else:
+            if run and tok.type not in (tokenize.NL, tokenize.NEWLINE,
+                                        tokenize.INDENT, tokenize.DEDENT):
+                yield run_line, " ".join(run)
+                run = []
+            if tok.type == tokenize.STRING:
+                yield tok.start[0], tok.string
+    if run:
+        yield run_line, " ".join(run)
+
+
+def check_file(path: str, extracts: dict[int, str], stats: dict[int, int],
+               errors: list[str]) -> None:
+    for lineno, text in iter_citation_text(path):
+        for m in CITE_RE.finditer(text):
+            bolt = int(m.group(1))
+            stats[bolt] = stats.get(bolt, 0) + 1
+            if bolt not in VALID_BOLTS:
+                errors.append(f"{path}:{lineno}: BOLT#{bolt} is not a "
+                              f"real BOLT number")
+        for m in QUOTE_RE.finditer(text):
+            bolt, quote = int(m.group(1)), collapse(m.group(2))
+            if bolt not in VALID_BOLTS:
+                continue
+            corpus = extracts.get(bolt)
+            if corpus is None:
+                errors.append(f"{path}:{lineno}: no spec extracts for "
+                              f"BOLT#{bolt} (doc/bolt_extracts)")
+                continue
+            if quote.lower() not in collapse(corpus).lower():
+                errors.append(f"{path}:{lineno}: BOLT#{bolt} quote not "
+                              f"found in spec: \"{quote[:70]}...\""
+                              if len(quote) > 70 else
+                              f"{path}:{lineno}: BOLT#{bolt} quote not "
+                              f"found in spec: \"{quote}\"")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--report", action="store_true",
+                    help="print per-BOLT citation counts")
+    ap.add_argument("paths", nargs="*",
+                    default=["lightning_tpu", "tests"])
+    args = ap.parse_args(argv)
+
+    extracts = load_extracts()
+    stats: dict[int, int] = {}
+    errors: list[str] = []
+    n_files = 0
+    for root in args.paths:
+        root = os.path.join(REPO, root) if not os.path.isabs(root) else root
+        for dirpath, _, files in os.walk(root):
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    n_files += 1
+                    check_file(os.path.join(dirpath, fn), extracts,
+                               stats, errors)
+
+    if args.report:
+        print(f"boltcheck: scanned {n_files} files")
+        for bolt in sorted(stats):
+            mark = "" if bolt in VALID_BOLTS else "  <-- INVALID"
+            print(f"  BOLT#{bolt:<3} {stats[bolt]:4d} citations{mark}")
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        print(f"boltcheck: {len(errors)} violations", file=sys.stderr)
+        return 1
+    if args.report:
+        print("boltcheck: all citations well-formed, all quotes verified")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
