@@ -67,10 +67,14 @@ def emit(value: float, vs_baseline: float, **extra):
 
 
 def record_tpu_measurement(rec: dict) -> None:
-    """Persist the honest accelerator numbers for future fallback runs."""
+    """Persist the honest accelerator numbers for future fallback runs.
+    Atomic (tmp + rename): a watchdog hard-exit mid-write must not
+    destroy the previously persisted measurement."""
     try:
-        with open(LAST_TPU_PATH, "w") as f:
+        tmp = LAST_TPU_PATH + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(rec, f, indent=1)
+        os.replace(tmp, LAST_TPU_PATH)
     except Exception:
         pass
 
@@ -277,14 +281,19 @@ def run_sweep(platform: str) -> None:
                 row = {"impl": impl, **k}
                 if best is None or k["throughput"] > best["throughput"]:
                     best = row
+                    # persist incrementally: the tunnel has died mid-sweep
+                    # in two previous rounds, and a partial sweep is still
+                    # a real hardware measurement
+                    if platform not in ("cpu",):
+                        record_tpu_measurement({
+                            "platform": platform,
+                            "date": time.strftime("%Y-%m-%d"),
+                            "sweep_best": best})
             except Exception as e:
                 row = {"impl": impl, "bucket": b,
                        "error": f"{type(e).__name__}: {e}"}
             print(json.dumps(row), flush=True)
-    if best and platform not in ("cpu",):
-        record_tpu_measurement({
-            "platform": platform, "date": time.strftime("%Y-%m-%d"),
-            "sweep_best": best})
+    if best:
         print(f"# best: {json.dumps(best)}", flush=True)
 
 

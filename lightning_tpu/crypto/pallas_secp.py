@@ -302,7 +302,7 @@ def _dual_mul_kernel_v2(d2, qtx, qty, qtz, gsx, gsy, gsz, ox, oy, oz):
     acc = (ox[...], oy[...], oz[...])
     for _ in range(4):                       # WINDOW doublings
         acc = point_doubleT(acc)
-    acc = point_addT(acc, _sel16T(d2[...], qtx, qty, qtz))
+    acc = point_addT(acc, _sel16T(d2[...][0], qtx, qty, qtz))
     acc = point_addT(acc, (gsx[0], gsy[0], gsz[0]))
     ox[...], oy[...], oz[...] = acc
 
@@ -327,7 +327,11 @@ def dual_mul_pallas_v2(u1, u2, qx, qy, tile: int = 512,
 
     nb = B // tile
     tab_spec = pl.BlockSpec((16, NLIMBS, tile), lambda b, w: (0, 0, b))
-    dig_spec = pl.BlockSpec((1, tile), lambda b, w: (w, b))
+    # digits ride as (64, 1, B): a (1, 1, tile) block's last two dims
+    # equal/divide the array dims, which a (1, tile) block over (64, B)
+    # does not (Mosaic lowering requires last-two ∈ {divisible by
+    # (8, 128), equal to array dim})
+    dig_spec = pl.BlockSpec((1, 1, tile), lambda b, w: (w, 0, b))
     g_spec = pl.BlockSpec((1, NLIMBS, tile), lambda b, w: (w, 0, b))
     out_spec = pl.BlockSpec((NLIMBS, tile), lambda b, w: (0, b))
     ox, oy, oz = pl.pallas_call(
@@ -337,7 +341,7 @@ def dual_mul_pallas_v2(u1, u2, qx, qy, tile: int = 512,
         out_specs=[out_spec] * 3,
         out_shape=[jax.ShapeDtypeStruct((NLIMBS, B), jnp.uint32)] * 3,
         interpret=interpret,
-    )(d2.T, qt[:, 0], qt[:, 1], qt[:, 2], gsx, gsy, gsz)
+    )(d2.T[:, None, :], qt[:, 0], qt[:, 1], qt[:, 2], gsx, gsy, gsz)
     return ox.T[:B0], oy.T[:B0], oz.T[:B0]
 
 
@@ -374,8 +378,8 @@ def _dual_mul_kernel_glv(d2l, d2h, qlx, qly, qlz, qhx, qhy, qhz,
     acc = (ox[...], oy[...], oz[...])
     for _ in range(4):
         acc = point_doubleT(acc)
-    acc = point_addT(acc, _sel16T(d2l[...], qlx, qly, qlz))
-    acc = point_addT(acc, _sel16T(d2h[...], qhx, qhy, qhz))
+    acc = point_addT(acc, _sel16T(d2l[...][0], qlx, qly, qlz))
+    acc = point_addT(acc, _sel16T(d2h[...][0], qhx, qhy, qhz))
     acc = point_addT(acc, (g1x[0], g1y[0], g1z[0]))
     acc = point_addT(acc, (g2x[0], g2y[0], g2z[0]))
     ox[...], oy[...], oz[...] = acc
@@ -455,7 +459,8 @@ def dual_mul_pallas_glv(u1, u2, qx, qy, tile: int = 512,
     nb = B // tile
     ndw = GLV.NDIGITS_GLV
     tab_spec = pl.BlockSpec((16, NLIMBS, tile), lambda b, w: (0, 0, b))
-    dig_spec = pl.BlockSpec((1, tile), lambda b, w: (w, b))
+    # digits as (33, 1, B) — see dual_mul_pallas_v2's dig_spec comment
+    dig_spec = pl.BlockSpec((1, 1, tile), lambda b, w: (w, 0, b))
     g_spec = pl.BlockSpec((1, NLIMBS, tile), lambda b, w: (w, 0, b))
     out_spec = pl.BlockSpec((NLIMBS, tile), lambda b, w: (0, b))
     ox, oy, oz = pl.pallas_call(
@@ -465,7 +470,7 @@ def dual_mul_pallas_glv(u1, u2, qx, qy, tile: int = 512,
         out_specs=[out_spec] * 3,
         out_shape=[jax.ShapeDtypeStruct((NLIMBS, B), jnp.uint32)] * 3,
         interpret=interpret,
-    )(d2l.T, d2h.T, *qlo, *qhi, *g1, *g2)
+    )(d2l.T[:, None, :], d2h.T[:, None, :], *qlo, *qhi, *g1, *g2)
     return ox.T[:B0], oy.T[:B0], oz.T[:B0]
 
 
