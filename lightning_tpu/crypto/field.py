@@ -338,6 +338,12 @@ def select(cond, a, b):
     return jnp.where(cond[..., None], a, b)
 
 
+def match_variance(x, ref):
+    """x + ref·0: value-identical to x but carrying ref's mesh-axis
+    variance, so constant scan carries type-check under shard_map."""
+    return x + ref * jnp.uint32(0)
+
+
 def inv(mod: Modulus, a):
     """Fermat inversion a^(m-2) via a 256-step square-and-multiply scan.
     inv(0) = 0 by convention (useful for branchless point formulas)."""
@@ -348,9 +354,39 @@ def inv(mod: Modulus, a):
         acc = select(bit != 0, mul(mod, acc, a), acc)
         return acc, None
 
-    acc0 = one(a.shape[:-1])
+    # match_variance keeps the carry's mesh-variance equal to a's under
+    # shard_map (an unvarying constant carry fails the scan type check)
+    acc0 = match_variance(one(a.shape[:-1]), a)
     acc, _ = lax.scan(body, acc0, bits)
     return acc
+
+
+def inv_batch(mod: Modulus, a):
+    """Batched inversion via the Montgomery product trick: two
+    associative-scan product sweeps + ONE Fermat inversion of the total,
+    then inv(a_i) = prefix_{i-1} · suffix_{i+1} · inv(total).
+
+    Replaces B independent 256-step square-and-multiply chains
+    (the dominant non-dual-mul cost of batched ECDSA verify, measured
+    ~12 ms @ B=4096 on TPU) with ~2 log B fused batch muls.  Keeps the
+    inv(0) = 0 convention by substituting 1 for zero inputs and masking
+    the output.  a: (B, NLIMBS) in redundant representation; any other
+    rank falls back to the per-element Fermat chain so call sites don't
+    need shape dispatch."""
+    if a.ndim != 2:
+        return inv(mod, a)
+    z = is_zero(mod, a)
+    a1 = select(z, one(a.shape[:-1]), a)
+    comb = lambda x, y: mul(mod, x, y)      # associative mod-m product
+    pre = lax.associative_scan(comb, a1, axis=0)
+    suf = lax.associative_scan(comb, a1, axis=0, reverse=True)
+    total_inv = inv(mod, pre[-1:])          # one (1, NLIMBS) Fermat chain
+    one_row = match_variance(one((1,)), a1[:1])
+    pm1 = jnp.concatenate([one_row, pre[:-1]], axis=0)
+    sp1 = jnp.concatenate([suf[1:], one_row], axis=0)
+    out = mul(mod, mul(mod, pm1, sp1),
+              jnp.broadcast_to(total_inv, a.shape))
+    return select(z, jnp.zeros_like(a), out)
 
 
 def pow_const(mod: Modulus, a, e: int):

@@ -159,5 +159,6 @@ def dual_mul_glv(u1, u2, qx, qy):
         acc = S.point_add(acc, _neg_y(ph, s2h))
         return acc, None
 
-    acc, _ = lax.scan(body, S.point_inf((u1.shape[0],)), ds)
+    inf0 = tuple(F.match_variance(c, u1) for c in S.point_inf((u1.shape[0],)))
+    acc, _ = lax.scan(body, inf0, ds)
     return acc

@@ -176,7 +176,7 @@ def point_add(p1, p2):
 def point_to_affine(p):
     """(x, y) with (0, 0) for infinity (inv(0)=0 convention)."""
     X, Y, Z = p
-    zi = F.inv(FP, Z)
+    zi = F.inv_batch(FP, Z)
     return _mul(X, zi), _mul(Y, zi)
 
 
@@ -245,7 +245,8 @@ def dual_mul(u1, u2, qx, qy):
         acc = point_add(acc, _shared_table_lookup(gtab, dg1))
         return acc, None
 
-    acc, _ = lax.scan(body, point_inf((u1.shape[0],)), xs)
+    inf0 = tuple(F.match_variance(c, u1) for c in point_inf((u1.shape[0],)))
+    acc, _ = lax.scan(body, inf0, xs)
     return acc
 
 
@@ -268,7 +269,8 @@ def fixed_base_mul(k):
         acc = point_add(acc, _shared_table_lookup(tg, dg))
         return acc, None
 
-    acc, _ = lax.scan(body, point_inf((Bsz,)), (proj, digits.T))
+    inf0 = tuple(F.match_variance(c, k) for c in point_inf((Bsz,)))
+    acc, _ = lax.scan(body, inf0, (proj, digits.T))
     return acc
 
 
@@ -280,10 +282,69 @@ def _nonzero(a):
     return jnp.any(a != 0, axis=-1)
 
 
+def _pow2k(a, k: int):
+    """a^(2^k) mod p; rolled loop for long squaring runs."""
+    if k <= 4:
+        for _ in range(k):
+            a = _sqr(a)
+        return a
+    return lax.fori_loop(0, k, lambda _, v: _sqr(v), a)
+
+
+def sqrt_p(a):
+    """a^((p+1)/4) mod p via a repunit addition chain: the exponent is
+    [223 ones] 0 [22 ones] 0000 11 00, so building x^(2^k - 1) blocks by
+    doubling-composition needs ~253 squarings + 14 multiplies — vs ~247
+    data-dependent multiplies for the generic bit-scan pow_const
+    (measured ~9 ms of the 52 ms batched verify @4096 on TPU).
+    test_field pins it against pow_const and the int oracle."""
+    r1 = a
+    r2 = _mul(_pow2k(r1, 1), r1)        # x^(2^2-1)
+    r4 = _mul(_pow2k(r2, 2), r2)
+    r6 = _mul(_pow2k(r4, 2), r2)
+    r8 = _mul(_pow2k(r4, 4), r4)
+    r16 = _mul(_pow2k(r8, 8), r8)
+    r22 = _mul(_pow2k(r16, 6), r6)
+    r44 = _mul(_pow2k(r22, 22), r22)
+    r88 = _mul(_pow2k(r44, 44), r44)
+    r176 = _mul(_pow2k(r88, 88), r88)
+    r220 = _mul(_pow2k(r176, 44), r44)
+    r222 = _mul(_pow2k(r220, 2), r2)
+    r223 = _mul(_pow2k(r222, 1), r1)
+    acc = _pow2k(r223, 1)               # append 0
+    acc = _mul(_pow2k(acc, 22), r22)    # append 22 ones
+    acc = _pow2k(acc, 4)                # append 0000
+    acc = _mul(_pow2k(acc, 2), r2)      # append 11
+    return _pow2k(acc, 2)               # append 00
+
+
+def _sqrt_chain_exponent() -> int:
+    """The exponent sqrt_p actually computes (mirrors its structure in
+    exact ints) — asserted equal to (p+1)/4 in tests."""
+    e1 = 1
+    e2 = (e1 << 1) + e1
+    e4 = (e2 << 2) + e2
+    e6 = (e4 << 2) + e2
+    e8 = (e4 << 4) + e4
+    e16 = (e8 << 8) + e8
+    e22 = (e16 << 6) + e6
+    e44 = (e22 << 22) + e22
+    e88 = (e44 << 44) + e44
+    e176 = (e88 << 88) + e88
+    e220 = (e176 << 44) + e44
+    e222 = (e220 << 2) + e2
+    e223 = (e222 << 1) + e1
+    acc = e223 << 1
+    acc = (acc << 22) + e22
+    acc <<= 4
+    acc = (acc << 2) + e2
+    return acc << 2
+
+
 def decompress(qx, parity):
     """Canonical x, parity bit → (y, on_curve)."""
     y2 = _add(_mul(_sqr(qx), qx), F.from_const(7, qx.shape[:-1]))
-    y = F.pow_const(FP, y2, (P_INT + 1) // 4)
+    y = sqrt_p(y2)
     on_curve = F.eq(FP, _sqr(y), y2)
     yn = F.normalize(FP, y)
     flip = (yn[..., 0] & 1) != parity.astype(jnp.uint32)
@@ -312,7 +373,7 @@ def ecdsa_verify_kernel(z, r, s, qx, q_parity, dual_mul_impl=None):
     q_ok = F.lt_const(qx, P_INT)
     qy, on_curve = decompress(qx, q_parity)
 
-    w = F.inv(FN, s)
+    w = F.inv_batch(FN, s)
     u1 = F.normalize(FN, F.mul(FN, z, w))
     u2 = F.normalize(FN, F.mul(FN, r, w))
     R = (dual_mul_impl or dual_mul)(u1, u2, qx, qy)
@@ -356,7 +417,7 @@ def ecdsa_sign_kernel(z, d, ks):
     )[:, 0]
     r_sel = take(r_all)
     k_sel = take(ks)
-    ki = F.inv(FN, k_sel)
+    ki = F.inv_batch(FN, k_sel)
     s = F.mul(FN, ki, F.add(FN, z, F.mul(FN, r_sel, d)))
     s = F.normalize(FN, s)
     s_ok = _nonzero(s)
@@ -372,7 +433,8 @@ def ecdsa_sign_simple_kernel(z, d, k):
     s = k⁻¹(z + r·d) mod n, low-S normalized.  Used for bulk synthesis."""
     rx, _ = point_to_affine(fixed_base_mul(k))
     r = F.normalize(FN, F.normalize(FP, rx))
-    s = F.mul(FN, F.inv(FN, k), F.add(FN, z, F.mul(FN, r, d)))
+    ki = F.inv_batch(FN, k)
+    s = F.mul(FN, ki, F.add(FN, z, F.mul(FN, r, d)))
     s = F.normalize(FN, s)
     high = ~F.lt_const(s, (N_INT + 1) // 2)
     s = F.select(high, F.normalize(FN, F.sub(FN, F.zero(z.shape[:-1]), s)), s)

@@ -133,3 +133,35 @@ def test_jit_and_vmap_compose():
     g = jax.vmap(lambda u, v: F.mul(mod, u, v))
     got2 = ints(F.normalize(mod, g(a, b)))
     assert got2 == got
+
+
+@pytest.mark.parametrize("mod", [F.FP, F.FN], ids=["p", "n"])
+def test_inv_batch(mod):
+    """Montgomery-trick batch inversion matches the per-element Fermat
+    chain on boundary values, randoms, and interleaved zeros."""
+    xs = ([x for x in BOUNDARY if x % mod.m != 0][:6]
+          + rand_ints(20) + [0, mod.m, 1, mod.m - 1, 0])
+    a = limbs(xs)
+    got = ints(F.normalize(mod, jax.jit(lambda v: F.inv_batch(mod, v))(a)))
+    assert got == [pow(x % mod.m, -1, mod.m) if x % mod.m else 0 for x in xs]
+
+
+def test_inv_batch_single_and_redundant():
+    # B=1 degenerate scan + redundant (non-canonical) representatives
+    a = limbs([F.P_INT + 5])
+    got = ints(F.normalize(F.FP, F.inv_batch(F.FP, a)))
+    assert got == [pow(5, -1, F.P_INT)]
+
+
+def test_sqrt_chain():
+    """The repunit addition chain computes exactly (p+1)/4, and sqrt_p
+    matches the int oracle on squares and non-residues."""
+    from lightning_tpu.crypto import secp256k1 as S
+
+    assert S._sqrt_chain_exponent() == (F.P_INT + 1) // 4
+    ys = rand_ints(8)
+    sq = [pow(y, 2, F.P_INT) for y in ys]
+    a = limbs(sq)
+    got = ints(F.normalize(F.FP, jax.jit(S.sqrt_p)(a)))
+    e = (F.P_INT + 1) // 4
+    assert got == [pow(x, e, F.P_INT) for x in sq]
