@@ -68,12 +68,21 @@ def emit(value: float, vs_baseline: float, **extra):
 
 def record_tpu_measurement(rec: dict) -> None:
     """Persist the honest accelerator numbers for future fallback runs.
-    Atomic (tmp + rename): a watchdog hard-exit mid-write must not
+    MERGES into the existing record (a kernel sweep and an e2e replay
+    each own different keys; one must not clobber the other) and writes
+    atomically (tmp + rename): a watchdog hard-exit mid-write must not
     destroy the previously persisted measurement."""
     try:
+        merged = {}
+        try:
+            with open(LAST_TPU_PATH) as f:
+                merged = json.load(f)
+        except Exception:
+            pass
+        merged.update(rec)
         tmp = LAST_TPU_PATH + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(rec, f, indent=1)
+            json.dump(merged, f, indent=1)
         os.replace(tmp, LAST_TPU_PATH)
     except Exception:
         pass
@@ -198,11 +207,16 @@ def run_bench(platform: str) -> dict:
     # workload so the run finishes at all.
     if on_accel:
         n_channels = int(os.environ.get("BENCH_CHANNELS", "25000"))
-        # 8192 is the measured throughput sweet spot on v5e: bigger
-        # buckets spill the per-element window tables out of effective
-        # cache (honest readback timing: 29.2k/s @8192, 19.5k @16384,
-        # 11.9k @32768)
-        bucket = int(os.environ.get("BENCH_BUCKET", "8192"))
+        # 16384 is the measured sweet spot for the VMEM-resident fused
+        # kernels (round-4 session-3 sweep: pallas_fb+pp 174.5k/s
+        # @16384 vs 167.9k @8192; 32k batches regress on table HBM
+        # residency)
+        bucket = int(os.environ.get("BENCH_BUCKET", "16384"))
+        # production engine on hardware = the sweep winner (in-kernel
+        # table build + fused sqrt/inv prep); the CPU fallback keeps
+        # the XLA scan (pallas interpret mode is orders of magnitude
+        # slower than compiled XLA on CPU)
+        os.environ.setdefault("LIGHTNING_TPU_DUAL_MUL", "pallas_fb+pp")
     else:
         # bucket 64 = the unit-test bucket, warm in the persistent cache
         n_channels = int(os.environ.get("BENCH_CPU_CHANNELS", "200"))
@@ -269,7 +283,9 @@ def run_sweep(platform: str) -> None:
     the production impl/bucket on real hardware; results go in
     BENCH_NOTES.md."""
     impls = os.environ.get(
-        "BENCH_IMPLS", "xla,glv,pallas,pallas_v2,pallas_glv").split(",")
+        "BENCH_IMPLS",
+        "xla,glv,pallas,pallas_v2,pallas_glv,pallas_fb,pallas_fb+pp",
+    ).split(",")
     buckets = [int(b) for b in os.environ.get(
         "BENCH_BUCKETS", "4096,8192,16384").split(",")]
     print(f"# sweep on {platform}", flush=True)

@@ -308,21 +308,38 @@ def _ripple(cols, out_limbs: int):
 
 
 def normalize(mod: Modulus, a):
-    """Map a redundant representative (< 2^260) to canonical [0, m):
-    full ripple, then conditional subtracts of 16m, 8m, 4m, 2m, m."""
-    x = _ripple(a, NLIMBS)
+    """Map a redundant representative to canonical [0, m).
+
+    Stored representations legally reach ~2^262 (STORED_VMAX), so the
+    ripple must NOT truncate at 2^260: a 20-limb ripple silently drops
+    the ≥2^260 carry, shifting the result by a multiple of c260 mod m.
+    (Found the hard way: 1 signature in a 612,500-sig store normalized
+    its low-S negation to s − 16·(2^256 − n) — an invalid signature.)
+    So: exact ripple to NLIMBS+2 limbs, fold the top limbs back via
+    2^260 ≡ c260 (mod m), then conditional subtracts of 16m…1m over
+    21-limb arithmetic (post-fold value < 2^260 + 2^160 < 32m)."""
+    x = _ripple(a, NLIMBS + 2)
+    lo = x[..., :NLIMBS]
+    hi = x[..., NLIMBS:]
+    cols = _pad_last(lo, 0, NLIMBS + 1)
+    for j in range(2):
+        # hi_j·(c260 << 13j): products < 2^26, column sums < 2^27
+        prod = hi[..., j:j + 1] * jnp.asarray(mod.c_limbs)
+        cols = cols + _pad_last(prod, j, NLIMBS + 1)
+    x = _ripple(cols, NLIMBS + 1)
+    W = NLIMBS + 1
     for k in (16, 8, 4, 2, 1):
-        km = jnp.asarray(int_to_limbs(k * mod.m, NLIMBS + 1)).astype(jnp.int32)
-        xi = _pad_last(x, 0, NLIMBS + 1).astype(jnp.int32)
+        km = jnp.asarray(int_to_limbs(k * mod.m, W + 1)).astype(jnp.int32)
+        xi = _pad_last(x, 0, W + 1).astype(jnp.int32)
         outs = []
         carry = jnp.zeros_like(xi[..., 0])
-        for i in range(NLIMBS + 1):
+        for i in range(W + 1):
             v = xi[..., i] - km[i] + carry
             outs.append(v & LIMB_MASK)
             carry = v >> LIMB_BITS  # arithmetic: -1 on borrow
-        t = jnp.stack(outs, axis=-1).astype(jnp.uint32)[..., :NLIMBS]
+        t = jnp.stack(outs, axis=-1).astype(jnp.uint32)[..., :W]
         x = jnp.where((carry == 0)[..., None], t, x)
-    return x
+    return x[..., :NLIMBS]
 
 
 def is_zero(mod: Modulus, a):

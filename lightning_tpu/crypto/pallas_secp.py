@@ -214,6 +214,127 @@ def point_doubleT(p):
     return (X3, Y3, Z3)
 
 
+def _pow2kT(mod, a, k: int):
+    """a^(2^k), limbs-first.  Long squaring runs roll into a fori_loop
+    (body = one mulT) to keep the Mosaic program size bounded."""
+    if k == 0:
+        return a
+    if k <= 4:
+        for _ in range(k):
+            a = mulT(mod, a, a)
+        return a
+    return lax.fori_loop(0, k, lambda _, v: mulT(mod, v, v), a)
+
+
+def sqrtT(a):
+    """a^((p+1)/4) limbs-first — the same repunit addition chain as
+    secp256k1.sqrt_p (see its docstring for the chain derivation);
+    ~253 squarings + 14 multiplies."""
+    m = _mulP
+    r1 = a
+    r2 = m(_pow2kT(FP, r1, 1), r1)
+    r4 = m(_pow2kT(FP, r2, 2), r2)
+    r6 = m(_pow2kT(FP, r4, 2), r2)
+    r8 = m(_pow2kT(FP, r4, 4), r4)
+    r16 = m(_pow2kT(FP, r8, 8), r8)
+    r22 = m(_pow2kT(FP, r16, 6), r6)
+    r44 = m(_pow2kT(FP, r22, 22), r22)
+    r88 = m(_pow2kT(FP, r44, 44), r44)
+    r176 = m(_pow2kT(FP, r88, 88), r88)
+    r220 = m(_pow2kT(FP, r176, 44), r44)
+    r222 = m(_pow2kT(FP, r220, 2), r2)
+    r223 = m(_pow2kT(FP, r222, 1), r1)
+    acc = _pow2kT(FP, r223, 1)
+    acc = m(_pow2kT(FP, acc, 22), r22)
+    acc = _pow2kT(FP, acc, 4)
+    acc = m(_pow2kT(FP, acc, 2), r2)
+    return _pow2kT(FP, acc, 2)
+
+
+_N_LOW128 = F.N_INT & ((1 << 128) - 1)
+
+
+def inv_nT(a):
+    """a^(n-2) mod n limbs-first (Fermat; inv(0)=0 convention holds
+    because 0^k = 0).  n-2 = (2^127 - 1)·2^129 + (low128 - 2): the top
+    127 ones come from a doubling-composition repunit ladder (12 muls),
+    the irregular low 129 bits from a grouped bit scan — ~255 squarings
+    + ~81 multiplies total, vs ~247 extra multiplies for a naive scan."""
+    m = functools.partial(mulT, FN)
+    # repunit ladder to x^(2^127 - 1)
+    r1 = a
+    r2 = m(_pow2kT(FN, r1, 1), r1)
+    r3 = m(_pow2kT(FN, r2, 1), r1)
+    r6 = _pow2kT(FN, r3, 3)
+    r6 = m(r6, r3)
+    r7 = m(_pow2kT(FN, r6, 1), r1)
+    r14 = m(_pow2kT(FN, r7, 7), r7)
+    r15 = m(_pow2kT(FN, r14, 1), r1)
+    r30 = m(_pow2kT(FN, r15, 15), r15)
+    r31 = m(_pow2kT(FN, r30, 1), r1)
+    r62 = m(_pow2kT(FN, r31, 31), r31)
+    r63 = m(_pow2kT(FN, r62, 1), r1)
+    r126 = m(_pow2kT(FN, r63, 63), r63)
+    r127 = m(_pow2kT(FN, r126, 1), r1)
+    # scan the remaining 129 bits (bit128 = 0, then low128 - 2), grouping
+    # zero runs into _pow2kT squaring loops
+    e_low = _N_LOW128 - 2
+    bits = [(e_low >> i) & 1 for i in range(128, -1, -1)]
+    acc = r127
+    run = 0
+    for b in bits:
+        run += 1
+        if b:
+            acc = m(_pow2kT(FN, acc, run), r1)
+            run = 0
+    if run:
+        acc = _pow2kT(FN, acc, run)
+    return acc
+
+
+def _verify_prep_kernel(qxr, sr, oy, od, ow):
+    """Per-element verify prep, limbs-first in VMEM: y = sqrt(x³+7),
+    d = y² − (x³+7) (a stored representative of 0 iff x is on-curve),
+    w = s⁻¹ mod n.  Replaces the XLA decompress + Montgomery inv_batch
+    stages (measured ~10 ms combined @4096 — batch-first layouts waste
+    ~84% of each VPU op on the limb axis; the Montgomery scans serialize
+    over the batch besides)."""
+    x = qxr[...]
+    width = x.shape[1]
+    seven = _const_col([7] + [0] * (NLIMBS - 1), width)
+    y2 = addT(FP, _mulP(_mulP(x, x), x), seven)
+    y = sqrtT(y2)
+    oy[...] = y
+    od[...] = subT(FP, _mulP(y, y), y2)
+    ow[...] = inv_nT(sr[...])
+
+
+def verify_prep_pallas(qx, parity, s, tile: int = 512,
+                       interpret: bool | None = None):
+    """Drop-in for (decompress, inv_batch): returns (qy, on_curve, w).
+    qx, s: canonical limbs (B, 20); parity: (B,) y-parity bits."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B0 = qx.shape[0]
+    (qxp, sp), tile = _shape_batch_list((qx, s), tile)
+    B = qxp.shape[0]
+    spec = pl.BlockSpec((NLIMBS, tile), lambda b: (0, b))
+    y, d, w = pl.pallas_call(
+        _verify_prep_kernel,
+        grid=(B // tile,),
+        in_specs=[spec] * 2,
+        out_specs=[spec] * 3,
+        out_shape=[jax.ShapeDtypeStruct((NLIMBS, B), jnp.uint32)] * 3,
+        interpret=interpret,
+    )(qxp.T, sp.T)
+    y, d, w = y.T[:B0], d.T[:B0], w.T[:B0]
+    on_curve = F.is_zero(FP, d)
+    yn = F.normalize(FP, y)
+    flip = (yn[..., 0] & 1) != parity.astype(jnp.uint32)
+    y = F.select(flip, F.sub(FP, F.zero(qx.shape[:-1]), y), y)
+    return y, on_curve, w
+
+
 # ---------------------------------------------------------------------------
 # The fused dual-mul kernel
 
@@ -261,12 +382,12 @@ def _select_shared_planes(tab, digits_msb):
     return sel[0], sel[1], sel[2]
 
 
-def _shape_batch(u1, u2, qx, qy, tile: int):
+def _shape_batch_list(arrays, tile: int):
     """Shared batch-shaping for every pallas engine: pick a supported
     tile or pad the batch to the next tile multiple (zeros are safe —
     the RCB formulas are complete, no divisions).  Returns the possibly
     padded operands + the tile; callers slice outputs back to B0."""
-    B0 = u1.shape[0]
+    B0 = arrays[0].shape[0]
     if B0 % tile != 0:
         divs = [t for t in (128, 256, 512) if B0 % t == 0]
         if B0 < tile:
@@ -275,8 +396,12 @@ def _shape_batch(u1, u2, qx, qy, tile: int):
             tile = max(divs)
         else:
             pad = tile - (B0 % tile)
-            u1, u2, qx, qy = (jnp.pad(a, ((0, pad), (0, 0)))
-                              for a in (u1, u2, qx, qy))
+            arrays = [jnp.pad(a, ((0, pad), (0, 0))) for a in arrays]
+    return list(arrays), tile
+
+
+def _shape_batch(u1, u2, qx, qy, tile: int):
+    (u1, u2, qx, qy), tile = _shape_batch_list((u1, u2, qx, qy), tile)
     return u1, u2, qx, qy, tile
 
 
@@ -418,6 +543,52 @@ def _signed_g_tables():
     return signed(S._g_window_proj()), signed(_g_phi_window_proj())
 
 
+def _glv_prep(u1, u2):
+    """Shared XLA-side GLV prep for the glv-flavoured pallas engines:
+    split both scalars, extract MSB-first digit planes, select the
+    signed G/φG planes.  Returns (d2l, d2h digit arrays, s2l, s2h sign
+    masks, g1, g2 plane triples)."""
+    from . import glv as GLV
+
+    m1l, s1l, m1h, s1h = GLV.split(u1)
+    m2l, s2l, m2h, s2h = GLV.split(u2)
+    d1l = jnp.flip(GLV.digits4(m1l), axis=-1)     # (B, 33) MSB-first
+    d1h = jnp.flip(GLV.digits4(m1h), axis=-1)
+    d2l = jnp.flip(GLV.digits4(m2l), axis=-1).astype(jnp.uint32)
+    d2h = jnp.flip(GLV.digits4(m2h), axis=-1).astype(jnp.uint32)
+
+    gt, gpt = _signed_g_tables()
+    sd1l = d1l + 16 * s1l[:, None].astype(d1l.dtype)
+    sd1h = d1h + 16 * s1h[:, None].astype(d1h.dtype)
+    g1 = _select_signed_shared_planes(jnp.asarray(gt), sd1l)
+    g2 = _select_signed_shared_planes(jnp.asarray(gpt), sd1h)
+    return d2l, d2h, s2l, s2h, g1, g2
+
+
+def _run_glv_scan(d2l, d2h, qlo, qhi, g1, g2, tile: int, interpret: bool):
+    """The shared 33-window GLV scan pallas_call (grid, BlockSpecs and
+    operand order in ONE place — the dig_spec shape in particular is a
+    hard-won TPU lowering constraint; see dual_mul_pallas_v2).  qlo/qhi:
+    (16, NLIMBS, B) plane triples; g1/g2: (W, NLIMBS, B) triples."""
+    from .glv import NDIGITS_GLV
+
+    B = qlo[0].shape[-1]
+    nb = B // tile
+    tab_spec = pl.BlockSpec((16, NLIMBS, tile), lambda b, w: (0, 0, b))
+    # digits as (33, 1, B) — see dual_mul_pallas_v2's dig_spec comment
+    dig_spec = pl.BlockSpec((1, 1, tile), lambda b, w: (w, 0, b))
+    g_spec = pl.BlockSpec((1, NLIMBS, tile), lambda b, w: (w, 0, b))
+    out_spec = pl.BlockSpec((NLIMBS, tile), lambda b, w: (0, b))
+    return pl.pallas_call(
+        _dual_mul_kernel_glv,
+        grid=(nb, NDIGITS_GLV),
+        in_specs=[dig_spec] * 2 + [tab_spec] * 6 + [g_spec] * 6,
+        out_specs=[out_spec] * 3,
+        out_shape=[jax.ShapeDtypeStruct((NLIMBS, B), jnp.uint32)] * 3,
+        interpret=interpret,
+    )(d2l.T[:, None, :], d2h.T[:, None, :], *qlo, *qhi, *g1, *g2)
+
+
 def dual_mul_pallas_glv(u1, u2, qx, qy, tile: int = 512,
                         interpret: bool | None = None):
     """GLV + fused-kernel dual mul: 33-window scan, VMEM-resident signed
@@ -431,12 +602,7 @@ def dual_mul_pallas_glv(u1, u2, qx, qy, tile: int = 512,
     u1, u2, qx, qy, tile = _shape_batch(u1, u2, qx, qy, tile)
     B = u1.shape[0]
 
-    m1l, s1l, m1h, s1h = GLV.split(u1)
-    m2l, s2l, m2h, s2h = GLV.split(u2)
-    d1l = jnp.flip(GLV.digits4(m1l), axis=-1)     # (B, 33) MSB-first
-    d1h = jnp.flip(GLV.digits4(m1h), axis=-1)
-    d2l = jnp.flip(GLV.digits4(m2l), axis=-1).astype(jnp.uint32)
-    d2h = jnp.flip(GLV.digits4(m2h), axis=-1).astype(jnp.uint32)
+    d2l, d2h, s2l, s2h, g1, g2 = _glv_prep(u1, u2)
 
     # per-element tables with φ and signs pre-applied (XLA side)
     qtab = S._build_window(qx, qy)                # (B, 16, 3, NLIMBS)
@@ -450,27 +616,97 @@ def dual_mul_pallas_glv(u1, u2, qx, qy, tile: int = 512,
     qlo = (to_planes(tx), to_planes(ty_lo), to_planes(tz))
     qhi = (to_planes(tx_hi), to_planes(ty_hi), to_planes(tz))
 
-    gt, gpt = _signed_g_tables()
-    sd1l = d1l + 16 * s1l[:, None].astype(d1l.dtype)
-    sd1h = d1h + 16 * s1h[:, None].astype(d1h.dtype)
-    g1 = _select_signed_shared_planes(jnp.asarray(gt), sd1l)
-    g2 = _select_signed_shared_planes(jnp.asarray(gpt), sd1h)
+    ox, oy, oz = _run_glv_scan(d2l, d2h, qlo, qhi, g1, g2, tile, interpret)
+    return ox.T[:B0], oy.T[:B0], oz.T[:B0]
+
+
+def _build_tables_kernel(bx, byl, sflip, olx, oly, olz, ohx, ohy, ohz):
+    """Limbs-first window-table build, one grid step per batch tile:
+    lo table = chain L[v] = v·(bx, byl) (14 complete adds); hi table
+    derives per entry as φ(±L[v]) = (β·x, ±y, z) — one field mul + a
+    masked y-flip (sflip = s2l ^ s2h per element) instead of a second
+    14-add chain.  Replaces the XLA _build_window + φ/sign prep, which
+    ran batch-first and wasted ~84% of each VPU op on the 20-limb axis
+    (the dominant prep cost of pallas_glv, ~10 ms @4096 of 41 ms).
+
+    A separate kernel (not a w==0 phase of the window scan), with 2-D
+    ``(16·NLIMBS, tile)`` outputs written by static row-slice stores:
+    both field ops inside a pl.when/scf.if region AND static-index
+    stores into a 3-D block ref crash Mosaic's ApplyVectorLayout on
+    real TPU (vector extract/insert, `limits[i] <= dim(i) (4 vs 1)`);
+    a grid-only kernel storing 2-D slices avoids both.  The extra HBM
+    round-trip of the tables is ~15 KB/element — sub-ms per dispatch —
+    and the window kernel re-fetches them once per batch tile anyway."""
+    from .glv import BETA
+
+    zero = jnp.zeros(bx.shape, jnp.uint32)
+    # `one` via splat-row concat, NOT an iota/where: point ops consuming
+    # an iota-derived operand crash Mosaic's ApplyVectorLayout (vector
+    # extract `limits[i] <= dim(i) (4 vs 1)`) — found by AOT bisection;
+    # _const_col is the proven in-kernel constant constructor
+    one = _const_col([1] + [0] * (NLIMBS - 1), bx.shape[1])
+    beta = _const_col([int(v) for v in F.int_to_limbs(BETA)],
+                      bx.shape[1])
+    keep = (sflip[...] == 0).astype(jnp.uint32)          # (1, tile)
+    flip = jnp.uint32(1) - keep
+
+    def put(ref, v, val):
+        ref[v * NLIMBS:(v + 1) * NLIMBS, :] = val
+
+    # entry 0: infinity (0:1:0) in both tables
+    for r0, val in ((olx, zero), (oly, one), (olz, zero),
+                    (ohx, zero), (ohy, one), (ohz, zero)):
+        put(r0, 0, val)
+    base = (bx[...], byl[...], one)
+    acc = base
+    for v in range(1, 16):
+        if v > 1:
+            acc = point_addT(acc, base)
+        ax, ay, az = acc
+        put(olx, v, ax); put(oly, v, ay); put(olz, v, az)
+        ay_neg = subT(FP, zero, ay)
+        put(ohx, v, mulT(FP, ax, beta))
+        put(ohy, v, ay * keep + ay_neg * flip)
+        put(ohz, v, az)
+
+
+def dual_mul_pallas_fb(u1, u2, qx, qy, tile: int = 512,
+                       interpret: bool | None = None):
+    """GLV + fused window kernel + PALLAS table build: the per-element
+    window tables come from _build_tables_kernel (limbs-first) instead
+    of the batch-first XLA _build_window, so the only XLA prep left is
+    the GLV split/digits and one y-sign select.  Drop-in for dual_mul;
+    value-equal results pinned by tests against the exact-int oracle."""
+    B0 = u1.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    u1, u2, qx, qy, tile = _shape_batch(u1, u2, qx, qy, tile)
+    B = u1.shape[0]
+
+    d2l, d2h, s2l, s2h, g1, g2 = _glv_prep(u1, u2)
+
+    # signed-lo base + hi-derivation mask (the tables themselves are
+    # built limbs-first in the pallas kernel)
+    qy_neg = F.sub(F.FP, jnp.zeros_like(qy), qy)
+    byl = jnp.where(s2l[:, None], qy_neg, qy)
+    sflip = (s2l ^ s2h).astype(jnp.uint32)
 
     nb = B // tile
-    ndw = GLV.NDIGITS_GLV
-    tab_spec = pl.BlockSpec((16, NLIMBS, tile), lambda b, w: (0, 0, b))
-    # digits as (33, 1, B) — see dual_mul_pallas_v2's dig_spec comment
-    dig_spec = pl.BlockSpec((1, 1, tile), lambda b, w: (w, 0, b))
-    g_spec = pl.BlockSpec((1, NLIMBS, tile), lambda b, w: (w, 0, b))
-    out_spec = pl.BlockSpec((NLIMBS, tile), lambda b, w: (0, b))
-    ox, oy, oz = pl.pallas_call(
-        _dual_mul_kernel_glv,
-        grid=(nb, ndw),
-        in_specs=[dig_spec] * 2 + [tab_spec] * 6 + [g_spec] * 6,
-        out_specs=[out_spec] * 3,
-        out_shape=[jax.ShapeDtypeStruct((NLIMBS, B), jnp.uint32)] * 3,
+    base_spec = pl.BlockSpec((NLIMBS, tile), lambda b: (0, b))
+    mask_spec = pl.BlockSpec((1, tile), lambda b: (0, b))
+    tab_out_spec = pl.BlockSpec((16 * NLIMBS, tile), lambda b: (0, b))
+    qlo_and_qhi = pl.pallas_call(
+        _build_tables_kernel,
+        grid=(nb,),
+        in_specs=[base_spec] * 2 + [mask_spec],
+        out_specs=[tab_out_spec] * 6,
+        out_shape=[jax.ShapeDtypeStruct((16 * NLIMBS, B), jnp.uint32)] * 6,
         interpret=interpret,
-    )(d2l.T[:, None, :], d2h.T[:, None, :], *qlo, *qhi, *g1, *g2)
+    )(qx.T, byl.T, sflip[None, :])
+    planes = [a.reshape(16, NLIMBS, B) for a in qlo_and_qhi]
+    qlo, qhi = planes[:3], planes[3:]
+
+    ox, oy, oz = _run_glv_scan(d2l, d2h, qlo, qhi, g1, g2, tile, interpret)
     return ox.T[:B0], oy.T[:B0], oz.T[:B0]
 
 

@@ -356,7 +356,8 @@ def decompress(qx, parity):
 # ECDSA
 
 
-def ecdsa_verify_kernel(z, r, s, qx, q_parity, dual_mul_impl=None):
+def ecdsa_verify_kernel(z, r, s, qx, q_parity, dual_mul_impl=None,
+                        prep_impl=None):
     """Batched ECDSA verify.
 
     z: (B, 20) hash limbs (raw 256-bit value, reduced mod n implicitly)
@@ -365,15 +366,20 @@ def ecdsa_verify_kernel(z, r, s, qx, q_parity, dual_mul_impl=None):
     Returns bool (B,).  Fully branchless; invalid encodings yield False.
     dual_mul_impl: alternate u1·G+u2·Q engine (the fused Pallas kernel
     in crypto.pallas_secp); default = the XLA scan.
+    prep_impl: alternate (decompress, s-inverse) engine with signature
+    (qx, parity, s) -> (qy, on_curve, w); default = XLA decompress +
+    Montgomery inv_batch.
     """
     r_ok = F.lt_const(r, N_INT) & _nonzero(r)
     # libsecp256k1's secp256k1_ecdsa_verify (bitcoin/signature.c:174 path)
     # rejects high-S outright: accept only s ≤ (n-1)/2
     s_ok = F.lt_const(s, (N_INT + 1) // 2) & _nonzero(s)
     q_ok = F.lt_const(qx, P_INT)
-    qy, on_curve = decompress(qx, q_parity)
-
-    w = F.inv_batch(FN, s)
+    if prep_impl is not None:
+        qy, on_curve, w = prep_impl(qx, q_parity, s)
+    else:
+        qy, on_curve = decompress(qx, q_parity)
+        w = F.inv_batch(FN, s)
     u1 = F.normalize(FN, F.mul(FN, z, w))
     u2 = F.normalize(FN, F.mul(FN, r, w))
     R = (dual_mul_impl or dual_mul)(u1, u2, qx, qy)
@@ -543,6 +549,8 @@ def resolve_dual_mul(name: str | None = None):
       pallas     — fused Mosaic kernel, streamed pre-selected planes
       pallas_v2  — fused kernel, VMEM-resident tables
       pallas_glv — GLV + VMEM-resident tables (fewest HBM bytes + FLOPs)
+      pallas_fb  — pallas_glv + IN-KERNEL window-table build (scratch
+                   VMEM); remaining XLA prep is split/digits only
     """
     import os
 
@@ -556,14 +564,51 @@ def resolve_dual_mul(name: str | None = None):
 
     return {"pallas": PS.dual_mul_pallas,
             "pallas_v2": PS.dual_mul_pallas_v2,
-            "pallas_glv": PS.dual_mul_pallas_glv}[name]
+            "pallas_glv": PS.dual_mul_pallas_glv,
+            "pallas_fb": PS.dual_mul_pallas_fb}[name]
 
 
-@functools.lru_cache(maxsize=8)
-def _jit_verify(impl_name: str | None = None):
+def resolve_prep(name: str | None = None):
+    """Select the (decompress, s-inverse) prep engine:
+      xla    — XLA decompress + Montgomery inv_batch (default)
+      pallas — fused limbs-first kernel (crypto.pallas_secp
+               verify_prep_pallas: in-kernel sqrt chain + Fermat inv)
+    """
+    import os
+
+    name = name or os.environ.get("LIGHTNING_TPU_VERIFY_PREP", "xla")
+    if name == "pallas":
+        from . import pallas_secp as PS
+        return PS.verify_prep_pallas
+    if name != "xla":
+        # loud failure: a typo'd engine name must not silently measure
+        # the XLA prep under a fused-prep label
+        raise KeyError(f"unknown verify-prep engine {name!r}")
+    return None
+
+
+def _jit_verify(impl_name: str | None = None,
+                prep_name: str | None = None):
+    """Resolve env names OUTSIDE the cache: the cache key must be the
+    resolved names, or an env change mid-process would keep serving the
+    previously-built program under the new label."""
+    if impl_name is None:
+        impl_name = _os.environ.get("LIGHTNING_TPU_DUAL_MUL", "glv")
+    if "+" in impl_name:
+        impl_name, suffix = impl_name.split("+", 1)
+        prep_name = {"pp": "pallas"}.get(suffix, suffix)
+    if prep_name is None:
+        prep_name = _os.environ.get("LIGHTNING_TPU_VERIFY_PREP", "xla")
+    return _jit_verify_resolved(impl_name, prep_name)
+
+
+@functools.lru_cache(maxsize=16)
+def _jit_verify_resolved(impl_name: str, prep_name: str):
     impl = resolve_dual_mul(impl_name)
+    prep = resolve_prep(prep_name)
     return jax.jit(functools.partial(ecdsa_verify_kernel,
-                                     dual_mul_impl=impl))
+                                     dual_mul_impl=impl,
+                                     prep_impl=prep))
 
 
 def ecdsa_verify_batch(msg_hashes: np.ndarray, sigs64: np.ndarray,

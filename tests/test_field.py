@@ -165,3 +165,39 @@ def test_sqrt_chain():
     got = ints(F.normalize(F.FP, jax.jit(S.sqrt_p)(a)))
     e = (F.P_INT + 1) // 4
     assert got == [pow(x, e, F.P_INT) for x in sq]
+
+
+def test_normalize_above_2_260():
+    """normalize must be exact for the WHOLE stored range (~2^262):
+    the original 20-limb ripple truncated values ≥ 2^260, shifting the
+    canonical result by multiples of c260 mod m.  Found in the wild as
+    1 invalid signature in a 612,500-sig store (the low-S negation
+    sub(FN, 0, s) produced a representative just over 2^260 and the
+    store carried s − 16·(2^256 − n)); this pins the repaired behavior
+    on max-stored limbs, random stored values, and that exact shape."""
+    rng = np.random.default_rng(99)
+    a = rng.integers(0, F.STORED_LIMB_MAX + 1, (32, F.NLIMBS)).astype(np.uint32)
+    a[0] = F.STORED_LIMB_MAX                       # value ≈ 2^262.3
+    a[1, :] = 0
+    a[1, F.NLIMBS - 1] = F.STORED_LIMB_MAX         # top limb only
+    for mod in (F.FP, F.FN):
+        got = np.asarray(jax.jit(
+            lambda v, m=mod: F.normalize(m, v))(jnp.asarray(a)))
+        for i in range(len(a)):
+            assert F.limbs_to_int(got[i]) == F.limbs_to_int(a[i]) % mod.m, (
+                mod.name, i)
+
+
+def test_normalize_low_s_negation_regression():
+    """The exact failing path from the 100k-channel store: negate a
+    canonical scalar via sub(FN, 0, s) and normalize — the redundant
+    negation representative exceeds 2^260 for every input (neg_bound is
+    ~2^262), so pre-fix every low-S negation was at risk whenever the
+    greedy subtract chain landed in the truncated region."""
+    s_pre = 0xFFFFFAD1EE565E66D2F0DE6E89133BFF2DB5F1C3B5465C77CDDAA245367E2736
+    ss = [s_pre] + rand_ints(15)
+    sl = limbs([x % F.N_INT for x in ss])
+    neg = jax.jit(lambda v: F.normalize(
+        F.FN, F.sub(F.FN, F.zero((len(ss),)), v)))(sl)
+    got = ints(neg)
+    assert got == [(F.N_INT - x % F.N_INT) % F.N_INT for x in ss]

@@ -89,10 +89,15 @@ def main():
 
     from lightning_tpu.crypto import pallas_secp as PS
 
+    if os.environ.get("PROF_PREP") == "pallas":
+        prep = jax.jit(lambda x, p, sv: PS.verify_prep_pallas(x, p, sv))
+        timed("prep_pallas[sqrt+inv]", prep, qx, par, s)
+
     dual = {
         "pallas": PS.dual_mul_pallas,
         "pallas_v2": PS.dual_mul_pallas_v2,
         "pallas_glv": PS.dual_mul_pallas_glv,
+        "pallas_fb": PS.dual_mul_pallas_fb,
     }.get(impl)
     if dual is not None:
         dj = jax.jit(lambda a, b, x, y: dual(a, b, x, y))

@@ -29,7 +29,7 @@ echo "=== $(stamp) TPU measurement session ===" | tee -a "$LOG"
 
 echo "--- kernel sweep (impl x bucket, kernel-only, readback-timed)" \
   | tee -a "$LOG"
-BENCH_IMPLS=xla,glv,pallas,pallas_v2,pallas_glv \
+BENCH_IMPLS=pallas_glv,pallas_fb,pallas_glv+pp,pallas_fb+pp \
 BENCH_BUCKETS=4096,8192,16384 \
   timeout 2400 python bench.py --sweep 2>>"$LOG" | tee -a "$LOG"
 
