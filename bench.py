@@ -80,6 +80,7 @@ def record_tpu_measurement(rec: dict) -> None:
         except Exception:
             pass
         merged.update(rec)
+        merged.pop("date", None)   # legacy unscoped key (pre-round-4.3)
         tmp = LAST_TPU_PATH + ".tmp"
         with open(tmp, "w") as f:
             json.dump(merged, f, indent=1)
@@ -268,8 +269,11 @@ def run_bench(platform: str) -> dict:
            "impl": os.environ.get("LIGHTNING_TPU_DUAL_MUL", "glv"),
            "bucket": bucket}
     if on_accel:
+        # the date rides INSIDE the keys this writer owns — the merge
+        # must not re-date a surviving sweep_best from another run
         record_tpu_measurement({
-            "platform": platform, "date": time.strftime("%Y-%m-%d"),
+            "platform": platform,
+            "e2e_date": time.strftime("%Y-%m-%d"),
             "end_to_end_sig_verifies_per_sec": round(out["throughput"], 1),
             "n_sigs": res2.n_sigs, "kernel_only": kern,
             "impl": out["impl"], "bucket": bucket,
@@ -303,8 +307,9 @@ def run_sweep(platform: str) -> None:
                     if platform not in ("cpu",):
                         record_tpu_measurement({
                             "platform": platform,
-                            "date": time.strftime("%Y-%m-%d"),
-                            "sweep_best": best})
+                            "sweep_best": {
+                                **best,
+                                "date": time.strftime("%Y-%m-%d")}})
             except Exception as e:
                 row = {"impl": impl, "bucket": b,
                        "error": f"{type(e).__name__}: {e}"}

@@ -437,6 +437,26 @@ def canonical_bits(a, nbits: int = 256):
     return bits.reshape(*a.shape[:-1], NLIMBS * LIMB_BITS)[..., :nbits]
 
 
+def from_bytes_be_dev(data):
+    """(..., 32) uint8 big-endian → (..., 20) uint32 canonical limbs,
+    TRACED — the device-side twin of from_bytes_be, so callers can ship
+    raw 32-byte scalars (2.5× less host→device traffic than limbs) and
+    unpack on-device.  Each 13-bit limb spans ≤3 bytes; all indices are
+    static."""
+    d = data.astype(jnp.uint32)
+    limbs = []
+    for j in range(NLIMBS):
+        s = j * LIMB_BITS
+        k0, r = divmod(s, 8)
+        v = jnp.zeros_like(d[..., 0])
+        for t in range(3):
+            k = k0 + t
+            if k < 32:
+                v = v | (d[..., 31 - k] << (8 * t))
+        limbs.append((v >> r) & LIMB_MASK)
+    return jnp.stack(limbs, axis=-1)
+
+
 def lt_const(a, c: int):
     """a < c for canonical-limb a and a static 260-bit constant (traced)."""
     climbs = int_to_limbs(c, NLIMBS)
@@ -453,15 +473,24 @@ def lt_const(a, c: int):
 
 
 def from_bytes_be(data: np.ndarray) -> np.ndarray:
-    """(..., 32) uint8 big-endian → (..., 20) uint32 canonical limbs."""
+    """(..., 32) uint8 big-endian → (..., 20) uint32 canonical limbs.
+    Same 3-byte-window algorithm as from_bytes_be_dev (the old
+    unpackbits formulation was the top host cost of big store
+    replays)."""
     data = np.asarray(data, dtype=np.uint8)
     assert data.shape[-1] == 32
-    bits = np.unpackbits(data, axis=-1, bitorder="big")  # (..., 256) MSB-first
-    bits = bits[..., ::-1]  # LSB-first
-    pad = np.zeros((*bits.shape[:-1], REPR_BITS - 256), np.uint8)
-    bits = np.concatenate([bits, pad], axis=-1).reshape(*bits.shape[:-1], NLIMBS, LIMB_BITS)
-    weights = (1 << np.arange(LIMB_BITS, dtype=np.uint32))
-    return (bits.astype(np.uint32) * weights).sum(axis=-1, dtype=np.uint32)
+    d = data.astype(np.uint32)
+    out = np.empty((*data.shape[:-1], NLIMBS), np.uint32)
+    for j in range(NLIMBS):
+        s = j * LIMB_BITS
+        k0, r = divmod(s, 8)
+        v = np.zeros(data.shape[:-1], np.uint32)
+        for t in range(3):
+            k = k0 + t
+            if k < 32:
+                v |= d[..., 31 - k] << np.uint32(8 * t)
+        out[..., j] = (v >> np.uint32(r)) & LIMB_MASK
+    return out
 
 
 def to_bytes_be(limbs: np.ndarray) -> np.ndarray:

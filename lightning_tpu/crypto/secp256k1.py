@@ -587,11 +587,11 @@ def resolve_prep(name: str | None = None):
     return None
 
 
-def _jit_verify(impl_name: str | None = None,
-                prep_name: str | None = None):
-    """Resolve env names OUTSIDE the cache: the cache key must be the
-    resolved names, or an env change mid-process would keep serving the
-    previously-built program under the new label."""
+def _resolve_engine_names(impl_name: str | None, prep_name: str | None):
+    """Resolve env defaults and the "impl+suffix" form to concrete
+    (impl, prep) names.  Done OUTSIDE every jit cache: the cache key
+    must be the resolved names, or an env change mid-process would keep
+    serving the previously-built program under the new label."""
     if impl_name is None:
         impl_name = _os.environ.get("LIGHTNING_TPU_DUAL_MUL", "glv")
     if "+" in impl_name:
@@ -599,7 +599,12 @@ def _jit_verify(impl_name: str | None = None,
         prep_name = {"pp": "pallas"}.get(suffix, suffix)
     if prep_name is None:
         prep_name = _os.environ.get("LIGHTNING_TPU_VERIFY_PREP", "xla")
-    return _jit_verify_resolved(impl_name, prep_name)
+    return impl_name, prep_name
+
+
+def _jit_verify(impl_name: str | None = None,
+                prep_name: str | None = None):
+    return _jit_verify_resolved(*_resolve_engine_names(impl_name, prep_name))
 
 
 @functools.lru_cache(maxsize=16)
@@ -609,6 +614,33 @@ def _jit_verify_resolved(impl_name: str, prep_name: str):
     return jax.jit(functools.partial(ecdsa_verify_kernel,
                                      dual_mul_impl=impl,
                                      prep_impl=prep))
+
+
+def _jit_verify_from_bytes(impl_name: str | None = None,
+                           prep_name: str | None = None):
+    """Like _jit_verify but taking RAW BYTES for sig/pubkey operands
+    (z stays limbs — it typically comes straight from the hash kernel):
+    the byte→limb unpack runs on-device (F.from_bytes_be_dev), cutting
+    both host CPU (the numpy unpack was a top store-replay cost) and
+    host→device traffic (97 B vs 240 B per signature)."""
+    return _jit_verify_from_bytes_resolved(
+        *_resolve_engine_names(impl_name, prep_name))
+
+
+@functools.lru_cache(maxsize=16)
+def _jit_verify_from_bytes_resolved(impl_name: str, prep_name: str):
+    impl = resolve_dual_mul(impl_name)
+    prep = resolve_prep(prep_name)
+
+    def kern(z, sig_bytes, pub_bytes):
+        r = F.from_bytes_be_dev(sig_bytes[:, :32])
+        s = F.from_bytes_be_dev(sig_bytes[:, 32:])
+        qx = F.from_bytes_be_dev(pub_bytes[:, 1:])
+        parity = (pub_bytes[:, 0] & 1).astype(jnp.uint32)
+        return ecdsa_verify_kernel(z, r, s, qx, parity,
+                                   dual_mul_impl=impl, prep_impl=prep)
+
+    return jax.jit(kern)
 
 
 def ecdsa_verify_batch(msg_hashes: np.ndarray, sigs64: np.ndarray,

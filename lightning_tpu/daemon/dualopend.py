@@ -43,10 +43,12 @@ class DualOpenError(Exception):
 @dataclass
 class FundingInput:
     """One UTXO a side contributes: the full previous tx (the peer
-    verifies the spent output really exists in it) + our signing key."""
+    verifies the spent output really exists in it) + our signing key.
+    privkey None = externally signed (the staged openchannel_init/
+    openchannel_signed flow supplies witnesses via sign_hook)."""
     prevtx: T.Tx
     vout: int
-    privkey: int            # p2wpkh key owning that output
+    privkey: int | None     # p2wpkh key owning that output
     sequence: int = 0xFFFFFFFD
 
     @property
@@ -213,9 +215,15 @@ def _unpack_witnesses(raw: bytes) -> list[list[bytes]]:
 async def _finish_v2(ch: Channeld, peer: Peer, con: _Construction,
                      tx: T.Tx, our_inputs, my_serials,
                      our_total: int, their_total: int,
-                     we_initiate: bool, lockin: bool = True) -> T.Tx:
+                     we_initiate: bool, lockin: bool = True,
+                     sign_hook=None) -> T.Tx:
     """Commitment exchange + tx_signatures (+ channel_ready unless the
-    caller holds lockin open for RBF rounds)."""
+    caller holds lockin open for RBF rounds).  sign_hook, when given,
+    replaces the wallet signer: ``await sign_hook(ch, tx, my_serials)``
+    must return the witness stacks for our inputs in serial order —
+    this is where the staged openchannel_signed RPC parks until the
+    caller delivers the signed PSBT (dual_open_control.c holds the
+    dualopend fd the same way between commit and tx_signatures)."""
     # both sides send commitment_signed for the other's first commitment
     fsig, hsigs = ch._sign_remote(0)
     await peer.send(M.CommitmentSigned(
@@ -227,7 +235,10 @@ async def _finish_v2(ch: Channeld, peer: Peer, con: _Construction,
                             cs.htlc_signatures)
 
     # witness exchange: lower input total first (tie → the opener)
-    ours = _sign_our_inputs(tx, con, our_inputs, my_serials)
+    if sign_hook is not None:
+        ours = await sign_hook(ch, tx, my_serials)
+    else:
+        ours = _sign_our_inputs(tx, con, our_inputs, my_serials)
     we_first = our_total < their_total or (
         our_total == their_total and we_initiate)
 
@@ -321,6 +332,7 @@ async def open_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
                           locktime: int = 0,
                           funding_feerate: int = 2500,
                           lockin: bool = True,
+                          sign_hook=None,
                           ) -> tuple[Channeld, T.Tx]:
     """Opener side.  Returns (live channel, fully-signed funding tx)."""
     cfg = cfg or ChannelConfig()
@@ -387,7 +399,7 @@ async def open_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
                                   T.Tx.parse(p).outputs[v].amount_sat
                                   for s, (p, v, q) in con.inputs.items()
                                   if s not in my_serials),
-                              True, lockin=lockin)
+                              True, lockin=lockin, sign_hook=sign_hook)
     ch._v2_feerate = funding_feerate
     ch._v2_our_sat = funding_sat
     ch._v2_outpoints = {(i.txid, i.vout) for i in signed.inputs}

@@ -19,6 +19,7 @@ import time
 from ..bolt import bolt11 as B11
 from ..wire import messages as WM
 from . import channeld as CD
+from . import dualopend as DO
 from .channeld import _CloseCommand, _PayCommand
 from .hsmd import CAP_MASTER, CAP_SIGN_ONCHAIN
 
@@ -69,6 +70,8 @@ class ChannelManager:
         self.channels: dict[bytes, tuple] = {}
         # peer_id -> Channeld awaiting fundchannel_complete
         self._pending_opens: dict[bytes, object] = {}
+        # channel_id hex -> staged v2 open state (openchannel_init)
+        self._staged_v2: dict[str, dict] = {}
         self._bg_tasks: set = set()   # strong refs for spawned tasks
         self._next_dbid = 1
         self._load_next_dbid()
@@ -576,6 +579,184 @@ class ChannelManager:
             pass
         return {"cancelled": "Channel open canceled"}
 
+    # -- staged v2 open (lightningd/dual_open_control.c
+    #    json_openchannel_init/update/signed/abort): the caller brings a
+    #    PSBT, the interactive construction runs with the peer, and the
+    #    flow parks between commitment_signed and tx_signatures until
+    #    the caller returns the SIGNED psbt via openchannel_signed.
+
+    async def openchannel_init(self, peer_id: bytes, amount_sat: int,
+                               initialpsbt: str, announce: bool = True,
+                               funding_feerate: int = 2500) -> dict:
+        import base64
+
+        from ..btc.psbt import Psbt
+        from .dualopend import FundingInput
+
+        peer = self.node.peers.get(peer_id)
+        if peer is None:
+            raise ManagerError(f"peer {peer_id.hex()[:16]} not connected")
+        if peer_id in self._pending_opens:
+            # same invariant as fundchannel_start: ONE open per peer —
+            # two flows would interleave wire messages on one stream
+            raise ManagerError("open already in progress with this peer")
+        p = Psbt.parse(base64.b64decode(initialpsbt))
+        if not p.tx.inputs:
+            raise ManagerError("initialpsbt has no inputs")
+        inputs = []
+        for txin in p.tx.inputs:
+            seen = (self.topology.txs_seen.get(txin.txid)
+                    if self.topology is not None else None)
+            if seen is None:
+                raise ManagerError(
+                    f"prevtx for {txin.txid.hex()[:16]} not in chain "
+                    "view (the v2 interactive protocol ships full "
+                    "previous transactions)")
+            # BOLT#2 v2 interactive construction requires RBF-signaling
+            # sequences (< 0xfffffffe); PSBT creators default to final
+            seq = txin.sequence
+            if seq >= 0xFFFFFFFE:
+                seq = 0xFFFFFFFD
+            inputs.append(FundingInput(prevtx=seen[0], vout=txin.vout,
+                                       privkey=None, sequence=seq))
+        dbid = self._next_dbid
+        self._next_dbid += 1
+        client = self.hsm.client(CAP_MASTER, peer_id, dbid=dbid)
+
+        st = {"secured": asyncio.Event(),
+              "wits": asyncio.get_running_loop().create_future(),
+              "inputs": inputs, "ch": None, "tx": None,
+              "my_serials": None}
+
+        async def hook(ch, tx, my_serials):
+            st["ch"], st["tx"], st["my_serials"] = ch, tx, my_serials
+            st["secured"].set()
+            return await st["wits"]
+
+        self._pending_opens[peer_id] = st
+        st["peer_id"] = peer_id
+        st["task"] = asyncio.get_running_loop().create_task(
+            DO.open_channel_v2(
+                peer, self.hsm, client, int(amount_sat), inputs,
+                cfg=CD.ChannelConfig(announce=announce),
+                funding_feerate=int(funding_feerate), sign_hook=hook))
+        secured = asyncio.get_running_loop().create_task(
+            st["secured"].wait())
+        done, _ = await asyncio.wait(
+            {st["task"], secured}, return_when=asyncio.FIRST_COMPLETED)
+        if st["task"] in done:
+            secured.cancel()
+            del self._pending_opens[peer_id]
+            st["task"].result()     # raises the open failure
+            raise ManagerError("open finished before signing — bug")
+        cid = st["ch"].channel_id.hex()
+        self._staged_v2[cid] = st
+        return {"channel_id": cid, "psbt": self._staged_psbt(st),
+                "commitments_secured": True,
+                "funding_outnum": st["ch"].funding_outidx,
+                "channel_type": {"bits": [12]}}
+
+    def _staged_psbt(self, st) -> str:
+        """The constructed funding tx as a PSBT with witness_utxo filled
+        in for OUR inputs, so a standard external signer can produce the
+        signatures openchannel_signed expects."""
+        import base64
+
+        from ..btc.psbt import Psbt
+
+        p = Psbt.from_tx(st["tx"])
+        spent = {(fi.prevtx.txid(), fi.vout):
+                 fi.prevtx.outputs[fi.vout] for fi in st["inputs"]}
+        for i, txin in enumerate(p.tx.inputs):
+            out = spent.get((txin.txid, txin.vout))
+            if out is not None:
+                p.inputs[i].witness_utxo = out
+        return base64.b64encode(p.serialize()).decode()
+
+    async def openchannel_update(self, channel_id: str,
+                                 psbt: str | None = None) -> dict:
+        import base64
+
+        from ..btc.psbt import Psbt
+
+        st = self._staged_v2.get(channel_id)
+        if st is None:
+            raise ManagerError("unknown channel_id for staged open")
+        if psbt is not None:
+            # the interactive construction already completed at init
+            # time; a caller-modified tx cannot be folded in, so reject
+            # it loudly instead of silently dropping the modification
+            given = Psbt.parse(base64.b64decode(psbt)).tx
+            if given.inputs and given.txid() != st["tx"].txid():
+                raise ManagerError(
+                    "psbt differs from the negotiated funding tx; "
+                    "contributions are fixed at openchannel_init time")
+        return {"channel_id": channel_id,
+                "psbt": self._staged_psbt(st),
+                "commitments_secured": True,
+                "funding_outnum": st["ch"].funding_outidx}
+
+    async def openchannel_signed(self, channel_id: str,
+                                 signed_psbt: str) -> dict:
+        import base64
+
+        from ..btc.psbt import Psbt
+
+        st = self._staged_v2.get(channel_id)
+        if st is None:
+            raise ManagerError("unknown channel_id for staged open")
+        sp = Psbt.parse(base64.b64decode(signed_psbt))
+        try:
+            sp.finalize()
+        except Exception:
+            pass                      # already finalized is fine
+        wmap = {}
+        for i, txin in enumerate(sp.tx.inputs):
+            if sp.inputs[i].final_witness:
+                wmap[(txin.txid, txin.vout)] = sp.inputs[i].final_witness
+            elif txin.witness:
+                wmap[(txin.txid, txin.vout)] = txin.witness
+        ours = []
+        for fi in st["inputs"]:
+            key = (fi.prevtx.txid(), fi.vout)
+            wit = wmap.get(key)
+            if not wit:
+                raise ManagerError(
+                    f"signed psbt lacks a witness for input "
+                    f"{key[0].hex()[:16]}:{key[1]}")
+            ours.append(wit)
+        del self._staged_v2[channel_id]
+        self._pending_opens.pop(st.get("peer_id"), None)
+        st["wits"].set_result(ours)
+        try:
+            ch, tx = await st["task"]
+        except BaseException:
+            raise
+        self._spawn_loop(ch)
+        if self.chain_backend is not None:
+            try:
+                await self.chain_backend.sendrawtransaction(
+                    tx.serialize().hex())
+            except Exception as e:
+                log.warning("funding broadcast failed: %s", e)
+        return {"channel_id": channel_id, "tx": tx.serialize().hex(),
+                "txid": tx.txid().hex()}
+
+    async def openchannel_abort(self, channel_id: str) -> dict:
+        st = self._staged_v2.pop(channel_id, None)
+        if st is None:
+            raise ManagerError("unknown channel_id for staged open")
+        self._pending_opens.pop(st.get("peer_id"), None)
+        st["wits"].cancel()
+        st["task"].cancel()
+        try:
+            await st["ch"].peer.send_error(b"open aborted",
+                                           st["ch"].channel_id)
+        except Exception:
+            pass
+        return {"channel_id": channel_id,
+                "channel_canceled": True}
+
     async def multifundchannel(self, destinations: list[dict]) -> dict:
         """Open channels to several peers from ONE funding transaction
         (plugins/spender/multifundchannel.c): negotiate every open
@@ -1073,6 +1254,25 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     async def fundchannel_cancel(id: str) -> dict:
         return await mgr.fundchannel_cancel(bytes.fromhex(id))
 
+    async def openchannel_init(id: str, amount, initialpsbt: str,
+                               announce: bool = True,
+                               funding_feerate=2500) -> dict:
+        return await mgr.openchannel_init(
+            bytes.fromhex(id), int(amount), initialpsbt,
+            announce=bool(announce),
+            funding_feerate=int(funding_feerate))
+
+    async def openchannel_update(channel_id: str,
+                                 psbt: str | None = None) -> dict:
+        return await mgr.openchannel_update(channel_id, psbt)
+
+    async def openchannel_signed(channel_id: str,
+                                 signed_psbt: str) -> dict:
+        return await mgr.openchannel_signed(channel_id, signed_psbt)
+
+    async def openchannel_abort(channel_id: str) -> dict:
+        return await mgr.openchannel_abort(channel_id)
+
     async def renepay(invstring: str, amount_msat=None,
                       retry_for: int = 60) -> dict:
         """Pickhardt-payments front door: the reliability cost model is
@@ -1127,6 +1327,10 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     rpc.register("fundchannel_start", fundchannel_start)
     rpc.register("fundchannel_complete", fundchannel_complete)
     rpc.register("fundchannel_cancel", fundchannel_cancel)
+    rpc.register("openchannel_init", openchannel_init)
+    rpc.register("openchannel_update", openchannel_update)
+    rpc.register("openchannel_signed", openchannel_signed)
+    rpc.register("openchannel_abort", openchannel_abort)
     rpc.register("renepay", renepay)
     rpc.register("renepaystatus", renepaystatus)
     rpc.register("createonion", createonion)

@@ -107,6 +107,14 @@ class GossipIngest:
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._run())
 
+    async def warmup(self) -> None:
+        """Pre-compile the hash+verify programs at this ingest's bucket
+        (see verify.warmup: a cold compile inside a live flush stalls
+        acceptance for minutes).  Daemons call this at startup; safe to
+        skip for pure-CPU library use where the caller prefers lazy
+        compilation."""
+        await asyncio.to_thread(gverify.warmup, self.bucket)
+
     async def close(self) -> None:
         self._closed = True
         self._wakeup.set()

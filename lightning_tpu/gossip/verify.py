@@ -48,15 +48,25 @@ def gossip_hash_kernel(blocks, n_blocks):
     return H.digest_words_to_limbs(digest)
 
 
-def gossip_verify_kernel(blocks, n_blocks, r, s, qx, parity):
-    """sha256d(signed region) + ECDSA verify (two chained jit programs)."""
-    z = _jit_hash()(blocks, n_blocks)
-    return S._jit_verify()(z, r, s, qx, parity)
-
-
 @functools.lru_cache(maxsize=2)
 def _jit_hash():
     return jax.jit(gossip_hash_kernel)
+
+
+def warmup(bucket: int = DEFAULT_BUCKET) -> None:
+    """Compile (or load from the persistent cache) the hash + verify
+    programs at the given bucket, off the live path.  A cold XLA:CPU
+    compile of the EC verify program takes minutes; a daemon that
+    first compiles it inside a live flush stalls gossip acceptance far
+    past peer/test timeouts (found via test_gossip_origination on a
+    fresh cache).  Call from startup — idempotent and cheap once the
+    jit caches are warm."""
+    blocks = jnp.zeros((bucket, MAX_BLOCKS, 16), jnp.uint32)
+    nb = jnp.ones((bucket,), jnp.int32)
+    z = _jit_hash()(blocks, nb)
+    sigs = jnp.zeros((bucket, 64), jnp.uint8)
+    pubs = jnp.zeros((bucket, 33), jnp.uint8)
+    np.asarray(S._jit_verify_from_bytes()(z, sigs, pubs))
 
 
 def _bytes_to_blocks(rows: np.ndarray, max_blocks: int) -> np.ndarray:
@@ -68,29 +78,39 @@ def _bytes_to_blocks(rows: np.ndarray, max_blocks: int) -> np.ndarray:
 
 @dataclass
 class VerifyItems:
-    """One flat signature-check workload (possibly many sigs per message)."""
+    """One flat signature-check workload (possibly many sigs per message).
 
-    rows: np.ndarray  # (N, MAX_BLOCKS*64) uint8 pre-padded signed regions
-    n_blocks: np.ndarray  # (N,) uint32; 0 = oversized, hashed host-side
+    ``rows``/``n_blocks``/``z_host`` are per unique MESSAGE (M rows);
+    sigs/pubkeys are per SIGNATURE (N items).  ``row_of_item`` maps each
+    signature to its message row — None means 1:1 (M == N).  Hashing per
+    unique row instead of per signature matters: channel_announcements
+    carry 4 signatures over ONE signed region, so the per-item layout
+    hashed (and uploaded) every CA region 4×."""
+
+    rows: np.ndarray  # (M, MAX_BLOCKS*64) uint8 pre-padded signed regions
+    n_blocks: np.ndarray  # (M,) uint32; 0 = oversized, hashed host-side
     sigs: np.ndarray  # (N, 64) uint8
     pubkeys: np.ndarray  # (N, 33) uint8
     msg_index: np.ndarray  # (N,) int64 — row in the originating batch
-    z_host: np.ndarray | None = None  # (N, 32) host sha256d where n_blocks==0
-
-    @property
-    def oversized(self) -> np.ndarray:
-        return self.n_blocks == 0
+    z_host: np.ndarray | None = None  # (M, 32) host sha256d where n_blocks==0
+    row_of_item: np.ndarray | None = None  # (N,) int64; None = identity
 
     @staticmethod
     def concat(items: list["VerifyItems"]) -> "VerifyItems":
         if any(x.z_host is not None for x in items):
             zh = np.concatenate([
                 x.z_host if x.z_host is not None
-                else np.zeros((len(x), 32), np.uint8)
+                else np.zeros((x.rows.shape[0], 32), np.uint8)
                 for x in items
             ])
         else:
             zh = None
+        rois, base = [], 0
+        for x in items:
+            roi = (np.arange(len(x), dtype=np.int64)
+                   if x.row_of_item is None else x.row_of_item)
+            rois.append(roi + base)
+            base += x.rows.shape[0]
         return VerifyItems(
             np.concatenate([x.rows for x in items]),
             np.concatenate([x.n_blocks for x in items]),
@@ -98,6 +118,7 @@ class VerifyItems:
             np.concatenate([x.pubkeys for x in items]),
             np.concatenate([x.msg_index for x in items]),
             zh,
+            np.concatenate(rois),
         )
 
     def __len__(self):
@@ -141,13 +162,17 @@ def extract_channel_announcements(idx: StoreIndex) -> VerifyItems:
     for i, sig_off in enumerate(wire.CA_SIG_OFFSETS):
         sigs.append(native.gather_fields(idx.buf, off, sig_off, 64))
         keys.append(native.gather_fields(idx.buf, off + key_base, 33 * i, 33))
+    # rows stay per-MESSAGE: the 4 signatures share one signed region,
+    # and row_of_item maps them back — tiling the 512-byte rows 4× made
+    # the hash phase (and its upload) 4× bigger for nothing
     return VerifyItems(
-        np.tile(rows, (4, 1)),
-        np.tile(nb, 4),
+        rows,
+        nb,
         np.concatenate(sigs),
         np.concatenate(keys),
         np.tile(np.arange(n, dtype=np.int64), 4),
-        np.tile(z_host, (4, 1)) if z_host is not None else None,
+        z_host,
+        np.tile(np.arange(n, dtype=np.int64), 4),
     )
 
 
@@ -239,38 +264,58 @@ def make_scid_map(ca_idx: StoreIndex):
 
 
 def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray:
-    """Run the chained hash+verify kernels over fixed-size buckets.
-    Oversized rows (n_blocks == 0) ride the batched EC verify with their
-    host-computed hash instead of the device hash.  Returns bool (N,)."""
+    """Two bucketed device phases: sha256d per unique MESSAGE row, then
+    ECDSA verify per SIGNATURE with the hash gathered by row_of_item
+    and sig/pubkey bytes unpacked on-device.  Oversized rows
+    (n_blocks == 0) get their host-computed hash spliced into the hash
+    results and ride the same verify phase.  All readbacks are deferred
+    so host prep of bucket i+1 overlaps device compute of bucket i
+    (a per-bucket readback costs a full tunnel round-trip).
+    Returns bool (N,)."""
     N = len(items)
-    out = np.zeros(N, bool)
-    parity_all = (items.pubkeys[:, 0] & 1).astype(np.uint32)
+    if N == 0:
+        return np.zeros(0, bool)
+    roi = items.row_of_item
+    if roi is None:
+        roi = np.arange(N, dtype=np.int64)
+    M = items.rows.shape[0]
     tag_ok = (items.pubkeys[:, 0] == 2) | (items.pubkeys[:, 0] == 3)
+
+    # --- hash phase (per unique row)
+    z_all = np.empty((M, F.NLIMBS), np.uint32)
+    pending = []
+    for start in range(0, M, bucket):
+        end = min(start + bucket, M)
+        sl = slice(start, end)
+        blocks = _bytes_to_blocks(S._pad_rows(items.rows[sl], bucket),
+                                  MAX_BLOCKS)
+        z = _jit_hash()(
+            jnp.asarray(blocks),
+            jnp.asarray(S._pad_rows(items.n_blocks[sl],
+                                    bucket).astype(np.int32)),
+        )
+        pending.append((sl, end - start, z))
+    for sl, n_real, z in pending:
+        z_all[sl] = np.asarray(z)[:n_real]
+    ovs_rows = items.n_blocks == 0
+    if ovs_rows.any() and items.z_host is not None:
+        z_all[ovs_rows] = F.from_bytes_be(items.z_host[ovs_rows])
+
+    # --- verify phase (per signature)
+    out = np.zeros(N, bool)
+    kern = S._jit_verify_from_bytes()
+    pending = []
     for start in range(0, N, bucket):
         end = min(start + bucket, N)
         sl = slice(start, end)
-        pad = bucket - (end - start)
-
-        def pad_to(a):
-            if pad == 0:
-                return a
-            return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
-
-        blocks = _bytes_to_blocks(pad_to(items.rows[sl]), MAX_BLOCKS)
-        ok = gossip_verify_kernel(
-            jnp.asarray(blocks),
-            jnp.asarray(pad_to(items.n_blocks[sl]).astype(np.int32)),
-            jnp.asarray(F.from_bytes_be(pad_to(items.sigs[sl][:, :32]))),
-            jnp.asarray(F.from_bytes_be(pad_to(items.sigs[sl][:, 32:]))),
-            jnp.asarray(F.from_bytes_be(pad_to(items.pubkeys[sl][:, 1:]))),
-            jnp.asarray(pad_to(parity_all[sl])),
+        ok = kern(
+            jnp.asarray(S._pad_rows(z_all[roi[sl]], bucket)),
+            jnp.asarray(S._pad_rows(items.sigs[sl], bucket)),
+            jnp.asarray(S._pad_rows(items.pubkeys[sl], bucket)),
         )
-        out[sl] = np.asarray(ok)[: end - start]
-    ovs = items.oversized
-    if ovs.any() and items.z_host is not None:
-        out[ovs] = S.ecdsa_verify_batch(
-            items.z_host[ovs], items.sigs[ovs], items.pubkeys[ovs]
-        )
+        pending.append((sl, end - start, ok))
+    for sl, n_real, ok in pending:
+        out[sl] = np.asarray(ok)[:n_real]
     return out & tag_ok
 
 

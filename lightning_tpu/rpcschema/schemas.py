@@ -304,6 +304,25 @@ COMMANDS: dict[str, dict] = {
         "params": {"id": "hex", "psbt": "str"},
         "result": {"channel_id": "hex", "commitments_secured": "bool"},
     },
+    "openchannel_init": {
+        "params": {"id": "hex", "amount": "any", "initialpsbt": "str",
+                   "announce": "bool?", "funding_feerate": "any?"},
+        "result": {"channel_id": "hex", "psbt": "str",
+                   "commitments_secured": "bool", "funding_outnum": "int"},
+    },
+    "openchannel_update": {
+        "params": {"channel_id": "hex", "psbt": "str?"},
+        "result": {"channel_id": "hex", "psbt": "str",
+                   "commitments_secured": "bool", "funding_outnum": "int"},
+    },
+    "openchannel_signed": {
+        "params": {"channel_id": "hex", "signed_psbt": "str"},
+        "result": {"channel_id": "hex", "tx": "hex", "txid": "hex"},
+    },
+    "openchannel_abort": {
+        "params": {"channel_id": "hex"},
+        "result": {"channel_id": "hex", "channel_canceled": "bool"},
+    },
     "fundchannel_cancel": {
         "params": {"id": "hex"},
         "result": {"cancelled": "str"},

@@ -150,3 +150,118 @@ def test_serial_parity_enforced():
         DO._check_serial(1, True)
     with pytest.raises(DO.DualOpenError):
         DO._check_serial(2, False)
+
+
+def test_staged_openchannel_family():
+    """openchannel_init → update → signed staged flow
+    (dual_open_control.c json_openchannel_init/update/signed): the
+    caller brings a PSBT, commitments are secured before signing, and
+    tx_signatures only flow after openchannel_signed returns the signed
+    PSBT.  Abort of a second staged open is exercised too."""
+    import base64
+    import types
+
+    from lightning_tpu.btc.psbt import Psbt, PsbtInput
+    from lightning_tpu.daemon.manager import ChannelManager, ManagerError
+    from lightning_tpu.channel.state import ChannelState
+
+    async def scenario():
+        hsm_a, hsm_b = Hsm(b"\xd5" * 32), Hsm(b"\xd6" * 32)
+        na = LightningNode(privkey=hsm_b.node_key)
+        nb = LightningNode(privkey=hsm_a.node_key)
+        fut = asyncio.get_running_loop().create_future()
+
+        async def serve(peer):
+            client = hsm_b.client(CAP_MASTER, peer.node_id, dbid=9)
+            res = await DO.accept_channel_v2(peer, hsm_b, client,
+                                             contribute_sat=0)
+            fut.set_result(res)
+
+        na.on_peer = serve
+        port = await na.listen()
+        peer = await nb.connect("127.0.0.1", port, na.node_id)
+
+        key = 0xC0FFEE
+        fi = _utxo(key, 180_000, salt=9)
+        topo = types.SimpleNamespace(
+            txs_seen={fi.prevtx.txid(): (fi.prevtx, 0)})
+        mgr = ChannelManager(nb, hsm_a, topology=topo)
+
+        psbt0 = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=0)]))
+        init = await mgr.openchannel_init(
+            peer.node_id, 100_000,
+            base64.b64encode(psbt0.serialize()).decode())
+        assert init["commitments_secured"]
+        cid = init["channel_id"]
+
+        upd = await mgr.openchannel_update(cid)
+        assert upd["commitments_secured"]
+        funding = Psbt.parse(base64.b64decode(upd["psbt"])).tx
+
+        # sign OUR input of the constructed funding tx (the caller's
+        # signer role; here plain p2wpkh sighash with the test key)
+        idx = next(i for i, ti in enumerate(funding.inputs)
+                   if ti.txid == fi.prevtx.txid() and ti.vout == 0)
+        pub = ref.pubkey_serialize(ref.pubkey_create(key))
+        h = hashlib.new("ripemd160", hashlib.sha256(pub).digest()).digest()
+        code = b"\x76\xa9\x14" + h + b"\x88\xac"
+        sighash = funding.sighash_segwit(idx, code, fi.amount_sat)
+        r, s = ref.ecdsa_sign(sighash, key)
+        sp = Psbt.from_tx(funding)
+        sp.inputs[idx].final_witness = [T.sig_to_der(r, s), pub]
+        done = await mgr.openchannel_signed(
+            cid, base64.b64encode(sp.serialize()).decode())
+        assert done["txid"] == funding.txid().hex()
+
+        ch_b, _tx_b = await asyncio.wait_for(fut, 120)
+        ch_a = mgr.channels[bytes.fromhex(cid)][0]
+        assert ch_a.core.state is ChannelState.NORMAL
+        assert ch_b.core.state is ChannelState.NORMAL
+        assert ch_a.funding_sat == 100_000
+
+        # unknown channel_id aborts loudly
+        try:
+            await mgr.openchannel_abort("ff" * 32)
+            raise AssertionError("abort of unknown id must fail")
+        except ManagerError:
+            pass
+
+        # a LIVE staged open aborts cleanly: park a second open on a
+        # fresh peer pair and cancel it mid-signing
+        fut2 = asyncio.get_running_loop().create_future()
+
+        async def serve2(peer2):
+            client2 = hsm_b.client(CAP_MASTER, peer2.node_id, dbid=11)
+            try:
+                await DO.accept_channel_v2(peer2, hsm_b, client2,
+                                           contribute_sat=0)
+            except Exception as e:
+                fut2.set_result(type(e).__name__)
+
+        na.on_peer = serve2
+        peer2 = await nb.connect("127.0.0.1", port, na.node_id)
+        fi2 = _utxo(0xBEEF, 150_000, salt=11)
+        topo.txs_seen[fi2.prevtx.txid()] = (fi2.prevtx, 0)
+        psbt2 = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi2.prevtx.txid(), vout=0)]))
+        init2 = await mgr.openchannel_init(
+            peer2.node_id, 90_000,
+            base64.b64encode(psbt2.serialize()).decode())
+        res = await mgr.openchannel_abort(init2["channel_id"])
+        assert res["channel_canceled"]
+        assert init2["channel_id"] not in mgr._staged_v2
+        try:
+            await mgr.openchannel_signed(init2["channel_id"], "")
+            raise AssertionError("signed after abort must fail")
+        except ManagerError:
+            pass
+
+        for _, t in mgr.channels.values():
+            t.cancel()
+        await na.close()
+        await nb.close()
+
+    run(scenario())

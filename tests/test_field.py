@@ -201,3 +201,18 @@ def test_normalize_low_s_negation_regression():
         F.FN, F.sub(F.FN, F.zero((len(ss),)), v)))(sl)
     got = ints(neg)
     assert got == [(F.N_INT - x % F.N_INT) % F.N_INT for x in ss]
+
+
+def test_from_bytes_be_dev_matches_host():
+    """The traced byte→limb unpacker (used by the verify phase to ship
+    raw sig/pubkey bytes and unpack on-device) is bit-identical to the
+    numpy from_bytes_be on random and boundary values."""
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, (64, 32)).astype(np.uint8)
+    data[0] = 0
+    data[1] = 255
+    got = np.asarray(jax.jit(F.from_bytes_be_dev)(jnp.asarray(data)))
+    want = F.from_bytes_be(data)
+    assert np.array_equal(got, want)
+    for i in range(8):
+        assert F.limbs_to_int(got[i]) == int.from_bytes(bytes(data[i]), "big")

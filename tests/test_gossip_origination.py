@@ -51,6 +51,11 @@ def test_public_channel_announces_and_routes(tmp_path):
         nd = LightningNode(privkey=0xD111)
         gd = GD.Gossipd(nd, str(tmp_path / "gd.gs"), flush_ms=1.0)
         gd.start()
+        # pre-compile the verify programs: the first-ever compile takes
+        # minutes on cold XLA:CPU and must not race the live
+        # announcement flow's wait gates (one warmup covers all three
+        # gossipds — same process, same bucket)
+        await ga.ingest.warmup()
         try:
             port = await b.node.listen()
             await a.node.connect("127.0.0.1", port, b.node.node_id)

@@ -31,13 +31,10 @@ def _warm_verify_kernels():
     from lightning_tpu.crypto import secp256k1 as S
     from lightning_tpu.gossip import verify
 
-    B = 64
-    z = jnp.zeros((B, F.NLIMBS), jnp.uint32)
-    par = jnp.zeros(B, jnp.uint32)
-    blocks = jnp.zeros((B, verify.MAX_BLOCKS, 16), jnp.uint32)
-    nb = jnp.ones(B, jnp.int32)
-    zz = verify._jit_hash()(blocks, nb)
-    np.asarray(S._jit_verify()(zz, z, z, z, par))
+    # warm the PRODUCTION flush path (hash + from-bytes verify): the
+    # ingest now ships raw sig/pubkey bytes, so warming the limb-based
+    # program would leave the actual flush to cold-compile mid-test
+    verify.warmup(verify.DEFAULT_BUCKET)
 
 
 async def _wait(cond, timeout=60.0):

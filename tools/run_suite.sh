@@ -1,0 +1,51 @@
+#!/bin/bash
+# Full test suite in TWO pytest slices, with crash-retry.
+#
+# jax 0.9's persistent compilation cache sometimes dies INSIDE
+# XLA:CPU executable serialize/deserialize (SIGABRT on write, SIGSEGV
+# on read) while storing one of this repo's large EC programs — only
+# in long-running processes (every file passes in a fresh process, and
+# a minimal compile+write of the same program succeeds).  Round 3's
+# review already ran the suite in two slices for related reasons.
+#
+# The mitigation exploits cache monotonicity: every entry written
+# BEFORE a crash persists, so rerunning a crashed slice starts warmer
+# and ratchets past the crash point; a fully-warm run performs no
+# writes at all and cannot hit the bug.  Test FAILURES (rc 1) are
+# never retried — only crash exits (≥128).
+#
+# NOTE: do NOT run anything else that touches the jax compilation
+# cache concurrently — concurrent writers corrupt entries (readers
+# then segfault).  Side processes: LIGHTNING_TPU_JAX_CACHE=/tmp/...
+set -u
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+run_slice() {
+  local name="$1"; shift
+  local attempt rc f
+  for attempt in 1 2; do
+    python -m pytest "$@" -x -q && return 0
+    rc=$?
+    if [ "$rc" -lt 128 ]; then
+      echo "slice $name failed rc=$rc (test failure, not retried)"
+      return "$rc"
+    fi
+    echo "slice $name crashed rc=$rc (attempt $attempt) — retrying" \
+         "with the now-warmer cache"
+  done
+  # an executable whose WRITE crashes re-crashes on every whole-slice
+  # retry; every file is known to pass in a fresh process, so finish
+  # the slice file-per-process (slower: ~20 s jax startup per file)
+  echo "slice $name: falling back to file-per-process"
+  for f in "$@"; do
+    python -m pytest "$f" -x -q || { rc=$?;
+      echo "slice $name: $f failed rc=$rc"; return "$rc"; }
+  done
+  return 0
+}
+
+run_slice A tests/test_[a-f]*.py || exit $?
+run_slice B tests/test_[g-z]*.py || exit $?
+echo "suite green (2 slices)"
