@@ -133,17 +133,23 @@ async def amain(args) -> int:
         # pre-compile the verify kernels off the live path (a cold
         # first compile inside a live gossip flush stalls acceptance
         # for minutes; verify.warmup docstring has the postmortem).
+        # TPU-attached daemons only: on a CPU-forced daemon (tests,
+        # dev) the full-opt compile takes minutes on one core and
+        # starves startup itself — there the first flush compiles
+        # lazily (or the caller invokes ingest.warmup() explicitly).
         # anchored on the gossipd so GC cannot drop the task mid-await
-        gossipd._warmup_task = asyncio.get_running_loop().create_task(
-            gossipd.ingest.warmup())
+        if not args.cpu:
+            gossipd._warmup_task = asyncio.get_running_loop().create_task(
+                gossipd.ingest.warmup())
 
-        def _warmup_done(t):
-            if not t.cancelled() and t.exception() is not None:
-                print(f"gossip verify warmup failed: {t.exception()!r} "
-                      "(first live flush will pay the cold compile)",
-                      file=sys.stderr, flush=True)
+            def _warmup_done(t):
+                if not t.cancelled() and t.exception() is not None:
+                    print(f"gossip verify warmup failed: "
+                          f"{t.exception()!r} (first live flush will "
+                          "pay the cold compile)",
+                          file=sys.stderr, flush=True)
 
-        gossipd._warmup_task.add_done_callback(_warmup_done)
+            gossipd._warmup_task.add_done_callback(_warmup_done)
         if loaded:
             print(f"gossipd: {loaded} records from {gpath}", flush=True)
         # autonomous seeker: full-sync on startup, then rotate peers and

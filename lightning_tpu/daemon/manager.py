@@ -70,8 +70,11 @@ class ChannelManager:
         self.channels: dict[bytes, tuple] = {}
         # peer_id -> Channeld awaiting fundchannel_complete
         self._pending_opens: dict[bytes, object] = {}
-        # channel_id hex -> staged v2 open state (openchannel_init)
+        # channel_id hex -> staged v2 open state (openchannel_init);
+        # _staged_peers guards one-open-per-peer WITHOUT putting dicts
+        # into _pending_opens (whose consumers expect Channelds)
         self._staged_v2: dict[str, dict] = {}
+        self._staged_peers: set[bytes] = set()
         self._bg_tasks: set = set()   # strong refs for spawned tasks
         self._next_dbid = 1
         self._load_next_dbid()
@@ -513,7 +516,7 @@ class ChannelManager:
         peer = self.node.peers.get(peer_id)
         if peer is None:
             raise ManagerError(f"peer {peer_id.hex()[:16]} not connected")
-        if peer_id in self._pending_opens:
+        if peer_id in self._pending_opens or peer_id in self._staged_peers:
             raise ManagerError("open already in progress with this peer")
         dbid = self._next_dbid
         self._next_dbid += 1
@@ -596,7 +599,7 @@ class ChannelManager:
         peer = self.node.peers.get(peer_id)
         if peer is None:
             raise ManagerError(f"peer {peer_id.hex()[:16]} not connected")
-        if peer_id in self._pending_opens:
+        if peer_id in self._pending_opens or peer_id in self._staged_peers:
             # same invariant as fundchannel_start: ONE open per peer —
             # two flows would interleave wire messages on one stream
             raise ManagerError("open already in progress with this peer")
@@ -633,7 +636,7 @@ class ChannelManager:
             st["secured"].set()
             return await st["wits"]
 
-        self._pending_opens[peer_id] = st
+        self._staged_peers.add(peer_id)
         st["peer_id"] = peer_id
         st["task"] = asyncio.get_running_loop().create_task(
             DO.open_channel_v2(
@@ -642,11 +645,20 @@ class ChannelManager:
                 funding_feerate=int(funding_feerate), sign_hook=hook))
         secured = asyncio.get_running_loop().create_task(
             st["secured"].wait())
-        done, _ = await asyncio.wait(
-            {st["task"], secured}, return_when=asyncio.FIRST_COMPLETED)
+        try:
+            done, _ = await asyncio.wait(
+                {st["task"], secured},
+                return_when=asyncio.FIRST_COMPLETED)
+        except BaseException:
+            # RPC cancelled mid-negotiation: tear the open down, or the
+            # per-peer guard and the task would leak until restart
+            secured.cancel()
+            st["task"].cancel()
+            self._staged_peers.discard(peer_id)
+            raise
         if st["task"] in done:
             secured.cancel()
-            del self._pending_opens[peer_id]
+            self._staged_peers.discard(peer_id)
             st["task"].result()     # raises the open failure
             raise ManagerError("open finished before signing — bug")
         cid = st["ch"].channel_id.hex()
@@ -726,12 +738,9 @@ class ChannelManager:
                     f"{key[0].hex()[:16]}:{key[1]}")
             ours.append(wit)
         del self._staged_v2[channel_id]
-        self._pending_opens.pop(st.get("peer_id"), None)
+        self._staged_peers.discard(st.get("peer_id"))
         st["wits"].set_result(ours)
-        try:
-            ch, tx = await st["task"]
-        except BaseException:
-            raise
+        ch, tx = await st["task"]
         self._spawn_loop(ch)
         if self.chain_backend is not None:
             try:
@@ -746,7 +755,7 @@ class ChannelManager:
         st = self._staged_v2.pop(channel_id, None)
         if st is None:
             raise ManagerError("unknown channel_id for staged open")
-        self._pending_opens.pop(st.get("peer_id"), None)
+        self._staged_peers.discard(st.get("peer_id"))
         st["wits"].cancel()
         st["task"].cancel()
         try:

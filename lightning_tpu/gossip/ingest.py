@@ -320,33 +320,38 @@ class GossipIngest:
 
 
     def _build_items(self, batch: list[_QItem]) -> gverify.VerifyItems:
-        """Flatten queued messages into one VerifyItems workload."""
+        """Flatten queued messages into one VerifyItems workload: ONE
+        hashed row per message, with row_of_item fanning the 4
+        channel_announcement signatures onto their shared row (same
+        layout as the store-replay extractor)."""
         regions: list[bytes] = []
         sigs: list[bytes] = []
         keys: list[bytes] = []
         midx: list[int] = []
+        roi: list[int] = []
         for i, it in enumerate(batch):
             p = it.parsed
-            region = p.signed_region()
+            row = len(regions)
+            regions.append(p.signed_region())
             if it.kind == wire.MSG_CHANNEL_ANNOUNCEMENT:
                 for sig, key in p.signature_tuples():
-                    regions.append(region)
                     sigs.append(sig)
                     keys.append(key)
                     midx.append(i)
+                    roi.append(row)
             elif it.kind == wire.MSG_CHANNEL_UPDATE:
                 # _precheck guarantees the channel is known by now; the
                 # signer is the channel endpoint for this direction, so
                 # identity and signature are checked in one kernel pass.
-                regions.append(region)
                 sigs.append(p.signature)
                 keys.append(self.channels[p.short_channel_id][p.direction])
                 midx.append(i)
+                roi.append(row)
             else:  # node_announcement (self-signed)
-                regions.append(region)
                 sigs.append(p.signature)
                 keys.append(p.node_id)
                 midx.append(i)
+                roi.append(row)
         buf = np.frombuffer(b"".join(regions), np.uint8)
         lengths = np.array([len(r) for r in regions], np.int64)
         offsets = np.concatenate(
@@ -360,4 +365,5 @@ class GossipIngest:
             np.frombuffer(b"".join(k.ljust(33, b"\0") for k in keys),
                           np.uint8).reshape(-1, 33),
             np.array(midx, np.int64), z_host,
+            np.array(roi, np.int64),
         )
