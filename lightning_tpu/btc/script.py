@@ -89,6 +89,21 @@ def p2wpkh(pubkey: bytes) -> bytes:
     return bytes([OP_0, 20]) + hash160(pubkey)
 
 
+def dust_floor_sat(spk: bytes) -> int:
+    """Relay-policy dust floor for an output paying to this script
+    (Core policy/policy.cpp GetDustThreshold at the 3000 sat/kvB
+    dust relay rate): OP_RETURN outputs carry no value by design,
+    witness programs get the discounted 294/330 floors, everything
+    else the legacy 546."""
+    if spk[:1] == b"\x6a":
+        return 0
+    if spk and spk[0] == 0x00 and len(spk) in (22, 34):
+        return 294 if len(spk) == 22 else 330
+    if spk and 0x51 <= spk[0] <= 0x60 and len(spk) >= 4:
+        return 330                     # v1+ witness program (taproot)
+    return 546
+
+
 # ---------------------------------------------------------------------------
 # BOLT#3 templates
 

@@ -87,6 +87,18 @@ def _side_fee_sat(feerate_perkw: int, n_inputs: int, n_outputs: int,
     return feerate_perkw * wu // 1000
 
 
+def opener_fee_floor(feerate_perkw: int, n_inputs: int,
+                     n_outputs: int, template: bool) -> int:
+    """Minimum funding fee the opener must leave: its own inputs +
+    outputs + the common fields/funding output.  Template mode (a
+    caller-built PSBT) counts exactly the caller's outputs; wallet
+    mode reserves room for the fallback change output.  Shared by
+    open_channel_v2 and the manager's pre-wire affordability check so
+    the two can never drift."""
+    n_out = (1 + n_outputs) if template else 2
+    return _side_fee_sat(feerate_perkw, n_inputs, n_out, common=True)
+
+
 def _change_spk(pub: bytes) -> bytes:
     """Fallback change scriptpubkey keyed to the side's funding pubkey
     (callers with a wallet pass a tracked key instead)."""
@@ -333,13 +345,25 @@ async def open_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
                           funding_feerate: int = 2500,
                           lockin: bool = True,
                           sign_hook=None,
+                          our_outputs: list[tuple[int, bytes]] | None = None,
+                          template: bool = False,
                           ) -> tuple[Channeld, T.Tx]:
-    """Opener side.  Returns (live channel, fully-signed funding tx)."""
+    """Opener side.  Returns (live channel, fully-signed funding tx).
+
+    our_outputs: extra (amount_sat, scriptpubkey) outputs the opener
+    contributes to the funding tx — the caller's own change from a
+    pre-built PSBT (lightningd/dual_open_control.c treats the
+    initialpsbt's outputs as the opener's outputs, not surplus).
+    template: the inputs/outputs came from a caller-built PSBT —
+    inputs − outputs is the fee the CALLER chose; never add a
+    fallback change output (even when our_outputs is empty)."""
     cfg = cfg or ChannelConfig()
     ch = Channeld(peer, hsm, client, funder=True, cfg=cfg)
     temp_id = b"\x00" * 32
+    our_outputs = list(our_outputs or [])
+    out_total = sum(sats for sats, _ in our_outputs)
     in_total = sum(fi.amount_sat for fi in our_inputs)
-    if in_total < funding_sat:
+    if in_total < funding_sat + out_total:
         raise DualOpenError("inputs do not cover funding contribution")
     await peer.send(M.OpenChannel2(
         chain_hash=b"\x00" * 32, temporary_channel_id=temp_id,
@@ -381,14 +405,27 @@ async def open_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
     con = _Construction(locktime=locktime)
     # opener adds the funding output (serial even) + its inputs/change,
     # paying funding-feerate fees on its own footprint + common fields
-    fee = _side_fee_sat(funding_feerate, len(our_inputs), 2, common=True)
-    if in_total < funding_sat + fee:
-        raise DualOpenError("inputs do not cover contribution + fee")
-    change = in_total - funding_sat - fee
-    outs = [(total, spk)]
-    if change > 546:
-        change_spk = _change_spk(ch.our_funding_pub)
-        outs.append((change, change_spk))
+    template = template or bool(our_outputs)
+    fee = opener_fee_floor(funding_feerate, len(our_inputs),
+                           len(our_outputs), template)
+    if template:
+        # caller-built template (openchannel_init psbt): the caller
+        # already chose its change, so inputs − outputs IS the fee the
+        # caller picked — require it to cover at least the negotiated
+        # feerate, and NEVER add a fallback change output (it would
+        # land on a script no wallet tracks)
+        if in_total < funding_sat + out_total + fee:
+            raise DualOpenError(
+                "inputs do not cover contribution + outputs + fee")
+        outs = [(total, spk)] + our_outputs
+    else:
+        if in_total < funding_sat + fee:
+            raise DualOpenError("inputs do not cover contribution + fee")
+        change = in_total - funding_sat - fee
+        outs = [(total, spk)]
+        if change > 546:
+            change_spk = _change_spk(ch.our_funding_pub)
+            outs.append((change, change_spk))
     my_serials = await _interactive_construct(
         peer, ch.channel_id, con, True, our_inputs, outs, serial_base=0)
 

@@ -50,6 +50,10 @@ class _DeadPeer:
 
 
 class ChannelManager:
+    # abandoned openchannel_init states auto-abort after this long
+    # (keeps the per-peer open guard from leaking until restart)
+    STAGED_OPEN_TIMEOUT = 600.0
+
     def __init__(self, node, hsm, wallet=None, onchain=None,
                  chain_backend=None, topology=None, invoices=None,
                  relay=None, htlc_sets=None, gossmap_ref=None,
@@ -607,7 +611,14 @@ class ChannelManager:
         if not p.tx.inputs:
             raise ManagerError("initialpsbt has no inputs")
         inputs = []
+        seen_outpoints: set[tuple[bytes, int]] = set()
         for txin in p.tx.inputs:
+            op = (txin.txid, txin.vout)
+            if op in seen_outpoints:
+                raise ManagerError(
+                    f"initialpsbt lists input {txin.txid.hex()[:16]}:"
+                    f"{txin.vout} twice")
+            seen_outpoints.add(op)
             seen = (self.topology.txs_seen.get(txin.txid)
                     if self.topology is not None else None)
             if seen is None:
@@ -615,6 +626,11 @@ class ChannelManager:
                     f"prevtx for {txin.txid.hex()[:16]} not in chain "
                     "view (the v2 interactive protocol ships full "
                     "previous transactions)")
+            if txin.vout >= len(seen[0].outputs):
+                raise ManagerError(
+                    f"initialpsbt input {txin.txid.hex()[:16]}:"
+                    f"{txin.vout} — prevtx has only "
+                    f"{len(seen[0].outputs)} outputs")
             # BOLT#2 v2 interactive construction requires RBF-signaling
             # sequences (< 0xfffffffe); PSBT creators default to final
             seq = txin.sequence
@@ -622,6 +638,31 @@ class ChannelManager:
                 seq = 0xFFFFFFFD
             inputs.append(FundingInput(prevtx=seen[0], vout=txin.vout,
                                        privkey=None, sequence=seq))
+        # the initialpsbt's outputs are the OPENER'S outputs (the
+        # caller's change, e.g. from fundpsbt) and ride into the
+        # interactive construction (dual_open_control.c
+        # json_openchannel_init) — they must never be silently dropped
+        from ..btc.script import dust_floor_sat
+        outs = [(o.amount_sat, o.script_pubkey) for o in p.tx.outputs]
+        for sats, spk in outs:
+            if sats < dust_floor_sat(spk):
+                raise ManagerError(
+                    f"initialpsbt output of {sats} sat is below the "
+                    f"dust floor ({dust_floor_sat(spk)}) for its "
+                    "script — the funding tx would never relay")
+        in_total = sum(fi.amount_sat for fi in inputs)
+        out_total = sum(sats for sats, _ in outs)
+        # affordability INCLUDING the minimum funding fee, checked
+        # before any wire contact so a short PSBT fails cleanly here
+        # rather than parking the peer mid-open (same helper dualopend
+        # itself uses, so the two checks cannot drift)
+        fee = DO.opener_fee_floor(int(funding_feerate), len(inputs),
+                                  len(outs), template=True)
+        if in_total < int(amount_sat) + out_total + fee:
+            raise ManagerError(
+                f"initialpsbt inputs ({in_total} sat) do not cover "
+                f"funding ({amount_sat}) + psbt outputs ({out_total}) "
+                f"+ fee ({fee})")
         dbid = self._next_dbid
         self._next_dbid += 1
         client = self.hsm.client(CAP_MASTER, peer_id, dbid=dbid)
@@ -642,7 +683,8 @@ class ChannelManager:
             DO.open_channel_v2(
                 peer, self.hsm, client, int(amount_sat), inputs,
                 cfg=CD.ChannelConfig(announce=announce),
-                funding_feerate=int(funding_feerate), sign_hook=hook))
+                funding_feerate=int(funding_feerate), sign_hook=hook,
+                our_outputs=outs, template=True))
         secured = asyncio.get_running_loop().create_task(
             st["secured"].wait())
         try:
@@ -663,10 +705,42 @@ class ChannelManager:
             raise ManagerError("open finished before signing — bug")
         cid = st["ch"].channel_id.hex()
         self._staged_v2[cid] = st
+
+        # a staged open the caller abandons (never signed/aborted) must
+        # not park the peer task + per-peer guard forever: auto-abort
+        # when the peer connection drops, or after STAGED_OPEN_TIMEOUT
+        # seconds, whichever comes first (the reference ties staged
+        # lifetime to the connection, dual_open_control.c)
+        async def _expire():
+            try:
+                await asyncio.wait_for(peer.wait_closed(),
+                                       self.STAGED_OPEN_TIMEOUT)
+                reason = "peer disconnected"
+            except asyncio.TimeoutError:
+                reason = (f"still unsigned after "
+                          f"{self.STAGED_OPEN_TIMEOUT:g}s")
+            except Exception:       # pump died with the transport error
+                reason = "peer connection lost"
+            if self._staged_v2.get(cid) is st:
+                log.warning("staged open %s %s — aborting",
+                            cid[:16], reason)
+                try:
+                    await self.openchannel_abort(cid)
+                except Exception:
+                    pass
+
+        exp = asyncio.get_running_loop().create_task(_expire())
+        self._bg_tasks.add(exp)
+        exp.add_done_callback(self._bg_tasks.discard)
+        st["expire_task"] = exp
         return {"channel_id": cid, "psbt": self._staged_psbt(st),
                 "commitments_secured": True,
                 "funding_outnum": st["ch"].funding_outidx,
-                "channel_type": {"bits": [12]}}
+                "channel_type": {"bits": [12]},
+                # callers get the signing deadline up front so a slow
+                # external signer can re-init instead of being
+                # surprised by the auto-abort
+                "signing_deadline_seconds": self.STAGED_OPEN_TIMEOUT}
 
     def _staged_psbt(self, st) -> str:
         """The constructed funding tx as a PSBT with witness_utxo filled
@@ -739,6 +813,8 @@ class ChannelManager:
             ours.append(wit)
         del self._staged_v2[channel_id]
         self._staged_peers.discard(st.get("peer_id"))
+        if st.get("expire_task") is not None:
+            st["expire_task"].cancel()
         st["wits"].set_result(ours)
         ch, tx = await st["task"]
         self._spawn_loop(ch)
@@ -756,6 +832,9 @@ class ChannelManager:
         if st is None:
             raise ManagerError("unknown channel_id for staged open")
         self._staged_peers.discard(st.get("peer_id"))
+        exp = st.get("expire_task")
+        if exp is not None and exp is not asyncio.current_task():
+            exp.cancel()
         st["wits"].cancel()
         st["task"].cancel()
         try:

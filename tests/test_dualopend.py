@@ -265,3 +265,215 @@ def test_staged_openchannel_family():
         await nb.close()
 
     run(scenario())
+
+
+def test_staged_open_carries_psbt_outputs():
+    """The initialpsbt's outputs are the OPENER'S outputs (the caller's
+    change from fundpsbt) and must appear verbatim in the constructed
+    funding tx — never silently replaced by a fallback change script or
+    burned to fees (dual_open_control.c json_openchannel_init)."""
+    import base64
+    import types
+
+    from lightning_tpu.btc.psbt import Psbt
+    from lightning_tpu.daemon.manager import ChannelManager, ManagerError
+
+    async def scenario():
+        hsm_a, hsm_b = Hsm(b"\xd7" * 32), Hsm(b"\xd8" * 32)
+        na = LightningNode(privkey=hsm_b.node_key)
+        nb = LightningNode(privkey=hsm_a.node_key)
+
+        async def serve(peer):
+            client = hsm_b.client(CAP_MASTER, peer.node_id, dbid=9)
+            try:
+                await DO.accept_channel_v2(peer, hsm_b, client,
+                                           contribute_sat=0)
+            except Exception:
+                pass        # opener aborts after inspecting the psbt
+
+        na.on_peer = serve
+        port = await na.listen()
+        peer = await nb.connect("127.0.0.1", port, na.node_id)
+
+        fi = _utxo(0xFACE, 250_000, salt=13)
+        topo = types.SimpleNamespace(
+            txs_seen={fi.prevtx.txid(): (fi.prevtx, 0)})
+        mgr = ChannelManager(nb, hsm_a, topology=topo)
+        change_spk = b"\x00\x14" + b"\xab" * 20
+
+        # -- pre-wire rejections first (no peer traffic at all) --
+
+        # duplicate outpoints must not double-count value (the
+        # constructed tx could never confirm)
+        dup = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=0),
+                    T.TxInput(txid=fi.prevtx.txid(), vout=0)]))
+        with pytest.raises(ManagerError, match="twice"):
+            await mgr.openchannel_init(
+                peer.node_id, 100_000,
+                base64.b64encode(dup.serialize()).decode())
+
+        # exact cover with zero fee headroom fails BEFORE wire contact
+        tight = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=0)],
+            outputs=[T.TxOutput(amount_sat=150_000,
+                                script_pubkey=change_spk)]))
+        with pytest.raises(ManagerError, match="fee"):
+            await mgr.openchannel_init(
+                peer.node_id, 100_000,
+                base64.b64encode(tight.serialize()).decode())
+
+        # below-dust template output: the funding tx would never relay
+        dusty = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=0)],
+            outputs=[T.TxOutput(amount_sat=1,
+                                script_pubkey=change_spk)]))
+        with pytest.raises(ManagerError, match="dust"):
+            await mgr.openchannel_init(
+                peer.node_id, 100_000,
+                base64.b64encode(dusty.serialize()).decode())
+
+        # ...but a zero-value OP_RETURN is standard and passes the
+        # dust check (this template then fails on affordability,
+        # proving the dust floor did not fire)
+        opret = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=0)],
+            outputs=[T.TxOutput(amount_sat=0,
+                                script_pubkey=b"\x6a\x04test"),
+                     T.TxOutput(amount_sat=200_000,
+                                script_pubkey=change_spk)]))
+        with pytest.raises(ManagerError, match="cover"):
+            await mgr.openchannel_init(
+                peer.node_id, 100_000,
+                base64.b64encode(opret.serialize()).decode())
+
+        # bad vout rejected up front, not via a late IndexError
+        bad = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=5)]))
+        with pytest.raises(ManagerError, match="outputs"):
+            await mgr.openchannel_init(
+                peer.node_id, 100_000,
+                base64.b64encode(bad.serialize()).decode())
+
+        # inputs that can't cover funding + psbt outputs rejected
+        # before any wire contact with the peer
+        rich = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=0)],
+            outputs=[T.TxOutput(amount_sat=200_000,
+                                script_pubkey=change_spk)]))
+        with pytest.raises(ManagerError, match="cover"):
+            await mgr.openchannel_init(
+                peer.node_id, 100_000,
+                base64.b64encode(rich.serialize()).decode())
+
+        # -- live constructions --
+
+        psbt0 = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=0)],
+            outputs=[T.TxOutput(amount_sat=120_000,
+                                script_pubkey=change_spk)]))
+        init = await mgr.openchannel_init(
+            peer.node_id, 100_000,
+            base64.b64encode(psbt0.serialize()).decode())
+        funding = Psbt.parse(base64.b64decode(init["psbt"])).tx
+        carried = [o for o in funding.outputs
+                   if o.script_pubkey == change_spk]
+        assert len(carried) == 1, "caller change output was dropped"
+        assert carried[0].amount_sat == 120_000
+        # caller-built template: inputs − outputs is the caller's fee;
+        # no fallback change output may be injected
+        assert len(funding.outputs) == 2, \
+            "unexpected extra output on a caller-built template"
+        await mgr.openchannel_abort(init["channel_id"])
+
+        # output-less template: surplus is the caller's fee — no
+        # fallback change output on an untracked script (fresh peer:
+        # the abort above tears down the old accepter conversation)
+        peer_b = await nb.connect("127.0.0.1", port, na.node_id)
+        bare = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=0)]))
+        init_b = await mgr.openchannel_init(
+            peer_b.node_id, 100_000,
+            base64.b64encode(bare.serialize()).decode())
+        tx_b = Psbt.parse(base64.b64decode(init_b["psbt"])).tx
+        assert len(tx_b.outputs) == 1, \
+            "output-less template grew a fallback change output"
+        await mgr.openchannel_abort(init_b["channel_id"])
+
+        await na.close()
+        await nb.close()
+
+    run(scenario())
+
+
+def test_staged_open_expires_when_abandoned():
+    """An openchannel_init the caller never signs or aborts must not
+    park the per-peer guard forever: the staged state auto-aborts after
+    STAGED_OPEN_TIMEOUT and a fresh open with the same peer succeeds."""
+    import base64
+    import types
+
+    from lightning_tpu.btc.psbt import Psbt
+    from lightning_tpu.daemon.manager import ChannelManager
+
+    async def scenario():
+        hsm_a, hsm_b = Hsm(b"\xd9" * 32), Hsm(b"\xda" * 32)
+        na = LightningNode(privkey=hsm_b.node_key)
+        nb = LightningNode(privkey=hsm_a.node_key)
+
+        async def serve(peer):
+            client = hsm_b.client(CAP_MASTER, peer.node_id, dbid=9)
+            try:
+                await DO.accept_channel_v2(peer, hsm_b, client,
+                                           contribute_sat=0)
+            except Exception:
+                pass
+
+        na.on_peer = serve
+        port = await na.listen()
+        peer = await nb.connect("127.0.0.1", port, na.node_id)
+
+        fi = _utxo(0xDEAD, 200_000, salt=17)
+        topo = types.SimpleNamespace(
+            txs_seen={fi.prevtx.txid(): (fi.prevtx, 0)})
+        mgr = ChannelManager(nb, hsm_a, topology=topo)
+        mgr.STAGED_OPEN_TIMEOUT = 0.3
+
+        psbt0 = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=0)]))
+        b64 = base64.b64encode(psbt0.serialize()).decode()
+        init = await mgr.openchannel_init(peer.node_id, 100_000, b64)
+        cid = init["channel_id"]
+        assert cid in mgr._staged_v2
+        assert peer.node_id in mgr._staged_peers
+
+        await asyncio.sleep(0.8)
+        assert cid not in mgr._staged_v2, "abandoned open never expired"
+        assert peer.node_id not in mgr._staged_peers
+
+        # a peer disconnect clears the staged state well before the
+        # wall-clock deadline (reference ties lifetime to the conn)
+        mgr.STAGED_OPEN_TIMEOUT = 30.0
+        peer2 = await nb.connect("127.0.0.1", port, na.node_id)
+        init2 = await mgr.openchannel_init(peer2.node_id, 100_000, b64)
+        cid2 = init2["channel_id"]
+        assert init2["signing_deadline_seconds"] == 30.0
+        await peer2.disconnect()
+        await asyncio.sleep(1.0)
+        assert cid2 not in mgr._staged_v2, \
+            "staged open survived peer disconnect"
+        assert peer2.node_id not in mgr._staged_peers
+
+        await na.close()
+        await nb.close()
+
+    run(scenario())

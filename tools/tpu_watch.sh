@@ -1,0 +1,16 @@
+#!/bin/bash
+# Watch the axon tunnel; the moment it opens, run the measurement session.
+# Single-shot: exits after one successful session (or after max wait).
+cd "$(dirname "$0")/.."
+LOG=tpu_watch.log
+echo "$(date '+%F %T') watcher start" >> "$LOG"
+for i in $(seq 1 960); do  # up to ~12h at 45s
+  if timeout 3 bash -c 'echo > /dev/tcp/127.0.0.1/8083' 2>/dev/null; then
+    echo "$(date '+%F %T') tunnel UP — starting measurement session" >> "$LOG"
+    bash tools/tpu_measure.sh >> "$LOG" 2>&1
+    echo "$(date '+%F %T') measurement session done rc=$?" >> "$LOG"
+    exit 0
+  fi
+  sleep 45
+done
+echo "$(date '+%F %T') watcher gave up" >> "$LOG"
