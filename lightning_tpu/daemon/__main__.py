@@ -282,8 +282,11 @@ async def amain(args) -> int:
 
         from ..plugins.autoclean import Autoclean, attach_autoclean_commands
         from ..plugins.sqlrpc import attach_sql_command
+        from .rest import attach_rest_commands
 
         attach_sql_command(rpc)
+        rest_paths: dict = {}
+        attach_rest_commands(rpc, rest_paths)
         autoclean = Autoclean(invoices=invoices, wallet=wallet,
                               relay=relay_svc)
         attach_autoclean_commands(rpc, autoclean)
@@ -436,7 +439,8 @@ async def amain(args) -> int:
         if args.rest_port is not None:
             from .rest import RestServer
 
-            rest = RestServer(rpc, commando=commando, port=args.rest_port)
+            rest = RestServer(rpc, commando=commando, port=args.rest_port,
+                              custom_paths=rest_paths)
             port = await rest.start()
             print(f"rest ready 127.0.0.1:{port}", flush=True)
 

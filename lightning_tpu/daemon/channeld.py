@@ -1269,6 +1269,20 @@ class _SpliceCommand:
     done: object = None            # asyncio.Future[Tx]
 
 
+@dataclass
+class _BumpCommand:
+    """In-loop sentinel: RBF the unconfirmed v2 funding with the
+    caller's template inputs/outputs (openchannel_bump).  Runs INSIDE
+    the channel loop so the RBF dance never races the loop for wire
+    messages — the same reason splice uses a sentinel."""
+    inputs: list
+    outputs: list
+    funding_sat: int
+    feerate: int
+    sign_hook: object = None       # parks for openchannel_signed
+    done: object = None            # asyncio.Future[Tx]
+
+
 async def channel_responder(peer: Peer, hsm: Hsm, client: HsmClient,
                             node_privkey: int,
                             cfg: ChannelConfig | None = None,
@@ -1372,7 +1386,7 @@ async def channel_loop(ch: Channeld, node_privkey: int,
             M.UpdateAddHtlc, M.UpdateFulfillHtlc, M.UpdateFailHtlc,
             M.UpdateFee, M.CommitmentSigned, M.Shutdown, M.Stfu,
             _Resolve, _RelayOffer, _PayCommand, _CloseCommand,
-            _SpliceCommand, _AnnPoke, timeout=RECV_TIMEOUT,
+            _SpliceCommand, _BumpCommand, _AnnPoke, timeout=RECV_TIMEOUT,
         )
         if isinstance(msg, _AnnPoke):
             continue                 # stash handled at the loop top
@@ -1403,6 +1417,33 @@ async def channel_loop(ch: Channeld, node_privkey: int,
             except ChannelError as e:
                 if msg.done is not None and not msg.done.done():
                     msg.done.set_exception(e)
+            continue
+        if isinstance(msg, _BumpCommand):
+            from . import dualopend as DOP
+
+            try:
+                tx = await DOP.rbf_initiate(
+                    ch, msg.inputs, msg.feerate,
+                    our_outputs=msg.outputs, template=True,
+                    funding_sat=msg.funding_sat,
+                    sign_hook=msg.sign_hook)
+                if msg.done is not None and not msg.done.done():
+                    msg.done.set_result(tx)
+            except (ChannelError, DOP.DualOpenError) as e:
+                # abort arrives as DualOpenError via the sign_hook
+                # future: the bump failed but the channel lives on
+                if msg.done is not None and not msg.done.done():
+                    msg.done.set_exception(e)
+            except BaseException as e:
+                # transport death, recv timeout, or cancellation of
+                # the loop task itself: the loop is going down — the
+                # waiting RPC must still be woken, never left hanging
+                if msg.done is not None and not msg.done.done():
+                    msg.done.set_exception(
+                        ChannelError(f"bump failed: {e!r}")
+                        if isinstance(e, asyncio.CancelledError)
+                        else e)
+                raise
             continue
         if isinstance(msg, _PayCommand):
             try:

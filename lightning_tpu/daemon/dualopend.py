@@ -548,9 +548,17 @@ async def accept_channel_v2(peer: Peer, hsm: Hsm, client: HsmClient,
 
 
 async def rbf_initiate(ch: Channeld, our_inputs: list[FundingInput],
-                       new_feerate: int, locktime: int = 0) -> T.Tx:
+                       new_feerate: int, locktime: int = 0,
+                       our_outputs: list[tuple[int, bytes]] | None = None,
+                       template: bool = False,
+                       funding_sat: int | None = None,
+                       sign_hook=None) -> T.Tx:
     """Opener: fee-bump the unconfirmed funding.  Returns the signed
-    replacement tx; ch now points at it."""
+    replacement tx; ch now points at it.  our_outputs/template follow
+    open_channel_v2's caller-built-PSBT semantics (openchannel_bump);
+    funding_sat overrides our contribution for the replacement;
+    sign_hook parks before tx_signatures for external signing, as in
+    the staged open."""
     prev = getattr(ch, "_v2_feerate", 0)
     if new_feerate * 24 < prev * 25:
         raise DualOpenError(
@@ -564,32 +572,52 @@ async def rbf_initiate(ch: Channeld, our_inputs: list[FundingInput],
     # tlv 0 = funding_output_contribution (absent → 0 this round)
     their_sat = int.from_bytes(ack.tlvs.get(0, b""), "big") \
         if ack.tlvs.get(0) else 0
-    funding_sat = ch._v2_our_sat
+    funding_sat = ch._v2_our_sat if funding_sat is None \
+        else int(funding_sat)
+    our_outputs = list(our_outputs or [])
+    template = template or bool(our_outputs)
+    out_total = sum(sats for sats, _ in our_outputs)
     in_total = sum(fi.amount_sat for fi in our_inputs)
     total = funding_sat + their_sat
     fscript = ch._funding_script()
     spk = b"\x00\x20" + hashlib.sha256(fscript).digest()
     con = _Construction(locktime=locktime)
-    fee = _side_fee_sat(new_feerate, len(our_inputs), 2, common=True)
-    if in_total < funding_sat + fee:
+    fee = opener_fee_floor(new_feerate, len(our_inputs),
+                           len(our_outputs), template)
+    if in_total < funding_sat + out_total + fee:
         raise DualOpenError("inputs do not cover contribution + rbf fee")
-    change = in_total - funding_sat - fee
-    outs = [(total, spk)]
-    if change > 546:
-        change_spk = _change_spk(ch.our_funding_pub)
-        outs.append((change, change_spk))
+    if template:
+        # caller-built PSBT: its outputs ride as-is, surplus is fee
+        outs = [(total, spk)] + our_outputs
+    else:
+        change = in_total - funding_sat - fee
+        outs = [(total, spk)]
+        if change > 546:
+            change_spk = _change_spk(ch.our_funding_pub)
+            outs.append((change, change_spk))
     my_serials = await _interactive_construct(
         ch.peer, ch.channel_id, con, True, our_inputs, outs,
         serial_base=0)
-    _setup_core(ch, total, funding_sat, True, ch.cfg, con, fscript)
-    tx = con.build_tx()
-    signed = await _finish_v2(ch, ch.peer, con, tx, our_inputs,
-                              my_serials, in_total,
-                              sum(T.Tx.parse(p).outputs[v].amount_sat
-                                  for s, (p, v, q) in con.inputs.items()
-                                  if s not in my_serials),
-                              True, lockin=False)
+    # _setup_core points ch at the REPLACEMENT; an aborted/failed bump
+    # must roll back to the original funding (the peer still has it,
+    # and the original may yet confirm)
+    snapshot = (ch.funding_txid, ch.funding_outidx, ch.funding_sat,
+                ch.core)
+    try:
+        _setup_core(ch, total, funding_sat, True, ch.cfg, con, fscript)
+        tx = con.build_tx()
+        signed = await _finish_v2(
+            ch, ch.peer, con, tx, our_inputs, my_serials, in_total,
+            sum(T.Tx.parse(p).outputs[v].amount_sat
+                for s, (p, v, q) in con.inputs.items()
+                if s not in my_serials),
+            True, lockin=False, sign_hook=sign_hook)
+    except BaseException:
+        (ch.funding_txid, ch.funding_outidx, ch.funding_sat,
+         ch.core) = snapshot
+        raise
     ch._v2_feerate = new_feerate
+    ch._v2_our_sat = funding_sat
     ch._v2_outpoints = {(i.txid, i.vout) for i in signed.inputs}
     log.info("channel %s rbf to feerate %d (txid %s)",
              ch.channel_id.hex()[:16], new_feerate,

@@ -52,6 +52,9 @@ class JsonRpcServer:
         # writers of clients that enabled jsonrpc notifications
         # (jsonrpc.c json_notifications: per-connection opt-in)
         self._notify_writers: set = set()
+        # fired when any client connection closes (e.g. `batching`
+        # must not outlive the connection that enabled it)
+        self.on_client_close: list = []
         self.register("help", self._help)
         self.register("check", self._check)
         self.register("notifications", self._notifications_cmd)
@@ -201,6 +204,11 @@ class JsonRpcServer:
             pass
         finally:
             self._notify_writers.discard(writer)
+            for cb in self.on_client_close:
+                try:
+                    cb(writer)
+                except Exception:
+                    log.exception("on_client_close callback failed")
             writer.close()
 
     async def _dispatch(self, req, writer=None) -> dict:
@@ -215,7 +223,9 @@ class JsonRpcServer:
             return _err(rid, METHOD_NOT_FOUND,
                         f"command {method!r} is deprecated")
         params = req.get("params") or {}
-        if method == "notifications" and isinstance(params, dict):
+        if method in ("notifications", "batching") \
+                and isinstance(params, dict):
+            # connection-scoped commands get their client's identity
             params = dict(params, _writer=writer)
         if isinstance(params, list):
             # positional params: map onto the handler's signature
@@ -661,6 +671,102 @@ def attach_utility_commands(rpc: JsonRpcServer, node, hsm=None,
         # all our addresses are native segwit already; nothing to sweep
         return {"upgraded_outs": 0}
 
+    # -- network event log (lightningd `listnetworkevents`): every
+    #    connect/disconnect lands here with a created_index the
+    #    autoclean plugin can prune through delnetworkevent
+    netlog: list[dict] = []
+    netidx = [0]
+    NETLOG_CAP = 10_000     # a flapping peer must not grow this forever
+
+    def _net_event(etype: str):
+        def on(payload: dict) -> None:
+            netidx[0] += 1
+            netlog.append({"created_index": netidx[0],
+                           "node_id": payload.get("id", ""),
+                           "type": etype,
+                           "timestamp": int(time.time())})
+            if len(netlog) > NETLOG_CAP:
+                del netlog[:len(netlog) - NETLOG_CAP]
+        return on
+
+    from ..utils import events as _nev
+    _nev.subscribe("connect", _net_event("connect"))
+    _nev.subscribe("disconnect", _net_event("disconnect"))
+
+    async def listnetworkevents(id: str | None = None,
+                                start: int | None = None,
+                                limit: int | None = None) -> dict:
+        rows = [e for e in netlog
+                if (id is None or e["node_id"] == id)
+                and (start is None or e["created_index"] >= start)]
+        if limit is not None:
+            rows = rows[:limit]
+        return {"networkevents": rows}
+
+    async def delnetworkevent(created_index: int) -> dict:
+        for i, e in enumerate(netlog):
+            if e["created_index"] == int(created_index):
+                return {"deleted": netlog.pop(i)}
+        raise RpcError(RPC_ERROR,
+                       f"unknown created_index {created_index}")
+
+    _batch_owner = [None]     # the writer whose connection enabled it
+
+    async def batching(enable: bool = True, _writer=None) -> dict:
+        """Defer db commits while many commands stream in on this
+        connection (lightningd/jsonrpc.c json_batching).  When THE
+        ENABLING connection closes, the batch commits and batching
+        disables — other clients' connections don't affect it."""
+        if wallet is not None and hasattr(wallet.db, "set_batching"):
+            wallet.db.set_batching(bool(enable))
+            _batch_owner[0] = _writer if enable else None
+            if _batching_off not in rpc.on_client_close:
+                rpc.on_client_close.append(_batching_off)
+        return {}
+
+    def _batching_off(writer) -> None:
+        if writer is not None and writer is _batch_owner[0] \
+                and wallet is not None \
+                and hasattr(wallet.db, "set_batching"):
+            wallet.db.set_batching(False)
+            _batch_owner[0] = None
+
+    async def fetchbip353(address: str) -> dict:
+        """Resolve a BIP-353 `user@domain` to its payment instructions
+        via DNS TXT (plugins/fetchbip353; needs network egress)."""
+        from ..utils import bip353
+
+        try:
+            uri = await bip353.resolve(address)
+        except bip353.Bip353Error as e:
+            raise RpcError(RPC_ERROR, str(e))
+        return {"address": address, "instructions": uri}
+
+    async def reckless(subcommand: str, target: str | None = None,
+                       lightning_dir: str | None = None) -> dict:
+        """Plugin install manager (tools/reckless semantics, exposed
+        over RPC like `lightning-cli reckless`)."""
+        from .. import reckless as RK
+
+        ldir = lightning_dir or getattr(node, "data_dir", None) or "."
+        ops = {"install": lambda: RK.install(ldir, target),
+               "uninstall": lambda: RK.uninstall(ldir, target),
+               "enable": lambda: RK.enable(ldir, target),
+               "disable": lambda: RK.disable(ldir, target),
+               "list": lambda: {"plugins": RK.list_installed(ldir)}}
+        op = ops.get(subcommand)
+        if op is None:
+            raise RpcError(INVALID_PARAMS,
+                           f"unknown subcommand {subcommand!r}")
+        try:
+            # install can git-clone: never block the event loop on it
+            return await asyncio.wait_for(asyncio.to_thread(op), 120)
+        except RK.RecklessError as e:
+            raise RpcError(RPC_ERROR, str(e))
+        except asyncio.TimeoutError:
+            raise RpcError(RPC_ERROR,
+                           f"reckless {subcommand} timed out")
+
     for name, fn in [
         ("disconnect", disconnect), ("sendcustommsg", sendcustommsg),
         ("waitblockheight", waitblockheight), ("feerates", feerates),
@@ -672,6 +778,11 @@ def attach_utility_commands(rpc: JsonRpcServer, node, hsm=None,
         ("preapproveinvoice", preapproveinvoice),
         ("preapprovekeysend", preapprovekeysend),
         ("upgradewallet", upgradewallet),
+        ("listnetworkevents", listnetworkevents),
+        ("delnetworkevent", delnetworkevent),
+        ("batching", batching),
+        ("fetchbip353", fetchbip353),
+        ("reckless", reckless),
     ]:
         rpc.register(name, fn)
 

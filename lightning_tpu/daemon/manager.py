@@ -592,21 +592,21 @@ class ChannelManager:
     #    flow parks between commitment_signed and tx_signatures until
     #    the caller returns the SIGNED psbt via openchannel_signed.
 
-    async def openchannel_init(self, peer_id: bytes, amount_sat: int,
-                               initialpsbt: str, announce: bool = True,
-                               funding_feerate: int = 2500) -> dict:
+    def _parse_initialpsbt(self, initialpsbt: str, amount_sat: int,
+                           funding_feerate: int):
+        """Validate a caller-built funding PSBT BEFORE any wire
+        contact (dual_open_control.c json_openchannel_init parsing):
+        known prevtxs, in-range vouts, no duplicate outpoints, no
+        below-dust outputs, and affordability including the minimum
+        fee at the negotiated feerate.  Returns (inputs, outputs) for
+        the interactive construction; the PSBT's outputs are the
+        OPENER'S outputs (its change) and must never be dropped."""
         import base64
 
         from ..btc.psbt import Psbt
+        from ..btc.script import dust_floor_sat
         from .dualopend import FundingInput
 
-        peer = self.node.peers.get(peer_id)
-        if peer is None:
-            raise ManagerError(f"peer {peer_id.hex()[:16]} not connected")
-        if peer_id in self._pending_opens or peer_id in self._staged_peers:
-            # same invariant as fundchannel_start: ONE open per peer —
-            # two flows would interleave wire messages on one stream
-            raise ManagerError("open already in progress with this peer")
         p = Psbt.parse(base64.b64decode(initialpsbt))
         if not p.tx.inputs:
             raise ManagerError("initialpsbt has no inputs")
@@ -638,11 +638,6 @@ class ChannelManager:
                 seq = 0xFFFFFFFD
             inputs.append(FundingInput(prevtx=seen[0], vout=txin.vout,
                                        privkey=None, sequence=seq))
-        # the initialpsbt's outputs are the OPENER'S outputs (the
-        # caller's change, e.g. from fundpsbt) and ride into the
-        # interactive construction (dual_open_control.c
-        # json_openchannel_init) — they must never be silently dropped
-        from ..btc.script import dust_floor_sat
         outs = [(o.amount_sat, o.script_pubkey) for o in p.tx.outputs]
         for sats, spk in outs:
             if sats < dust_floor_sat(spk):
@@ -652,17 +647,29 @@ class ChannelManager:
                     "script — the funding tx would never relay")
         in_total = sum(fi.amount_sat for fi in inputs)
         out_total = sum(sats for sats, _ in outs)
-        # affordability INCLUDING the minimum funding fee, checked
-        # before any wire contact so a short PSBT fails cleanly here
-        # rather than parking the peer mid-open (same helper dualopend
-        # itself uses, so the two checks cannot drift)
-        fee = DO.opener_fee_floor(int(funding_feerate), len(inputs),
+        # same fee helper dualopend itself uses, so the checks can't
+        # drift
+        fee = DO.opener_fee_floor(funding_feerate, len(inputs),
                                   len(outs), template=True)
-        if in_total < int(amount_sat) + out_total + fee:
+        if in_total < amount_sat + out_total + fee:
             raise ManagerError(
                 f"initialpsbt inputs ({in_total} sat) do not cover "
                 f"funding ({amount_sat}) + psbt outputs ({out_total}) "
                 f"+ fee ({fee})")
+        return inputs, outs
+
+    async def openchannel_init(self, peer_id: bytes, amount_sat: int,
+                               initialpsbt: str, announce: bool = True,
+                               funding_feerate: int = 2500) -> dict:
+        peer = self.node.peers.get(peer_id)
+        if peer is None:
+            raise ManagerError(f"peer {peer_id.hex()[:16]} not connected")
+        if peer_id in self._pending_opens or peer_id in self._staged_peers:
+            # same invariant as fundchannel_start: ONE open per peer —
+            # two flows would interleave wire messages on one stream
+            raise ManagerError("open already in progress with this peer")
+        inputs, outs = self._parse_initialpsbt(
+            initialpsbt, int(amount_sat), int(funding_feerate))
         dbid = self._next_dbid
         self._next_dbid += 1
         client = self.hsm.client(CAP_MASTER, peer_id, dbid=dbid)
@@ -705,12 +712,22 @@ class ChannelManager:
             raise ManagerError("open finished before signing — bug")
         cid = st["ch"].channel_id.hex()
         self._staged_v2[cid] = st
+        self._arm_staged_expiry(cid, st, peer)
+        return {"channel_id": cid, "psbt": self._staged_psbt(st),
+                "commitments_secured": True,
+                "funding_outnum": st["ch"].funding_outidx,
+                "channel_type": {"bits": [12]},
+                # callers get the signing deadline up front so a slow
+                # external signer can re-init instead of being
+                # surprised by the auto-abort
+                "signing_deadline_seconds": self.STAGED_OPEN_TIMEOUT}
 
-        # a staged open the caller abandons (never signed/aborted) must
-        # not park the peer task + per-peer guard forever: auto-abort
-        # when the peer connection drops, or after STAGED_OPEN_TIMEOUT
-        # seconds, whichever comes first (the reference ties staged
-        # lifetime to the connection, dual_open_control.c)
+    def _arm_staged_expiry(self, cid: str, st: dict, peer) -> None:
+        """A staged open/bump the caller abandons (never signed or
+        aborted) must not park its machinery forever: auto-abort when
+        the peer connection drops, or after STAGED_OPEN_TIMEOUT
+        seconds, whichever comes first (the reference ties staged
+        lifetime to the connection, dual_open_control.c)."""
         async def _expire():
             try:
                 await asyncio.wait_for(peer.wait_closed(),
@@ -733,14 +750,6 @@ class ChannelManager:
         self._bg_tasks.add(exp)
         exp.add_done_callback(self._bg_tasks.discard)
         st["expire_task"] = exp
-        return {"channel_id": cid, "psbt": self._staged_psbt(st),
-                "commitments_secured": True,
-                "funding_outnum": st["ch"].funding_outidx,
-                "channel_type": {"bits": [12]},
-                # callers get the signing deadline up front so a slow
-                # external signer can re-init instead of being
-                # surprised by the auto-abort
-                "signing_deadline_seconds": self.STAGED_OPEN_TIMEOUT}
 
     def _staged_psbt(self, st) -> str:
         """The constructed funding tx as a PSBT with witness_utxo filled
@@ -816,8 +825,13 @@ class ChannelManager:
         if st.get("expire_task") is not None:
             st["expire_task"].cancel()
         st["wits"].set_result(ours)
-        ch, tx = await st["task"]
-        self._spawn_loop(ch)
+        if st.get("bump"):
+            # RBF: the channel loop is already running (the dance rode
+            # a _BumpCommand inside it) — just await the replacement tx
+            tx = await st["task"]
+        else:
+            ch, tx = await st["task"]
+            self._spawn_loop(ch)
         if self.chain_backend is not None:
             try:
                 await self.chain_backend.sendrawtransaction(
@@ -827,6 +841,78 @@ class ChannelManager:
         return {"channel_id": channel_id, "tx": tx.serialize().hex(),
                 "txid": tx.txid().hex()}
 
+    async def openchannel_bump(self, channel_id: str, amount_sat: int,
+                               initialpsbt: str,
+                               funding_feerate: int) -> dict:
+        """RBF an unconfirmed v2 open: re-run the interactive
+        construction at the higher feerate with the caller's inputs
+        AND outputs — same template semantics, pre-wire validation,
+        and staged signing as openchannel_init: the flow parks after
+        commitments and the caller finishes with openchannel_signed
+        (dual_open_control.c json_openchannel_bump).  The RBF dance
+        runs INSIDE the channel loop (a _BumpCommand sentinel, like
+        splice) so it never races the loop for wire messages."""
+        from .channeld import _BumpCommand
+
+        from ..channel.state import ChannelState
+
+        cid = bytes.fromhex(channel_id)
+        entry = self.channels.get(cid)
+        if entry is None:
+            raise ManagerError("unknown channel")
+        ch = entry[0]
+        # only an UNCONFIRMED v2 funding can be replaced
+        # (dual_open_control.c allows bump pre-lock-in only — past
+        # that, tx_init_rbf would just desync a live channel)
+        if getattr(ch, "_v2_our_sat", None) is None:
+            raise ManagerError("channel was not opened with the v2 "
+                               "protocol; nothing to bump")
+        if ch.core.state not in (ChannelState.AWAITING_LOCKIN,
+                                 ChannelState.NORMAL):
+            raise ManagerError(
+                f"channel is {ch.core.state.value}; only an "
+                "unconfirmed funding can be bumped")
+        if self.topology is not None \
+                and self.topology.txs_seen.get(ch.funding_txid) \
+                is not None:
+            raise ManagerError(
+                "funding tx already confirmed; RBF is no longer "
+                "possible")
+        if channel_id in self._staged_v2:
+            raise ManagerError("an open/bump is already staged for "
+                               "this channel")
+        inputs, outs = self._parse_initialpsbt(
+            initialpsbt, int(amount_sat), int(funding_feerate))
+        loop = asyncio.get_running_loop()
+        st = {"secured": asyncio.Event(), "wits": loop.create_future(),
+              "inputs": inputs, "ch": ch, "tx": None,
+              "my_serials": None, "bump": True, "peer_id": None}
+
+        async def hook(ch_h, tx, my_serials):
+            st["tx"], st["my_serials"] = tx, my_serials
+            st["secured"].set()
+            return await st["wits"]
+
+        fut = loop.create_future()
+        st["task"] = fut
+        ch.peer.inbox.put_nowait(_BumpCommand(
+            inputs=inputs, outputs=outs, funding_sat=int(amount_sat),
+            feerate=int(funding_feerate), sign_hook=hook, done=fut))
+        secured = loop.create_task(st["secured"].wait())
+        done, _ = await asyncio.wait({fut, secured},
+                                     return_when=asyncio.FIRST_COMPLETED)
+        if fut in done:
+            secured.cancel()
+            fut.result()           # raises the negotiation failure
+            raise ManagerError("bump finished before signing — bug")
+        self._staged_v2[channel_id] = st
+        self._arm_staged_expiry(channel_id, st, ch.peer)
+        return {"channel_id": channel_id,
+                "psbt": self._staged_psbt(st),
+                "commitments_secured": True,
+                "funding_outnum": ch.funding_outidx,
+                "signing_deadline_seconds": self.STAGED_OPEN_TIMEOUT}
+
     async def openchannel_abort(self, channel_id: str) -> dict:
         st = self._staged_v2.pop(channel_id, None)
         if st is None:
@@ -835,6 +921,26 @@ class ChannelManager:
         exp = st.get("expire_task")
         if exp is not None and exp is not asyncio.current_task():
             exp.cancel()
+        if st.get("bump"):
+            # cancelling an RBF must NOT kill the live channel: wake
+            # the parked sign_hook with a protocol error (it unwinds
+            # rbf_initiate, which rolls the channel back to the
+            # original funding) and signal tx_abort, not BOLT#1 error
+            from . import dualopend as DO_
+
+            if not st["wits"].done():
+                st["wits"].set_exception(
+                    DO_.DualOpenError("bump aborted by caller"))
+            try:
+                from ..wire import messages as M_
+
+                await st["ch"].peer.send(M_.TxAbort(
+                    channel_id=st["ch"].channel_id,
+                    data=b"rbf aborted"))
+            except Exception:
+                pass
+            return {"channel_id": channel_id,
+                    "channel_canceled": True}
         st["wits"].cancel()
         st["task"].cancel()
         try:
@@ -1037,10 +1143,14 @@ class ChannelManager:
 
     async def pay(self, bolt11_str: str,
                   amount_msat: int | None = None,
-                  timeout: float = 60.0) -> dict:
+                  timeout: float = 60.0,
+                  maxfee_msat: int | None = None,
+                  maxfeepercent: float | None = None) -> dict:
         """The pay/xpay front door: route (direct peer or gossmap),
         build the onion, originate on the right channel, await the
-        preimage, record the payments row."""
+        preimage, record the payments row.  maxfee_msat/maxfeepercent
+        bound the route fee — the payment fails rather than exceed
+        them (pay plugin maxfee semantics)."""
         from ..bolt import sphinx as SX
         from ..pay import payer as PAYER
 
@@ -1084,10 +1194,21 @@ class ChannelManager:
             ch = cand
             route = [PAYER.RouteStep(ch.peer.node_id, 0, src_amount,
                                      src_cltv)] + tail
+        sent_msat = route[0].amount_msat
+        fee_budget = None
+        if maxfee_msat is not None:
+            fee_budget = int(maxfee_msat)
+        if maxfeepercent is not None:
+            pct = int(amount * float(maxfeepercent) / 100)
+            fee_budget = pct if fee_budget is None \
+                else min(fee_budget, pct)
+        if fee_budget is not None and sent_msat - amount > fee_budget:
+            raise ManagerError(
+                f"route fee {sent_msat - amount} msat exceeds maxfee "
+                f"{fee_budget}")
         onion, _secrets = PAYER.build_payment_onion(
             route, inv.payment_hash, inv.payment_secret, amount,
             SX.random_session_key())
-        sent_msat = route[0].amount_msat
         created = int(time.time())
         pay_id = self._record_payment(inv, bolt11_str, amount, sent_msat,
                                       created)
@@ -1250,11 +1371,14 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
         return await mgr.multifundchannel(destinations)
 
     async def pay(bolt11: str, amount_msat=None, retry_for: int = 60,
-                  maxfeepercent=None) -> dict:
+                  maxfeepercent=None, maxfee=None) -> dict:
         return await mgr.pay(bolt11,
                              amount_msat=(int(amount_msat)
                                           if amount_msat else None),
-                             timeout=float(retry_for))
+                             timeout=float(retry_for),
+                             maxfee_msat=(int(maxfee)
+                                          if maxfee is not None else None),
+                             maxfeepercent=maxfeepercent)
 
     async def xpay(invstring: str, amount_msat=None,
                    retry_for: int = 60) -> dict:
@@ -1292,15 +1416,17 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
             SX.random_session_key())
         fut = asyncio.get_running_loop().create_future()
         mgr._pending_sendpays = getattr(mgr, "_pending_sendpays", {})
-        mgr._pending_sendpays[ph] = fut
+        mgr._pending_sendpays[(ph, 0, 0)] = fut
         ch.peer.inbox.put_nowait(_PayCommand(
             amount_msat=first.amount_msat, payment_hash=ph,
             cltv_expiry=first.delay, onion=onion, done=fut))
         return {"payment_hash": payment_hash, "status": "pending"}
 
-    async def waitsendpay(payment_hash: str, timeout: int = 60) -> dict:
+    async def waitsendpay(payment_hash: str, timeout: int = 60,
+                          partid: int = 0, groupid: int = 0) -> dict:
         ph = bytes.fromhex(payment_hash)
-        fut = getattr(mgr, "_pending_sendpays", {}).get(ph)
+        fut = getattr(mgr, "_pending_sendpays", {}).get(
+            (ph, int(partid), int(groupid)))
         if fut is None:
             raise ManagerError("no pending sendpay for that hash")
         preimage, reason = await asyncio.wait_for(fut, timeout)
@@ -1329,6 +1455,182 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
 
     async def listhtlcs() -> dict:
         return {"htlcs": mgr.listhtlcs()}
+
+    async def xkeysend(destination: str, amount_msat,
+                       retry_for: int = 60) -> dict:
+        """keysend successor (plugins/xpay xkeysend): same spontaneous
+        preimage-in-onion flow, reference's newer command name."""
+        return await keysend(destination, amount_msat,
+                             retry_for=retry_for)
+
+    async def sendamount(invstring: str, amount_msat,
+                         retry_for: int = 60) -> dict:
+        """Spend a FIXED total: route fees come out of amount_msat, so
+        the destination receives amount minus fees (sendamount.json).
+        Only amount-less invoices make sense here."""
+        from ..bolt import bolt11 as B11
+
+        total = int(amount_msat)
+        dec = B11.decode(invstring)       # sig check recovers payee
+        direct = any(ch.peer.node_id == dec.payee
+                     for ch, _t in mgr.channels.values())
+        if direct:
+            fee_est = 0                   # one hop: no routing fee
+        else:
+            # the fixed-total contract needs a fee estimate — without
+            # one we would silently overspend, so fail instead
+            g = mgr.gossmap_ref.get("map")
+            if g is None:
+                raise ManagerError(
+                    "sendamount needs a gossip graph to bound the "
+                    "route fee (destination is not a direct peer)")
+            from ..routing import mcf as MCF
+
+            est = MCF.getroutes(g, mgr.node.node_id, dec.payee, total)
+            fee_est = est["fee_msat"]
+        deliver = total - fee_est
+        if deliver <= 0:
+            raise ManagerError(
+                f"amount {total} cannot cover the route fee {fee_est}")
+        # the estimate is also the HARD fee bound: pay fails rather
+        # than spend beyond the fixed total
+        res = await mgr.pay(invstring, amount_msat=deliver,
+                            timeout=float(retry_for),
+                            maxfee_msat=fee_est)
+        res["amount_msat"] = deliver
+        res.setdefault("amount_sent_msat", deliver + fee_est)
+        return res
+
+    async def injectpaymentonion(onion: str, payment_hash: str,
+                                 amount_msat, cltv_expiry: int,
+                                 partid: int = 0,
+                                 groupid: int = 0) -> dict:
+        """Process a caller-built onion as if it arrived in an HTLC on
+        a local channel (lightningd/pay.c json_injectpaymentonion —
+        xpay's dispatch door).  We unwrap OUR hop and forward the rest
+        through the named next channel."""
+        from ..bolt import onion_payload as OP
+        from ..bolt import sphinx as SX
+
+        pkt = SX.OnionPacket.parse(bytes.fromhex(onion))
+        ph = bytes.fromhex(payment_hash)
+        step = SX.peel_onion(pkt, ph, mgr.hsm.node_key)
+        payload = OP.HopPayload.parse(step.payload)
+        if step.next_packet is None:
+            raise ManagerError(
+                "onion terminates at this node — nothing to inject")
+        scid = payload.short_channel_id
+        if not scid:
+            raise ManagerError(
+                "forward payload names no short_channel_id")
+        # the caller's envelope must cover what OUR hop forwards
+        # (lightningd validates the injected budget the same way)
+        if int(amount_msat) < payload.amt_to_forward_msat:
+            raise ManagerError(
+                f"amount_msat {amount_msat} below the payload's "
+                f"forward amount {payload.amt_to_forward_msat}")
+        if int(cltv_expiry) < payload.outgoing_cltv:
+            raise ManagerError(
+                f"cltv_expiry {cltv_expiry} below the payload's "
+                f"outgoing_cltv {payload.outgoing_cltv}")
+        ch = None
+        for cand, _t in mgr.channels.values():
+            if cand.scid == scid:
+                ch = cand
+                break
+        if ch is None:
+            raise ManagerError(f"no channel with scid {scid}")
+        fut = asyncio.get_running_loop().create_future()
+        mgr._pending_sendpays = getattr(mgr, "_pending_sendpays", {})
+        # parts are distinct in-flight payments: key by (hash, part,
+        # group) so a second part never orphans the first's future
+        mgr._pending_sendpays[(ph, int(partid), int(groupid))] = fut
+        ch.peer.inbox.put_nowait(_PayCommand(
+            amount_msat=payload.amt_to_forward_msat, payment_hash=ph,
+            cltv_expiry=payload.outgoing_cltv,
+            onion=step.next_packet.serialize(), done=fut))
+        return {"payment_hash": payment_hash, "status": "pending"}
+
+    async def dev_forget_channel(id: str, channel_id: str | None = None,
+                                 force: bool = False) -> dict:
+        """Drop a channel from memory and the db WITHOUT closing it
+        (lightningd/peer_control.c json_dev_forget_channel — recovery
+        tool; the funds in the funding output are abandoned unless
+        force confirms the caller understands)."""
+        peer_id = bytes.fromhex(id)
+        victim = None
+        for cid, (ch, task) in list(mgr.channels.items()):
+            if ch.peer.node_id != peer_id:
+                continue
+            if channel_id is not None and cid.hex() != channel_id:
+                continue
+            victim = (cid, ch, task)
+            break
+        if victim is None:
+            raise ManagerError("no such channel")
+        cid, ch, task = victim
+        if not force:
+            raise ManagerError(
+                "dev-forget-channel abandons the funding output; "
+                "call with force=true to confirm")
+        task.cancel()
+        del mgr.channels[cid]
+        if mgr.wallet is not None:
+            with mgr.wallet.db.transaction() as c:
+                # dependent rows first: htlcs/shachain_slots carry
+                # FOREIGN KEYs into channels (PRAGMA foreign_keys=ON)
+                row = c.execute(
+                    "SELECT id FROM channels WHERE channel_id=?",
+                    (cid,)).fetchone()
+                if row is not None:
+                    c.execute("DELETE FROM htlcs WHERE channel_ref=?",
+                              (row[0],))
+                    c.execute(
+                        "DELETE FROM shachain_slots WHERE channel_ref=?",
+                        (row[0],))
+                    c.execute("DELETE FROM channels WHERE id=?",
+                              (row[0],))
+        return {"forced": True, "forgotten": cid.hex()}
+
+    async def openchannel_bump(channel_id: str, amount,
+                               initialpsbt: str,
+                               funding_feerate: int) -> dict:
+        return await mgr.openchannel_bump(channel_id, int(amount),
+                                          initialpsbt,
+                                          int(funding_feerate))
+
+    async def graceful(timeout: int | None = None,
+                       cancel: bool = False) -> dict:
+        """Stop taking new HTLCs, wait for the in-flight set to drain,
+        then disconnect idle peers (lightningd json_graceful: the
+        safe-shutdown front door).  A timeout return leaves the node
+        draining (the shutdown is still in progress); `cancel=true`
+        reopens forwarding if the operator changes their mind."""
+        import time as _t
+
+        if cancel:
+            if mgr.relay is not None:
+                mgr.relay.draining = False
+            return {"cancelled": True}
+        if mgr.relay is not None:
+            mgr.relay.draining = True
+        deadline = None if timeout is None \
+            else _t.monotonic() + float(timeout)
+        while True:
+            pending = mgr.listhtlcs()
+            if not pending:
+                break
+            if deadline is not None and _t.monotonic() > deadline:
+                return {"htlcs": pending,
+                        "peers": [p.node_id.hex()
+                                  for p in mgr.node.peers.values()]}
+            await asyncio.sleep(0.05)
+        for p in list(mgr.node.peers.values()):
+            try:
+                await p.disconnect()
+            except Exception:
+                pass
+        return {}
 
     async def fundchannel_start(id: str, amount, push_msat: int = 0,
                                 announce: bool = True) -> dict:
@@ -1405,7 +1707,7 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
             raise ManagerError("first hop is not a connected channel")
         fut = asyncio.get_running_loop().create_future()
         mgr._pending_sendpays = getattr(mgr, "_pending_sendpays", {})
-        mgr._pending_sendpays[ph] = fut
+        mgr._pending_sendpays[(ph, 0, 0)] = fut
         ch.peer.inbox.put_nowait(_PayCommand(
             amount_msat=int(first_hop["amount_msat"]),
             payment_hash=ph, cltv_expiry=int(first_hop["delay"]),
@@ -1436,3 +1738,9 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     rpc.register("listpeerchannels", listpeerchannels)
     rpc.register("keysend", keysend)
     rpc.register("listhtlcs", listhtlcs)
+    rpc.register("xkeysend", xkeysend)
+    rpc.register("sendamount", sendamount)
+    rpc.register("injectpaymentonion", injectpaymentonion)
+    rpc.register("dev-forget-channel", dev_forget_channel)
+    rpc.register("openchannel_bump", openchannel_bump)
+    rpc.register("graceful", graceful)

@@ -69,6 +69,7 @@ class Relay:
         # (id(out_ch), out_hid) -> on_result, popped by the out loop
         self.pending: dict[tuple[int, int], object] = {}
         self.forwards: list[dict] = []            # listforwards log
+        self.draining = False    # `graceful`: refuse new forwards
 
     def register(self, scid: int, ch) -> None:
         self.by_scid[scid] = ch
@@ -97,6 +98,10 @@ class Relay:
             return SX.create_error_onion(
                 shared_secret, code.to_bytes(2, "big") + data)
 
+        if self.draining:
+            # graceful shutdown: no NEW forwards; in-flight ones drain
+            self._log(inc, payload, "failed", "draining")
+            return _err(TEMPORARY_CHANNEL_FAILURE)
         out_ch = self.by_scid.get(payload.short_channel_id)
         if out_ch is None or out_ch is in_ch:
             self._log(inc, payload, "failed", "unknown_next_peer")

@@ -23,15 +23,18 @@ MAX_HEADERS = 100
 
 class RestServer:
     def __init__(self, rpc, commando=None, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, custom_paths: dict | None = None):
         """rpc: JsonRpcServer (command table).  commando: when given,
         its master secret checks the `Rune` header (clnrest requires a
         rune per request; without commando the server is auth-less and
-        should only bind loopback)."""
+        should only bind loopback).  custom_paths: extra HTTP path →
+        rpc method mappings (clnrest-register-path)."""
         self.rpc = rpc
         self.commando = commando
         self.host = host
         self.port = port
+        self.custom_paths = custom_paths if custom_paths is not None \
+            else {}
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> int:
@@ -86,9 +89,13 @@ class RestServer:
         else:
             return 400, {"error": "too many headers"}
 
-        if not target.startswith("/v1/"):
+        custom = self.custom_paths.get("/" + target.strip("/"))
+        if custom is not None:
+            method = custom
+        elif target.startswith("/v1/"):
+            method = target[4:].strip("/")
+        else:
             return 404, {"error": "unknown path (use /v1/<method>)"}
-        method = target[4:].strip("/")
         if method_verb != "POST":
             return 400, {"error": "use POST"}
 
@@ -124,3 +131,18 @@ class RestServer:
             return 400, {"error": str(e), "code": e.code}
         except TypeError as e:
             return 400, {"error": str(e)}
+
+
+def attach_rest_commands(rpc, custom_paths: dict) -> None:
+    """clnrest-register-path: map an extra HTTP path onto a registered
+    RPC method (the clnrest plugin's extension point, so plugins can
+    publish friendly REST routes)."""
+
+    async def clnrest_register_path(path: str, method: str) -> dict:
+        if method not in rpc.methods:
+            raise RpcError(-32601, f"unknown rpc method {method!r}")
+        norm = "/" + str(path).strip("/")
+        custom_paths[norm] = method
+        return {"path": norm, "method": method}
+
+    rpc.register("clnrest-register-path", clnrest_register_path)

@@ -138,15 +138,23 @@ class OfferRegistry:
         return row
 
     def disable(self, offer_id: bytes) -> None:
+        self._set_status(offer_id, "disabled")
+
+    def enable(self, offer_id: bytes) -> None:
+        """Re-arm a disabled offer (json_enableoffer; a used single-use
+        offer stays used)."""
+        self._set_status(offer_id, "active")
+
+    def _set_status(self, offer_id: bytes, status: str) -> None:
         row = self.offers.get(offer_id)
         if row is None:
             raise OffersError("unknown offer")
-        row["status"] = "disabled"
+        row["status"] = status
         if self.db is not None:
             with self.db.transaction():
                 self.db.conn.execute(
-                    "UPDATE offers SET status='disabled' WHERE offer_id=?",
-                    (offer_id,))
+                    "UPDATE offers SET status=? WHERE offer_id=?",
+                    (status, offer_id))
 
     def active(self, offer_id: bytes) -> B12.Offer | None:
         row = self.offers.get(offer_id)
@@ -354,6 +362,10 @@ def attach_offers_commands(rpc, service: OffersService,
         registry.disable(bytes.fromhex(offer_id))
         return {"offer_id": offer_id, "active": False}
 
+    async def enableoffer(offer_id: str) -> dict:
+        registry.enable(bytes.fromhex(offer_id))
+        return {"offer_id": offer_id, "active": True}
+
     async def fetchinvoice(offer: str, amount_msat: int | None = None,
                            quantity: int | None = None,
                            payer_note: str | None = None,
@@ -526,6 +538,15 @@ def attach_offers_commands(rpc, service: OffersService,
                 "payment_hash": inv12.payment_hash.hex(),
                 "amount_msat": inv12.amount_msat, "label": label}
 
+    async def injectonionmessage(message: str, path_key: str) -> dict:
+        """Process a fully-built onion message as if it had arrived
+        from a peer (lightningd/onion_message.c
+        json_injectonionmessage — the xpay/BOLT12 dispatch door)."""
+        msg = M.OnionMessage(path_key=bytes.fromhex(path_key),
+                             onionmsg=bytes.fromhex(message))
+        await service.messenger._on_message(None, msg)
+        return {}
+
     async def sendonionmessage(node_ids: list,
                                content: dict | None = None) -> dict:
         """Send an onion message along a path of node ids; the first
@@ -541,11 +562,12 @@ def attach_offers_commands(rpc, service: OffersService,
             raise OffersError("first hop not connected")
         return {"sent": True}
 
-    for fn in (offer, listoffers, disableoffer, fetchinvoice, invoice,
+    for fn in (offer, listoffers, disableoffer, enableoffer,
+               fetchinvoice, invoice,
                listinvoices, waitinvoice, waitanyinvoice, delinvoice,
                decode, createinvoice, signinvoice, invoicerequest,
                listinvoicerequests, disableinvoicerequest, sendinvoice,
-               sendonionmessage):
+               sendonionmessage, injectonionmessage):
         rpc.register(fn.__name__, fn)
     rpc.register("decodepay", decodepay, deprecated=True)
 

@@ -33,12 +33,13 @@ class Bookkeeper:
             self._ensure_table()
             for r in db.conn.execute(
                     "SELECT account, tag, credit_msat, debit_msat,"
-                    " currency, timestamp, reference FROM bkpr_events"
-                    " ORDER BY id").fetchall():
+                    " currency, timestamp, reference, description"
+                    " FROM bkpr_events ORDER BY id").fetchall():
                 self.events.append({
                     "account": r[0], "tag": r[1], "credit_msat": r[2],
                     "debit_msat": r[3], "currency": r[4],
-                    "timestamp": r[5], "reference": r[6]})
+                    "timestamp": r[5], "reference": r[6],
+                    "description": r[7]})
         events.subscribe("coin_movement", self._on_mvt)
 
     def close(self) -> None:
@@ -55,10 +56,22 @@ class Bookkeeper:
                     debit_msat INTEGER NOT NULL DEFAULT 0,
                     currency TEXT NOT NULL DEFAULT 'bcrt',
                     timestamp INTEGER NOT NULL,
-                    reference TEXT
+                    reference TEXT,
+                    description TEXT
                 )""")
+            cols = [r[1] for r in self.db.conn.execute(
+                "PRAGMA table_info(bkpr_events)").fetchall()]
+            if "description" not in cols:   # pre-round-5 table
+                self.db.conn.execute(
+                    "ALTER TABLE bkpr_events ADD COLUMN description TEXT")
 
     # -- ingestion ---------------------------------------------------------
+
+    # tags whose movements touch the chain rather than a channel
+    # balance (common/coin_mvt.c chain_mvt vs channel_mvt)
+    CHAIN_TAGS = ("deposit", "withdrawal", "onchain_fee", "channel_open",
+                  "channel_close", "delayed_to_us", "htlc_timeout",
+                  "htlc_tx", "anchor", "to_them", "penalty")
 
     def _on_mvt(self, payload: dict) -> None:
         self.record(
@@ -80,17 +93,18 @@ class Bookkeeper:
             "timestamp": int(timestamp if timestamp is not None
                              else time.time()),
             "reference": reference,
+            "description": None,
         }
         self.events.append(ev)
         if self.db is not None:
             with self.db.transaction():
                 self.db.conn.execute(
                     "INSERT INTO bkpr_events (account, tag, credit_msat,"
-                    " debit_msat, currency, timestamp, reference)"
-                    " VALUES (?,?,?,?,?,?,?)",
+                    " debit_msat, currency, timestamp, reference,"
+                    " description) VALUES (?,?,?,?,?,?,?,?)",
                     (ev["account"], ev["tag"], ev["credit_msat"],
                      ev["debit_msat"], ev["currency"], ev["timestamp"],
-                     ev["reference"]))
+                     ev["reference"], None))
         return ev
 
     # -- queries (bkpr-* RPC shapes) --------------------------------------
@@ -129,6 +143,111 @@ class Bookkeeper:
                 "total_expense_msat": expense,
                 "net_msat": income - expense}
 
+    @staticmethod
+    def _is_chain(e: dict) -> bool:
+        return e["tag"] in Bookkeeper.CHAIN_TAGS or e["account"] in (
+            "wallet", "external")
+
+    def listchainmoves(self) -> list[dict]:
+        """Movements that touched the chain (bkpr recorder chain_mvt
+        rows: deposits, withdrawals, closes, fees)."""
+        return [e for e in self.events if Bookkeeper._is_chain(e)]
+
+    def listchannelmoves(self) -> list[dict]:
+        """Off-chain balance movements on channel accounts
+        (channel_mvt rows: pushes, invoices, routed htlcs)."""
+        return [e for e in self.events if not Bookkeeper._is_chain(e)]
+
+    def inspect(self, account: str) -> dict:
+        """Events of one channel account grouped by originating tx
+        (bkpr-inspect: the channel's on-chain footprint)."""
+        txs: dict[str, list[dict]] = {}
+        for e in self.events:
+            if e["account"] != account:
+                continue
+            key = (e["reference"] or "").split(":")[0] or "unattributed"
+            txs.setdefault(key, []).append(e)
+        return {"txs": [{"txid": t, "fees_paid_msat": sum(
+            x["debit_msat"] for x in evs if x["tag"] == "onchain_fee"),
+            "outputs": evs} for t, evs in sorted(txs.items())]}
+
+    def channelsapy(self) -> list[dict]:
+        """Per-channel routing yield (bkpr-channelsapy): fees earned /
+        funds deployed, annualized over the account's observed
+        lifetime."""
+        out = []
+        for acct in sorted({e["account"] for e in self.events}):
+            if acct in ("wallet", "external"):
+                continue
+            evs = [e for e in self.events if e["account"] == acct]
+            earned = sum(e["credit_msat"] for e in evs
+                         if e["tag"] == "routed")
+            balance = sum(e["credit_msat"] - e["debit_msat"]
+                          for e in evs)
+            t0 = min(e["timestamp"] for e in evs)
+            t1 = max(e["timestamp"] for e in evs)
+            span = max(t1 - t0, 1)
+            apy = (earned / balance) * (365 * 86400 / span) * 100 \
+                if balance > 0 else 0.0
+            out.append({"account": acct,
+                        "routed_in_msat": sum(
+                            e["credit_msat"] for e in evs
+                            if e["tag"] == "routed"),
+                        "fees_in_msat": earned,
+                        "total_msat": balance,
+                        "apy_in": round(apy, 4),
+                        "start_time": t0, "end_time": t1})
+        return out
+
+    def income_csv(self, csv_format: str = "koinly") -> str:
+        """Income events as CSV (bkpr-dumpincomecsv formats)."""
+        import csv
+        import io
+
+        buf = io.StringIO()
+        w = csv.writer(buf)
+        rows = self.listincome()["income_events"]
+        if csv_format == "koinly":
+            w.writerow(["Date", "Sent Amount", "Sent Currency",
+                        "Received Amount", "Received Currency",
+                        "Label", "Description", "TxHash"])
+            for e in rows:
+                w.writerow([
+                    time.strftime("%Y-%m-%d %H:%M UTC",
+                                  time.gmtime(e["timestamp"])),
+                    e["debit_msat"] / 1e11 or "",
+                    "BTC" if e["debit_msat"] else "",
+                    e["credit_msat"] / 1e11 or "",
+                    "BTC" if e["credit_msat"] else "",
+                    e["tag"], e.get("description") or "",
+                    e["reference"] or ""])
+        else:       # "cointracker" and the generic fallback
+            w.writerow(["date", "account", "tag", "credit_msat",
+                        "debit_msat", "description", "reference"])
+            for e in rows:
+                w.writerow([e["timestamp"], e["account"], e["tag"],
+                            e["credit_msat"], e["debit_msat"],
+                            e.get("description") or "",
+                            e["reference"] or ""])
+        return buf.getvalue()
+
+    def edit_description(self, match_reference: str,
+                         description: str) -> list[dict]:
+        """Attach a description to every event whose reference matches
+        (bkpr-editdescriptionbyoutpoint / bypaymentid)."""
+        hit = []
+        for e in self.events:
+            if e["reference"] == match_reference:
+                e["description"] = description
+                hit.append(e)
+        if hit and self.db is not None:
+            with self.db.transaction():
+                self.db.conn.execute(
+                    "UPDATE bkpr_events SET description=?"
+                    " WHERE reference=?",
+                    (description, match_reference))
+        return hit
+
 
 def attach_bookkeeper_commands(rpc, bk: Bookkeeper) -> None:
     async def bkpr_listaccountevents(account: str | None = None) -> dict:
@@ -141,6 +260,44 @@ def attach_bookkeeper_commands(rpc, bk: Bookkeeper) -> None:
                               end_time: int | None = None) -> dict:
         return bk.listincome(start_time, end_time)
 
+    async def bkpr_inspect(account: str) -> dict:
+        return bk.inspect(account)
+
+    async def bkpr_channelsapy() -> dict:
+        return {"channels_apy": bk.channelsapy()}
+
+    async def bkpr_dumpincomecsv(csv_format: str = "koinly",
+                                 csv_file: str | None = None) -> dict:
+        text = bk.income_csv(csv_format)
+        if csv_file:
+            with open(csv_file, "w") as f:
+                f.write(text)
+        return {"csv_format": csv_format,
+                "csv_file": csv_file or "", "csv": text}
+
+    async def bkpr_editdescriptionbyoutpoint(
+            outpoint: str, description: str) -> dict:
+        return {"updated": bk.edit_description(outpoint, description)}
+
+    async def bkpr_editdescriptionbypaymentid(
+            payment_id: str, description: str) -> dict:
+        return {"updated": bk.edit_description(payment_id, description)}
+
+    async def listchainmoves() -> dict:
+        return {"chain_moves": bk.listchainmoves()}
+
+    async def listchannelmoves() -> dict:
+        return {"channel_moves": bk.listchannelmoves()}
+
     rpc.register("bkpr-listaccountevents", bkpr_listaccountevents)
     rpc.register("bkpr-listbalances", bkpr_listbalances)
     rpc.register("bkpr-listincome", bkpr_listincome)
+    rpc.register("bkpr-inspect", bkpr_inspect)
+    rpc.register("bkpr-channelsapy", bkpr_channelsapy)
+    rpc.register("bkpr-dumpincomecsv", bkpr_dumpincomecsv)
+    rpc.register("bkpr-editdescriptionbyoutpoint",
+                 bkpr_editdescriptionbyoutpoint)
+    rpc.register("bkpr-editdescriptionbypaymentid",
+                 bkpr_editdescriptionbypaymentid)
+    rpc.register("listchainmoves", listchainmoves)
+    rpc.register("listchannelmoves", listchannelmoves)

@@ -145,5 +145,29 @@ def attach_currency_commands(rpc, svc: CurrencyRate) -> None:
         return {"rates": rates,
                 "median": statistics.median(rates.values())}
 
+    async def currencyrate(currency: str,
+                           source: str | None = None) -> dict:
+        """One BTC in `currency` (doc/schemas/currencyrate.json): the
+        median across sources, or one named source's quote."""
+        rates = await svc.rates(currency)
+        if source is not None:
+            if source not in rates:
+                raise RateError(f"source {source!r} could not quote "
+                                f"{currency}")
+            return {"currency": currency.upper(), "source": source,
+                    "rate": round(rates[source], 3)}
+        if not rates:
+            raise RateError(f"no source could quote {currency}")
+        return {"currency": currency.upper(),
+                "rate": round(statistics.median(rates.values()), 3)}
+
+    async def listcurrencyrates(currency: str) -> dict:
+        rates = await svc.rates(currency)
+        return {"rates": [{"source": s, "currency": currency.upper(),
+                           "rate": round(r, 3)}
+                          for s, r in sorted(rates.items())]}
+
     rpc.register("currencyconvert", currencyconvert)
     rpc.register("currencyrates", currencyrates)
+    rpc.register("currencyrate", currencyrate)
+    rpc.register("listcurrencyrates", listcurrencyrates)

@@ -133,9 +133,26 @@ def attach_datastore_commands(rpc, store: Datastore) -> None:
     async def listdatastore(key=None) -> dict:
         return {"datastore": store.list(key)}
 
+    async def datastoreusage(key=None) -> dict:
+        """Total bytes stored under key — every descendant's data plus
+        its key strings (datastore.c json_datastoreusage)."""
+        rows = store.db.conn.execute(
+            "SELECT key, data FROM datastore").fetchall()
+        prefix = _key_list(_key_str(key)) if key else []
+        total = 0
+        for ks, data in rows:
+            kl = _key_list(ks)
+            if kl[:len(prefix)] != prefix:
+                continue
+            total += sum(len(k) for k in kl) + len(data)
+        return {"datastoreusage": {
+            "key": "[" + ",".join(prefix) + "]",
+            "total_bytes": total}}
+
     async def deldatastore(key, generation: int | None = None) -> dict:
         return store.delete(key, generation=generation)
 
     rpc.register("datastore", datastore)
     rpc.register("listdatastore", listdatastore)
     rpc.register("deldatastore", deldatastore)
+    rpc.register("datastoreusage", datastoreusage)

@@ -72,7 +72,8 @@ FORBIDDEN = ("insert", "update", "delete", "drop", "create", "alter",
              "attach", "pragma", "vacuum", "replace")
 
 
-async def run_query(rpc, query: str) -> list[list]:
+async def run_query(rpc, query: str,
+                    params: list | None = None) -> list[list]:
     """Populate a scratch db from the list commands the query mentions,
     run it, return rows (sql.c returns arrays per row)."""
     low = " ".join(query.lower().split())
@@ -113,7 +114,7 @@ async def run_query(rpc, query: str) -> list[list]:
                     f"INSERT INTO {table} VALUES "
                     f"({','.join('?' * len(cols))})", vals)
         try:
-            cur = db.execute(query)
+            cur = db.execute(query, params or [])
             return [list(r) for r in cur.fetchall()]
         except sqlite3.Error as e:
             raise SqlRpcError(str(e)) from None
@@ -131,4 +132,28 @@ def attach_sql_command(rpc) -> None:
             raise RpcError(-1, str(e))
         return {"rows": rows}
 
+    async def listsqlschemas(table: str | None = None) -> dict:
+        """Schemas of the SQL-queryable tables (sql.c
+        json_listsqlschemas)."""
+        names = [table] if table else sorted(TABLES)
+        out = []
+        for n in names:
+            spec = TABLES.get(n)
+            if spec is None:
+                raise RpcError(-1, f"unknown table {n!r}")
+            out.append({"tablename": n, "columns": [
+                {"name": c, "type": t} for c, t, _ in spec[2]]})
+        return {"schemas": out}
+
+    async def sql_template(template: str, params: list | None = None) -> dict:
+        """Parameterized SELECT: '?' placeholders bound by sqlite so
+        clients never string-interpolate into SQL (sql-template)."""
+        try:
+            rows = await run_query(rpc, template, params)
+        except SqlRpcError as e:
+            raise RpcError(-1, str(e))
+        return {"rows": rows}
+
     rpc.register("sql", sql)
+    rpc.register("listsqlschemas", listsqlschemas)
+    rpc.register("sql-template", sql_template)

@@ -65,6 +65,18 @@ class Layers:
     # usable capacity (a payment of max+1 failed there); `min` is
     # advisory knowledge that at least that much passed.
     knowledge: dict = field(default_factory=dict)
+    # askrene-create-channel: scid -> {"source": bytes(33),
+    # "destination": bytes(33), "capacity_sat": int}.  Created channels
+    # route only in directions that also carry an update (the
+    # reference's create-then-update flow, askrene/layer.c).
+    created: dict = field(default_factory=dict)
+    # askrene-update-channel: (scid, dir) -> overrides {enabled,
+    # fee_base_msat, fee_proportional_millionths, cltv_expiry_delta,
+    # htlc_minimum_msat, htlc_maximum_msat}
+    updates: dict = field(default_factory=dict)
+    # askrene-disable-node / askrene-bias-node: node_id bytes keys
+    disabled_nodes: set = field(default_factory=set)
+    node_biases: dict = field(default_factory=dict)
 
     def inform(self, scid: int, direction: int, *,
                max_msat: int | None = None, min_msat: int | None = None,
@@ -103,6 +115,123 @@ class Layers:
 
 
 @dataclass
+class _LayeredGossmap(Gossmap):
+    """A Gossmap with layer-created channels appended.  The base node
+    table stays sorted (searchsorted still works on the prefix); nodes
+    that exist only in layer-created channels resolve through
+    extra_nodes."""
+    base_nodes: int = 0
+    extra_nodes: dict = field(default_factory=dict)  # node_id -> index
+
+    def node_index(self, node_id: bytes) -> int:
+        ids = self.node_ids[:self.base_nodes].view(
+            [("k", "V33")]).reshape(-1)
+        key = np.frombuffer(node_id, np.uint8).view([("k", "V33")])
+        i = np.searchsorted(ids, key[0])
+        if i < len(ids) and ids[i] == key[0]:
+            return int(i)
+        if node_id in self.extra_nodes:
+            return self.extra_nodes[node_id]
+        raise KeyError(f"unknown node {node_id.hex()[:16]}")
+
+
+def graph_with_layers(g: Gossmap, layers: Layers | None) -> Gossmap:
+    """Materialize layer-created channels and per-direction channel
+    updates into a solver-ready graph (askrene/layer.c
+    add_layer_channel / layer_update_channel semantics).  Returns g
+    unchanged when the layers carry neither.
+
+    Materialization copies every per-channel array (O(C)), so results
+    are memoized ON the base graph keyed by the layer content — the
+    common one-layer-per-payment-attempt pattern pays the copy once,
+    and the cache dies with g."""
+    if layers is None or not (layers.created or layers.updates):
+        return g
+    sig = (
+        tuple(sorted((s, c["source"], c["destination"],
+                      c["capacity_sat"])
+                     for s, c in layers.created.items())),
+        tuple(sorted(
+            (k, tuple(sorted((n, v) for n, v in u.items()
+                             if v is not None)))
+            for k, u in layers.updates.items())),
+    )
+    cache = g.__dict__.setdefault("_layer_graph_cache", {})
+    hit = cache.get(sig)
+    if hit is not None:
+        return hit
+
+    extra: dict[bytes, int] = {}
+    new_ids: list[np.ndarray] = []
+
+    def _idx(nid: bytes) -> int:
+        try:
+            return g.node_index(nid)
+        except KeyError:
+            if nid not in extra:
+                extra[nid] = g.n_nodes + len(new_ids)
+                new_ids.append(np.frombuffer(nid, np.uint8))
+            return extra[nid]
+
+    created = sorted(layers.created.items())
+    n1 = [_idx(c["source"]) for _, c in created]
+    n2 = [_idx(c["destination"]) for _, c in created]
+    Cn = len(created)
+
+    node_ids = (np.concatenate([g.node_ids, np.stack(new_ids)])
+                if new_ids else g.node_ids)
+    scids = np.concatenate(
+        [g.scids, np.array([s for s, _ in created], np.uint64)])
+    node1 = np.concatenate([g.node1, np.array(n1, np.int32)])
+    node2 = np.concatenate([g.node2, np.array(n2, np.int32)])
+    capacity = np.concatenate(
+        [g.capacity_sat,
+         np.array([c["capacity_sat"] for _, c in created], np.float32)])
+
+    def _ext(arr, fill):
+        pad = np.full((2, Cn), fill, arr.dtype)
+        return np.concatenate([arr, pad], axis=1)
+
+    # created directions start disabled: only an update makes them
+    # routable (fees/limits come from that update)
+    enabled = _ext(g.enabled, False)
+    cltv = _ext(g.cltv_delta, 6)
+    hmin = _ext(g.htlc_min_msat, 0)
+    hmax = _ext(g.htlc_max_msat, 0)
+    fbase = _ext(g.fee_base_msat, 0)
+    fppm = _ext(g.fee_ppm, 0)
+    ts = _ext(g.timestamps, 0)
+
+    pos = {int(s): g.n_channels + i for i, (s, _) in enumerate(created)}
+    for (scid, d), u in layers.updates.items():
+        p = pos.get(int(scid))
+        if p is None:
+            try:
+                p = g.channel_index(int(scid))
+            except KeyError:
+                continue             # update names no known channel
+        enabled[d, p] = u.get("enabled", True)
+        for key, arr in (("fee_base_msat", fbase),
+                         ("fee_proportional_millionths", fppm),
+                         ("cltv_expiry_delta", cltv),
+                         ("htlc_minimum_msat", hmin),
+                         ("htlc_maximum_msat", hmax)):
+            if u.get(key) is not None:
+                arr[d, p] = u[key]
+
+    built = _LayeredGossmap(
+        node_ids=node_ids, scids=scids, node1=node1, node2=node2,
+        capacity_sat=capacity, enabled=enabled, cltv_delta=cltv,
+        htlc_min_msat=hmin, htlc_max_msat=hmax, fee_base_msat=fbase,
+        fee_ppm=fppm, timestamps=ts,
+        base_nodes=g.n_nodes, extra_nodes=extra)
+    if len(cache) >= 8:            # bound: distinct layer combos rare
+        cache.clear()
+    cache[sig] = built
+    return built
+
+
+@dataclass
 class Arcs:
     """Residual-graph arcs, one row per (channel-direction × piece),
     plus paired reverse arcs at odd indices (arc i ^ 1 = its reverse)."""
@@ -135,6 +264,17 @@ def build_arcs(g: Gossmap, amount_msat: int, layers: Layers | None = None,
             dis = np.fromiter((int(s) in layers.disabled for s in g.scids),
                               bool, C)
             en &= ~dis
+        if layers.disabled_nodes:
+            bad = []
+            for nid in layers.disabled_nodes:
+                try:
+                    bad.append(g.node_index(nid))
+                except KeyError:
+                    pass
+            if bad:
+                u_all = g.node1 if d == 0 else g.node2
+                v_all = g.node2 if d == 0 else g.node1
+                en &= ~(np.isin(u_all, bad) | np.isin(v_all, bad))
         idx = np.nonzero(en)[0]
         if len(idx) == 0:
             continue
@@ -175,6 +315,14 @@ def build_arcs(g: Gossmap, amount_msat: int, layers: Layers | None = None,
                 (layers.biases.get(int(s), 0) for s in g.scids[idx]),
                 np.float64, len(idx))
             eff_ppm += bias
+        if layers.node_biases:
+            nb = np.zeros(g.n_nodes)
+            for nid, b in layers.node_biases.items():
+                try:
+                    nb[g.node_index(nid)] = b
+                except KeyError:
+                    pass
+            eff_ppm += nb[u]         # bias rides on the node's channels
 
         # piece capacities sum EXACTLY to cap: a reserved-to-zero or
         # tiny direction must not leak phantom capacity (the last piece
@@ -411,6 +559,7 @@ def getroutes(g: Gossmap, source: bytes, destination: bytes,
     blows the budget we re-solve with the reliability weight slashed so
     fees dominate the objective (the direction askrene's refine step
     moves its fee-weight mu)."""
+    g = graph_with_layers(g, layers)
     for attempt_prob in (prob_weight, prob_weight / 100.0):
         parts = solve(g, source, destination, amount_msat, layers,
                       attempt_prob, delay_weight, max_parts)
@@ -465,8 +614,13 @@ def attach_routing_commands(rpc, gossmap_ref: dict,
         out = Layers()
         for ly in use:
             out.disabled |= ly.disabled
+            out.disabled_nodes |= ly.disabled_nodes
+            out.created.update(ly.created)
+            out.updates.update(ly.updates)
             for k, v in ly.biases.items():
                 out.biases[k] = out.biases.get(k, 0) + v
+            for k, v in ly.node_biases.items():
+                out.node_biases[k] = out.node_biases.get(k, 0) + v
             for k, v in ly.reserved.items():
                 out.reserved[k] = out.reserved.get(k, 0) + v
             for k, v in ly.knowledge.items():
@@ -581,6 +735,85 @@ def attach_routing_commands(rpc, gossmap_ref: dict,
         removed = _layer(layer).age(float(cutoff))
         return {"layer": layer, "num_removed": removed}
 
+    def _scid_dir(sd: str) -> tuple[int, int]:
+        scid, _, d = str(sd).rpartition("/")
+        return scid_parse(scid), int(d)
+
+    async def askrene_create_channel(layer: str, source: str,
+                                     destination: str,
+                                     short_channel_id,
+                                     capacity_msat: int) -> dict:
+        """Add a layer-local channel the solver can route through once
+        a direction gets an update (askrene/layer.c
+        json_askrene_create_channel)."""
+        ly = _layer(layer)
+        scid = scid_parse(short_channel_id)
+        ly.created[scid] = {
+            "source": bytes.fromhex(source),
+            "destination": bytes.fromhex(destination),
+            "capacity_sat": int(capacity_msat) // 1000}
+        return {"channels": [{
+            "source": source, "destination": destination,
+            "short_channel_id": short_channel_id,
+            "capacity_msat": int(capacity_msat)}]}
+
+    async def askrene_update_channel(
+            layer: str, short_channel_id_dir,
+            enabled: bool = True,
+            htlc_minimum_msat: int | None = None,
+            htlc_maximum_msat: int | None = None,
+            fee_base_msat: int | None = None,
+            fee_proportional_millionths: int | None = None,
+            cltv_expiry_delta: int | None = None) -> dict:
+        ly = _layer(layer)
+        key = _scid_dir(short_channel_id_dir)
+        ly.updates[key] = {
+            "enabled": bool(enabled),
+            "htlc_minimum_msat": htlc_minimum_msat,
+            "htlc_maximum_msat": htlc_maximum_msat,
+            "fee_base_msat": fee_base_msat,
+            "fee_proportional_millionths": fee_proportional_millionths,
+            "cltv_expiry_delta": cltv_expiry_delta}
+        return {"channel_updates": [{
+            "short_channel_id_dir": str(short_channel_id_dir),
+            **{k: v for k, v in ly.updates[key].items()
+               if v is not None}}]}
+
+    async def askrene_remove_channel_update(
+            layer: str, short_channel_id_dir) -> dict:
+        _layer(layer).updates.pop(_scid_dir(short_channel_id_dir), None)
+        return {}
+
+    async def askrene_disable_node(layer: str, node: str) -> dict:
+        """Node-level disable lives in a NAMED layer only (as in
+        askrene.c, where layer is mandatory): removing the layer is
+        the undo — the base layer would have no way back."""
+        if not layer:
+            raise ValueError(
+                "askrene-disable-node needs a named layer "
+                "(askrene-remove-layer is the undo)")
+        _layer(layer).disabled_nodes.add(bytes.fromhex(node))
+        return {"disabled_nodes": len(_layer(layer).disabled_nodes)}
+
+    async def askrene_bias_node(node: str, bias: int,
+                                layer: str = "") -> dict:
+        """Additive ppm-equivalent cost on every channel leaving the
+        node (negative prefers it); bias 0 removes the entry."""
+        ly = _layer(layer)
+        if int(bias) == 0:
+            ly.node_biases.pop(bytes.fromhex(node), None)
+        else:
+            ly.node_biases[bytes.fromhex(node)] = float(bias)
+        return {"biases": [{"node": node, "bias": int(bias),
+                            "layer": layer}]}
+
+    async def askrene_listreservations(layer: str = "") -> dict:
+        from ..gossip.gossmap import scid_str
+        return {"reservations": [{
+            "short_channel_id_dir": f"{scid_str(s)}/{d}",
+            "amount_msat": amt}
+            for (s, d), amt in sorted(_layer(layer).reserved.items())]}
+
     for name, fn in [
         ("getroutes", getroutes_cmd),
         ("askrene-reserve", askrene_reserve),
@@ -592,5 +825,11 @@ def attach_routing_commands(rpc, gossmap_ref: dict,
         ("askrene-listlayers", askrene_listlayers),
         ("askrene-inform-channel", askrene_inform_channel),
         ("askrene-age", askrene_age),
+        ("askrene-create-channel", askrene_create_channel),
+        ("askrene-update-channel", askrene_update_channel),
+        ("askrene-remove-channel-update", askrene_remove_channel_update),
+        ("askrene-disable-node", askrene_disable_node),
+        ("askrene-bias-node", askrene_bias_node),
+        ("askrene-listreservations", askrene_listreservations),
     ]:
         rpc.register(name, fn)

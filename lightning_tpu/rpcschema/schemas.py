@@ -85,7 +85,8 @@ COMMANDS: dict[str, dict] = {
     },
     "pay": {
         "params": {"bolt11": "str", "amount_msat": "int?",
-                   "retry_for": "int?"},
+                   "retry_for": "int?", "maxfeepercent": "any?",
+                   "maxfee": "msat?"},
         "result": {"payment_preimage": "hex", "payment_hash": "hex",
                    "amount_msat": "msat", "amount_sent_msat": "msat",
                    "status": "str"},
@@ -354,7 +355,8 @@ COMMANDS: dict[str, dict] = {
         "result": {"payment_hash": "hex", "status": "str"},
     },
     "waitsendpay": {
-        "params": {"payment_hash": "hex", "timeout": "int?"},
+        "params": {"payment_hash": "hex", "timeout": "int?",
+                   "partid": "int?", "groupid": "int?"},
         "result": {"payment_hash": "hex", "status": "str",
                    "payment_preimage": "hex"},
     },
@@ -632,6 +634,158 @@ COMMANDS: dict[str, dict] = {
         "result": {"jit_channel_scid": "str",
                    "lsp_cltv_expiry_delta": "int",
                    "client_trusts_lsp": "bool"},
+    },
+    # -- round-5 surface growth (reference schema names) ------------------
+    "bkpr-inspect": {
+        "params": {"account": "str"},
+        "result": {"txs": "list"},
+    },
+    "bkpr-channelsapy": {
+        "params": {},
+        "result": {"channels_apy": "list"},
+    },
+    "bkpr-dumpincomecsv": {
+        "params": {"csv_format": "str?", "csv_file": "str?"},
+        "result": {"csv_format": "str", "csv_file": "str", "csv": "str"},
+    },
+    "bkpr-editdescriptionbyoutpoint": {
+        "params": {"outpoint": "str", "description": "str"},
+        "result": {"updated": "list"},
+    },
+    "bkpr-editdescriptionbypaymentid": {
+        "params": {"payment_id": "str", "description": "str"},
+        "result": {"updated": "list"},
+    },
+    "listchainmoves": {
+        "params": {},
+        "result": {"chain_moves": "list"},
+    },
+    "listchannelmoves": {
+        "params": {},
+        "result": {"channel_moves": "list"},
+    },
+    "askrene-create-channel": {
+        "params": {"layer": "str", "source": "hex", "destination": "hex",
+                   "short_channel_id": "any", "capacity_msat": "msat"},
+        "result": {"channels": "list"},
+    },
+    "askrene-update-channel": {
+        "params": {"layer": "str", "short_channel_id_dir": "any",
+                   "enabled": "bool?", "htlc_minimum_msat": "msat?",
+                   "htlc_maximum_msat": "msat?", "fee_base_msat": "msat?",
+                   "fee_proportional_millionths": "int?",
+                   "cltv_expiry_delta": "int?"},
+        "result": {"channel_updates": "list"},
+    },
+    "askrene-remove-channel-update": {
+        "params": {"layer": "str", "short_channel_id_dir": "any"},
+        "result": {},
+    },
+    "askrene-disable-node": {
+        "params": {"layer": "str", "node": "hex"},
+        "result": {"disabled_nodes": "int"},
+    },
+    "askrene-bias-node": {
+        "params": {"node": "hex", "bias": "int", "layer": "str?"},
+        "result": {"biases": "list"},
+    },
+    "askrene-listreservations": {
+        "params": {"layer": "str?"},
+        "result": {"reservations": "list"},
+    },
+    "listsqlschemas": {
+        "params": {"table": "str?"},
+        "result": {"schemas": "list"},
+    },
+    "sql-template": {
+        "params": {"template": "str", "params": "list?"},
+        "result": {"rows": "list"},
+    },
+    "currencyrate": {
+        "params": {"currency": "str", "source": "str?"},
+        "result": {"currency": "str", "rate": "any"},
+    },
+    "listcurrencyrates": {
+        "params": {"currency": "str"},
+        "result": {"rates": "list"},
+    },
+    "datastoreusage": {
+        "params": {"key": "any?"},
+        "result": {"datastoreusage": "dict"},
+    },
+    "enableoffer": {
+        "params": {"offer_id": "hex"},
+        "result": {"offer_id": "hex", "active": "bool"},
+    },
+    "recoverchannel": {
+        "params": {"scb": "list"},
+        "result": {"stubs": "list"},
+    },
+    "signmessagewithkey": {
+        "params": {"message": "str", "address": "str"},
+        "result": {"address": "str", "pubkey": "hex",
+                   "signature": "str"},
+    },
+    "listnetworkevents": {
+        "params": {"id": "str?", "start": "int?", "limit": "int?"},
+        "result": {"networkevents": "list"},
+    },
+    "delnetworkevent": {
+        "params": {"created_index": "int"},
+        "result": {"deleted": "dict"},
+    },
+    "batching": {
+        "params": {"enable": "bool?"},
+        "result": {},
+    },
+    "fetchbip353": {
+        "params": {"address": "str"},
+        "result": {"address": "str", "instructions": "dict"},
+    },
+    "reckless": {
+        "params": {"subcommand": "str", "target": "str?",
+                   "lightning_dir": "str?"},
+        "result": {},
+    },
+    "xkeysend": {
+        "params": {"destination": "hex", "amount_msat": "any",
+                   "retry_for": "int?"},
+        "result": {"payment_hash": "hex", "status": "str",
+                   "payment_preimage": "hex"},
+    },
+    "sendamount": {
+        "params": {"invstring": "str", "amount_msat": "any",
+                   "retry_for": "int?"},
+        "result": {"payment_hash": "hex", "status": "str",
+                   "amount_msat": "msat", "amount_sent_msat": "msat"},
+    },
+    "injectpaymentonion": {
+        "params": {"onion": "hex", "payment_hash": "hex",
+                   "amount_msat": "any", "cltv_expiry": "int",
+                   "partid": "int?", "groupid": "int?"},
+        "result": {"payment_hash": "hex", "status": "str"},
+    },
+    "dev-forget-channel": {
+        "params": {"id": "hex", "channel_id": "hex?", "force": "bool?"},
+        "result": {"forced": "bool", "forgotten": "hex"},
+    },
+    "openchannel_bump": {
+        "params": {"channel_id": "hex", "amount": "any",
+                   "initialpsbt": "str", "funding_feerate": "int"},
+        "result": {"channel_id": "hex", "tx": "hex", "txid": "hex",
+                   "commitments_secured": "bool"},
+    },
+    "graceful": {
+        "params": {"timeout": "int?", "cancel": "bool?"},
+        "result": {},
+    },
+    "injectonionmessage": {
+        "params": {"message": "hex", "path_key": "hex"},
+        "result": {},
+    },
+    "clnrest-register-path": {
+        "params": {"path": "str", "method": "str"},
+        "result": {"path": "str", "method": "str"},
     },
 }
 

@@ -272,6 +272,19 @@ def attach_backup_commands(rpc, svc: PeerStorageService) -> None:
         blob = svc.our_blob()
         return {"filedata": blob.hex() if blob else ""}
 
+    async def recoverchannel(scb: list) -> dict:
+        """Restore channel stubs from individual UNENCRYPTED scb
+        entries (json_recoverchannel: each element is one channel's
+        packed backup hex, as `staticbackup` lists them)."""
+        stubs = []
+        for entry in scb:
+            c, _ = _unpack_chan(bytes.fromhex(entry), 0)
+            if svc.wallet is not None:
+                svc._restore_stub(c)
+            stubs.append(c["channel_id"].hex())
+        return {"stubs": stubs}
+
     rpc.register("staticbackup", staticbackup)
     rpc.register("emergencyrecover", emergencyrecover)
     rpc.register("getemergencyrecoverdata", getemergencyrecoverdata)
+    rpc.register("recoverchannel", recoverchannel)

@@ -171,6 +171,7 @@ class Db:
         self.path = path
         self._local = threading.local()
         self.db_write_hook = None    # fn(data_version, [(sql, None)])
+        self._batching = False       # `batching` RPC: defer commits
         self._version_lock = threading.Lock()
         self._migrate()
         v = self.get_var("data_version")
@@ -269,6 +270,23 @@ class Db:
     @contextmanager
     def transaction(self):
         c = self.conn
+        if self._batching:
+            # batched mode: each transaction is a SAVEPOINT so a later
+            # failure rolls back ONLY itself, never the acknowledged
+            # writes accumulated before it
+            c.execute("SAVEPOINT batched_txn")
+            try:
+                yield c
+                if self.db_write_hook is not None:
+                    self._flush_writes(c)
+                c.execute("RELEASE batched_txn")
+            except BaseException:
+                c.execute("ROLLBACK TO batched_txn")
+                c.execute("RELEASE batched_txn")
+                if getattr(self._local, "pending_writes", None):
+                    self._local.pending_writes = []
+                raise
+            return
         try:
             yield c
             if self.db_write_hook is not None:
@@ -279,6 +297,22 @@ class Db:
             if getattr(self._local, "pending_writes", None):
                 self._local.pending_writes = []
             raise
+
+    def set_batching(self, enable: bool) -> None:
+        """Defer COMMITs while enabled (jsonrpc.c `batching`): many
+        writes ride one fsync.  Disabling (or rpc connection close)
+        commits whatever accumulated — the documented crash-window
+        tradeoff."""
+        enable = bool(enable)
+        if enable and not self._batching:
+            # hold an explicit enclosing transaction: a SAVEPOINT
+            # released OUTSIDE a transaction would commit on its own
+            # (sqlite outermost-savepoint rule), defeating the batch
+            self.conn.commit()
+            self.conn.execute("BEGIN")
+        elif not enable and self._batching:
+            self.conn.commit()
+        self._batching = enable
 
     def get_var(self, name: str, default=None):
         row = self.conn.execute(

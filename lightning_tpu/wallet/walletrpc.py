@@ -310,6 +310,46 @@ def attach_wallet_commands(rpc, wallet: OnchainWallet, hsm=None,
         return {"transactions": sorted(txs.values(),
                                        key=lambda t: t["blockheight"])}
 
+    async def signmessagewithkey(message: str, address: str) -> dict:
+        """BIP137 recoverable signature with the key behind one of OUR
+        wallet addresses (reference signmessagewithkey; header 39+recid
+        marks a bech32 p2wpkh signer)."""
+        import hashlib
+
+        from ..crypto import ref_python as ref
+        from ..utils import zbase32 as Z
+
+        idx = None
+        for a in wallet.listaddresses():
+            if a["bech32"] == address:
+                idx = a["keyindex"]
+                break
+        if idx is None:
+            raise WalletError(f"address {address} is not from this "
+                              "wallet")
+        key = wallet.keyman.key(idx)
+
+        def _varstr(b: bytes) -> bytes:
+            return bytes([len(b)]) if len(b) < 0xfd else \
+                b"\xfd" + len(b).to_bytes(2, "little")
+
+        payload = (_varstr(b"Bitcoin Signed Message:\n")
+                   + b"Bitcoin Signed Message:\n"
+                   + _varstr(message.encode()) + message.encode())
+        digest = hashlib.sha256(
+            hashlib.sha256(payload).digest()).digest()
+        r, s = ref.ecdsa_sign(digest, key.key)
+        z = int.from_bytes(digest, "big")
+        pub = ref.pubkey_create(key.key)
+        recid = next(c for c in range(4)
+                     if (q := Z._recover(z, r, s, c)) is not None
+                     and q.x == pub.x and q.y == pub.y)
+        sig65 = bytes([39 + recid]) + r.to_bytes(32, "big") \
+            + s.to_bytes(32, "big")
+        return {"address": address, "pubkey": key.pubkey.hex(),
+                "signature": base64.b64encode(sig65).decode()}
+
+    rpc.register("signmessagewithkey", signmessagewithkey)
     rpc.register("signpsbt", signpsbt)
     rpc.register("sendpsbt", sendpsbt)
     rpc.register("utxopsbt", utxopsbt)

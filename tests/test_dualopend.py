@@ -477,3 +477,106 @@ def test_staged_open_expires_when_abandoned():
         await nb.close()
 
     run(scenario())
+
+
+def test_openchannel_bump_staged_flow():
+    """openchannel_bump RBFs a completed staged open at a higher
+    feerate: the dance rides the live channel loop (no inbox race),
+    parks for the caller's signature like openchannel_init, and
+    openchannel_signed returns the replacement txid
+    (dual_open_control.c json_openchannel_bump)."""
+    import base64
+    import types
+
+    from lightning_tpu.btc.psbt import Psbt
+    from lightning_tpu.channel.state import ChannelState
+    from lightning_tpu.daemon.manager import ChannelManager
+
+    async def scenario():
+        hsm_a, hsm_b = Hsm(b"\xdb" * 32), Hsm(b"\xdc" * 32)
+        na = LightningNode(privkey=hsm_b.node_key)
+        nb = LightningNode(privkey=hsm_a.node_key)
+        fut = asyncio.get_running_loop().create_future()
+        rbf_done = asyncio.get_running_loop().create_future()
+
+        async def serve(peer):
+            client = hsm_b.client(CAP_MASTER, peer.node_id, dbid=9)
+            res = await DO.accept_channel_v2(peer, hsm_b, client,
+                                             contribute_sat=0)
+            fut.set_result(res)
+            ch_b = res[0]
+            rbf_msg = await peer.recv(DO.M.TxInitRbf, timeout=120)
+            tx_b2 = await DO.rbf_accept(ch_b, rbf_msg)
+            rbf_done.set_result(tx_b2)
+
+        na.on_peer = serve
+        port = await na.listen()
+        peer = await nb.connect("127.0.0.1", port, na.node_id)
+
+        key = 0xB00F
+        fi = _utxo(key, 200_000, salt=21)
+        topo = types.SimpleNamespace(
+            txs_seen={fi.prevtx.txid(): (fi.prevtx, 0)})
+        mgr = ChannelManager(nb, hsm_a, topology=topo)
+
+        def _psbt64(tx):
+            return base64.b64encode(Psbt.from_tx(tx).serialize()).decode()
+
+        def _sign(funding):
+            idx = next(i for i, ti in enumerate(funding.inputs)
+                       if ti.txid == fi.prevtx.txid() and ti.vout == 0)
+            pub = ref.pubkey_serialize(ref.pubkey_create(key))
+            h = hashlib.new("ripemd160",
+                            hashlib.sha256(pub).digest()).digest()
+            code = b"\x76\xa9\x14" + h + b"\x88\xac"
+            sighash = funding.sighash_segwit(idx, code, fi.amount_sat)
+            r, s = ref.ecdsa_sign(sighash, key)
+            sp = Psbt.from_tx(funding)
+            sp.inputs[idx].final_witness = [T.sig_to_der(r, s), pub]
+            return base64.b64encode(sp.serialize()).decode()
+
+        psbt0 = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=0)]))
+        init = await mgr.openchannel_init(
+            peer.node_id, 100_000,
+            base64.b64encode(psbt0.serialize()).decode())
+        cid = init["channel_id"]
+        funding1 = Psbt.parse(base64.b64decode(init["psbt"])).tx
+        done1 = await mgr.openchannel_signed(cid, _sign(funding1))
+        await asyncio.wait_for(fut, 120)
+
+        # RBF at a 25/24-passing feerate, SAME input (BOLT#2 rule),
+        # now with the caller's change output riding the template
+        change_spk = b"\x00\x14" + b"\xcd" * 20
+        psbt1 = Psbt.from_tx(T.Tx(
+            version=2,
+            inputs=[T.TxInput(txid=fi.prevtx.txid(), vout=0)],
+            outputs=[T.TxOutput(amount_sat=60_000,
+                                script_pubkey=change_spk)]))
+        bump = await mgr.openchannel_bump(
+            cid, 100_000,
+            base64.b64encode(psbt1.serialize()).decode(), 3200)
+        assert bump["commitments_secured"]
+        assert cid in mgr._staged_v2
+        funding2 = Psbt.parse(base64.b64decode(bump["psbt"])).tx
+        assert any(o.script_pubkey == change_spk
+                   and o.amount_sat == 60_000
+                   for o in funding2.outputs), \
+            "bump dropped the caller's change output"
+        done2 = await mgr.openchannel_signed(cid, _sign(funding2))
+        assert done2["txid"] != done1["txid"]
+        assert cid not in mgr._staged_v2
+
+        tx_b2 = await asyncio.wait_for(rbf_done, 120)
+        assert tx_b2.txid().hex() == done2["txid"]
+        ch_a = mgr.channels[bytes.fromhex(cid)][0]
+        # post-RBF the channel waits for the REPLACEMENT to confirm
+        assert ch_a.core.state is ChannelState.AWAITING_LOCKIN
+
+        for _, t in mgr.channels.values():
+            t.cancel()
+        await na.close()
+        await nb.close()
+
+    run(scenario())

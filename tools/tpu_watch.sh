@@ -8,7 +8,8 @@ for i in $(seq 1 960); do  # up to ~12h at 45s
   if timeout 3 bash -c 'echo > /dev/tcp/127.0.0.1/8083' 2>/dev/null; then
     echo "$(date '+%F %T') tunnel UP — starting measurement session" >> "$LOG"
     bash tools/tpu_measure.sh >> "$LOG" 2>&1
-    echo "$(date '+%F %T') measurement session done rc=$?" >> "$LOG"
+    rc=$?
+    echo "$(date '+%F %T') measurement session done rc=$rc" >> "$LOG"
     exit 0
   fi
   sleep 45
