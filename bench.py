@@ -110,7 +110,14 @@ def acquire_backend() -> str:
     if not os.environ.get("BENCH_FORCE_CPU"):
         import subprocess
 
-        retries = int(os.environ.get("BENCH_PROBE_RETRIES", "4"))
+        # the jax probe BLOCKS (the axon plugin retries internally)
+        # whether the tunnel port is open or refused — measured on this
+        # box: a probe against a closed port still hangs to its full
+        # timeout.  One 270 s attempt therefore already spans a cold
+        # tunnel bring-up (round-2 postmortem), and a wedged tunnel
+        # stays wedged for hours, so 2 attempts is the budget: the
+        # round-4 artifact lost 18 min to 4 hung probes.
+        retries = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
         # the tunnel has been observed to take >2 min to come up cold —
         # round-2 postmortem: a 150s probe timeout wrote off a live TPU
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "270"))

@@ -1262,10 +1262,15 @@ class _CloseCommand:
 @dataclass
 class _SpliceCommand:
     """In-loop sentinel from the RPC layer: splice-in add_sat using the
-    provided wallet inputs (daemon/splice.py drives the protocol)."""
+    provided wallet inputs (daemon/splice.py drives the protocol).
+    outputs/sign_hook carry the staged splice_init template — caller
+    outputs ride as-is and signing parks for splice_signed."""
     add_sat: int
     inputs: list
     change_script: bytes | None = None
+    outputs: list | None = None
+    sign_hook: object = None
+    feerate: int | None = None     # None = engine default
     done: object = None            # asyncio.Future[Tx]
 
 
@@ -1404,19 +1409,35 @@ async def channel_loop(ch: Channeld, node_privkey: int,
                 log.exception("inbound splice failed")
             continue
         if isinstance(msg, _SpliceCommand):
+            from . import dualopend as DOP
             from . import splice as SPL
 
             try:
                 tx = await SPL.splice_initiate(
                     ch, msg.add_sat, msg.inputs,
                     change_script=msg.change_script,
+                    feerate_perkw=(msg.feerate if msg.feerate
+                                   else SPL.SPLICE_FEERATE),
                     chain_backend=chain_backend, topology=topology,
-                    node_privkey=node_privkey, invoices=invoices)
+                    node_privkey=node_privkey, invoices=invoices,
+                    our_outputs=msg.outputs, sign_hook=msg.sign_hook)
                 if msg.done is not None and not msg.done.done():
                     msg.done.set_result(tx)
-            except ChannelError as e:
+            except (ChannelError, DOP.DualOpenError) as e:
+                # recoverable: the splice rolled back (including peer
+                # tx_abort, which the shared interactive-construction
+                # code raises as DualOpenError); the channel lives on
                 if msg.done is not None and not msg.done.done():
                     msg.done.set_exception(e)
+            except BaseException as e:
+                # transport death or loop cancellation mid-splice: the
+                # waiting RPC must still be woken before teardown
+                if msg.done is not None and not msg.done.done():
+                    msg.done.set_exception(
+                        ChannelError(f"splice failed: {e!r}")
+                        if isinstance(e, asyncio.CancelledError)
+                        else e)
+                raise
             continue
         if isinstance(msg, _BumpCommand):
             from . import dualopend as DOP

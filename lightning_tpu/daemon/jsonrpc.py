@@ -223,18 +223,19 @@ class JsonRpcServer:
             return _err(rid, METHOD_NOT_FOUND,
                         f"command {method!r} is deprecated")
         params = req.get("params") or {}
-        if method in ("notifications", "batching") \
-                and isinstance(params, dict):
-            # connection-scoped commands get their client's identity
-            params = dict(params, _writer=writer)
         if isinstance(params, list):
             # positional params: map onto the handler's signature
-            names = [p for p in inspect.signature(handler).parameters]
+            names = [p for p in inspect.signature(handler).parameters
+                     if p != "_writer"]
             if len(params) > len(names):
                 return _err(rid, INVALID_PARAMS, "too many parameters")
             params = dict(zip(names, params))
         if not isinstance(params, dict):
             return _err(rid, INVALID_PARAMS, "params must be object or array")
+        if method in ("notifications", "batching"):
+            # connection-scoped commands get their client's identity
+            # (AFTER positional mapping, so array-form calls get it too)
+            params = dict(params, _writer=writer)
         try:
             result = handler(**params)
             if inspect.isawaitable(result):

@@ -593,7 +593,7 @@ class ChannelManager:
     #    the caller returns the SIGNED psbt via openchannel_signed.
 
     def _parse_initialpsbt(self, initialpsbt: str, amount_sat: int,
-                           funding_feerate: int):
+                           funding_feerate: int, fee_floor=None):
         """Validate a caller-built funding PSBT BEFORE any wire
         contact (dual_open_control.c json_openchannel_init parsing):
         known prevtxs, in-range vouts, no duplicate outpoints, no
@@ -647,10 +647,15 @@ class ChannelManager:
                     "script — the funding tx would never relay")
         in_total = sum(fi.amount_sat for fi in inputs)
         out_total = sum(sats for sats, _ in outs)
-        # same fee helper dualopend itself uses, so the checks can't
-        # drift
-        fee = DO.opener_fee_floor(funding_feerate, len(inputs),
-                                  len(outs), template=True)
+        # fee_floor: callable(n_inputs, n_outputs) — callers pass the
+        # SAME helper their engine enforces, so the checks can't drift
+        # (dualopend.opener_fee_floor for opens, splice_fee_sat for
+        # splices)
+        if fee_floor is None:
+            fee = DO.opener_fee_floor(funding_feerate, len(inputs),
+                                      len(outs), template=True)
+        else:
+            fee = fee_floor(len(inputs), len(outs))
         if in_total < amount_sat + out_total + fee:
             raise ManagerError(
                 f"initialpsbt inputs ({in_total} sat) do not cover "
@@ -722,6 +727,61 @@ class ChannelManager:
                 # surprised by the auto-abort
                 "signing_deadline_seconds": self.STAGED_OPEN_TIMEOUT}
 
+    async def _stage_loop_command(self, channel_id: str, ch,
+                                  inputs, build_cmd, kind: str) -> dict:
+        """Shared scaffolding for staged in-loop flows (openchannel_
+        bump and splice_init): stage the state, enqueue the sentinel
+        built by build_cmd(sign_hook, done_future), wait until the
+        commitments are secured (hook fired) or the dance failed, arm
+        the expiry watchdog, and return the staged dict."""
+        loop = asyncio.get_running_loop()
+        st = {"secured": asyncio.Event(), "wits": loop.create_future(),
+              "inputs": inputs, "ch": ch, "tx": None,
+              "my_serials": None, "bump": True, "kind": kind,
+              "peer_id": None}
+
+        async def hook(ch_h, tx, my_serials):
+            st["tx"], st["my_serials"] = tx, my_serials
+            st["secured"].set()
+            return await st["wits"]
+
+        fut = loop.create_future()
+        st["task"] = fut
+
+        def _consume_late_failure(f):
+            # the RPC may have returned before the in-loop dance
+            # finished: surface late failures in the log instead of
+            # asyncio's unretrieved-exception noise
+            if not f.cancelled() and f.exception() is not None:
+                log.warning("staged %s for %s failed after the RPC "
+                            "returned: %s", kind, channel_id[:16],
+                            f.exception())
+
+        fut.add_done_callback(_consume_late_failure)
+        ch.peer.inbox.put_nowait(build_cmd(hook, fut))
+        secured = loop.create_task(st["secured"].wait())
+        done, _ = await asyncio.wait({fut, secured},
+                                     return_when=asyncio.FIRST_COMPLETED)
+        if fut in done:
+            secured.cancel()
+            fut.result()           # raises the negotiation failure
+            raise ManagerError(f"{kind} finished before signing — bug")
+        self._staged_v2[channel_id] = st
+        self._arm_staged_expiry(channel_id, st, ch.peer)
+        return st
+
+    def _staged_outnum(self, st: dict) -> int:
+        """Funding output index inside the STAGED tx: a splice's new
+        funding output can sit anywhere in the replacement (the old
+        funding_outidx belongs to the old tx)."""
+        if st.get("kind") == "splice" and st.get("tx") is not None:
+            from ..btc import script as SC
+
+            spk = SC.p2wsh(st["ch"]._funding_script())
+            return next(i for i, o in enumerate(st["tx"].outputs)
+                        if o.script_pubkey == spk)
+        return st["ch"].funding_outidx
+
     def _arm_staged_expiry(self, cid: str, st: dict, peer) -> None:
         """A staged open/bump the caller abandons (never signed or
         aborted) must not park its machinery forever: auto-abort when
@@ -789,7 +849,7 @@ class ChannelManager:
         return {"channel_id": channel_id,
                 "psbt": self._staged_psbt(st),
                 "commitments_secured": True,
-                "funding_outnum": st["ch"].funding_outidx}
+                "funding_outnum": self._staged_outnum(st)}
 
     async def openchannel_signed(self, channel_id: str,
                                  signed_psbt: str) -> dict:
@@ -825,14 +885,44 @@ class ChannelManager:
         if st.get("expire_task") is not None:
             st["expire_task"].cancel()
         st["wits"].set_result(ours)
-        if st.get("bump"):
+        if st.get("kind") == "splice":
+            # the splice engine resolves its task only at LOCK-IN
+            # (confirmation + splice_locked); the RPC answers at the
+            # signature exchange like the reference, returning the
+            # broadcast-ready tx from the persisted inflight
+            ch_s = st["ch"]
+            # peer may legally take the full wire timeout to return
+            # tx_signatures (channeld RECV_TIMEOUT) — allow that plus
+            # slack before declaring the splice stuck
+            from .channeld import RECV_TIMEOUT as _RT
+
+            deadline = time.monotonic() + _RT + 30
+            while True:
+                if st["task"].done():
+                    tx = st["task"].result()
+                    break
+                infl = ch_s.inflight
+                if infl is not None and infl.get("signed"):
+                    from ..btc import tx as T_
+
+                    tx = T_.Tx.parse(bytes.fromhex(infl["tx"]))
+                    break
+                if time.monotonic() > deadline:
+                    raise ManagerError(
+                        "splice signatures not exchanged in time")
+                await asyncio.sleep(0.05)
+        elif st.get("bump"):
             # RBF: the channel loop is already running (the dance rode
             # a _BumpCommand inside it) — just await the replacement tx
             tx = await st["task"]
         else:
             ch, tx = await st["task"]
             self._spawn_loop(ch)
-        if self.chain_backend is not None:
+        # the splice engine broadcasts the splice tx itself inside
+        # _locked_and_switch — a second submission here would race it
+        # (the engine treats already-in-mempool as broadcast failure)
+        if self.chain_backend is not None \
+                and st.get("kind") != "splice":
             try:
                 await self.chain_backend.sendrawtransaction(
                     tx.serialize().hex())
@@ -872,45 +962,127 @@ class ChannelManager:
             raise ManagerError(
                 f"channel is {ch.core.state.value}; only an "
                 "unconfirmed funding can be bumped")
-        if self.topology is not None \
-                and self.topology.txs_seen.get(ch.funding_txid) \
-                is not None:
-            raise ManagerError(
-                "funding tx already confirmed; RBF is no longer "
-                "possible")
+        if ch.core.state is ChannelState.NORMAL:
+            # NORMAL is only bumpable when the chain view proves the
+            # funding is still unconfirmed; without a topology we
+            # cannot prove it, so refuse
+            if self.topology is None:
+                raise ManagerError(
+                    "cannot verify the funding is unconfirmed "
+                    "(no chain topology); refusing to RBF")
+            if self.topology.txs_seen.get(ch.funding_txid) is not None:
+                raise ManagerError(
+                    "funding tx already confirmed; RBF is no longer "
+                    "possible")
         if channel_id in self._staged_v2:
             raise ManagerError("an open/bump is already staged for "
                                "this channel")
         inputs, outs = self._parse_initialpsbt(
             initialpsbt, int(amount_sat), int(funding_feerate))
-        loop = asyncio.get_running_loop()
-        st = {"secured": asyncio.Event(), "wits": loop.create_future(),
-              "inputs": inputs, "ch": ch, "tx": None,
-              "my_serials": None, "bump": True, "peer_id": None}
-
-        async def hook(ch_h, tx, my_serials):
-            st["tx"], st["my_serials"] = tx, my_serials
-            st["secured"].set()
-            return await st["wits"]
-
-        fut = loop.create_future()
-        st["task"] = fut
-        ch.peer.inbox.put_nowait(_BumpCommand(
-            inputs=inputs, outputs=outs, funding_sat=int(amount_sat),
-            feerate=int(funding_feerate), sign_hook=hook, done=fut))
-        secured = loop.create_task(st["secured"].wait())
-        done, _ = await asyncio.wait({fut, secured},
-                                     return_when=asyncio.FIRST_COMPLETED)
-        if fut in done:
-            secured.cancel()
-            fut.result()           # raises the negotiation failure
-            raise ManagerError("bump finished before signing — bug")
-        self._staged_v2[channel_id] = st
-        self._arm_staged_expiry(channel_id, st, ch.peer)
+        # BOLT#2 RBF rule (the acceptor enforces it too, rbf_accept):
+        # the replacement must CONFLICT with the original by spending
+        # at least one of its inputs — otherwise both could confirm
+        prev_pts = getattr(ch, "_v2_outpoints", set())
+        if prev_pts and not any(
+                (fi.prevtx.txid(), fi.vout) in prev_pts
+                for fi in inputs):
+            raise ManagerError(
+                "bump PSBT shares no input with the original funding "
+                "tx — both could confirm; include at least one of "
+                "the original inputs")
+        st = await self._stage_loop_command(
+            channel_id, ch, inputs,
+            lambda hook, fut: _BumpCommand(
+                inputs=inputs, outputs=outs,
+                funding_sat=int(amount_sat),
+                feerate=int(funding_feerate), sign_hook=hook,
+                done=fut),
+            kind="bump")
         return {"channel_id": channel_id,
                 "psbt": self._staged_psbt(st),
                 "commitments_secured": True,
-                "funding_outnum": ch.funding_outidx,
+                "funding_outnum": self._staged_outnum(st),
+                "signing_deadline_seconds": self.STAGED_OPEN_TIMEOUT}
+
+    async def spliceout(self, target: str, amount_sat: int,
+                        destination: str | None = None) -> dict:
+        """Move funds OUT of a channel onto the chain (plugins/splice
+        spliceout): shrink the funding by amount and pay
+        amount − fee to `destination` (or a fresh wallet address)."""
+        from ..btc import address as ADDR
+        from . import splice as SPL
+        from .channeld import _SpliceCommand
+
+        ch = self._find(target)
+        amount = int(amount_sat)
+        fee = SPL.splice_fee_sat(SPL.SPLICE_FEERATE, 0, 1)
+        if amount <= fee + 546:
+            raise ManagerError(
+                f"amount {amount} sat does not cover the splice fee "
+                f"{fee} + dust")
+        if destination is not None:
+            spk = ADDR.to_scriptpubkey(destination)
+        elif self.onchain is not None:
+            idx = self.onchain.keyman.fresh_index()
+            spk = self.onchain.keyman.scriptpubkey(idx)
+            self.onchain.filter.add(spk, idx)
+        else:
+            raise ManagerError(
+                "spliceout needs a destination or an on-chain wallet")
+        fut = asyncio.get_running_loop().create_future()
+        ch.peer.inbox.put_nowait(_SpliceCommand(
+            add_sat=-amount, inputs=[],
+            outputs=[(amount - fee, spk)], done=fut))
+        tx = await asyncio.wait_for(fut, 300)
+        return {"txid": tx.txid().hex(),
+                "channel_id": ch.channel_id.hex(),
+                "capacity_sat": ch.funding_sat,
+                "outnum": next(i for i, o in enumerate(tx.outputs)
+                               if o.script_pubkey == spk)}
+
+    async def splice_init(self, channel_id: str, relative_amount: int,
+                          initialpsbt: str | None = None,
+                          feerate_per_kw: int | None = None) -> dict:
+        """Staged splice-in (channeld splice_init/update/signed RPC
+        family): the caller brings the funding inputs in a PSBT, the
+        splice negotiates up to commitments INSIDE the channel loop,
+        and parks until splice_signed delivers the signed PSBT —
+        exactly the openchannel_init pattern over the splice engine."""
+        from . import splice as SPL
+        from .channeld import _SpliceCommand
+
+        cid = bytes.fromhex(channel_id)
+        entry = self.channels.get(cid)
+        if entry is None:
+            raise ManagerError("unknown channel")
+        ch = entry[0]
+        if int(relative_amount) < 0:
+            raise ManagerError(
+                "negative relative_amount (splice-out) is not "
+                "supported yet")
+        if channel_id in self._staged_v2:
+            raise ManagerError("an open/bump/splice is already staged "
+                               "for this channel")
+        if initialpsbt is None:
+            raise ManagerError(
+                "splice_init needs an initialpsbt carrying the "
+                "funding inputs")
+        feerate = int(feerate_per_kw or SPL.SPLICE_FEERATE)
+        inputs, outs = self._parse_initialpsbt(
+            initialpsbt, int(relative_amount), feerate,
+            fee_floor=lambda n_in, n_out: SPL.splice_fee_sat(
+                feerate, n_in, n_out))
+        st = await self._stage_loop_command(
+            channel_id, ch, inputs,
+            lambda hook, fut: _SpliceCommand(
+                add_sat=int(relative_amount), inputs=inputs,
+                outputs=outs, sign_hook=hook, feerate=feerate,
+                done=fut),
+            kind="splice")
+        return {"channel_id": channel_id,
+                "psbt": self._staged_psbt(st),
+                "commitments_secured": True,
+                "funding_outnum": self._staged_outnum(st),
                 "signing_deadline_seconds": self.STAGED_OPEN_TIMEOUT}
 
     async def openchannel_abort(self, channel_id: str) -> dict:
@@ -922,15 +1094,18 @@ class ChannelManager:
         if exp is not None and exp is not asyncio.current_task():
             exp.cancel()
         if st.get("bump"):
-            # cancelling an RBF must NOT kill the live channel: wake
-            # the parked sign_hook with a protocol error (it unwinds
-            # rbf_initiate, which rolls the channel back to the
-            # original funding) and signal tx_abort, not BOLT#1 error
+            # cancelling an RBF/splice must NOT kill the live channel:
+            # wake the parked sign_hook with a protocol error (it
+            # unwinds rbf_initiate/splice_initiate, which roll the
+            # channel back) and signal tx_abort, not BOLT#1 error
             from . import dualopend as DO_
+            from . import splice as SPL_
 
             if not st["wits"].done():
                 st["wits"].set_exception(
-                    DO_.DualOpenError("bump aborted by caller"))
+                    SPL_.SpliceError("splice aborted by caller")
+                    if st.get("kind") == "splice"
+                    else DO_.DualOpenError("bump aborted by caller"))
             try:
                 from ..wire import messages as M_
 
@@ -1736,6 +1911,34 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     rpc.register("listpays", listpays)
     rpc.register("listsendpays", listsendpays)
     rpc.register("listpeerchannels", listpeerchannels)
+    async def splicein(channel: str, amount) -> dict:
+        """splicein (plugins/splice): wallet-funded capacity growth —
+        the friendly face of `splice`."""
+        return await mgr.splice(channel, int(amount))
+
+    async def spliceout(channel: str, amount,
+                        destination: str | None = None) -> dict:
+        return await mgr.spliceout(channel, int(amount), destination)
+
+    async def splice_init(channel_id: str, relative_amount,
+                          initialpsbt: str | None = None,
+                          feerate_per_kw=None) -> dict:
+        return await mgr.splice_init(
+            channel_id, int(relative_amount), initialpsbt,
+            int(feerate_per_kw) if feerate_per_kw else None)
+
+    async def splice_update(channel_id: str,
+                            psbt: str | None = None) -> dict:
+        return await mgr.openchannel_update(channel_id, psbt)
+
+    async def splice_signed(channel_id: str, psbt: str) -> dict:
+        return await mgr.openchannel_signed(channel_id, psbt)
+
+    rpc.register("splice_init", splice_init)
+    rpc.register("splice_update", splice_update)
+    rpc.register("splice_signed", splice_signed)
+    rpc.register("splicein", splicein)
+    rpc.register("spliceout", spliceout)
     rpc.register("keysend", keysend)
     rpc.register("listhtlcs", listhtlcs)
     rpc.register("xkeysend", xkeysend)

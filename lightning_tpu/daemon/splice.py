@@ -43,6 +43,16 @@ def _new_funding_script(ch: Channeld) -> bytes:
     return ch._funding_script()       # same funding keys across a splice
 
 
+def splice_fee_sat(feerate_perkw: int, n_inputs: int,
+                   n_caller_outputs: int) -> int:
+    """Initiator's splice-tx fee: shared funding input (384wu), its
+    p2wpkh inputs, the funding output + change slot + caller outputs,
+    and the common fields.  One formula for the engine AND the RPC
+    layer so their checks cannot drift."""
+    weight = 384 + n_inputs * 272 + (2 + n_caller_outputs) * 124 + 172
+    return feerate_perkw * weight // 1000
+
+
 def _staged(ch: Channeld, tx: T.Tx, fund_idx: int, new_sat: int):
     """Context manager: temporarily point the channel at the new funding
     so commitment construction/signing targets the splice tx."""
@@ -127,18 +137,25 @@ def _assemble_shared_witness(ch: Channeld, tx: T.Tx, shared_idx: int,
 
 async def _exchange_sigs(ch: Channeld, tx: T.Tx, con: _Construction,
                          our_inputs, my_serials, shared_idx: int,
-                         old_sat: int, we_initiate: bool) -> None:
+                         old_sat: int, we_initiate: bool,
+                         sign_hook=None) -> None:
     """tx_signatures both ways: the first witness stack each way is the
     side's half-signature for the shared old-funding input; the rest
-    are p2wpkh witnesses for that side's contributed inputs."""
+    are p2wpkh witnesses for that side's contributed inputs.
+    sign_hook, when given, replaces the wallet signer for OUR
+    contributed inputs (the staged splice_signed RPC parks here) —
+    the shared-input half-sig always comes from the channel keys."""
     ours64 = _shared_input_sig(ch, tx, shared_idx, old_sat)
     # p2wpkh inputs sit AFTER the prepended shared input: shift indices
     stacks = [[ours64]]
     if our_inputs:
-        shifted = T.Tx(version=tx.version, inputs=tx.inputs,
-                       outputs=tx.outputs, locktime=tx.locktime)
-        ws = _sign_our_inputs_shifted(shifted, con, our_inputs,
-                                      my_serials, shift=1)
+        if sign_hook is not None:
+            ws = await sign_hook(ch, tx, my_serials)
+        else:
+            shifted = T.Tx(version=tx.version, inputs=tx.inputs,
+                           outputs=tx.outputs, locktime=tx.locktime)
+            ws = _sign_our_inputs_shifted(shifted, con, our_inputs,
+                                          my_serials, shift=1)
         stacks.extend(ws)
 
     async def send():
@@ -262,22 +279,45 @@ async def splice_initiate(ch: Channeld, add_sat: int,
                           feerate_perkw: int = SPLICE_FEERATE,
                           chain_backend=None, topology=None,
                           node_privkey: int | None = None,
-                          invoices=None) -> T.Tx:
+                          invoices=None,
+                          our_outputs: list[tuple[int, bytes]] | None = None,
+                          sign_hook=None) -> T.Tx:
     """Initiator: quiesce → splice_init/ack → interactive construct →
     inflight commitments → tx_signatures → splice_locked → switch.
     Caller provides wallet inputs covering add_sat + fee; the remainder
-    returns via change_script."""
+    returns via change_script.  our_outputs: a caller-built PSBT's
+    outputs (splice_init template semantics — inputs − outputs is the
+    caller's chosen fee, no auto-change); sign_hook parks before
+    tx_signatures for external signing (splice_signed)."""
     from .channeld import _quiesce
 
+    template = bool(our_outputs) or sign_hook is not None
+    our_outputs = list(our_outputs or [])
+    out_total = sum(sats for sats, _ in our_outputs)
     total_in = sum(fi.amount_sat for fi in inputs)
-    # initiator pays the whole splice-tx fee (shared input 384wu + its
-    # own p2wpkh inputs/outputs + the funding output + common fields)
-    weight = 384 + len(inputs) * 272 + 2 * 124 + 172
-    fee = feerate_perkw * weight // 1000
-    change = total_in - add_sat - fee
-    if change < 0:
-        raise SpliceError(
-            f"inputs {total_in} sat do not cover add {add_sat} + fee {fee}")
+    fee = splice_fee_sat(feerate_perkw, len(inputs), len(our_outputs))
+    if add_sat < 0:
+        # splice-out: funds leave OUR side of the channel through the
+        # caller's destination outputs; no wallet inputs ride along
+        if not our_outputs:
+            raise SpliceError(
+                "splice-out needs destination outputs (the removed "
+                "funds would otherwise burn as fee)")
+        reserve = ch.core.reserve_local_msat or 0
+        if ch.core.to_local_msat + add_sat * 1000 < reserve:
+            raise SpliceError(
+                f"splice-out of {-add_sat} sat dips below the "
+                f"channel reserve")
+        if out_total > -add_sat - fee:
+            raise SpliceError(
+                f"outputs {out_total} exceed removed {-add_sat} "
+                f"minus fee {fee}")
+    else:
+        change = total_in - add_sat - out_total - fee
+        if change < 0:
+            raise SpliceError(
+                f"inputs {total_in} sat do not cover add {add_sat} "
+                f"+ outputs {out_total} + fee {fee}")
 
     await _quiesce(ch, node_privkey, invoices)
     ch.core.transition(ChannelState.AWAITING_SPLICE)
@@ -297,13 +337,16 @@ async def splice_initiate(ch: Channeld, add_sat: int,
             raise SpliceError("peer splice-out not supported")
 
         new_sat = ch.funding_sat + add_sat + their_add
-        our_outputs = [(new_sat, SC.p2wsh(_new_funding_script(ch)))]
-        if change >= 546 and change_script is not None:
-            our_outputs.append((change, change_script))
+        outs = [(new_sat, SC.p2wsh(_new_funding_script(ch)))]
+        if template:
+            # caller's template outputs ride as-is; surplus is fee
+            outs.extend(our_outputs)
+        elif change >= 546 and change_script is not None:
+            outs.append((change, change_script))
 
         con = _Construction(locktime=0)
         my_serials = await _interactive_construct(
-            ch.peer, ch.channel_id, con, True, inputs, our_outputs,
+            ch.peer, ch.channel_id, con, True, inputs, outs,
             serial_base=0)
         tx, fund_idx = _build_splice_tx(ch, con)
         if tx.outputs[fund_idx].amount_sat != new_sat:
@@ -314,7 +357,7 @@ async def splice_initiate(ch: Channeld, add_sat: int,
         _make_inflight(ch, tx, fund_idx, new_sat, add_sat, their_add, cs)
         await _exchange_sigs(ch, tx, con, inputs, my_serials,
                              shared_idx=0, old_sat=old_sat,
-                             we_initiate=True)
+                             we_initiate=True, sign_hook=sign_hook)
         await _locked_and_switch(ch, tx, fund_idx, add_sat, their_add,
                                  chain_backend=chain_backend,
                                  topology=topology)
@@ -386,7 +429,13 @@ async def splice_accept(ch: Channeld, first_stfu: M.Stfu,
         await ch.peer.send(M.Stfu(channel_id=ch.channel_id, initiator=0))
         si = await ch.peer.recv(M.SpliceInit, timeout=RECV_TIMEOUT)
         if si.funding_contribution_satoshis < 0:
-            raise SpliceError("splice-out not supported")
+            # initiator splices OUT of its own side: allowed as long
+            # as its post-splice balance keeps its channel reserve
+            reserve = ch.core.reserve_remote_msat or 0
+            if ch.core.to_remote_msat \
+                    + si.funding_contribution_satoshis * 1000 < reserve:
+                raise SpliceError(
+                    "peer splice-out dips below its channel reserve")
         await ch.peer.send(M.SpliceAck(
             channel_id=ch.channel_id,
             funding_contribution_satoshis=contribute_sat,

@@ -143,6 +143,9 @@ class OfferRegistry:
     def enable(self, offer_id: bytes) -> None:
         """Re-arm a disabled offer (json_enableoffer; a used single-use
         offer stays used)."""
+        row = self.offers.get(offer_id)
+        if row is not None and row["status"] == "used":
+            raise OffersError("single-use offer was already paid")
         self._set_status(offer_id, "active")
 
     def _set_status(self, offer_id: bytes, status: str) -> None:
@@ -265,7 +268,9 @@ class OffersService:
         row = self.registry.offers.get(local_offer_id)
         if row is not None and row["single_use"] \
                 and row["status"] == "active":
-            self.registry.disable(local_offer_id)
+            # 'used' is terminal — distinguishable from an operator
+            # disable so enableoffer can never re-arm a spent offer
+            self.registry._set_status(local_offer_id, "used")
 
 
 class FetchInvoice:

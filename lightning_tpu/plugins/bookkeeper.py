@@ -199,18 +199,22 @@ class Bookkeeper:
                         "start_time": t0, "end_time": t1})
         return out
 
-    def income_csv(self, csv_format: str = "koinly") -> str:
-        """Income events as CSV (bkpr-dumpincomecsv formats)."""
+    def income_csv(self, csv_format: str = "koinly",
+                   start: int = 0, end: int | None = None,
+                   headers: bool = True) -> str:
+        """Income events as CSV (bkpr-dumpincomecsv formats), over the
+        SAME time window listincome uses."""
         import csv
         import io
 
         buf = io.StringIO()
         w = csv.writer(buf)
-        rows = self.listincome()["income_events"]
+        rows = self.listincome(start, end)["income_events"]
         if csv_format == "koinly":
-            w.writerow(["Date", "Sent Amount", "Sent Currency",
-                        "Received Amount", "Received Currency",
-                        "Label", "Description", "TxHash"])
+            if headers:
+                w.writerow(["Date", "Sent Amount", "Sent Currency",
+                            "Received Amount", "Received Currency",
+                            "Label", "Description", "TxHash"])
             for e in rows:
                 w.writerow([
                     time.strftime("%Y-%m-%d %H:%M UTC",
@@ -222,8 +226,9 @@ class Bookkeeper:
                     e["tag"], e.get("description") or "",
                     e["reference"] or ""])
         else:       # "cointracker" and the generic fallback
-            w.writerow(["date", "account", "tag", "credit_msat",
-                        "debit_msat", "description", "reference"])
+            if headers:
+                w.writerow(["date", "account", "tag", "credit_msat",
+                            "debit_msat", "description", "reference"])
             for e in rows:
                 w.writerow([e["timestamp"], e["account"], e["tag"],
                             e["credit_msat"], e["debit_msat"],
@@ -283,6 +288,24 @@ def attach_bookkeeper_commands(rpc, bk: Bookkeeper) -> None:
             payment_id: str, description: str) -> dict:
         return {"updated": bk.edit_description(payment_id, description)}
 
+    async def bkpr_report(format: str | None = None,  # noqa: A002
+                          headers: bool = True,
+                          escape: str | None = None,
+                          start_time: int = 0,
+                          end_time: int | None = None) -> dict:
+        """All income-impacting events in one report (bkpr-report);
+        format='csv' returns the CSV text alongside the rows."""
+        inc = bk.listincome(start_time, end_time)
+        out = {"report": inc["income_events"],
+               "total_income_msat": inc["total_income_msat"],
+               "total_expense_msat": inc["total_expense_msat"],
+               "net_msat": inc["net_msat"]}
+        if format == "csv" or escape == "csv":
+            # same window as the rows above — the two halves agree
+            out["csv"] = bk.income_csv("generic", start_time, end_time,
+                                       headers=bool(headers))
+        return out
+
     async def listchainmoves() -> dict:
         return {"chain_moves": bk.listchainmoves()}
 
@@ -295,6 +318,7 @@ def attach_bookkeeper_commands(rpc, bk: Bookkeeper) -> None:
     rpc.register("bkpr-inspect", bkpr_inspect)
     rpc.register("bkpr-channelsapy", bkpr_channelsapy)
     rpc.register("bkpr-dumpincomecsv", bkpr_dumpincomecsv)
+    rpc.register("bkpr-report", bkpr_report)
     rpc.register("bkpr-editdescriptionbyoutpoint",
                  bkpr_editdescriptionbyoutpoint)
     rpc.register("bkpr-editdescriptionbypaymentid",

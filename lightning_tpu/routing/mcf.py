@@ -737,6 +737,10 @@ def attach_routing_commands(rpc, gossmap_ref: dict,
 
     def _scid_dir(sd: str) -> tuple[int, int]:
         scid, _, d = str(sd).rpartition("/")
+        if d not in ("0", "1"):
+            raise ValueError(
+                f"short_channel_id_dir {sd!r}: direction must be 0 "
+                "or 1")
         return scid_parse(scid), int(d)
 
     async def askrene_create_channel(layer: str, source: str,

@@ -787,6 +787,39 @@ COMMANDS: dict[str, dict] = {
         "params": {"path": "str", "method": "str"},
         "result": {"path": "str", "method": "str"},
     },
+    "splice_init": {
+        "params": {"channel_id": "hex", "relative_amount": "any",
+                   "initialpsbt": "str?", "feerate_per_kw": "int?"},
+        "result": {"channel_id": "hex", "psbt": "str",
+                   "commitments_secured": "bool"},
+    },
+    "splice_update": {
+        "params": {"channel_id": "hex", "psbt": "str?"},
+        "result": {"channel_id": "hex", "psbt": "str",
+                   "commitments_secured": "bool"},
+    },
+    "splice_signed": {
+        "params": {"channel_id": "hex", "psbt": "str"},
+        "result": {"channel_id": "hex", "tx": "hex", "txid": "hex"},
+    },
+    "splicein": {
+        "params": {"channel": "str", "amount": "any"},
+        "result": {"txid": "hex", "channel_id": "hex",
+                   "capacity_sat": "int"},
+    },
+    "spliceout": {
+        "params": {"channel": "str", "amount": "any",
+                   "destination": "str?"},
+        "result": {"txid": "hex", "channel_id": "hex",
+                   "capacity_sat": "int", "outnum": "int"},
+    },
+    "bkpr-report": {
+        "params": {"format": "str?", "headers": "bool?",
+                   "escape": "str?", "start_time": "int?",
+                   "end_time": "int?"},
+        "result": {"report": "list", "total_income_msat": "msat",
+                   "total_expense_msat": "msat", "net_msat": "msat"},
+    },
 }
 
 _PY_TYPES = {"str": "str", "int": "int", "bool": "bool", "hex": "str",

@@ -328,14 +328,12 @@ def attach_wallet_commands(rpc, wallet: OnchainWallet, hsm=None,
             raise WalletError(f"address {address} is not from this "
                               "wallet")
         key = wallet.keyman.key(idx)
+        from ..btc.tx import write_varint
 
-        def _varstr(b: bytes) -> bytes:
-            return bytes([len(b)]) if len(b) < 0xfd else \
-                b"\xfd" + len(b).to_bytes(2, "little")
-
-        payload = (_varstr(b"Bitcoin Signed Message:\n")
+        msg = message.encode()
+        payload = (write_varint(len(b"Bitcoin Signed Message:\n"))
                    + b"Bitcoin Signed Message:\n"
-                   + _varstr(message.encode()) + message.encode())
+                   + write_varint(len(msg)) + msg)
         digest = hashlib.sha256(
             hashlib.sha256(payload).digest()).digest()
         r, s = ref.ecdsa_sign(digest, key.key)

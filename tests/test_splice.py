@@ -96,3 +96,144 @@ def test_splice_in_grows_capacity(tmp_path):
             await b.close()
 
     run(body())
+
+
+def test_staged_splice_family(tmp_path):
+    """splice_init → splice_update → splice_signed: the caller brings
+    wallet inputs in a PSBT (fundpsbt), the splice parks after the
+    inflight commitments, and the signed PSBT (signpsbt) completes it
+    — the staged channeld splice RPC family over the splice engine."""
+    async def body():
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x1a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x1b" * 32, bitcoind).start()
+        try:
+            port = await b.node.listen()
+            await a.node.connect("127.0.0.1", port, b.node.node_id)
+            await rpc_call(a.rpc.rpc_path, "dev-faucet",
+                           {"satoshi": 3_000_000})
+
+            fund = asyncio.create_task(
+                a.manager.fundchannel(b.node.node_id, 1_000_000))
+            while not bitcoind.mempool and not fund.done():
+                await asyncio.sleep(0.05)
+            if bitcoind.mempool:
+                bitcoind.generate(1)
+            opened = await asyncio.wait_for(fund, 600)
+
+            # caller-built funding: wallet picks inputs + change output
+            # startweight covers the splice's non-input weight (shared
+            # funding input + funding output + common fields) so the
+            # selection leaves fee headroom past the change output
+            funded = await rpc_call(a.rpc.rpc_path, "fundpsbt", {
+                "satoshi": 300_000, "excess_as_change": True,
+                "feerate": "1000perkw", "startweight": 1000})
+            init = await rpc_call(a.rpc.rpc_path, "splice_init", {
+                "channel_id": opened["channel_id"],
+                "relative_amount": 300_000,
+                "initialpsbt": funded["psbt"]})
+            assert init["commitments_secured"]
+
+            upd = await rpc_call(a.rpc.rpc_path, "splice_update", {
+                "channel_id": opened["channel_id"]})
+            signed = await rpc_call(a.rpc.rpc_path, "signpsbt",
+                                    {"psbt": upd["psbt"]})
+            # splice_signed completes only after lock-in depth, so it
+            # must run while the test confirms the broadcast tx
+            done_task = asyncio.create_task(rpc_call(
+                a.rpc.rpc_path, "splice_signed", {
+                    "channel_id": opened["channel_id"],
+                    "psbt": signed["signed_psbt"]}))
+            for _ in range(3000):
+                if bitcoind.mempool:
+                    break
+                if done_task.done() and done_task.exception():
+                    raise done_task.exception()
+                await asyncio.sleep(0.05)
+            assert bitcoind.mempool, "splice tx never broadcast"
+            bitcoind.generate(1)
+            done = await asyncio.wait_for(done_task, 300)
+
+            for _ in range(3000):
+                chans = await rpc_call(a.rpc.rpc_path,
+                                       "listpeerchannels")
+                if chans["channels"][0]["total_msat"] \
+                        == 1_300_000_000:
+                    break
+                await asyncio.sleep(0.05)
+            assert chans["channels"][0]["total_msat"] == 1_300_000_000
+            assert chans["channels"][0]["funding_txid"] == done["txid"]
+
+            # the channel still works after the staged splice
+            inv = await rpc_call(b.rpc.rpc_path, "invoice", {
+                "amount_msat": 50_000, "label": "post-staged",
+                "description": "x"})
+            paid = await rpc_call(a.rpc.rpc_path, "pay",
+                                  {"bolt11": inv["bolt11"]})
+            assert paid["status"] == "complete"
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
+
+
+def test_spliceout_moves_funds_onchain(tmp_path):
+    """spliceout shrinks the channel and pays the removed amount
+    (minus fee) to a wallet address; balances and the chain view both
+    reflect it (plugins/splice spliceout parity)."""
+    async def body():
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x2a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x2b" * 32, bitcoind).start()
+        try:
+            port = await b.node.listen()
+            await a.node.connect("127.0.0.1", port, b.node.node_id)
+            await rpc_call(a.rpc.rpc_path, "dev-faucet",
+                           {"satoshi": 3_000_000})
+
+            fund = asyncio.create_task(
+                a.manager.fundchannel(b.node.node_id, 1_000_000))
+            while not bitcoind.mempool and not fund.done():
+                await asyncio.sleep(0.05)
+            if bitcoind.mempool:
+                bitcoind.generate(1)
+            opened = await asyncio.wait_for(fund, 600)
+
+            wallet_before = a.onchain.balance_sat()
+            out_task = asyncio.create_task(
+                a.manager.spliceout(opened["channel_id"], 400_000))
+            for _ in range(3000):
+                if bitcoind.mempool or out_task.done():
+                    break
+                await asyncio.sleep(0.05)
+            assert bitcoind.mempool, "spliceout tx never broadcast"
+            bitcoind.generate(1)
+            res = await asyncio.wait_for(out_task, 300)
+            assert res["capacity_sat"] == 600_000
+
+            chans = await rpc_call(a.rpc.rpc_path, "listpeerchannels")
+            assert chans["channels"][0]["total_msat"] == 600_000_000
+
+            # the removed coins (minus splice fee) land in our wallet
+            for _ in range(200):
+                if a.onchain.balance_sat() > wallet_before:
+                    break
+                await asyncio.sleep(0.05)
+            gained = a.onchain.balance_sat() - wallet_before
+            assert 395_000 < gained < 400_000, gained
+
+            # channel still pays after shrinking
+            inv = await rpc_call(b.rpc.rpc_path, "invoice", {
+                "amount_msat": 30_000, "label": "post-out",
+                "description": "x"})
+            paid = await rpc_call(a.rpc.rpc_path, "pay",
+                                  {"bolt11": inv["bolt11"]})
+            assert paid["status"] == "complete"
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
