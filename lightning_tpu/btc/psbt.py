@@ -29,6 +29,18 @@ PSBT_IN_FINAL_SCRIPTWITNESS = 0x08
 # output types
 PSBT_OUT_WITNESS_SCRIPT = 0x01
 
+# PSBTv2 (BIP 370): the unsigned tx is decomposed into per-field maps
+PSBT_GLOBAL_TX_VERSION = 0x02
+PSBT_GLOBAL_FALLBACK_LOCKTIME = 0x03
+PSBT_GLOBAL_INPUT_COUNT = 0x04
+PSBT_GLOBAL_OUTPUT_COUNT = 0x05
+PSBT_GLOBAL_VERSION = 0xFB
+PSBT_IN_PREVIOUS_TXID = 0x0E
+PSBT_IN_OUTPUT_INDEX = 0x0F
+PSBT_IN_SEQUENCE = 0x10
+PSBT_OUT_AMOUNT = 0x03
+PSBT_OUT_SCRIPT = 0x04
+
 
 class PsbtError(Exception):
     pass
@@ -135,6 +147,10 @@ class Psbt:
     tx: Tx
     inputs: list[PsbtInput] = field(default_factory=list)
     outputs: list[dict] = field(default_factory=list)
+    # the encoding this PSBT arrived in (0 = BIP174, 2 = BIP370);
+    # serialize() preserves it so handlers like signpsbt never
+    # silently downgrade a v2 flow
+    psbt_version: int = 0
 
     @classmethod
     def from_tx(cls, tx: Tx) -> "Psbt":
@@ -143,6 +159,11 @@ class Psbt:
                    outputs=[{} for _ in tx.outputs])
 
     def serialize(self) -> bytes:
+        if self.psbt_version == 2:
+            return self.serialize_v2()
+        return self.serialize_v0()
+
+    def serialize_v0(self) -> bytes:
         out = bytearray(MAGIC)
         _write_kv(out, bytes([PSBT_GLOBAL_UNSIGNED_TX]),
                   self.tx.serialize(include_witness=False))
@@ -157,6 +178,94 @@ class Psbt:
             out += b"\x00"
         return bytes(out)
 
+    def serialize_v2(self) -> bytes:
+        """BIP 370 (PSBTv2) encoding: no global unsigned tx — the
+        skeleton rides as per-field global/input/output entries."""
+        out = bytearray(MAGIC)
+        _write_kv(out, bytes([PSBT_GLOBAL_TX_VERSION]),
+                  self.tx.version.to_bytes(4, "little"))
+        _write_kv(out, bytes([PSBT_GLOBAL_FALLBACK_LOCKTIME]),
+                  self.tx.locktime.to_bytes(4, "little"))
+        _write_kv(out, bytes([PSBT_GLOBAL_INPUT_COUNT]),
+                  write_varint(len(self.tx.inputs)))
+        _write_kv(out, bytes([PSBT_GLOBAL_OUTPUT_COUNT]),
+                  write_varint(len(self.tx.outputs)))
+        _write_kv(out, bytes([PSBT_GLOBAL_VERSION]),
+                  (2).to_bytes(4, "little"))
+        out += b"\x00"
+        for txin, inp in zip(self.tx.inputs, self.inputs):
+            m = inp.to_map()
+            # BIP370 stores prev txid in TX-SERIALIZATION order (the
+            # reverse of our display-order TxInput.txid)
+            m[bytes([PSBT_IN_PREVIOUS_TXID])] = txin.txid[::-1]
+            m[bytes([PSBT_IN_OUTPUT_INDEX])] = \
+                txin.vout.to_bytes(4, "little")
+            m[bytes([PSBT_IN_SEQUENCE])] = \
+                txin.sequence.to_bytes(4, "little")
+            for k, v in m.items():
+                _write_kv(out, k, v)
+            out += b"\x00"
+        for txout, o in zip(self.tx.outputs, self.outputs):
+            m = dict(o)
+            m[bytes([PSBT_OUT_AMOUNT])] = \
+                txout.amount_sat.to_bytes(8, "little")
+            m[bytes([PSBT_OUT_SCRIPT])] = txout.script_pubkey
+            for k, v in m.items():
+                _write_kv(out, k, v)
+            out += b"\x00"
+        return bytes(out)
+
+    @classmethod
+    def _parse_v2(cls, raw: bytes, gmap: dict, off: int) -> "Psbt":
+        # BIP370 makes tx_version and the counts mandatory
+        for req, name in ((PSBT_GLOBAL_TX_VERSION, "tx version"),
+                          (PSBT_GLOBAL_INPUT_COUNT, "input count"),
+                          (PSBT_GLOBAL_OUTPUT_COUNT, "output count")):
+            if bytes([req]) not in gmap:
+                raise PsbtError(f"v2 psbt lacks the global {name}")
+        n_in = read_varint(
+            gmap[bytes([PSBT_GLOBAL_INPUT_COUNT])], 0)[0]
+        n_out = read_varint(
+            gmap[bytes([PSBT_GLOBAL_OUTPUT_COUNT])], 0)[0]
+        version = int.from_bytes(
+            gmap[bytes([PSBT_GLOBAL_TX_VERSION])], "little")
+        locktime = int.from_bytes(
+            gmap.get(bytes([PSBT_GLOBAL_FALLBACK_LOCKTIME]), b""),
+            "little")
+        tx = Tx(version=version, locktime=locktime)
+        inputs, outputs = [], []
+        for _ in range(n_in):
+            m, off = _read_map(raw, off)
+            prev = m.get(bytes([PSBT_IN_PREVIOUS_TXID]))
+            if prev is None:
+                raise PsbtError("v2 input lacks previous txid")
+            vout_raw = m.get(bytes([PSBT_IN_OUTPUT_INDEX]))
+            if vout_raw is None:
+                raise PsbtError("v2 input lacks output index")
+            seq = int.from_bytes(
+                m.get(bytes([PSBT_IN_SEQUENCE]),
+                      (0xFFFFFFFF).to_bytes(4, "little")), "little")
+            # stored txid is tx-serialization order; ours is display
+            tx.inputs.append(TxInput(
+                txid=prev[::-1],
+                vout=int.from_bytes(vout_raw, "little"),
+                sequence=seq))
+            inputs.append(PsbtInput.from_map(m))
+        for _ in range(n_out):
+            m, off = _read_map(raw, off)
+            amt = m.get(bytes([PSBT_OUT_AMOUNT]))
+            spk = m.get(bytes([PSBT_OUT_SCRIPT]))
+            if amt is None or spk is None:
+                raise PsbtError("v2 output lacks amount/script")
+            tx.outputs.append(TxOutput(
+                amount_sat=int.from_bytes(amt, "little"),
+                script_pubkey=spk))
+            outputs.append({k: v for k, v in m.items()
+                            if k[0] not in (PSBT_OUT_AMOUNT,
+                                            PSBT_OUT_SCRIPT)})
+        return cls(tx=tx, inputs=inputs, outputs=outputs,
+                   psbt_version=2)
+
     @classmethod
     def parse(cls, raw: bytes) -> "Psbt":
         if raw[:5] != MAGIC:
@@ -164,6 +273,10 @@ class Psbt:
         gmap, off = _read_map(raw, 5)
         txraw = gmap.get(bytes([PSBT_GLOBAL_UNSIGNED_TX]))
         if txraw is None:
+            gver = gmap.get(bytes([PSBT_GLOBAL_VERSION]))
+            if gver is not None \
+                    and int.from_bytes(gver, "little") == 2:
+                return cls._parse_v2(raw, gmap, off)
             raise PsbtError("missing unsigned tx")
         tx = Tx.parse(txraw)
         if any(i.script_sig for i in tx.inputs):

@@ -813,6 +813,10 @@ COMMANDS: dict[str, dict] = {
         "result": {"txid": "hex", "channel_id": "hex",
                    "capacity_sat": "int", "outnum": "int"},
     },
+    "setpsbtversion": {
+        "params": {"psbt": "str", "version": "int"},
+        "result": {"psbt": "str"},
+    },
     "bkpr-report": {
         "params": {"format": "str?", "headers": "bool?",
                    "escape": "str?", "start_time": "int?",

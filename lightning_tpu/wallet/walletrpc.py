@@ -347,6 +347,19 @@ def attach_wallet_commands(rpc, wallet: OnchainWallet, hsm=None,
         return {"address": address, "pubkey": key.pubkey.hex(),
                 "signature": base64.b64encode(sig65).decode()}
 
+    async def setpsbtversion(psbt: str, version: int) -> dict:
+        """Convert a PSBT between v0 (BIP174) and v2 (BIP370)
+        (walletrpc setpsbtversion)."""
+        p = Psbt.parse(base64.b64decode(psbt))
+        if int(version) == 0:
+            raw = p.serialize_v0()
+        elif int(version) == 2:
+            raw = p.serialize_v2()
+        else:
+            raise WalletError(f"unsupported psbt version {version}")
+        return {"psbt": base64.b64encode(raw).decode()}
+
+    rpc.register("setpsbtversion", setpsbtversion)
     rpc.register("signmessagewithkey", signmessagewithkey)
     rpc.register("signpsbt", signpsbt)
     rpc.register("sendpsbt", sendpsbt)

@@ -350,3 +350,66 @@ def test_signmessagewithkey(tmp_path):
     with pytest.raises(Exception):
         run(rpc.methods["signmessagewithkey"](
             "hello", "bcrt1qqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqq"))
+
+
+# -- setpsbtversion (PSBTv2 round-trip) ------------------------------------
+
+def test_psbt_v2_roundtrip():
+    from lightning_tpu.btc.psbt import Psbt
+    from lightning_tpu.btc.tx import Tx, TxInput, TxOutput
+
+    tx = Tx(version=2, locktime=500_000,
+            inputs=[TxInput(txid=b"\xaa" * 32, vout=3,
+                            sequence=0xFFFFFFFD)],
+            outputs=[TxOutput(amount_sat=12_345,
+                              script_pubkey=b"\x00\x14" + b"\xbb" * 20)])
+    p = Psbt.from_tx(tx)
+    p.inputs[0].witness_utxo = TxOutput(
+        amount_sat=20_000, script_pubkey=b"\x00\x14" + b"\xcc" * 20)
+
+    v2 = p.serialize_v2()
+    # BIP370 stores the prev txid in tx-serialization order — the
+    # REVERSE of the display-order bytes our TxInput carries (interop:
+    # Core/CLN would read a nonexistent outpoint otherwise)
+    tx2 = Tx(version=2,
+             inputs=[TxInput(txid=bytes(range(32)), vout=1)])
+    enc = Psbt.from_tx(tx2).serialize_v2()
+    assert bytes(range(32))[::-1] in enc
+    assert bytes(range(32)) not in enc
+
+    back = Psbt.parse(v2)
+    assert back.tx.serialize(False) == tx.serialize(False)
+    assert back.inputs[0].witness_utxo.amount_sat == 20_000
+    assert back.psbt_version == 2
+    # a v2-parsed psbt re-serializes as v2 (no silent downgrade)
+    assert Psbt.parse(back.serialize()).psbt_version == 2
+    assert Psbt.parse(v2).serialize_v2() == v2
+    # explicit downgrade still available
+    assert Psbt.parse(back.serialize_v0()).tx.txid() == tx.txid()
+
+
+def test_setpsbtversion_rpc(tmp_path):
+    import base64
+
+    from lightning_tpu.btc.bip32 import ExtKey
+    from lightning_tpu.btc.psbt import Psbt
+    from lightning_tpu.btc.tx import Tx, TxInput
+    from lightning_tpu.wallet.onchain import KeyManager, OnchainWallet
+    from lightning_tpu.wallet.walletrpc import attach_wallet_commands
+
+    db = Db(str(tmp_path / "w2.sqlite3"))
+    wallet = OnchainWallet(
+        db, KeyManager(ExtKey.from_seed(b"\x52" * 32), db))
+    rpc = FakeRpc()
+    attach_wallet_commands(rpc, wallet)
+    p0 = base64.b64encode(Psbt.from_tx(Tx(
+        version=2,
+        inputs=[TxInput(txid=b"\x11" * 32, vout=0)])).serialize()
+    ).decode()
+    v2 = run(rpc.methods["setpsbtversion"](p0, 2))["psbt"]
+    assert base64.b64decode(v2)[:5] == base64.b64decode(p0)[:5]
+    v0 = run(rpc.methods["setpsbtversion"](v2, 0))["psbt"]
+    assert Psbt.parse(base64.b64decode(v0)).tx.inputs[0].txid \
+        == b"\x11" * 32
+    with pytest.raises(Exception):
+        run(rpc.methods["setpsbtversion"](p0, 3))
