@@ -127,17 +127,26 @@ def _tlv_entries(tlvs: dict[int, bytes]) -> list[tuple[int, bytes]]:
             for t, v in sorted(tlvs.items())]
 
 
-def merkle_root(tlvs: dict[int, bytes]) -> bytes:
+def _leaf_level(tlvs: dict[int, bytes]) -> list[tuple[int, bytes,
+                                                      bytes, bytes]]:
+    """Shared leaf construction for merkle_root AND merkle_path (the
+    derivation is spec-sensitive — one copy only): returns
+    [(type, wire, nonce_hash, level0_node)] for every signed field."""
     entries = [(t, w) for t, w in _tlv_entries(tlvs)
                if not (SIGNATURE <= t <= 1000)]
     if not entries:
         raise Bolt12Error("no fields to sign")
     first_tlv = entries[0][1]
-    level = []
+    out = []
     for t, wire in entries:
         leaf = _H(b"LnLeaf", wire)
         nonce = _H(b"LnNonce" + first_tlv, write_bigsize(t))
-        level.append(_branch(leaf, nonce))
+        out.append((t, wire, nonce, _branch(leaf, nonce)))
+    return out
+
+
+def merkle_root(tlvs: dict[int, bytes]) -> bytes:
+    level = [node for _t, _w, _n, node in _leaf_level(tlvs)]
     while len(level) > 1:
         nxt = [_branch(level[i], level[i + 1])
                for i in range(0, len(level) - 1, 2)]
@@ -145,6 +154,50 @@ def merkle_root(tlvs: dict[int, bytes]) -> bytes:
             nxt.append(level[-1])
         level = nxt
     return level[0]
+
+
+def merkle_path(tlvs: dict[int, bytes],
+                field_type: int) -> tuple[bytes, bytes, list[bytes]]:
+    """Inclusion proof for ONE TLV under the signature merkle root
+    (createproof's evidence format): returns (leaf_wire, nonce_hash,
+    siblings).  A verifier recomputes
+    fold(_branch(H(LnLeaf, leaf_wire), nonce_hash), siblings) and
+    compares it to the root the invoice signature covers — proving the
+    field value belongs to the signed invoice without revealing the
+    other fields."""
+    leaves = _leaf_level(tlvs)
+    level, idx, my_wire, my_nonce = [], None, b"", b""
+    for i, (t, wire, nonce, node) in enumerate(leaves):
+        if t == field_type:
+            idx, my_wire, my_nonce = i, wire, nonce
+        level.append(node)
+    if idx is None:
+        raise Bolt12Error(f"field {field_type} not present")
+    sibs: list[bytes] = []
+    while len(level) > 1:
+        nxt, new_idx = [], idx
+        for i in range(0, len(level) - 1, 2):
+            if idx in (i, i + 1):
+                sibs.append(level[i + 1] if idx == i else level[i])
+                new_idx = len(nxt)
+            nxt.append(_branch(level[i], level[i + 1]))
+        if len(level) % 2:
+            if idx == len(level) - 1:
+                new_idx = len(nxt)
+            nxt.append(level[-1])
+        idx, level = new_idx, nxt
+    return my_wire, my_nonce, sibs
+
+
+def verify_merkle_path(root: bytes, leaf_wire: bytes, nonce_hash: bytes,
+                       siblings: list[bytes]) -> bool:
+    """Check a merkle_path proof against the signed root.  _branch
+    sorts its operands, so sibling ORDER along the path is all the
+    proof needs to carry."""
+    h = _branch(_H(b"LnLeaf", leaf_wire), nonce_hash)
+    for s in siblings:
+        h = _branch(h, s)
+    return h == root
 
 
 def sig_hash(messagename: str, fieldname: str, tlvs: dict[int, bytes]) -> bytes:

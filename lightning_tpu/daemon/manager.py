@@ -1934,6 +1934,101 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     async def splice_signed(channel_id: str, psbt: str) -> dict:
         return await mgr.openchannel_signed(channel_id, psbt)
 
+    async def createproof(invstring: str,
+                          note: str | None = None) -> dict:
+        """Proof(s) that WE paid a bolt12 invoice (createproof.json,
+        draft format): the settled preimage plus merkle inclusion
+        proofs tying payment_hash/amount to the payee-signed invoice
+        root, so a verifier needs only this proof and `decode`."""
+        from ..bolt import bolt12 as B12
+
+        if mgr.wallet is None:
+            raise ManagerError("createproof needs the payment db")
+        hrp, raw = B12.decode_string(invstring)
+        # each target carries (lni_string, raw_tlv_bytes, Invoice12):
+        # the merkle work MUST run over the RAW wire TLVs — the typed
+        # model drops unknown odd TLVs it is required to accept, and a
+        # root over the lossy reconstruction would not match what the
+        # payee actually signed
+        targets: list[tuple[str, bytes, object]] = []
+        if hrp == "lni":
+            targets = [(invstring, raw, B12.Invoice12.parse(raw))]
+        elif hrp == "lno":
+            want = B12.Offer.decode(invstring).offer_id()
+
+            def _scan():
+                hits = []
+                for (b12,) in mgr.wallet.db.conn.execute(
+                        "SELECT bolt11 FROM payments WHERE "
+                        "status='complete' AND bolt11 LIKE 'lni1%'"):
+                    try:
+                        r2 = B12.decode_string(b12)[1]
+                        inv = B12.Invoice12.parse(r2)
+                        if inv.invreq.offer.offer_id() == want:
+                            hits.append((b12, r2, inv))
+                    except Exception:
+                        continue
+                return hits
+
+            # decoding every settled bolt12 payment is O(payments):
+            # keep it off the event loop
+            targets = await asyncio.to_thread(_scan)
+        else:
+            raise ManagerError(f"cannot prove payments to {hrp!r}")
+        proofs = []
+        for lni, raw_inv, inv in targets:
+            tlvs = B12.read_tlv_stream(raw_inv)
+            # the signature check must run over the RAW tlvs too —
+            # checking the lossy model would reject invoices carrying
+            # TLVs the model drops (an unsigned invoice proves nothing)
+            if inv.signature is None or not B12.check_signature(
+                    "invoice", tlvs, inv.node_id):
+                continue
+            row = mgr.wallet.db.conn.execute(
+                "SELECT preimage FROM payments WHERE payment_hash=?"
+                " AND status='complete' AND preimage IS NOT NULL",
+                (inv.payment_hash,)).fetchone()
+            if row is None:
+                continue
+            root = B12.merkle_root(tlvs)
+            field_proofs = {}
+            for name, ftype in (("payment_hash", 168),
+                                ("amount_msat", 170),
+                                ("node_id", 176)):
+                wire, nonce, sibs = B12.merkle_path(tlvs, ftype)
+                field_proofs[name] = {
+                    "leaf_wire": wire.hex(), "nonce": nonce.hex(),
+                    "path": [s.hex() for s in sibs]}
+            proof = {
+                "invoice": lni,
+                "payment_preimage": bytes(row[0]).hex(),
+                "payment_hash": inv.payment_hash.hex(),
+                "payee": inv.node_id.hex(),
+                "merkle_root": root.hex(),
+                "signature": inv.signature.hex(),
+                "field_proofs": field_proofs,
+            }
+            if note is not None:
+                # challenger-supplied note, signed with OUR node key.
+                # Domain-separated and length-prefixed: the signed text
+                # can never read as a free-standing attestation, and
+                # the (note, preimage) boundary is unambiguous
+                from ..utils import zbase32 as Z
+
+                signed_text = (f"bolt12 createproof:{len(note)}:"
+                               f"{note}:{proof['payment_preimage']}")
+                zb, _s, _r = Z.sign_message(signed_text,
+                                            mgr.hsm.node_key)
+                proof["note"] = note
+                proof["note_signature"] = zb
+                proof["note_signed_text"] = signed_text
+            proofs.append(proof)
+        if not proofs:
+            raise ManagerError(
+                "no settled payment found for that invoice/offer")
+        return {"proofs": proofs}
+
+    rpc.register("createproof", createproof)
     rpc.register("splice_init", splice_init)
     rpc.register("splice_update", splice_update)
     rpc.register("splice_signed", splice_signed)

@@ -813,6 +813,10 @@ COMMANDS: dict[str, dict] = {
         "result": {"txid": "hex", "channel_id": "hex",
                    "capacity_sat": "int", "outnum": "int"},
     },
+    "createproof": {
+        "params": {"invstring": "str", "note": "str?"},
+        "result": {"proofs": "list"},
+    },
     "setpsbtversion": {
         "params": {"psbt": "str", "version": "int"},
         "result": {"psbt": "str"},

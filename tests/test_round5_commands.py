@@ -413,3 +413,118 @@ def test_setpsbtversion_rpc(tmp_path):
         == b"\x11" * 32
     with pytest.raises(Exception):
         run(rpc.methods["setpsbtversion"](p0, 3))
+
+
+# -- createproof (bolt12 payment proofs) -----------------------------------
+
+def test_createproof_and_merkle_paths(tmp_path):
+    import hashlib
+
+    from lightning_tpu.bolt import bolt12 as B12
+    from lightning_tpu.crypto import ref_python as ref
+    from lightning_tpu.daemon.hsmd import Hsm
+    from lightning_tpu.daemon.manager import (ChannelManager,
+                                              attach_manager_commands)
+    from lightning_tpu.wallet.wallet import Wallet
+
+    def key(i):
+        return int.from_bytes(
+            hashlib.sha256(bytes([i]) * 4).digest(), "big") % ref.N
+
+    def pub(i):
+        return ref.pubkey_serialize(ref.pubkey_create(key(i)))
+
+    offer = B12.Offer(description="coffee", amount_msat=5000,
+                      issuer="cafe", issuer_id=pub(50))
+    req = B12.InvoiceRequest(offer=offer, metadata=b"k" * 16,
+                             payer_id=pub(61))
+    req.sign(key(61))
+    preimage = b"p" * 32
+    inv = B12.Invoice12(
+        invreq=req, payment_hash=hashlib.sha256(preimage).digest(),
+        amount_msat=5000, node_id=pub(50), created_at=1_700_000_000)
+    inv.sign(key(50))
+    lni = inv.encode()
+
+    # merkle inclusion proofs verify against the signed root
+    tlvs = inv.tlvs()
+    root = B12.merkle_root(tlvs)
+    for ftype in (168, 170, 176):
+        wire, nonce, sibs = B12.merkle_path(tlvs, ftype)
+        assert B12.verify_merkle_path(root, wire, nonce, sibs)
+        assert not B12.verify_merkle_path(root, wire + b"x", nonce, sibs)
+
+    # a settled payment row makes createproof produce a full proof
+    db = Db(str(tmp_path / "p.sqlite3"))
+    with db.transaction():
+        db.conn.execute(
+            "INSERT INTO payments (payment_hash, destination,"
+            " amount_msat, amount_sent_msat, bolt11, status, preimage,"
+            " created_at) VALUES (?,?,?,?,?,?,?,?)",
+            (inv.payment_hash, pub(50), 5000, 5000, lni, "complete",
+             preimage, 1))
+    mgr = ChannelManager(None, Hsm(b"\x61" * 32), wallet=Wallet(db))
+    rpc = FakeRpc()
+    attach_manager_commands(rpc, mgr)
+
+    # by invoice AND by offer both find the settled payment
+    for query in (lni, offer.encode()):
+        res = run(rpc.methods["createproof"](query, note="challenge-1"))
+        proof = res["proofs"][0]
+        assert proof["payment_preimage"] == preimage.hex()
+        assert proof["merkle_root"] == root.hex()
+        assert proof["note"] == "challenge-1"
+        fp = proof["field_proofs"]["amount_msat"]
+        assert B12.verify_merkle_path(
+            root, bytes.fromhex(fp["leaf_wire"]),
+            bytes.fromhex(fp["nonce"]),
+            [bytes.fromhex(s) for s in fp["path"]])
+
+    # an unpaid invoice yields no proof
+    inv2 = B12.Invoice12(
+        invreq=req, payment_hash=b"\x42" * 32, amount_msat=5000,
+        node_id=pub(50), created_at=1_700_000_001)
+    inv2.sign(key(50))
+    with pytest.raises(Exception, match="no settled"):
+        run(rpc.methods["createproof"](inv2.encode()))
+
+    # an invoice carrying an unknown odd TLV (which BOLT12 requires
+    # accepting, and the typed model drops) must still produce proofs
+    # that match the SIGNED root — the merkle work runs on raw TLVs
+    pre3 = b"q" * 32
+    t3 = inv.tlvs(with_sig=False)
+    t3[hashlib.sha256(b"").digest()[0] | 1] = b"experimental"  # odd
+    t3[168] = hashlib.sha256(pre3).digest()
+    t3[B12.SIGNATURE] = B12.sign("invoice", t3, key(50))
+    lni3 = B12.encode_string("lni", B12.write_tlv_stream(t3))
+    with db.transaction():
+        db.conn.execute(
+            "INSERT INTO payments (payment_hash, destination,"
+            " amount_msat, amount_sent_msat, bolt11, status, preimage,"
+            " created_at) VALUES (?,?,?,?,?,?,?,?)",
+            (t3[168], pub(50), 5000, 5000, lni3, "complete", pre3, 2))
+    res3 = run(rpc.methods["createproof"](lni3))
+    p3 = res3["proofs"][0]
+    raw3 = B12.decode_string(lni3)[1]
+    assert p3["merkle_root"] == B12.merkle_root(
+        B12.read_tlv_stream(raw3)).hex()
+    fp3 = p3["field_proofs"]["payment_hash"]
+    assert B12.verify_merkle_path(
+        bytes.fromhex(p3["merkle_root"]),
+        bytes.fromhex(fp3["leaf_wire"]), bytes.fromhex(fp3["nonce"]),
+        [bytes.fromhex(s) for s in fp3["path"]])
+
+    # an unsigned invoice can prove nothing
+    t4 = dict(t3)
+    t4.pop(B12.SIGNATURE)
+    t4[168] = hashlib.sha256(b"r" * 32).digest()
+    lni4 = B12.encode_string("lni", B12.write_tlv_stream(t4))
+    with db.transaction():
+        db.conn.execute(
+            "INSERT INTO payments (payment_hash, destination,"
+            " amount_msat, amount_sent_msat, bolt11, status, preimage,"
+            " created_at) VALUES (?,?,?,?,?,?,?,?)",
+            (t4[168], pub(50), 5000, 5000, lni4, "complete",
+             b"r" * 32, 3))
+    with pytest.raises(Exception, match="no settled"):
+        run(rpc.methods["createproof"](lni4))
