@@ -56,10 +56,25 @@ class Plugin:
         self.alive = False
 
     async def start(self) -> None:
+        # plugins written against libplugin must be able to import
+        # lightning_tpu from ANY install location (e.g. a reckless dir
+        # under the node's data-dir) — a script's sys.path only has its
+        # own directory, so export our package root to the child
+        import lightning_tpu as _pkg
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(_pkg.__file__)))
+        env = dict(os.environ)
+        existing = env.get("PYTHONPATH")
+        # never leave a trailing separator: an empty PYTHONPATH entry
+        # means "cwd", silently injecting the daemon's cwd into every
+        # plugin's sys.path
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
+                             if existing else pkg_root)
         self.proc = await asyncio.create_subprocess_exec(
             self.path, stdin=asyncio.subprocess.PIPE,
             stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.DEVNULL)
+            stderr=asyncio.subprocess.DEVNULL, env=env)
         self.alive = True
         self._reader_task = asyncio.get_running_loop().create_task(
             self._read_loop())

@@ -26,7 +26,10 @@ run_slice() {
   local name="$1"; shift
   local attempt rc f
   for attempt in 1 2; do
-    python -m pytest "$@" -x -q && return 0
+    # slice-level hang guard: a test blocking on a silent daemon must
+    # never stall the suite for hours (timeout exits 124 < 128, which
+    # the crash-retry below correctly treats as a failure, not a crash)
+    timeout 3600 python -m pytest "$@" -x -q && return 0
     rc=$?
     if [ "$rc" -lt 128 ]; then
       echo "slice $name failed rc=$rc (test failure, not retried)"
@@ -37,10 +40,12 @@ run_slice() {
   done
   # an executable whose WRITE crashes re-crashes on every whole-slice
   # retry; every file is known to pass in a fresh process, so finish
-  # the slice file-per-process (slower: ~20 s jax startup per file)
+  # the slice file-per-process (slower: ~20 s jax startup per file).
+  # Per-file timeout: one hanging test (e.g. a readline on a silent
+  # daemon) must never stall the whole suite for hours.
   echo "slice $name: falling back to file-per-process"
   for f in "$@"; do
-    python -m pytest "$f" -x -q || { rc=$?;
+    timeout 900 python -m pytest "$f" -x -q || { rc=$?;
       echo "slice $name: $f failed rc=$rc"; return "$rc"; }
   done
   return 0
