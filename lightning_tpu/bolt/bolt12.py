@@ -156,37 +156,52 @@ def merkle_root(tlvs: dict[int, bytes]) -> bytes:
     return level[0]
 
 
-def merkle_path(tlvs: dict[int, bytes],
-                field_type: int) -> tuple[bytes, bytes, list[bytes]]:
-    """Inclusion proof for ONE TLV under the signature merkle root
-    (createproof's evidence format): returns (leaf_wire, nonce_hash,
-    siblings).  A verifier recomputes
+def merkle_paths(tlvs: dict[int, bytes], field_types: list[int],
+                 ) -> tuple[bytes, dict[int, tuple[bytes, bytes,
+                                                   list[bytes]]]]:
+    """Inclusion proofs for several TLVs from ONE tree construction
+    (createproof's evidence format): returns (root, {field_type:
+    (leaf_wire, nonce_hash, siblings)}).  A verifier recomputes
     fold(_branch(H(LnLeaf, leaf_wire), nonce_hash), siblings) and
     compares it to the root the invoice signature covers — proving the
     field value belongs to the signed invoice without revealing the
     other fields."""
     leaves = _leaf_level(tlvs)
-    level, idx, my_wire, my_nonce = [], None, b"", b""
-    for i, (t, wire, nonce, node) in enumerate(leaves):
-        if t == field_type:
-            idx, my_wire, my_nonce = i, wire, nonce
-        level.append(node)
-    if idx is None:
-        raise Bolt12Error(f"field {field_type} not present")
-    sibs: list[bytes] = []
+    level = [node for _t, _w, _n, node in leaves]
+    track: dict[int, dict] = {}
+    for want in field_types:
+        for i, (t, wire, nonce, _node) in enumerate(leaves):
+            if t == want:
+                track[want] = {"idx": i, "wire": wire,
+                               "nonce": nonce, "sibs": []}
+                break
+        else:
+            raise Bolt12Error(f"field {want} not present")
     while len(level) > 1:
-        nxt, new_idx = [], idx
+        nxt = []
+        positions = {w: tr["idx"] for w, tr in track.items()}
         for i in range(0, len(level) - 1, 2):
-            if idx in (i, i + 1):
-                sibs.append(level[i + 1] if idx == i else level[i])
-                new_idx = len(nxt)
+            for w, idx in positions.items():
+                if idx in (i, i + 1):
+                    track[w]["sibs"].append(
+                        level[i + 1] if idx == i else level[i])
+                    track[w]["idx"] = len(nxt)
             nxt.append(_branch(level[i], level[i + 1]))
         if len(level) % 2:
-            if idx == len(level) - 1:
-                new_idx = len(nxt)
+            for w, idx in positions.items():
+                if idx == len(level) - 1:
+                    track[w]["idx"] = len(nxt)
             nxt.append(level[-1])
-        idx, level = new_idx, nxt
-    return my_wire, my_nonce, sibs
+        level = nxt
+    return level[0], {w: (tr["wire"], tr["nonce"], tr["sibs"])
+                      for w, tr in track.items()}
+
+
+def merkle_path(tlvs: dict[int, bytes],
+                field_type: int) -> tuple[bytes, bytes, list[bytes]]:
+    """Single-field convenience wrapper over merkle_paths."""
+    _root, paths = merkle_paths(tlvs, [field_type])
+    return paths[field_type]
 
 
 def verify_merkle_path(root: bytes, leaf_wire: bytes, nonce_hash: bytes,

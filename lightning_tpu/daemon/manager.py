@@ -1990,12 +1990,14 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
                 (inv.payment_hash,)).fetchone()
             if row is None:
                 continue
-            root = B12.merkle_root(tlvs)
+            # one tree construction yields the root AND all paths
+            fields = (("payment_hash", 168), ("amount_msat", 170),
+                      ("node_id", 176))
+            root, paths = B12.merkle_paths(
+                tlvs, [t for _, t in fields])
             field_proofs = {}
-            for name, ftype in (("payment_hash", 168),
-                                ("amount_msat", 170),
-                                ("node_id", 176)):
-                wire, nonce, sibs = B12.merkle_path(tlvs, ftype)
+            for name, ftype in fields:
+                wire, nonce, sibs = paths[ftype]
                 field_proofs[name] = {
                     "leaf_wire": wire.hex(), "nonce": nonce.hex(),
                     "path": [s.hex() for s in sibs]}
