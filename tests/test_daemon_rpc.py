@@ -264,3 +264,50 @@ def test_keysend_and_listhtlcs(tmp_path):
             await b.close()
 
     run(body())
+
+
+def test_sendamount_fixed_total(tmp_path):
+    """sendamount spends a FIXED total against an amount-less invoice:
+    for a direct peer the fee is zero, so the destination receives
+    exactly the amount (sendamount.json semantics)."""
+    async def body():
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x3a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x3b" * 32, bitcoind).start()
+        try:
+            port = await b.node.listen()
+            info_b = await rpc_call(b.rpc.rpc_path, "getinfo")
+            await rpc_call(a.rpc.rpc_path, "connect", {
+                "id": f"{info_b['id']}@127.0.0.1:{port}"})
+            await rpc_call(a.rpc.rpc_path, "dev-faucet",
+                           {"satoshi": 2_000_000})
+            fund = asyncio.create_task(rpc_call(a.rpc.rpc_path,
+                                                "fundchannel", {
+                "id": info_b["id"], "amount": 1_000_000}))
+            while not bitcoind.mempool and not fund.done():
+                await asyncio.sleep(0.05)
+            if bitcoind.mempool:
+                bitcoind.generate(1)
+            await asyncio.wait_for(fund, 600)
+
+            inv = await rpc_call(b.rpc.rpc_path, "invoice", {
+                "amount_msat": "any", "label": "open-amt",
+                "description": "fixed-total"})
+            sent = await rpc_call(a.rpc.rpc_path, "sendamount", {
+                "invstring": inv["bolt11"],
+                "amount_msat": 7_000_000, "retry_for": 300})
+            assert sent["amount_msat"] == 7_000_000
+            assert sent["amount_sent_msat"] == 7_000_000  # direct: no fee
+            for _ in range(200):
+                chans_b = await rpc_call(b.rpc.rpc_path,
+                                         "listpeerchannels")
+                if chans_b["channels"][0]["to_us_msat"] == 7_000_000:
+                    break
+                await asyncio.sleep(0.1)
+            assert chans_b["channels"][0]["to_us_msat"] == 7_000_000
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())

@@ -12,7 +12,8 @@
 # BEFORE a crash persists, so rerunning a crashed slice starts warmer
 # and ratchets past the crash point; a fully-warm run performs no
 # writes at all and cannot hit the bug.  Test FAILURES (rc 1) are
-# never retried — only crash exits (≥128).
+# never retried — only crash exits (≥128) and slice timeouts (124,
+# which a cold cache can cause legitimately).
 #
 # NOTE: do NOT run anything else that touches the jax compilation
 # cache concurrently — concurrent writers corrupt entries (readers
@@ -27,8 +28,8 @@ run_slice() {
   local attempt rc f
   for attempt in 1 2; do
     # slice-level hang guard: a test blocking on a silent daemon must
-    # never stall the suite for hours (timeout exits 124 < 128, which
-    # the crash-retry below correctly treats as a failure, not a crash)
+    # never stall the suite for hours; a timeout (rc 124) retries like
+    # a crash because a cold cache can legitimately blow the budget
     timeout 3600 python -m pytest "$@" -x -q && return 0
     rc=$?
     # 124 (slice timeout) retries like a crash: a COLD cache can
