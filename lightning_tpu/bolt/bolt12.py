@@ -59,6 +59,20 @@ INVOICE_FALLBACKS = 172
 INVOICE_FEATURES = 174
 INVOICE_NODE_ID = 176
 
+# BOLT#12 recurrence draft (wire numbers from the spec's experimental
+# ranges; offer fields mirror into the invreq/invoice like the rest)
+OFFER_RECURRENCE = 1000000025            # recurrence{time_unit, period}
+OFFER_RECURRENCE_LIMIT = 1000000029      # max_period_index tu32
+INVREQ_RECURRENCE_COUNTER = 2000000092   # tu32
+INVREQ_RECURRENCE_START = 2000000093     # tu32 period offset
+INVREQ_RECURRENCE_CANCEL = 2000000094    # presence = stop recurring
+INVOICE_RECURRENCE_BASETIME = 3000000177  # tu64
+
+# seconds per recurrence time_unit (draft: 0=seconds, 1=days,
+# 2=months≈30d, 3=years≈365d — calendar math approximated)
+RECURRENCE_UNIT_SECONDS = {0: 1, 1: 86_400, 2: 30 * 86_400,
+                           3: 365 * 86_400}
+
 DEFAULT_INVOICE_EXPIRY = 7200
 
 
@@ -279,6 +293,9 @@ class Offer:
     absolute_expiry: int | None = None
     quantity_max: int | None = None
     paths: list[BlindedPath] = field(default_factory=list)
+    # recurrence draft: (time_unit, period) makes the offer repeat
+    recurrence: tuple[int, int] | None = None
+    recurrence_limit: int | None = None   # last valid period index
 
     def tlvs(self) -> dict[int, bytes]:
         t: dict[int, bytes] = {}
@@ -306,6 +323,11 @@ class Offer:
             t[OFFER_QUANTITY_MAX] = _tu(self.quantity_max)
         if self.issuer_id is not None:
             t[OFFER_ISSUER_ID] = self.issuer_id
+        if self.recurrence is not None:
+            unit, period = self.recurrence
+            t[OFFER_RECURRENCE] = bytes([unit]) + _tu(period)
+        if self.recurrence_limit is not None:
+            t[OFFER_RECURRENCE_LIMIT] = _tu(self.recurrence_limit)
         return t
 
     @classmethod
@@ -331,6 +353,13 @@ class Offer:
         if OFFER_QUANTITY_MAX in t:
             o.quantity_max = _tu_read(t[OFFER_QUANTITY_MAX])
         o.issuer_id = t.get(OFFER_ISSUER_ID)
+        if OFFER_RECURRENCE in t:
+            v = t[OFFER_RECURRENCE]
+            if not v:
+                raise Bolt12Error("empty recurrence")
+            o.recurrence = (v[0], _tu_read(v[1:]))
+        if OFFER_RECURRENCE_LIMIT in t:
+            o.recurrence_limit = _tu_read(t[OFFER_RECURRENCE_LIMIT])
         return o
 
     def offer_id(self) -> bytes:
@@ -365,6 +394,10 @@ class InvoiceRequest:
     payer_note: str | None = None
     features: bytes = b""
     signature: bytes | None = None
+    # recurrence draft: which period this request pays for
+    recurrence_counter: int | None = None
+    recurrence_start: int | None = None
+    recurrence_cancel: bool = False       # stop the recurrence instead
 
     def tlvs(self, with_sig: bool = True) -> dict[int, bytes]:
         t = self.offer.tlvs()
@@ -380,6 +413,12 @@ class InvoiceRequest:
         t[INVREQ_PAYER_ID] = self.payer_id
         if self.payer_note is not None:
             t[INVREQ_PAYER_NOTE] = self.payer_note.encode()
+        if self.recurrence_counter is not None:
+            t[INVREQ_RECURRENCE_COUNTER] = _tu(self.recurrence_counter)
+        if self.recurrence_start is not None:
+            t[INVREQ_RECURRENCE_START] = _tu(self.recurrence_start)
+        if self.recurrence_cancel:
+            t[INVREQ_RECURRENCE_CANCEL] = b""
         if with_sig and self.signature is not None:
             t[SIGNATURE] = self.signature
         return t
@@ -402,7 +441,9 @@ class InvoiceRequest:
     @classmethod
     def from_tlvs(cls, t: dict[int, bytes]) -> "InvoiceRequest":
         offer = Offer.from_tlvs(
-            {k: v for k, v in t.items() if 1 <= k <= 79})
+            {k: v for k, v in t.items()
+             if 1 <= k <= 79
+             or 1_000_000_000 <= k < 2_000_000_000})
         r = cls(offer=offer,
                 metadata=t.get(INVREQ_METADATA, b""),
                 payer_id=t.get(INVREQ_PAYER_ID, b""))
@@ -414,6 +455,11 @@ class InvoiceRequest:
             r.quantity = _tu_read(t[INVREQ_QUANTITY])
         if INVREQ_PAYER_NOTE in t:
             r.payer_note = t[INVREQ_PAYER_NOTE].decode()
+        if INVREQ_RECURRENCE_COUNTER in t:
+            r.recurrence_counter = _tu_read(t[INVREQ_RECURRENCE_COUNTER])
+        if INVREQ_RECURRENCE_START in t:
+            r.recurrence_start = _tu_read(t[INVREQ_RECURRENCE_START])
+        r.recurrence_cancel = INVREQ_RECURRENCE_CANCEL in t
         r.signature = t.get(SIGNATURE)
         return r
 
@@ -454,6 +500,21 @@ class InvoiceRequest:
         if (offer.absolute_expiry is not None
                 and time.time() > offer.absolute_expiry):
             raise Bolt12Error("offer expired")
+        # recurrence draft rules: a recurring offer demands a counter;
+        # a non-recurring one forbids the recurrence fields entirely
+        if offer.recurrence is not None:
+            if self.recurrence_counter is None:
+                raise Bolt12Error(
+                    "recurring offer needs invreq_recurrence_counter")
+            if offer.recurrence_limit is not None \
+                    and self.recurrence_counter > offer.recurrence_limit:
+                raise Bolt12Error("recurrence_counter past the limit")
+        else:
+            if (self.recurrence_counter is not None
+                    or self.recurrence_start is not None
+                    or self.recurrence_cancel):
+                raise Bolt12Error(
+                    "recurrence fields on a non-recurring offer")
 
 
 @dataclass
@@ -471,6 +532,8 @@ class Invoice12:
     features: bytes = b""
     fallbacks: bytes | None = None
     signature: bytes | None = None
+    # recurrence draft: anchors period arithmetic for the whole chain
+    recurrence_basetime: int | None = None
 
     def tlvs(self, with_sig: bool = True) -> dict[int, bytes]:
         t = self.invreq.tlvs()             # includes invreq signature (240)?
@@ -495,6 +558,8 @@ class Invoice12:
         if self.features:
             t[INVOICE_FEATURES] = self.features
         t[INVOICE_NODE_ID] = self.node_id
+        if self.recurrence_basetime is not None:
+            t[INVOICE_RECURRENCE_BASETIME] = _tu(self.recurrence_basetime)
         if with_sig and self.signature is not None:
             t[SIGNATURE] = self.signature
         return t
@@ -521,13 +586,19 @@ class Invoice12:
 
     @classmethod
     def from_tlvs(cls, t: dict[int, bytes]) -> "Invoice12":
+        # invreq fields: the classic <160 range PLUS the experimental
+        # offer (1e9) and invreq (2e9) ranges the recurrence draft uses
         invreq = InvoiceRequest.from_tlvs(
-            {k: v for k, v in t.items() if k < 160})
+            {k: v for k, v in t.items()
+             if k < 160 or 1_000_000_000 <= k < 3_000_000_000})
         inv = cls(invreq=invreq,
                   payment_hash=t.get(INVOICE_PAYMENT_HASH, b""),
                   amount_msat=_tu_read(t.get(INVOICE_AMOUNT, b"")),
                   node_id=t.get(INVOICE_NODE_ID, b""),
                   created_at=_tu_read(t.get(INVOICE_CREATED_AT, b"")))
+        if INVOICE_RECURRENCE_BASETIME in t:
+            inv.recurrence_basetime = _tu_read(
+                t[INVOICE_RECURRENCE_BASETIME])
         if INVOICE_RELATIVE_EXPIRY in t:
             inv.relative_expiry = _tu_read(t[INVOICE_RELATIVE_EXPIRY])
         if INVOICE_PATHS in t:
@@ -569,10 +640,15 @@ class Invoice12:
             raise Bolt12Error("bad payment_hash")
         mine = invreq.tlvs()
         mine.pop(SIGNATURE, None)
-        theirs = {k: v for k, v in self.tlvs().items() if k < 160}
+        theirs = {k: v for k, v in self.tlvs().items()
+                  if k < 160 or 1_000_000_000 <= k < 3_000_000_000}
         theirs.pop(SIGNATURE, None)
         if mine != theirs:
             raise Bolt12Error("invoice does not mirror invoice_request")
+        if invreq.offer.recurrence is not None \
+                and self.recurrence_basetime is None:
+            # BOLT-recurrence #12: period arithmetic is anchored here
+            raise Bolt12Error("recurring invoice lacks basetime")
         offer = invreq.offer
         if offer.issuer_id is not None:
             # Invoice must be signed by the issuer key UNCONDITIONALLY —

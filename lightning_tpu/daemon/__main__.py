@@ -207,7 +207,7 @@ async def amain(args) -> int:
     offer_reg = OfferRegistry(db)
     invoices = InvoiceRegistry(node_seckey, db=db)
     offers_svc = OffersService(messenger, offer_reg, invoices, node_seckey)
-    fetcher = FetchInvoice(messenger, node_seckey)
+    fetcher = FetchInvoice(messenger, node_seckey, db=db)
 
     # channel manager: live channel registry + fundchannel/pay/close RPC
     manager = None

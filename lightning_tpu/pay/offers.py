@@ -179,16 +179,54 @@ class OffersService:
         self.registry = registry
         self.invoices = invoices            # InvoiceRegistry
         self.node_seckey = node_seckey
+        # recurrence draft: (offer_id, payer_id) -> {"next": counter,
+        # "basetime": unix} — one chain per payer per recurring offer,
+        # persisted beside the invoices so a restart cannot strand a
+        # subscription mid-chain
+        self._recurrences: dict[tuple[bytes, bytes], dict] = \
+            self._load_recurrences()
         messenger.register_content(OM.INVOICE_REQUEST, self._on_invreq)
         invoices.on_bolt12_paid = self.on_invoice_paid
+
+    def _load_recurrences(self) -> dict:
+        import json
+
+        db = getattr(self.invoices, "db", None)
+        if db is None:
+            return {}
+        raw = db.get_var("bolt12_recurrences")
+        if not raw:
+            return {}
+        return {(bytes.fromhex(i["offer_id"]),
+                 bytes.fromhex(i["payer_id"])):
+                {"next": i["next"], "basetime": i["basetime"]}
+                for i in json.loads(raw)}
+
+    def _save_recurrences(self) -> None:
+        import json
+
+        db = getattr(self.invoices, "db", None)
+        if db is None:
+            return
+        db.set_var("bolt12_recurrences", json.dumps(
+            [{"offer_id": oid.hex(), "payer_id": pid.hex(),
+              "next": st["next"], "basetime": st["basetime"]}
+             for (oid, pid), st in self._recurrences.items()]))
+
+    def _drop_recurrence(self, key: tuple[bytes, bytes]) -> None:
+        self._recurrences.pop(key, None)
+        self._save_recurrences()
 
     def create_offer(self, description: str, amount_msat: int | None = None,
                      issuer: str | None = None, label: str = "",
                      quantity_max: int | None = None,
                      absolute_expiry: int | None = None,
-                     single_use: bool = False) -> dict:
+                     single_use: bool = False,
+                     recurrence: tuple[int, int] | None = None,
+                     recurrence_limit: int | None = None) -> dict:
         offer = B12.Offer(
             description=description, amount_msat=amount_msat, issuer=issuer,
+            recurrence=recurrence, recurrence_limit=recurrence_limit,
             issuer_id=ref.pubkey_serialize(
                 ref.pubkey_create(self.node_seckey)),
             quantity_max=quantity_max, absolute_expiry=absolute_expiry)
@@ -202,6 +240,31 @@ class OffersService:
             return
         if final.reply_path is None:
             return                          # nowhere to answer
+        if invreq.recurrence_cancel:
+            # payer stops the recurrence.  The cancel must be held to
+            # the SAME bar as a mint: a valid signature binds it to
+            # payer_id (else anyone could kill a victim's chain with
+            # an unsigned invreq), and the offer must be a known
+            # recurring one.  Ack = the EXACT sentinel the payer
+            # matches on.
+            from ..wire.codec import write_tlv_stream
+
+            async def _reply(text: bytes) -> None:
+                await self.messenger.send(
+                    final.reply_path,
+                    {OM.INVOICE_ERROR: write_tlv_stream({5: text})})
+
+            if not invreq.check_signature():
+                await _reply(b"bad invoice_request signature")
+                return
+            offer = self.registry.active(invreq.offer.offer_id())
+            if offer is None or offer.recurrence is None:
+                await _reply(b"unknown or non-recurring offer")
+                return
+            key = (invreq.offer.offer_id(), invreq.payer_id)
+            self._drop_recurrence(key)
+            await _reply(b"recurrence cancelled")
+            return
         try:
             inv = self.make_invoice(invreq)
             await self.messenger.send(
@@ -222,12 +285,31 @@ class OffersService:
         amount = invreq.amount_msat
         if amount is None:
             amount = (offer.amount_msat or 0) * (invreq.quantity or 1)
+        basetime = None
+        if offer.recurrence is not None:
+            # one monotone chain per payer: the counter must be exactly
+            # the next expected one (BOLT-recurrence #12 semantics,
+            # paywindow arithmetic simplified to strict succession)
+            key = (offer.offer_id(), invreq.payer_id)
+            st = self._recurrences.get(key)
+            expect = st["next"] if st is not None else 0
+            if invreq.recurrence_counter != expect:
+                raise B12.Bolt12Error(
+                    f"expected recurrence_counter {expect}")
+            if st is None:
+                st = {"next": 0, "basetime": int(time.time())}
+                self._recurrences[key] = st
+            st["next"] = invreq.recurrence_counter + 1
+            self._save_recurrences()
+            basetime = st["basetime"]
         return self.mint_for_invreq(invreq, amount,
-                                    local_offer_id=invreq.offer.offer_id())
+                                    local_offer_id=invreq.offer.offer_id(),
+                                    recurrence_basetime=basetime)
 
     def mint_for_invreq(self, invreq: B12.InvoiceRequest, amount: int,
                         label: str | None = None,
-                        local_offer_id: bytes | None = None
+                        local_offer_id: bytes | None = None,
+                        recurrence_basetime: int | None = None
                         ) -> B12.Invoice12:
         """Mint + register a bolt12 invoice answering an invoice_request
         — shared by the onion-message responder (make_invoice, offer
@@ -246,6 +328,7 @@ class OffersService:
         inv = B12.Invoice12(
             invreq=invreq, payment_hash=payment_hash, amount_msat=amount,
             node_id=node_id, created_at=int(time.time()),
+            recurrence_basetime=recurrence_basetime,
             paths=[path],
             blindedpay=[(0, 0, self.invoices.min_final_cltv, 0,
                          21_000_000 * 100_000_000 * 1000, b"")])
@@ -273,32 +356,102 @@ class OffersService:
             self.registry._set_status(local_offer_id, "used")
 
 
+class RecurrenceCancelled(Exception):
+    """The issuer confirmed a recurrence_cancel (expected outcome of
+    cancelrecurringinvoice — not a failure)."""
+
+
 class FetchInvoice:
     """Payer side: request an invoice for an offer and await it."""
 
-    def __init__(self, messenger: OnionMessenger, node_seckey: int):
+    def __init__(self, messenger: OnionMessenger, node_seckey: int,
+                 db=None):
         self.messenger = messenger
         self.node_seckey = node_seckey
+        self.db = db
         self.pending: dict[bytes, asyncio.Future] = {}  # path_id cookie
+        # recurrence draft: label -> {"payer_key", "next", "start"} —
+        # successive periods must reuse ONE payer_id so the issuer can
+        # link them into a chain; persisted so a restart can continue
+        # (or cancel) a subscription
+        self.recurrences: dict[str, dict] = {}
+        if db is not None:
+            import json
+
+            raw = db.get_var("bolt12_payer_recurrences")
+            if raw:
+                self.recurrences = {
+                    lb: {"payer_key": int(st["payer_key"], 16),
+                         "next": st["next"], "start": st["start"]}
+                    for lb, st in json.loads(raw).items()}
         messenger.register_content(OM.INVOICE, self._on_invoice)
         messenger.register_content(OM.INVOICE_ERROR, self._on_error)
+
+    def _persist_recurrences(self) -> None:
+        if self.db is None:
+            return
+        import json
+
+        self.db.set_var("bolt12_payer_recurrences", json.dumps(
+            {lb: {"payer_key": format(st["payer_key"], "x"),
+                  "next": st["next"], "start": st["start"]}
+             for lb, st in self.recurrences.items()}))
 
     async def fetch(self, offer: B12.Offer, amount_msat: int | None = None,
                     quantity: int | None = None,
                     payer_note: str | None = None,
-                    timeout: float = 30.0) -> B12.Invoice12:
+                    timeout: float = 30.0,
+                    recurrence_counter: int | None = None,
+                    recurrence_start: int | None = None,
+                    recurrence_label: str | None = None,
+                    recurrence_cancel: bool = False) -> B12.Invoice12:
         if offer.currency is not None:
             # no fiat converter on board (reference: currencyrate plugin)
             raise OffersError(
                 f"offer denominated in {offer.currency}: unsupported")
         if not offer.paths and offer.issuer_id is None:
             raise OffersError("offer names no issuer_id and no paths")
-        payer_key = int.from_bytes(os.urandom(32), "big") % ref.N or 1
+        if offer.recurrence is not None and recurrence_counter is None \
+                and not recurrence_cancel:
+            raise OffersError(
+                "recurring offer: pass recurrence_counter + "
+                "recurrence_label")
+        if recurrence_counter is not None and recurrence_label is None:
+            raise OffersError("recurrence_counter needs recurrence_label")
+        if recurrence_label is not None:
+            # ONE payer key per label, across every period of the chain
+            st = self.recurrences.get(recurrence_label)
+            if st is None:
+                if recurrence_cancel:
+                    # a cancel under a fresh random payer_id would hit
+                    # a chain the issuer has never seen — and falsely
+                    # report success while the real chain lives on
+                    raise OffersError(
+                        f"unknown recurrence_label "
+                        f"{recurrence_label!r}: nothing to cancel")
+                st = {"payer_key":
+                      int.from_bytes(os.urandom(32), "big") % ref.N or 1,
+                      "next": 0, "start": recurrence_start}
+                self.recurrences[recurrence_label] = st
+                self._persist_recurrences()
+            if recurrence_counter is not None and not recurrence_cancel \
+                    and recurrence_counter != st["next"]:
+                raise OffersError(
+                    f"label {recurrence_label!r} expects "
+                    f"recurrence_counter {st['next']}")
+            if recurrence_start is None:
+                recurrence_start = st.get("start")
+            payer_key = st["payer_key"]
+        else:
+            payer_key = int.from_bytes(os.urandom(32), "big") % ref.N or 1
         invreq = B12.InvoiceRequest(
             offer=offer, metadata=os.urandom(16),
             payer_id=ref.pubkey_serialize(ref.pubkey_create(payer_key)),
             amount_msat=amount_msat, quantity=quantity,
-            payer_note=payer_note)
+            payer_note=payer_note,
+            recurrence_counter=recurrence_counter,
+            recurrence_start=recurrence_start,
+            recurrence_cancel=recurrence_cancel)
         invreq.sign(payer_key)
 
         dest = offer.paths[0] if offer.paths else _direct_path(
@@ -318,9 +471,21 @@ class FetchInvoice:
         finally:
             self.pending.pop(cookie, None)
         if isinstance(result, bytes):
-            raise OffersError(f"invoice_error: {result.decode(errors='replace')}")
+            text = result.decode(errors='replace')
+            if recurrence_cancel and text == "recurrence cancelled":
+                # the issuer's ack for a recurrence_cancel IS an
+                # invoice_error (no invoice exists to return) — exact
+                # sentinel match, so no other failure text can pass
+                self.recurrences.pop(recurrence_label or "", None)
+                self._persist_recurrences()
+                raise RecurrenceCancelled(text)
+            raise OffersError(f"invoice_error: {text}")
         inv: B12.Invoice12 = result
         inv.validate_against(invreq)
+        if recurrence_label is not None and recurrence_counter is not None:
+            self.recurrences[recurrence_label]["next"] = \
+                recurrence_counter + 1
+            self._persist_recurrences()
         return inv
 
     async def _on_invoice(self, final: OM.Final) -> None:
@@ -351,11 +516,29 @@ def attach_offers_commands(rpc, service: OffersService,
     async def offer(amount: str | int, description: str,
                     issuer: str | None = None, label: str = "",
                     quantity_max: int | None = None,
-                    single_use: bool = False) -> dict:
+                    single_use: bool = False,
+                    recurrence: str | None = None,
+                    recurrence_limit: int | None = None) -> dict:
         amt = None if amount in ("any", None) else int(amount)
+        rec = None
+        if recurrence is not None:
+            # reference syntax: "<number><unit>" with unit in
+            # seconds/days/months/years (e.g. "1month", "12H" unsupported)
+            import re as _re
+
+            m = _re.fullmatch(r"(\d+)\s*(second|day|month|year)s?",
+                              str(recurrence).strip().lower())
+            if not m:
+                raise OffersError(
+                    f"unparseable recurrence {recurrence!r} "
+                    "(use e.g. '1month', '2weeks'→'14days')")
+            unit = {"second": 0, "day": 1, "month": 2,
+                    "year": 3}[m.group(2)]
+            rec = (unit, int(m.group(1)))
         row = service.create_offer(
             description, amount_msat=amt, issuer=issuer, label=label,
-            quantity_max=quantity_max, single_use=single_use)
+            quantity_max=quantity_max, single_use=single_use,
+            recurrence=rec, recurrence_limit=recurrence_limit)
         return {"offer_id": row["offer_id"].hex(), "bolt12": row["bolt12"],
                 "active": row["status"] == "active",
                 "single_use": row["single_use"], "used": False}
@@ -374,7 +557,10 @@ def attach_offers_commands(rpc, service: OffersService,
     async def fetchinvoice(offer: str, amount_msat: int | None = None,
                            quantity: int | None = None,
                            payer_note: str | None = None,
-                           timeout: float = 30.0) -> dict:
+                           timeout: float = 30.0,
+                           recurrence_counter: int | None = None,
+                           recurrence_start: int | None = None,
+                           recurrence_label: str | None = None) -> dict:
         if "@" in offer and not offer.startswith("lno1"):
             # BIP-353 payment address: resolve user@domain → lno offer
             # (reference: fetchinvoice's bip353 path)
@@ -387,13 +573,24 @@ def attach_offers_commands(rpc, service: OffersService,
                     f"(has: {sorted(set(uri) - {'dns_name'})})")
             offer = uri["lno"]
         o = B12.Offer.decode(offer)
-        inv = await fetcher.fetch(o, amount_msat=amount_msat,
-                                  quantity=quantity, payer_note=payer_note,
-                                  timeout=timeout)
-        return {"invoice": inv.encode(),
-                "amount_msat": inv.amount_msat,
-                "payment_hash": inv.payment_hash.hex(),
-                "expires_at": inv.expires_at}
+        inv = await fetcher.fetch(
+            o, amount_msat=amount_msat, quantity=quantity,
+            payer_note=payer_note, timeout=timeout,
+            recurrence_counter=recurrence_counter,
+            recurrence_start=recurrence_start,
+            recurrence_label=recurrence_label)
+        out = {"invoice": inv.encode(),
+               "amount_msat": inv.amount_msat,
+               "payment_hash": inv.payment_hash.hex(),
+               "expires_at": inv.expires_at}
+        if inv.recurrence_basetime is not None and o.recurrence is not None:
+            out["next_period"] = {
+                "counter": (recurrence_counter or 0) + 1,
+                "starttime": inv.recurrence_basetime
+                + ((recurrence_counter or 0) + 1)
+                * B12.RECURRENCE_UNIT_SECONDS.get(
+                    o.recurrence[0], 1) * o.recurrence[1]}
+        return out
 
     async def invoice(amount_msat, label: str, description: str,
                       expiry: int = 3600) -> dict:
@@ -543,6 +740,28 @@ def attach_offers_commands(rpc, service: OffersService,
                 "payment_hash": inv12.payment_hash.hex(),
                 "amount_msat": inv12.amount_msat, "label": label}
 
+    async def cancelrecurringinvoice(offer: str, recurrence_counter: int,
+                                     recurrence_label: str,
+                                     recurrence_start: int | None = None,
+                                     payer_note: str | None = None,
+                                     timeout: float = 30.0) -> dict:
+        """Stop a recurrence: sends invreq_recurrence_cancel in place
+        of an invoice_request (cancelrecurringinvoice.json); the
+        issuer's confirmation arrives as a recognizable invoice_error
+        and the label's chain state is dropped."""
+        o = B12.Offer.decode(offer)
+        try:
+            await fetcher.fetch(
+                o, payer_note=payer_note, timeout=timeout,
+                recurrence_counter=int(recurrence_counter),
+                recurrence_start=recurrence_start,
+                recurrence_label=recurrence_label,
+                recurrence_cancel=True)
+        except RecurrenceCancelled as e:
+            return {"cancelled": True, "detail": str(e)}
+        raise OffersError(
+            "issuer answered the cancel with an invoice, not an ack")
+
     async def injectonionmessage(message: str, path_key: str) -> dict:
         """Process a fully-built onion message as if it had arrived
         from a peer (lightningd/onion_message.c
@@ -572,7 +791,8 @@ def attach_offers_commands(rpc, service: OffersService,
                listinvoices, waitinvoice, waitanyinvoice, delinvoice,
                decode, createinvoice, signinvoice, invoicerequest,
                listinvoicerequests, disableinvoicerequest, sendinvoice,
-               sendonionmessage, injectonionmessage):
+               sendonionmessage, injectonionmessage,
+               cancelrecurringinvoice):
         rpc.register(fn.__name__, fn)
     rpc.register("decodepay", decodepay, deprecated=True)
 

@@ -130,14 +130,30 @@ COMMANDS: dict[str, dict] = {
     },
     "offer": {
         "params": {"amount": "any", "description": "str?",
-                   "issuer": "str?", "label": "str?"},
+                   "issuer": "str?", "label": "str?",
+                   "quantity_max": "int?", "single_use": "bool?",
+                   "recurrence": "str?", "recurrence_limit": "int?"},
         "result": {"offer_id": "hex", "bolt12": "str", "active": "bool"},
     },
     "fetchinvoice": {
+        # NOTE: new params append AFTER the pre-existing ones —
+        # protogen derives protobuf field numbers from dict order, so
+        # inserting mid-dict would renumber the wire format under
+        # already-compiled binrpc clients
         "params": {"offer": "str", "amount_msat": "int?",
-                   "quantity": "int?", "timeout": "int?"},
+                   "quantity": "int?", "timeout": "int?",
+                   "payer_note": "str?", "recurrence_counter": "int?",
+                   "recurrence_start": "int?",
+                   "recurrence_label": "str?"},
         "result": {"invoice": "str", "amount_msat": "msat",
                    "payment_hash": "hex"},
+    },
+    "cancelrecurringinvoice": {
+        "params": {"offer": "str", "recurrence_counter": "int",
+                   "recurrence_label": "str",
+                   "recurrence_start": "int?", "payer_note": "str?",
+                   "timeout": "int?"},
+        "result": {"cancelled": "bool"},
     },
     "waitinvoice": {
         "params": {"label": "str", "timeout": "int?"},

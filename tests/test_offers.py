@@ -193,3 +193,152 @@ def test_bolt12_blinded_path_cookie(tmp_path):
         payment_hash=inv.payment_hash, amount_msat=7_000, cltv_expiry=600))
     verdict2, _ = classify_incoming(lh2, ISSUER_KEY, invoices=invoices)
     assert verdict2 == "fail"
+
+
+def test_recurrence_chain_and_cancel(tmp_path):
+    """BOLT#12 recurrence draft: a recurring offer demands counters,
+    the issuer enforces strict succession per payer, every invoice in
+    the chain carries the SAME basetime, and invreq_recurrence_cancel
+    stops the chain (cancelrecurringinvoice semantics)."""
+    from lightning_tpu.pay.offers import RecurrenceCancelled
+
+    async def body():
+        issuer = LightningNode(privkey=ISSUER_KEY)
+        payer = LightningNode(privkey=PAYER_KEY)
+        db = Db(str(tmp_path / "issuer.sqlite3"))
+        _, registry, invoices, service, _ = _services(issuer, ISSUER_KEY, db)
+        _, _, _, _, fetcher = _services(payer, PAYER_KEY)
+        try:
+            await _connect(issuer, payer)
+            row = service.create_offer(
+                "netflix", amount_msat=9_000, issuer="acme", label="sub",
+                recurrence=(2, 1), recurrence_limit=11)   # monthly, 12x
+            offer = B12.Offer.decode(row["bolt12"])
+            assert offer.recurrence == (2, 1)
+            assert offer.recurrence_limit == 11
+
+            # a recurring offer without a counter is rejected
+            with pytest.raises(Exception, match="recurrence"):
+                await fetcher.fetch(offer, timeout=10)
+
+            inv0 = await fetcher.fetch(offer, timeout=10,
+                                       recurrence_counter=0,
+                                       recurrence_label="sub")
+            assert inv0.recurrence_basetime is not None
+            assert inv0.invreq.recurrence_counter == 0
+
+            # wrong counter (replay or skip) is refused — the payer's
+            # own chain state catches it before any wire traffic
+            with pytest.raises(Exception, match="recurrence_counter 1"):
+                await fetcher.fetch(offer, timeout=10,
+                                    recurrence_counter=5,
+                                    recurrence_label="sub")
+
+            inv1 = await fetcher.fetch(offer, timeout=10,
+                                       recurrence_counter=1,
+                                       recurrence_label="sub")
+            # the chain shares one basetime and one payer_id
+            assert inv1.recurrence_basetime == inv0.recurrence_basetime
+            assert inv1.invreq.payer_id == inv0.invreq.payer_id
+
+            # counter beyond the offer's limit is rejected outright
+            from lightning_tpu.crypto import ref_python as ref
+
+            bad = B12.InvoiceRequest(
+                offer=offer, metadata=b"m" * 16,
+                payer_id=ref.pubkey_serialize(ref.pubkey_create(0x1234)),
+                recurrence_counter=12)
+            bad.sign(0x1234)
+            with pytest.raises(Exception, match="limit"):
+                bad.validate_against(offer)
+
+            # an UNSIGNED cancel must not kill the chain (spoofing):
+            # craft one carrying the victim's payer_id, no signature
+            from lightning_tpu.wire.codec import write_tlv_stream
+            from lightning_tpu.bolt import onion_message as OM
+            from lightning_tpu.bolt import blindedpath as BPx
+
+            forged = B12.InvoiceRequest(
+                offer=offer, metadata=b"x" * 16,
+                payer_id=inv1.invreq.payer_id,
+                recurrence_counter=2, recurrence_cancel=True)
+            spoof_reply = OM.reply_path_for(
+                [issuer.node_id, payer.node_id], b"\x77" * 32)
+            await fetcher.messenger.send(
+                BPx.create_path([issuer.node_id], [BPx.EncryptedData()]),
+                {OM.INVOICE_REQUEST: forged.serialize_unsigned()
+                 if hasattr(forged, "serialize_unsigned")
+                 else write_tlv_stream(forged.tlvs(with_sig=False)),
+                 OM.REPLY_PATH: spoof_reply.serialize()})
+            await asyncio.sleep(0.3)
+            # chain still alive: period 2 mints fine afterwards
+            inv2 = await fetcher.fetch(offer, timeout=10,
+                                       recurrence_counter=2,
+                                       recurrence_label="sub")
+            assert inv2.recurrence_basetime == inv0.recurrence_basetime
+
+            # cancelling an unknown label fails loudly instead of
+            # acking a chain the issuer never saw
+            with pytest.raises(Exception, match="unknown recurrence"):
+                await fetcher.fetch(offer, timeout=10,
+                                    recurrence_counter=3,
+                                    recurrence_label="typo",
+                                    recurrence_cancel=True)
+
+            # REAL cancel: issuer acks with the exact sentinel
+            with pytest.raises(RecurrenceCancelled):
+                await fetcher.fetch(offer, timeout=10,
+                                    recurrence_counter=3,
+                                    recurrence_label="sub",
+                                    recurrence_cancel=True)
+            assert "sub" not in fetcher.recurrences
+            # ...after which a fresh label starts at counter 0 again
+            with pytest.raises(Exception, match="recurrence_counter 0"):
+                await fetcher.fetch(offer, timeout=10,
+                                    recurrence_counter=2,
+                                    recurrence_label="sub2")
+        finally:
+            await issuer.close()
+            await payer.close()
+
+    run(body())
+
+
+def test_recurrence_survives_restart(tmp_path):
+    """Both sides persist their chain state: a restarted issuer keeps
+    expecting the NEXT counter (not 0), and a restarted payer can still
+    continue or cancel under the original payer_id."""
+    async def body():
+        issuer = LightningNode(privkey=ISSUER_KEY)
+        payer = LightningNode(privkey=PAYER_KEY)
+        idb = Db(str(tmp_path / "issuer.sqlite3"))
+        pdb = Db(str(tmp_path / "payer.sqlite3"))
+        m_i, registry, invoices, service, _ = _services(
+            issuer, ISSUER_KEY, idb)
+        m_p = OnionMessenger(payer, PAYER_KEY)
+        fetcher = FetchInvoice(m_p, PAYER_KEY, db=pdb)
+        try:
+            await _connect(issuer, payer)
+            row = service.create_offer("sub", amount_msat=1_000,
+                                       recurrence=(1, 7))   # weekly
+            offer = B12.Offer.decode(row["bolt12"])
+            inv0 = await fetcher.fetch(offer, timeout=10,
+                                       recurrence_counter=0,
+                                       recurrence_label="L")
+
+            # "restart" both sides: fresh objects over the same dbs
+            service2 = OffersService(m_i, registry,
+                                     InvoiceRegistry(ISSUER_KEY, db=idb),
+                                     ISSUER_KEY)
+            fetcher2 = FetchInvoice(m_p, PAYER_KEY, db=pdb)
+            assert fetcher2.recurrences["L"]["next"] == 1
+            inv1 = await fetcher2.fetch(offer, timeout=10,
+                                        recurrence_counter=1,
+                                        recurrence_label="L")
+            assert inv1.recurrence_basetime == inv0.recurrence_basetime
+            assert inv1.invreq.payer_id == inv0.invreq.payer_id
+        finally:
+            await issuer.close()
+            await payer.close()
+
+    run(body())
