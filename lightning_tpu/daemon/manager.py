@@ -1911,6 +1911,100 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     rpc.register("listpays", listpays)
     rpc.register("listsendpays", listsendpays)
     rpc.register("listpeerchannels", listpeerchannels)
+    def _parse_splice_script(script_or_json: str) -> list[dict]:
+        """dev-splice input: either the JSON action array or the arrow
+        script subset `source -> destination: amount` per line, where
+        source/destination is `wallet`, a channel id, or a bitcoin
+        address (common/splice_script.c grammar, the wildcard/percent/
+        lease forms excluded)."""
+        import json as _json
+
+        s = script_or_json.strip()
+        if s.startswith("["):
+            try:
+                actions = _json.loads(s)
+            except _json.JSONDecodeError as e:
+                raise ManagerError(f"bad splice json: {e}")
+            if not isinstance(actions, list):
+                raise ManagerError("splice json must be an array")
+            # shape-check NOW so dryrun approves only what the live
+            # run can execute — exactly one nonzero direction each
+            for i, a in enumerate(actions):
+                if not isinstance(a, dict) or not a.get("channel_id"):
+                    raise ManagerError(
+                        f"action {i}: must be an object with a "
+                        "channel_id")
+                n_in = int(a.get("in_sat") or 0)
+                n_out = int(a.get("out_sat") or 0)
+                if (n_in > 0) == (n_out > 0):
+                    raise ManagerError(
+                        f"action {i}: exactly one of in_sat/out_sat "
+                        "must be positive")
+            return actions
+        actions = []
+        for ln, line in enumerate(s.splitlines(), 1):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if "->" not in line or ":" not in line:
+                raise ManagerError(
+                    f"line {ln}: expected 'src -> dst: amount'")
+            lhs, rest = line.split("->", 1)
+            dst, amt_s = rest.rsplit(":", 1)
+            src, dst = lhs.strip(), dst.strip()
+            amt_s = amt_s.strip().lower().replace("_", "")
+            mult = 1
+            if amt_s.endswith("k"):
+                mult, amt_s = 1_000, amt_s[:-1]
+            elif amt_s.endswith("m"):
+                mult, amt_s = 1_000_000, amt_s[:-1]
+            try:
+                amount = int(float(amt_s) * mult)
+            except ValueError:
+                raise ManagerError(f"line {ln}: bad amount {amt_s!r}")
+            if src == "wallet":
+                if dst == "wallet":
+                    raise ManagerError(f"line {ln}: wallet->wallet")
+                actions.append({"channel_id": dst, "in_sat": amount})
+            elif dst == "wallet":
+                actions.append({"channel_id": src, "out_sat": amount})
+            else:
+                # channel -> address: splice out to that address.
+                # channel -> channel (single-tx cross-channel moves)
+                # is a reference capability we don't batch yet — say
+                # so at PARSE time, not with an address error later
+                is_chan = len(dst) == 64 and all(
+                    c in "0123456789abcdef" for c in dst.lower())
+                if is_chan:
+                    raise ManagerError(
+                        f"line {ln}: channel->channel moves are not "
+                        "supported (splice out to the wallet, then "
+                        "in)")
+                actions.append({"channel_id": src, "out_sat": amount,
+                                "bitcoin_address": dst})
+        return actions
+
+    async def dev_splice(script_or_json: str,
+                         dryrun: bool = False) -> dict:
+        """Script-driven splices (plugins/spender/splice.c dev-splice).
+        Supported subset: per-action splice-in from the wallet and
+        splice-out to the wallet or an address; each action executes
+        as its OWN splice tx in sequence (the reference can batch
+        cross-channel moves into one tx — our engine does not yet)."""
+        actions = _parse_splice_script(script_or_json)
+        if dryrun:
+            return {"dryrun": True, "actions": actions}
+        results = []
+        for a in actions:
+            cid = a["channel_id"]
+            if int(a.get("in_sat") or 0) > 0:
+                results.append(await mgr.splice(cid, int(a["in_sat"])))
+            else:
+                results.append(await mgr.spliceout(
+                    cid, int(a["out_sat"]),
+                    destination=a.get("bitcoin_address")))
+        return {"actions": actions, "results": results}
+
     async def splicein(channel: str, amount) -> dict:
         """splicein (plugins/splice): wallet-funded capacity growth —
         the friendly face of `splice`."""
@@ -2036,6 +2130,7 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
     rpc.register("splice_signed", splice_signed)
     rpc.register("splicein", splicein)
     rpc.register("spliceout", spliceout)
+    rpc.register("dev-splice", dev_splice)
     rpc.register("keysend", keysend)
     rpc.register("listhtlcs", listhtlcs)
     rpc.register("xkeysend", xkeysend)

@@ -293,13 +293,18 @@ class OffersService:
             key = (offer.offer_id(), invreq.payer_id)
             st = self._recurrences.get(key)
             expect = st["next"] if st is not None else 0
-            if invreq.recurrence_counter != expect:
+            # accept the NEXT period, or a RETRY of the last minted
+            # one — the reply can be lost in flight, and without retry
+            # idempotence one dropped onion message would wedge the
+            # chain forever (payer stuck at N, issuer at N+1)
+            if invreq.recurrence_counter not in (expect,
+                                                 max(expect - 1, 0)):
                 raise B12.Bolt12Error(
                     f"expected recurrence_counter {expect}")
             if st is None:
                 st = {"next": 0, "basetime": int(time.time())}
                 self._recurrences[key] = st
-            st["next"] = invreq.recurrence_counter + 1
+            st["next"] = max(st["next"], invreq.recurrence_counter + 1)
             self._save_recurrences()
             basetime = st["basetime"]
         return self.mint_for_invreq(invreq, amount,
@@ -421,24 +426,29 @@ class FetchInvoice:
         if recurrence_label is not None:
             # ONE payer key per label, across every period of the chain
             st = self.recurrences.get(recurrence_label)
+            if st is None and recurrence_cancel:
+                # a cancel under a fresh random payer_id would hit
+                # a chain the issuer has never seen — and falsely
+                # report success while the real chain lives on
+                raise OffersError(
+                    f"unknown recurrence_label "
+                    f"{recurrence_label!r}: nothing to cancel")
+            expected = st["next"] if st is not None else 0
+            # next period or a retry of the last one (lost replies)
+            if recurrence_counter is not None and not recurrence_cancel \
+                    and recurrence_counter not in (expected,
+                                                   max(expected - 1, 0)):
+                raise OffersError(
+                    f"label {recurrence_label!r} expects "
+                    f"recurrence_counter {expected}")
             if st is None:
-                if recurrence_cancel:
-                    # a cancel under a fresh random payer_id would hit
-                    # a chain the issuer has never seen — and falsely
-                    # report success while the real chain lives on
-                    raise OffersError(
-                        f"unknown recurrence_label "
-                        f"{recurrence_label!r}: nothing to cancel")
+                # state exists in memory from here; persisted only once
+                # a fetch SUCCEEDS, so a failed first attempt leaves no
+                # phantom label whose cancel would falsely succeed
                 st = {"payer_key":
                       int.from_bytes(os.urandom(32), "big") % ref.N or 1,
                       "next": 0, "start": recurrence_start}
                 self.recurrences[recurrence_label] = st
-                self._persist_recurrences()
-            if recurrence_counter is not None and not recurrence_cancel \
-                    and recurrence_counter != st["next"]:
-                raise OffersError(
-                    f"label {recurrence_label!r} expects "
-                    f"recurrence_counter {st['next']}")
             if recurrence_start is None:
                 recurrence_start = st.get("start")
             payer_key = st["payer_key"]
@@ -483,8 +493,8 @@ class FetchInvoice:
         inv: B12.Invoice12 = result
         inv.validate_against(invreq)
         if recurrence_label is not None and recurrence_counter is not None:
-            self.recurrences[recurrence_label]["next"] = \
-                recurrence_counter + 1
+            st = self.recurrences[recurrence_label]
+            st["next"] = max(st["next"], recurrence_counter + 1)
             self._persist_recurrences()
         return inv
 
@@ -584,10 +594,13 @@ def attach_offers_commands(rpc, service: OffersService,
                "payment_hash": inv.payment_hash.hex(),
                "expires_at": inv.expires_at}
         if inv.recurrence_basetime is not None and o.recurrence is not None:
+            # period index = start offset + counter (draft semantics:
+            # recurrence_start shifts which period the chain began at)
+            nxt = (recurrence_counter or 0) + 1
             out["next_period"] = {
-                "counter": (recurrence_counter or 0) + 1,
+                "counter": nxt,
                 "starttime": inv.recurrence_basetime
-                + ((recurrence_counter or 0) + 1)
+                + ((recurrence_start or 0) + nxt)
                 * B12.RECURRENCE_UNIT_SECONDS.get(
                     o.recurrence[0], 1) * o.recurrence[1]}
         return out

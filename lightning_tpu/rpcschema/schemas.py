@@ -837,6 +837,10 @@ COMMANDS: dict[str, dict] = {
         "params": {"psbt": "str", "version": "int"},
         "result": {"psbt": "str"},
     },
+    "dev-splice": {
+        "params": {"script_or_json": "str", "dryrun": "bool?"},
+        "result": {"actions": "list"},
+    },
     "bkpr-report": {
         "params": {"format": "str?", "headers": "bool?",
                    "escape": "str?", "start_time": "int?",

@@ -342,3 +342,52 @@ def test_recurrence_survives_restart(tmp_path):
             await payer.close()
 
     run(body())
+
+
+def test_recurrence_retry_after_lost_reply(tmp_path):
+    """A lost INVOICE reply must not wedge the chain: the issuer
+    accepts a retry of the last minted period (same counter) and the
+    payer may re-request it."""
+    async def body():
+        issuer = LightningNode(privkey=ISSUER_KEY)
+        payer = LightningNode(privkey=PAYER_KEY)
+        _, registry, invoices, service, _ = _services(issuer, ISSUER_KEY)
+        m_p = OnionMessenger(payer, PAYER_KEY)
+        fetcher = FetchInvoice(m_p, PAYER_KEY)
+        try:
+            await _connect(issuer, payer)
+            row = service.create_offer("sub", amount_msat=500,
+                                       recurrence=(1, 30))
+            offer = B12.Offer.decode(row["bolt12"])
+            inv0 = await fetcher.fetch(offer, timeout=10,
+                                       recurrence_counter=0,
+                                       recurrence_label="R")
+            # simulate a lost reply: the payer re-requests period 0
+            # (its local 'next' is 1, so 0 rides as a retry) and the
+            # issuer re-mints rather than rejecting
+            fetcher.recurrences["R"]["next"] = 0
+            inv0b = await fetcher.fetch(offer, timeout=10,
+                                        recurrence_counter=0,
+                                        recurrence_label="R")
+            assert inv0b.recurrence_basetime == inv0.recurrence_basetime
+            # and the chain continues normally afterwards
+            inv1 = await fetcher.fetch(offer, timeout=10,
+                                       recurrence_counter=1,
+                                       recurrence_label="R")
+            assert inv1.invreq.recurrence_counter == 1
+
+            # a failed FIRST fetch leaves no phantom label to cancel
+            with pytest.raises(Exception, match="recurrence_counter"):
+                await fetcher.fetch(offer, timeout=10,
+                                    recurrence_counter=7,
+                                    recurrence_label="fresh")
+            with pytest.raises(Exception, match="unknown recurrence"):
+                await fetcher.fetch(offer, timeout=10,
+                                    recurrence_counter=0,
+                                    recurrence_label="fresh2",
+                                    recurrence_cancel=True)
+        finally:
+            await issuer.close()
+            await payer.close()
+
+    run(body())

@@ -528,3 +528,46 @@ def test_createproof_and_merkle_paths(tmp_path):
              b"r" * 32, 3))
     with pytest.raises(Exception, match="no settled"):
         run(rpc.methods["createproof"](lni4))
+
+
+# -- dev-splice script parsing ---------------------------------------------
+
+def test_dev_splice_parse_and_dryrun(tmp_path):
+    from lightning_tpu.daemon.hsmd import Hsm
+    from lightning_tpu.daemon.manager import (ChannelManager,
+                                              attach_manager_commands)
+    from lightning_tpu.wallet.wallet import Wallet
+
+    db = Db(str(tmp_path / "ds.sqlite3"))
+    mgr = ChannelManager(None, Hsm(b"\x71" * 32), wallet=Wallet(db))
+    rpc = FakeRpc()
+    attach_manager_commands(rpc, mgr)
+    dev_splice = rpc.methods["dev-splice"]
+
+    cid = "ab" * 32
+    script = f"""
+    # grow then shrink
+    wallet -> {cid}: 200k
+    {cid} -> wallet: 50_000
+    {cid} -> bcrt1qw508d6qejxtdg4y5r3zarvary0c5xw7kygt080: 1.5k
+    """
+    res = run(dev_splice(script, dryrun=True))
+    assert res["dryrun"] is True
+    assert res["actions"] == [
+        {"channel_id": cid, "in_sat": 200_000},
+        {"channel_id": cid, "out_sat": 50_000},
+        {"channel_id": cid, "out_sat": 1_500,
+         "bitcoin_address":
+         "bcrt1qw508d6qejxtdg4y5r3zarvary0c5xw7kygt080"},
+    ]
+
+    # json form round-trips identically
+    import json as _j
+
+    res2 = run(dev_splice(_j.dumps(res["actions"]), dryrun=True))
+    assert res2["actions"] == res["actions"]
+
+    for bad in ("nonsense line", "wallet -> wallet: 5",
+                f"wallet -> {cid}: pancakes", "[1,2"):
+        with pytest.raises(Exception):
+            run(dev_splice(bad, dryrun=True))
