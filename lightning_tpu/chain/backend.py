@@ -217,7 +217,16 @@ class FakeBitcoind(ChainBackend):
 
     async def getutxout(self, txid: bytes, vout: int):
         self._maybe_fail("getutxout")
-        return self.utxos.get((txid, vout))
+        got = self.utxos.get((txid, vout))
+        if got is not None:
+            return got
+        # gettxout include_mempool=true semantics (what the production
+        # BitcoindBackend queries): unconfirmed outputs count too
+        mtx = self.mempool.get(txid)
+        if mtx is not None and vout < len(mtx.outputs):
+            out = mtx.outputs[vout]
+            return (out.amount_sat, out.script_pubkey)
+        return None
 
     async def wait_new_block(self, timeout: float | None = None) -> None:
         evt = self._new_block_evt

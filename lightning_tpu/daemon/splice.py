@@ -239,7 +239,29 @@ async def _locked_and_switch(ch: Channeld, tx: T.Tx, fund_idx: int,
     if chain_backend is not None:
         ok, err = await chain_backend.sendrawtransaction(tx.serialize())
         if not ok:
-            raise SpliceError(f"splice broadcast failed: {err}")
+            # BOTH sides broadcast the same splice tx; the peer's copy
+            # can confirm before ours lands, making our submission
+            # fail missing-or-spent.  If OUR exact txid already exists
+            # (gettxout with mempool included) the broadcast goal is
+            # met — rolling back a confirmed splice would desync the
+            # channel.  A transient backend error must NOT look like
+            # "not found" (that too would roll back a confirmed
+            # splice), so retry briefly and propagate a real outage.
+            known = None
+            for _ in range(5):
+                try:
+                    known = (await chain_backend.getutxout(
+                        tx.txid(), fund_idx)) is not None
+                    break
+                except Exception:
+                    await asyncio.sleep(1.0)
+            if known is None:
+                raise SpliceError(
+                    "splice broadcast rejected and the chain backend "
+                    "is unreachable to confirm the peer's copy — "
+                    "keeping the inflight for restart replay")
+            if not known:
+                raise SpliceError(f"splice broadcast failed: {err}")
     if topology is not None:
         while topology.depth(tx.txid()) < min_depth:
             await asyncio.sleep(0.05)

@@ -237,3 +237,57 @@ def test_spliceout_moves_funds_onchain(tmp_path):
             await b.close()
 
     run(body())
+
+
+def test_staged_splice_peer_death_rolls_back(tmp_path):
+    """A peer that dies while a staged splice is parked for signatures
+    must not strand the channel: the parked flow unwinds, the channel
+    state rolls back to the original funding, and the staged entry is
+    cleared so a fresh splice can be staged later."""
+    async def body():
+        bitcoind = FakeBitcoind()
+        bitcoind.generate(1)
+        a = await Stack(tmp_path, "a", b"\x4a" * 32, bitcoind).start()
+        b = await Stack(tmp_path, "b", b"\x4b" * 32, bitcoind).start()
+        try:
+            port = await b.node.listen()
+            await a.node.connect("127.0.0.1", port, b.node.node_id)
+            await rpc_call(a.rpc.rpc_path, "dev-faucet",
+                           {"satoshi": 3_000_000})
+            fund = asyncio.create_task(
+                a.manager.fundchannel(b.node.node_id, 1_000_000))
+            while not bitcoind.mempool and not fund.done():
+                await asyncio.sleep(0.05)
+            if bitcoind.mempool:
+                bitcoind.generate(1)
+            opened = await asyncio.wait_for(fund, 600)
+            cid = opened["channel_id"]
+            ch = a.manager.channels[bytes.fromhex(cid)][0]
+            orig_funding = ch.funding_txid
+
+            funded = await rpc_call(a.rpc.rpc_path, "fundpsbt", {
+                "satoshi": 200_000, "excess_as_change": True,
+                "feerate": "1000perkw", "startweight": 1000})
+            init = await rpc_call(a.rpc.rpc_path, "splice_init", {
+                "channel_id": cid, "relative_amount": 200_000,
+                "initialpsbt": funded["psbt"]})
+            assert init["commitments_secured"]
+            assert cid in a.manager._staged_v2
+
+            # the peer dies while we are parked awaiting signatures
+            await b.close()
+            for _ in range(600):
+                if cid not in a.manager._staged_v2:
+                    break
+                await asyncio.sleep(0.05)
+            assert cid not in a.manager._staged_v2, \
+                "staged splice survived peer death"
+            # the channel rolled back to the ORIGINAL funding
+            assert ch.funding_txid == orig_funding
+            assert ch.funding_sat == 1_000_000
+            assert ch.inflight is None
+        finally:
+            await a.close()
+            await b.close()
+
+    run(body())
