@@ -220,10 +220,11 @@ def run_bench(platform: str) -> dict:
         # residency)
         bucket = int(os.environ.get("BENCH_BUCKET", "16384"))
         # production engine on hardware = the sweep winner (in-kernel
-        # table build + fused sqrt/inv prep); the CPU fallback keeps
+        # table build + joint G/φG table + fused sqrt/inv prep,
+        # 200.9k/s @16384 measured 2026-08-01); the CPU fallback keeps
         # the XLA scan (pallas interpret mode is orders of magnitude
         # slower than compiled XLA on CPU)
-        os.environ.setdefault("LIGHTNING_TPU_DUAL_MUL", "pallas_fb+pp")
+        os.environ.setdefault("LIGHTNING_TPU_DUAL_MUL", "pallas_fbj+pp")
     else:
         # bucket 64 = the unit-test bucket, warm in the persistent cache
         n_channels = int(os.environ.get("BENCH_CPU_CHANNELS", "200"))
@@ -294,7 +295,8 @@ def run_sweep(platform: str) -> None:
     BENCH_NOTES.md."""
     impls = os.environ.get(
         "BENCH_IMPLS",
-        "xla,glv,pallas,pallas_v2,pallas_glv,pallas_fb,pallas_fb+pp",
+        "xla,glv,pallas,pallas_v2,pallas_glv,pallas_fb,pallas_fb+pp,"
+        "pallas_fbj+pp",
     ).split(",")
     buckets = [int(b) for b in os.environ.get(
         "BENCH_BUCKETS", "4096,8192,16384").split(",")]

@@ -128,6 +128,40 @@ def _g_phi_window_proj() -> np.ndarray:
     return out
 
 
+@functools.lru_cache(maxsize=1)
+def _g_joint_window_proj() -> np.ndarray:
+    """(1024, 3, NLIMBS) joint signed window table for the FIXED pair
+    (G, φG): entry ``i = v1 + 16·s1 + 32·(v2 + 16·s2)`` holds
+    ``(-1)^s1·v1·G + (-1)^s2·v2·φG`` with Z=1 (the four v1=v2=0
+    entries are infinity, (0:1:0)).  245 KB, shared across the batch.
+
+    Pre-summing the two fixed-base contributions lets the GLV window
+    scan stream ONE G plane and spend ONE point add per window instead
+    of two — 33 of the 132 adds of a 33-window dual-mul vanish.  The
+    sum can only be infinity when both magnitudes are 0 (v1·G = -v2·φG
+    would need v1 ≡ ∓λ·v2 (mod n), impossible for 0 < v1, v2 < 16), so
+    every other entry is affine with Z=1.  Host-side exact ints."""
+    phi_g = ref.Point(BETA * ref.G.x % ref.P, ref.G.y)
+    out = np.zeros((1024, 3, NLIMBS), dtype=np.uint32)
+    for i in range(1024):
+        v1, s1 = i & 15, (i >> 4) & 1
+        v2, s2 = (i >> 5) & 15, (i >> 9) & 1
+        p1 = ref.point_mul(v1, ref.G)
+        p2 = ref.point_mul(v2, phi_g)
+        if s1:
+            p1 = ref.point_neg(p1)
+        if s2:
+            p2 = ref.point_neg(p2)
+        p = ref.point_add(p1, p2)
+        if p.inf:
+            out[i, 1, 0] = 1
+        else:
+            out[i, 0] = F.int_to_limbs(p.x)
+            out[i, 1] = F.int_to_limbs(p.y)
+            out[i, 2, 0] = 1
+    return out
+
+
 def _neg_y(pt, negv):
     x, y, z = pt
     return x, F.select(negv, F.sub(FP, F.zero(y.shape[:-1]), y), y), z

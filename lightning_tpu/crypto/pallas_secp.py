@@ -548,15 +548,7 @@ def _glv_prep(u1, u2):
     split both scalars, extract MSB-first digit planes, select the
     signed G/φG planes.  Returns (d2l, d2h digit arrays, s2l, s2h sign
     masks, g1, g2 plane triples)."""
-    from . import glv as GLV
-
-    m1l, s1l, m1h, s1h = GLV.split(u1)
-    m2l, s2l, m2h, s2h = GLV.split(u2)
-    d1l = jnp.flip(GLV.digits4(m1l), axis=-1)     # (B, 33) MSB-first
-    d1h = jnp.flip(GLV.digits4(m1h), axis=-1)
-    d2l = jnp.flip(GLV.digits4(m2l), axis=-1).astype(jnp.uint32)
-    d2h = jnp.flip(GLV.digits4(m2h), axis=-1).astype(jnp.uint32)
-
+    d1l, d1h, s1l, s1h, d2l, d2h, s2l, s2h = _glv_split_digits(u1, u2)
     gt, gpt = _signed_g_tables()
     sd1l = d1l + 16 * s1l[:, None].astype(d1l.dtype)
     sd1h = d1h + 16 * s1h[:, None].astype(d1h.dtype)
@@ -565,13 +557,80 @@ def _glv_prep(u1, u2):
     return d2l, d2h, s2l, s2h, g1, g2
 
 
-def _run_glv_scan(d2l, d2h, qlo, qhi, g1, g2, tile: int, interpret: bool):
+def _glv_split_digits(u1, u2):
+    """Shared GLV split + MSB-first digit extraction for both prep
+    flavours: (d1l, d1h, s1l, s1h) fixed-base digit/sign arrays and
+    (d2l, d2h, s2l, s2h) per-element ones."""
+    from . import glv as GLV
+
+    m1l, s1l, m1h, s1h = GLV.split(u1)
+    m2l, s2l, m2h, s2h = GLV.split(u2)
+    d1l = jnp.flip(GLV.digits4(m1l), axis=-1)     # (B, 33) MSB-first
+    d1h = jnp.flip(GLV.digits4(m1h), axis=-1)
+    d2l = jnp.flip(GLV.digits4(m2l), axis=-1).astype(jnp.uint32)
+    d2h = jnp.flip(GLV.digits4(m2h), axis=-1).astype(jnp.uint32)
+    return d1l, d1h, s1l, s1h, d2l, d2h, s2l, s2h
+
+
+def _glv_prep_joint(u1, u2):
+    """Joint-G twin of _glv_prep: the two shared fixed-base selects
+    (signed G and φG tables, 32 entries each) collapse into ONE gather
+    from the 1024-entry pre-summed joint table (glv._g_joint_window_proj)
+    — the window kernel then streams a single G plane and spends one
+    point add per window instead of two.  The gather moves 33·240 B/elt
+    (~130 MB/dispatch @16384) where the two selected plane triples it
+    replaces moved 2·33·NLIMBS·3·4 B/elt (~260 MB), and it replaces the
+    two one-hot einsums."""
+    from . import glv as GLV
+
+    d1l, d1h, s1l, s1h, d2l, d2h, s2l, s2h = _glv_split_digits(u1, u2)
+    jt = jnp.asarray(GLV._g_joint_window_proj())  # (1024, 3, NLIMBS)
+    idx = (d1l + 16 * s1l[:, None].astype(d1l.dtype)
+           + 32 * (d1h + 16 * s1h[:, None].astype(d1h.dtype)))
+    sel = jnp.take(jt.reshape(1024, 3 * NLIMBS), idx.astype(jnp.int32),
+                   axis=0)                        # (B, 33, 60)
+    sel = sel.reshape(idx.shape[0], idx.shape[1], 3, NLIMBS)
+    g12 = tuple(jnp.transpose(sel[:, :, c], (1, 2, 0)) for c in range(3))
+    return d2l, d2h, s2l, s2h, g12
+
+
+def _dual_mul_kernel_glvj(d2l, d2h, qlx, qly, qlz, qhx, qhy, qhz,
+                          gx, gy, gz, ox, oy, oz):
+    """Joint-G GLV grid step: acc = 16·acc + Qlo_sel + Qhi_sel + G12,
+    where G12 = ±v1·G ± v2·φG arrives pre-summed from the shared
+    1024-entry joint table — one streamed add per window instead of two
+    (33 fewer point adds per verify than _dual_mul_kernel_glv)."""
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _init():
+        shape = ox.shape
+        row = lax.broadcasted_iota(jnp.uint32, shape, 0)
+        ox[...] = jnp.zeros(shape, jnp.uint32)
+        oy[...] = jnp.where(row == 0, jnp.uint32(1), jnp.uint32(0))
+        oz[...] = jnp.zeros(shape, jnp.uint32)
+
+    acc = (ox[...], oy[...], oz[...])
+    for _ in range(4):
+        acc = point_doubleT(acc)
+    acc = point_addT(acc, _sel16T(d2l[...][0], qlx, qly, qlz))
+    acc = point_addT(acc, _sel16T(d2h[...][0], qhx, qhy, qhz))
+    acc = point_addT(acc, (gx[0], gy[0], gz[0]))
+    ox[...], oy[...], oz[...] = acc
+
+
+def _run_glv_scan(d2l, d2h, qlo, qhi, g_planes, tile: int,
+                  interpret: bool):
     """The shared 33-window GLV scan pallas_call (grid, BlockSpecs and
     operand order in ONE place — the dig_spec shape in particular is a
     hard-won TPU lowering constraint; see dual_mul_pallas_v2).  qlo/qhi:
-    (16, NLIMBS, B) plane triples; g1/g2: (W, NLIMBS, B) triples."""
+    (16, NLIMBS, B) plane triples; g_planes: streamed (W, NLIMBS, B)
+    fixed-base triples — two (G, φG) for the glv kernel, one
+    (pre-summed joint) for the glvj kernel."""
     from .glv import NDIGITS_GLV
 
+    flat_g = [p for triple in g_planes for p in triple]
+    kernel = {3: _dual_mul_kernel_glvj, 6: _dual_mul_kernel_glv}[len(flat_g)]
     B = qlo[0].shape[-1]
     nb = B // tile
     tab_spec = pl.BlockSpec((16, NLIMBS, tile), lambda b, w: (0, 0, b))
@@ -580,13 +639,13 @@ def _run_glv_scan(d2l, d2h, qlo, qhi, g1, g2, tile: int, interpret: bool):
     g_spec = pl.BlockSpec((1, NLIMBS, tile), lambda b, w: (w, 0, b))
     out_spec = pl.BlockSpec((NLIMBS, tile), lambda b, w: (0, b))
     return pl.pallas_call(
-        _dual_mul_kernel_glv,
+        kernel,
         grid=(nb, NDIGITS_GLV),
-        in_specs=[dig_spec] * 2 + [tab_spec] * 6 + [g_spec] * 6,
+        in_specs=[dig_spec] * 2 + [tab_spec] * 6 + [g_spec] * len(flat_g),
         out_specs=[out_spec] * 3,
         out_shape=[jax.ShapeDtypeStruct((NLIMBS, B), jnp.uint32)] * 3,
         interpret=interpret,
-    )(d2l.T[:, None, :], d2h.T[:, None, :], *qlo, *qhi, *g1, *g2)
+    )(d2l.T[:, None, :], d2h.T[:, None, :], *qlo, *qhi, *flat_g)
 
 
 def dual_mul_pallas_glv(u1, u2, qx, qy, tile: int = 512,
@@ -616,7 +675,8 @@ def dual_mul_pallas_glv(u1, u2, qx, qy, tile: int = 512,
     qlo = (to_planes(tx), to_planes(ty_lo), to_planes(tz))
     qhi = (to_planes(tx_hi), to_planes(ty_hi), to_planes(tz))
 
-    ox, oy, oz = _run_glv_scan(d2l, d2h, qlo, qhi, g1, g2, tile, interpret)
+    ox, oy, oz = _run_glv_scan(d2l, d2h, qlo, qhi, (g1, g2), tile,
+                               interpret)
     return ox.T[:B0], oy.T[:B0], oz.T[:B0]
 
 
@@ -670,23 +730,14 @@ def _build_tables_kernel(bx, byl, sflip, olx, oly, olz, ohx, ohy, ohz):
         put(ohz, v, az)
 
 
-def dual_mul_pallas_fb(u1, u2, qx, qy, tile: int = 512,
-                       interpret: bool | None = None):
-    """GLV + fused window kernel + PALLAS table build: the per-element
-    window tables come from _build_tables_kernel (limbs-first) instead
-    of the batch-first XLA _build_window, so the only XLA prep left is
-    the GLV split/digits and one y-sign select.  Drop-in for dual_mul;
-    value-equal results pinned by tests against the exact-int oracle."""
-    B0 = u1.shape[0]
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    u1, u2, qx, qy, tile = _shape_batch(u1, u2, qx, qy, tile)
-    B = u1.shape[0]
-
-    d2l, d2h, s2l, s2h, g1, g2 = _glv_prep(u1, u2)
-
-    # signed-lo base + hi-derivation mask (the tables themselves are
-    # built limbs-first in the pallas kernel)
+def _build_q_tables(qx, qy, s2l, s2h, tile: int, interpret: bool):
+    """Shared per-element window-table build for the fb-family engines:
+    sign prep (signed-lo base + hi-derivation mask) and the
+    _build_tables_kernel dispatch live in ONE place — the BlockSpecs
+    and the 2-D output layout encode Mosaic lowering constraints (see
+    the kernel docstring) and must not fork per engine.  Returns
+    (qlo, qhi) plane triples, each (16, NLIMBS, B)."""
+    B = qx.shape[0]
     qy_neg = F.sub(F.FP, jnp.zeros_like(qy), qy)
     byl = jnp.where(s2l[:, None], qy_neg, qy)
     sflip = (s2l ^ s2h).astype(jnp.uint32)
@@ -704,9 +755,46 @@ def dual_mul_pallas_fb(u1, u2, qx, qy, tile: int = 512,
         interpret=interpret,
     )(qx.T, byl.T, sflip[None, :])
     planes = [a.reshape(16, NLIMBS, B) for a in qlo_and_qhi]
-    qlo, qhi = planes[:3], planes[3:]
+    return planes[:3], planes[3:]
 
-    ox, oy, oz = _run_glv_scan(d2l, d2h, qlo, qhi, g1, g2, tile, interpret)
+
+def dual_mul_pallas_fb(u1, u2, qx, qy, tile: int = 512,
+                       interpret: bool | None = None):
+    """GLV + fused window kernel + PALLAS table build: the per-element
+    window tables come from _build_tables_kernel (limbs-first) instead
+    of the batch-first XLA _build_window, so the only XLA prep left is
+    the GLV split/digits and one y-sign select.  Drop-in for dual_mul;
+    value-equal results pinned by tests against the exact-int oracle."""
+    B0 = u1.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    u1, u2, qx, qy, tile = _shape_batch(u1, u2, qx, qy, tile)
+    B = u1.shape[0]
+
+    d2l, d2h, s2l, s2h, g1, g2 = _glv_prep(u1, u2)
+    qlo, qhi = _build_q_tables(qx, qy, s2l, s2h, tile, interpret)
+    ox, oy, oz = _run_glv_scan(d2l, d2h, qlo, qhi, (g1, g2), tile,
+                               interpret)
+    return ox.T[:B0], oy.T[:B0], oz.T[:B0]
+
+
+def dual_mul_pallas_fbj(u1, u2, qx, qy, tile: int = 512,
+                        interpret: bool | None = None):
+    """pallas_fb + joint G table: in-kernel window-table build AND the
+    pre-summed 1024-entry fixed-base table, so each of the 33 windows
+    costs 4 doublings + 3 adds (vs 4+4 for pallas_fb) — ~12% fewer
+    point ops per verify.  Drop-in for dual_mul; value-equal results
+    pinned by tests against the exact-int oracle."""
+    B0 = u1.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    u1, u2, qx, qy, tile = _shape_batch(u1, u2, qx, qy, tile)
+    B = u1.shape[0]
+
+    d2l, d2h, s2l, s2h, g12 = _glv_prep_joint(u1, u2)
+    qlo, qhi = _build_q_tables(qx, qy, s2l, s2h, tile, interpret)
+    ox, oy, oz = _run_glv_scan(d2l, d2h, qlo, qhi, (g12,), tile,
+                               interpret)
     return ox.T[:B0], oy.T[:B0], oz.T[:B0]
 
 

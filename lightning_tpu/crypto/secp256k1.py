@@ -551,6 +551,8 @@ def resolve_dual_mul(name: str | None = None):
       pallas_glv — GLV + VMEM-resident tables (fewest HBM bytes + FLOPs)
       pallas_fb  — pallas_glv + IN-KERNEL window-table build (scratch
                    VMEM); remaining XLA prep is split/digits only
+      pallas_fbj — pallas_fb + pre-summed 1024-entry joint G/φG table:
+                   one fixed-base add per window instead of two
     """
     import os
 
@@ -565,7 +567,8 @@ def resolve_dual_mul(name: str | None = None):
     return {"pallas": PS.dual_mul_pallas,
             "pallas_v2": PS.dual_mul_pallas_v2,
             "pallas_glv": PS.dual_mul_pallas_glv,
-            "pallas_fb": PS.dual_mul_pallas_fb}[name]
+            "pallas_fb": PS.dual_mul_pallas_fb,
+            "pallas_fbj": PS.dual_mul_pallas_fbj}[name]
 
 
 def resolve_prep(name: str | None = None):

@@ -93,7 +93,7 @@ def test_dual_mul_pallas_v2_and_glv_match_oracle():
 
     norm = jax.jit(lambda v: F.normalize(F.FP, v))
     for impl in (PS.dual_mul_pallas_v2, PS.dual_mul_pallas_glv,
-                 PS.dual_mul_pallas_fb):
+                 PS.dual_mul_pallas_fb, PS.dual_mul_pallas_fbj):
         got = impl(u1, u2, qx, qy, tile=B)
         gx, gy = jax.jit(S.point_to_affine)(got)
         gxn = np.asarray(norm(gx))

@@ -98,6 +98,7 @@ def main():
         "pallas_v2": PS.dual_mul_pallas_v2,
         "pallas_glv": PS.dual_mul_pallas_glv,
         "pallas_fb": PS.dual_mul_pallas_fb,
+        "pallas_fbj": PS.dual_mul_pallas_fbj,
     }.get(impl)
     if dual is not None:
         dj = jax.jit(lambda a, b, x, y: dual(a, b, x, y))

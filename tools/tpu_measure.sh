@@ -29,8 +29,8 @@ echo "=== $(stamp) TPU measurement session ===" | tee -a "$LOG"
 
 echo "--- kernel sweep (impl x bucket, kernel-only, readback-timed)" \
   | tee -a "$LOG"
-BENCH_IMPLS=pallas_glv,pallas_fb,pallas_glv+pp,pallas_fb+pp \
-BENCH_BUCKETS=4096,8192,16384 \
+BENCH_IMPLS=pallas_fb+pp,pallas_fbj,pallas_fbj+pp \
+BENCH_BUCKETS=8192,16384 \
   timeout 2400 python bench.py --sweep 2>>"$LOG" | tee -a "$LOG"
 
 [ "${1:-}" = "sweep" ] && exit 0

@@ -1,17 +1,27 @@
 #!/bin/bash
-# Watch the axon tunnel; the moment it opens, run the measurement session.
+# Watch the axon tunnel; the moment it works, run the measurement session.
 # Single-shot: exits after one successful session (or after max wait).
+#
+# The probe must be a REAL backend init, not a port check: the wedge
+# mode observed rounds 4-5 keeps the port accepting while backend init
+# hangs forever — a port-only watcher then launches a session that
+# burns its probe budget and falls back to a uselessly slow CPU sweep.
+# The init probe runs in a throwaway subprocess (a hung init holds the
+# in-process backend lock unrecoverably) with its own jax cache dir
+# (two processes sharing a cache dir corrupt entries).
 cd "$(dirname "$0")/.."
 LOG=tpu_watch.log
 echo "$(date '+%F %T') watcher start" >> "$LOG"
-for i in $(seq 1 960); do  # up to ~12h at 45s
-  if timeout 3 bash -c 'echo > /dev/tcp/127.0.0.1/8083' 2>/dev/null; then
+for i in $(seq 1 240); do  # up to ~12h at ~3 min/iteration
+  if timeout 150 env LIGHTNING_TPU_JAX_CACHE=/tmp/jax_cache_probe \
+      python -c "import jax; assert jax.default_backend() != 'cpu'" \
+      2>/dev/null; then
     echo "$(date '+%F %T') tunnel UP — starting measurement session" >> "$LOG"
     bash tools/tpu_measure.sh >> "$LOG" 2>&1
     rc=$?
     echo "$(date '+%F %T') measurement session done rc=$rc" >> "$LOG"
     exit 0
   fi
-  sleep 45
+  sleep 30
 done
 echo "$(date '+%F %T') watcher gave up" >> "$LOG"
