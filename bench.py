@@ -180,16 +180,19 @@ def time_kernel_only(bucket: int, n_iters: int = 8,
     rng = np.random.default_rng(42)
     rows, nb, sigs, pubs = synth.make_signed_batch(bucket, rng)
     blocks = verify._bytes_to_blocks(rows, verify.MAX_BLOCKS)
-    # the PRODUCTION pipeline: hash → from-bytes verify (sig/pubkey
-    # bytes unpack on-device, exactly what verify_items dispatches)
+    # the PRODUCTION pipeline: hash → device-side z gather → from-bytes
+    # verify (sig/pubkey bytes unpack on-device, exactly what
+    # verify_items dispatches)
     args = (
         jnp.asarray(blocks), jnp.asarray(nb.astype(np.int32)),
+        jnp.asarray(np.arange(bucket, dtype=np.int32)),
         jnp.asarray(sigs), jnp.asarray(pubs),
     )
 
     def call():
         z = verify._jit_hash()(args[0], args[1])
-        return S._jit_verify_from_bytes(impl_name)(z, args[2], args[3])
+        z = S._jit_gather_rows()(z, args[2])
+        return S._jit_verify_from_bytes(impl_name)(z, args[3], args[4])
 
     ok = np.asarray(call())            # warm-up incl. compile + readback
     assert ok.all(), "kernel-only workload failed verification"

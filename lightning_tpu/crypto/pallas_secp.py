@@ -769,7 +769,6 @@ def dual_mul_pallas_fb(u1, u2, qx, qy, tile: int = 512,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     u1, u2, qx, qy, tile = _shape_batch(u1, u2, qx, qy, tile)
-    B = u1.shape[0]
 
     d2l, d2h, s2l, s2h, g1, g2 = _glv_prep(u1, u2)
     qlo, qhi = _build_q_tables(qx, qy, s2l, s2h, tile, interpret)
@@ -789,7 +788,6 @@ def dual_mul_pallas_fbj(u1, u2, qx, qy, tile: int = 512,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     u1, u2, qx, qy, tile = _shape_batch(u1, u2, qx, qy, tile)
-    B = u1.shape[0]
 
     d2l, d2h, s2l, s2h, g12 = _glv_prep_joint(u1, u2)
     qlo, qhi = _build_q_tables(qx, qy, s2l, s2h, tile, interpret)

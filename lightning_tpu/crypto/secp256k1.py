@@ -630,6 +630,23 @@ def _jit_verify_from_bytes(impl_name: str | None = None,
         *_resolve_engine_names(impl_name, prep_name))
 
 
+@functools.lru_cache(maxsize=1)
+def _jit_gather_rows():
+    """Device-side row gather (z limbs by per-signature row index).
+
+    Deliberately its OWN tiny jit program, NOT fused into the EC verify
+    program: its z_rows operand shape varies with the number of hash
+    buckets (K·bucket rows), and fusing it would recompile the whole
+    multi-minute EC program for every distinct K — a compile storm on
+    the live ingest path (see gossip.verify.warmup's postmortem).  As a
+    standalone take() the per-K compile is sub-second, the EC program
+    stays shape-static, and the hash→verify handoff is device-resident
+    either way (the previous host readback + re-upload of z between the
+    phases was a full sync point and ~30% of the measured e2e
+    store-replay wall clock)."""
+    return jax.jit(lambda z_rows, idx: jnp.take(z_rows, idx, axis=0))
+
+
 @functools.lru_cache(maxsize=16)
 def _jit_verify_from_bytes_resolved(impl_name: str, prep_name: str):
     impl = resolve_dual_mul(impl_name)

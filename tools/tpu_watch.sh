@@ -7,13 +7,13 @@
 # hangs forever — a port-only watcher then launches a session that
 # burns its probe budget and falls back to a uselessly slow CPU sweep.
 # The init probe runs in a throwaway subprocess (a hung init holds the
-# in-process backend lock unrecoverably) with its own jax cache dir
-# (two processes sharing a cache dir corrupt entries).
+# in-process backend lock unrecoverably); it never compiles anything,
+# so it touches no jax compilation cache.
 cd "$(dirname "$0")/.."
 LOG=tpu_watch.log
 echo "$(date '+%F %T') watcher start" >> "$LOG"
-for i in $(seq 1 240); do  # up to ~12h at ~3 min/iteration
-  if timeout 150 env LIGHTNING_TPU_JAX_CACHE=/tmp/jax_cache_probe \
+while [ "$SECONDS" -lt 43200 ]; do  # 12h deadline regardless of probe speed
+  if timeout 150 \
       python -c "import jax; assert jax.default_backend() != 'cpu'" \
       2>/dev/null; then
     echo "$(date '+%F %T') tunnel UP — starting measurement session" >> "$LOG"
