@@ -483,31 +483,42 @@ def _sel16T(d, tx, ty, tz):
     return sx, sy, sz
 
 
-def _dual_mul_kernel_glv(d2l, d2h, qlx, qly, qlz, qhx, qhy, qhz,
-                         g1x, g1y, g1z, g2x, g2y, g2z, ox, oy, oz):
-    """GLV grid step (33 windows instead of 64): acc = 16·acc + Qlo_sel
-    + Qhi_sel + Glo + Ghi.  Both per-element tables (Q and φQ, signs
-    pre-applied in XLA) are VMEM-resident across the whole scan; the
-    pre-selected/pre-signed G planes stream.  Pure arithmetic — no signs
-    or φ in-kernel."""
-    w = pl.program_id(1)
+@functools.lru_cache(maxsize=2)
+def _make_glv_kernel(n_g: int):
+    """GLV grid-step kernel over 33 windows: acc = 16·acc + Qlo_sel +
+    Qhi_sel + (n_g streamed fixed-base adds).  n_g=2 streams separate
+    pre-selected/pre-signed G and φG planes (pallas_glv/fb); n_g=1
+    streams the pre-summed joint ±v1·G ± v2·φG plane (pallas_fbj, 33
+    fewer point adds per verify).  Both per-element tables (Q and φQ,
+    signs pre-applied in XLA) are VMEM-resident across the whole scan;
+    the kernel body is pure arithmetic — ONE body serves both arities
+    so the accumulator-infinity init and lowering constraints cannot
+    fork."""
 
-    @pl.when(w == 0)
-    def _init():
-        shape = ox.shape
-        row = lax.broadcasted_iota(jnp.uint32, shape, 0)
-        ox[...] = jnp.zeros(shape, jnp.uint32)
-        oy[...] = jnp.where(row == 0, jnp.uint32(1), jnp.uint32(0))
-        oz[...] = jnp.zeros(shape, jnp.uint32)
+    def kernel(d2l, d2h, qlx, qly, qlz, qhx, qhy, qhz, *rest):
+        g_refs = rest[:3 * n_g]
+        ox, oy, oz = rest[3 * n_g:]
+        w = pl.program_id(1)
 
-    acc = (ox[...], oy[...], oz[...])
-    for _ in range(4):
-        acc = point_doubleT(acc)
-    acc = point_addT(acc, _sel16T(d2l[...][0], qlx, qly, qlz))
-    acc = point_addT(acc, _sel16T(d2h[...][0], qhx, qhy, qhz))
-    acc = point_addT(acc, (g1x[0], g1y[0], g1z[0]))
-    acc = point_addT(acc, (g2x[0], g2y[0], g2z[0]))
-    ox[...], oy[...], oz[...] = acc
+        @pl.when(w == 0)
+        def _init():
+            shape = ox.shape
+            row = lax.broadcasted_iota(jnp.uint32, shape, 0)
+            ox[...] = jnp.zeros(shape, jnp.uint32)
+            oy[...] = jnp.where(row == 0, jnp.uint32(1), jnp.uint32(0))
+            oz[...] = jnp.zeros(shape, jnp.uint32)
+
+        acc = (ox[...], oy[...], oz[...])
+        for _ in range(4):
+            acc = point_doubleT(acc)
+        acc = point_addT(acc, _sel16T(d2l[...][0], qlx, qly, qlz))
+        acc = point_addT(acc, _sel16T(d2h[...][0], qhx, qhy, qhz))
+        for k in range(n_g):
+            gx, gy, gz = g_refs[3 * k:3 * k + 3]
+            acc = point_addT(acc, (gx[0], gy[0], gz[0]))
+        ox[...], oy[...], oz[...] = acc
+
+    return kernel
 
 
 def _select_signed_shared_planes(tab32, digits_msb):
@@ -594,31 +605,6 @@ def _glv_prep_joint(u1, u2):
     return d2l, d2h, s2l, s2h, g12
 
 
-def _dual_mul_kernel_glvj(d2l, d2h, qlx, qly, qlz, qhx, qhy, qhz,
-                          gx, gy, gz, ox, oy, oz):
-    """Joint-G GLV grid step: acc = 16·acc + Qlo_sel + Qhi_sel + G12,
-    where G12 = ±v1·G ± v2·φG arrives pre-summed from the shared
-    1024-entry joint table — one streamed add per window instead of two
-    (33 fewer point adds per verify than _dual_mul_kernel_glv)."""
-    w = pl.program_id(1)
-
-    @pl.when(w == 0)
-    def _init():
-        shape = ox.shape
-        row = lax.broadcasted_iota(jnp.uint32, shape, 0)
-        ox[...] = jnp.zeros(shape, jnp.uint32)
-        oy[...] = jnp.where(row == 0, jnp.uint32(1), jnp.uint32(0))
-        oz[...] = jnp.zeros(shape, jnp.uint32)
-
-    acc = (ox[...], oy[...], oz[...])
-    for _ in range(4):
-        acc = point_doubleT(acc)
-    acc = point_addT(acc, _sel16T(d2l[...][0], qlx, qly, qlz))
-    acc = point_addT(acc, _sel16T(d2h[...][0], qhx, qhy, qhz))
-    acc = point_addT(acc, (gx[0], gy[0], gz[0]))
-    ox[...], oy[...], oz[...] = acc
-
-
 def _run_glv_scan(d2l, d2h, qlo, qhi, g_planes, tile: int,
                   interpret: bool):
     """The shared 33-window GLV scan pallas_call (grid, BlockSpecs and
@@ -630,7 +616,7 @@ def _run_glv_scan(d2l, d2h, qlo, qhi, g_planes, tile: int,
     from .glv import NDIGITS_GLV
 
     flat_g = [p for triple in g_planes for p in triple]
-    kernel = {3: _dual_mul_kernel_glvj, 6: _dual_mul_kernel_glv}[len(flat_g)]
+    kernel = _make_glv_kernel(len(g_planes))
     B = qlo[0].shape[-1]
     nb = B // tile
     tab_spec = pl.BlockSpec((16, NLIMBS, tile), lambda b, w: (0, 0, b))

@@ -1,0 +1,111 @@
+"""Scripted-fault matrix for the SPLICE dance (extends the open/commit/
+close matrix of test_fault_matrix.py to splicing): crash one side at
+every message of quiesce → splice_init/ack → interactive construction →
+inflight commitment exchange, in the reference's dev_disconnect
+`-`/`+` styles (/root/reference/common/dev_disconnect.h:8-44; its
+splice crash scripts live in tests/test_splicing.py).
+
+All faults here hit BEFORE either side's tx_signatures leaves, so the
+splice tx is provably unbroadcastable and the required disposition is a
+full rollback (splice._rollback_splice_state): both channels return to
+NORMAL on the OLD funding, no inflight survives in memory or db, value
+is conserved, and — the strong part — a fresh splice over the same
+still-open connection completes to the new capacity.
+
+The crash-AFTER-tx_signatures dispositions (survivor keeps a signed
+inflight, restart resume) are covered by test_splice_inflight.py.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lightning_tpu.channel.state import ChannelState  # noqa: E402
+from lightning_tpu.daemon import splice as SP  # noqa: E402
+from lightning_tpu.wire import messages as M  # noqa: E402
+from test_fault_matrix import fault_on_send  # noqa: E402
+from test_reestablish import (FUND, SendCrash, _open_pair,  # noqa: E402
+                              _teardown, run)
+from test_splice_inflight import funding_input  # noqa: E402
+
+ADD = 500_000
+
+SPLICE_FAULTS = [
+    ("a", M.Stfu, "-"),
+    ("a", M.Stfu, "+"),
+    ("b", M.Stfu, "-"),
+    ("b", M.Stfu, "+"),
+    ("a", M.SpliceInit, "-"),
+    ("a", M.SpliceInit, "+"),
+    ("b", M.SpliceAck, "-"),
+    ("b", M.SpliceAck, "+"),
+    ("a", M.TxComplete, "-"),
+    ("b", M.TxComplete, "-"),
+    ("a", M.CommitmentSigned, "-"),
+    ("b", M.CommitmentSigned, "-"),
+]
+
+
+@pytest.mark.parametrize(
+    "who,mtype,mode", SPLICE_FAULTS,
+    ids=[f"{w}{m}{t.__name__}" for w, t, m in SPLICE_FAULTS])
+def test_splice_dance_fault_then_clean_retry(tmp_path, who, mtype, mode):
+    async def body():
+        na, nb, wa, wb, ch_a, ch_b = await _open_pair(tmp_path)
+        target = ch_a if who == "a" else ch_b
+        restore = fault_on_send(target.peer, mtype, mode)
+
+        async def a_run():
+            await SP.splice_initiate(
+                ch_a, ADD, [funding_input(0x61, ADD + 2_000)])
+
+        async def b_run():
+            stfu = await ch_b.peer.recv(M.Stfu, timeout=60)
+            await SP.splice_accept(ch_b, stfu)
+
+        ta = asyncio.create_task(a_run())
+        tb = asyncio.create_task(b_run())
+        done, pending = await asyncio.wait(
+            {ta, tb}, return_when=asyncio.FIRST_COMPLETED, timeout=90)
+        assert done, "neither side reacted to the injected fault"
+        for t in pending:
+            t.cancel()
+        results = await asyncio.gather(ta, tb, return_exceptions=True)
+        assert any(isinstance(r, SendCrash) for r in results), results
+
+        # rollback disposition: both NORMAL on the old funding, no
+        # inflight anywhere, value conserved
+        for ch, w in ((ch_a, wa), (ch_b, wb)):
+            assert ch.core.state is ChannelState.NORMAL, (who, mode)
+            assert ch.funding_sat == FUND
+            assert ch.inflight is None
+            row = w.list_channels()[0]
+            assert not row["inflight"], json.loads(row["inflight"] or "{}")
+        assert ch_a.core.to_local_msat + ch_a.core.to_remote_msat \
+            == FUND * 1000
+        assert ch_a.core.to_local_msat == ch_b.core.to_remote_msat
+
+        # the connection is still up and quiescence fully unwound:
+        # a clean retry must complete the splice end-to-end
+        restore()
+        b2 = asyncio.create_task(b_run())
+        tx = await asyncio.wait_for(
+            SP.splice_initiate(
+                ch_a, ADD, [funding_input(0x62, ADD + 2_000)]), 120)
+        await asyncio.wait_for(b2, 30)
+        for ch in (ch_a, ch_b):
+            assert ch.core.state is ChannelState.NORMAL
+            assert ch.funding_sat == FUND + ADD
+            assert ch.funding_txid == tx.txid()
+            assert ch.inflight is None
+        assert ch_a.core.to_local_msat + ch_a.core.to_remote_msat \
+            == (FUND + ADD) * 1000
+        await _teardown(na, nb, wa, wb)
+
+    run(body())
