@@ -336,9 +336,13 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
         out[sl] = np.asarray(ok)[:n_real]
 
     # oversized rows: the device hashed garbage for them; their host
-    # sha256d was computed at extraction — verify those few serially
+    # sha256d was computed at extraction — verify those few serially.
+    # A builder that marks rows oversized MUST supply z_host, or valid
+    # signatures would silently verify as False off the garbage hash.
     ovs = items.n_blocks[roi] == 0
-    if ovs.any() and items.z_host is not None:
+    if ovs.any():
+        assert items.z_host is not None, \
+            "oversized rows (n_blocks == 0) require z_host"
         out[ovs] = S._host_verify(items.z_host[roi[ovs]],
                                   items.sigs[ovs], items.pubkeys[ovs])
     return out & tag_ok

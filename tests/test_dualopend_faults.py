@@ -1,0 +1,124 @@
+"""Scripted-fault matrix for the v2 DUAL-FUNDING open dance
+(completes the conformance matrices of test_fault_matrix.py (v1
+open/commit/close) and test_splice_faults.py): crash one side at every
+message of open_channel2 → accept_channel2 → interactive construction
+→ commitment_signed exchange → tx_signatures, dev_disconnect style
+(/root/reference/common/dev_disconnect.h:8-44; the reference exercises
+the v2 dance's aborts throughout tests/test_opening.py).
+
+Required disposition for every pre-broadcast fault: the injected side
+raises at its send, the surviving side unwinds with a connection
+error (never a hang — RECV_TIMEOUT here is 600 s, so a leaked recv
+would blow the test budget instantly), no channel reaches NORMAL, and
+a clean open between fresh nodes with the same parameters succeeds.
+Durable-disposition coverage (what survives a db-backed crash) lives
+in test_fault_matrix.py; this matrix pins the PROTOCOL unwind of the
+v2 dance itself.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lightning_tpu.channel.state import ChannelState  # noqa: E402
+from lightning_tpu.daemon import dualopend as DO  # noqa: E402
+from lightning_tpu.daemon.hsmd import CAP_MASTER, Hsm  # noqa: E402
+from lightning_tpu.daemon.node import LightningNode  # noqa: E402
+from lightning_tpu.wire import messages as M  # noqa: E402
+from test_dualopend import _utxo, run  # noqa: E402
+from test_fault_matrix import fault_on_send  # noqa: E402
+from test_reestablish import SendCrash  # noqa: E402
+
+OPEN_SAT = 500_000
+ACC_SAT = 300_000
+
+V2_FAULTS = [
+    ("a", M.OpenChannel2, "-"),
+    ("a", M.OpenChannel2, "+"),
+    ("b", M.AcceptChannel2, "-"),
+    ("b", M.AcceptChannel2, "+"),
+    ("a", M.TxAddInput, "-"),
+    ("a", M.TxComplete, "-"),
+    ("b", M.TxComplete, "-"),
+    ("a", M.CommitmentSigned, "-"),
+    ("b", M.CommitmentSigned, "-"),
+    ("a", M.TxSignatures, "-"),
+    ("b", M.TxSignatures, "-"),
+]
+
+
+async def _faulted_open(who, mtype, mode):
+    """One faulted v2 open between fresh nodes; returns the two
+    exceptions (opener's, accepter's)."""
+    hsm_a, hsm_b = Hsm(b"\xd1" * 32), Hsm(b"\xd2" * 32)
+    na = LightningNode(privkey=hsm_b.node_key)   # accepter listens
+    nb = LightningNode(privkey=hsm_a.node_key)   # opener dials
+    result: dict = {}
+    served = asyncio.Event()
+
+    async def serve(peer):
+        client = hsm_b.client(CAP_MASTER, peer.node_id, dbid=9)
+        if who == "b":
+            fault_on_send(peer, mtype, mode)
+        try:
+            result["res"] = await DO.accept_channel_v2(
+                peer, hsm_b, client, contribute_sat=ACC_SAT,
+                our_inputs=[_utxo(0xB0B, ACC_SAT + 50_000, salt=7)])
+        except BaseException as e:  # noqa: BLE001 — recorded, asserted on
+            result["err"] = e
+            await peer.disconnect()
+        finally:
+            served.set()
+
+    na.on_peer = serve
+    port = await na.listen()
+    peer = await nb.connect("127.0.0.1", port, na.node_id)
+    client = hsm_a.client(CAP_MASTER, peer.node_id, dbid=9)
+    if who == "a":
+        fault_on_send(peer, mtype, mode)
+    opener_err = None
+    try:
+        await asyncio.wait_for(DO.open_channel_v2(
+            peer, hsm_a, client, OPEN_SAT,
+            [_utxo(0xA11CE, OPEN_SAT + 30_000, salt=3)]), 120)
+    except BaseException as e:  # noqa: BLE001
+        opener_err = e
+    finally:
+        await peer.disconnect()
+    await asyncio.wait_for(served.wait(), 30)
+    await na.close()
+    await nb.close()
+    assert "res" not in result, "faulted open must not complete"
+    return opener_err, result.get("err")
+
+
+@pytest.mark.parametrize(
+    "who,mtype,mode", V2_FAULTS,
+    ids=[f"{w}{m}{t.__name__}" for w, t, m in V2_FAULTS])
+def test_v2_open_fault_unwinds_then_fresh_open_works(who, mtype, mode):
+    async def body():
+        a_err, b_err = await _faulted_open(who, mtype, mode)
+        # the injected side crashed AT its send; the survivor unwound
+        # with a connection/protocol error — neither hung
+        faulted = a_err if who == "a" else b_err
+        assert isinstance(faulted, SendCrash), (a_err, b_err)
+        assert (a_err if who == "b" else b_err) is not None
+
+        # same parameters, fresh nodes: the dance completes end-to-end
+        from test_dualopend import _open_v2
+        na, nb, ch_a, tx_a, ch_b, tx_b = await _open_v2(OPEN_SAT, ACC_SAT)
+        try:
+            assert tx_a.txid() == tx_b.txid()
+            assert ch_a.core.state is ChannelState.NORMAL
+            assert ch_b.core.state is ChannelState.NORMAL
+            assert ch_a.funding_sat == OPEN_SAT + ACC_SAT
+        finally:
+            await na.close()
+            await nb.close()
+
+    run(body())
