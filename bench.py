@@ -36,6 +36,11 @@ Every emitted line also carries:
 * last_measured_tpu: the most recent REAL-accelerator measurement
   (persisted in bench_last_tpu.json by any successful accelerator run),
   so a cpu-fallback round still carries the hardware signal.
+
+`--metrics` brackets the run with lightning_tpu.obs snapshots and embeds
+the per-counter diff (verify flush latency/occupancy/compile events) in
+the emitted line — the same registry a live daemon serves via the
+`getmetrics` RPC and REST `GET /metrics` (doc/observability.md).
 """
 import json
 import os
@@ -168,7 +173,13 @@ def time_kernel_only(bucket: int, n_iters: int = 8,
     one warm-up call (compile + page-in), then n_iters enqueued
     dispatches followed by a SINGLE host readback.  The readback is the
     only honest clock on the tunneled backend (block_until_ready returns
-    immediately there); queue order serializes the dispatches."""
+    immediately there); queue order serializes the dispatches.
+
+    timing_scope: since round 5 the timed call includes the device-side
+    z-row gather between the hash and verify phases (the production
+    verify_items pipeline).  Pre-round-5 kernel_only numbers excluded
+    it; `gather_ms_per_call` reports the gather's own cost so the two
+    eras stay comparable (ADVICE.md round 5 / BENCH_NOTES.md)."""
     import numpy as np
 
     import jax.numpy as jnp
@@ -195,15 +206,34 @@ def time_kernel_only(bucket: int, n_iters: int = 8,
         return S._jit_verify_from_bytes(impl_name)(z, args[3], args[4])
 
     ok = np.asarray(call())            # warm-up incl. compile + readback
-    assert ok.all(), "kernel-only workload failed verification"
+    if not ok.all():
+        raise AssertionError("kernel-only workload failed verification")
     t0 = time.perf_counter()
     for _ in range(n_iters):
         out = call()
-    assert bool(np.asarray(out).all())  # ONE readback drains the queue
+    # ONE readback drains the queue — a plain statement, not an assert:
+    # under `python -O` a stripped assert would skip the readback and
+    # time enqueue-only dispatch (wildly inflated throughput)
+    final_ok = bool(np.asarray(out).all())
     dt = time.perf_counter() - t0
+    if not final_ok:
+        raise AssertionError("kernel-only workload failed verification")
+
+    # gather-only cost, same enqueue-N + one-readback clock: isolates
+    # the inter-phase hop that round 5 folded into kernel_only
+    z_dev = verify._jit_hash()(args[0], args[1])
+    np.asarray(S._jit_gather_rows()(z_dev, args[2]))        # warm
+    tg = time.perf_counter()
+    for _ in range(n_iters):
+        g = S._jit_gather_rows()(z_dev, args[2])
+    np.asarray(g)
+    dtg = time.perf_counter() - tg
+
     return {"bucket": bucket, "iters": n_iters,
             "throughput": round(bucket * n_iters / dt, 1),
-            "ms_per_call": round(dt / n_iters * 1e3, 2)}
+            "ms_per_call": round(dt / n_iters * 1e3, 2),
+            "timing_scope": "hash+gather+verify",
+            "gather_ms_per_call": round(dtg / n_iters * 1e3, 3)}
 
 
 def run_bench(platform: str) -> dict:
@@ -356,14 +386,31 @@ def main():
             guard.cancel()
             run_sweep(platform)
             return
+        # --metrics: bracket the run with obs snapshots and embed the
+        # diff, so an offline bench round reports through the SAME
+        # counters a live daemon exposes via getmetrics / GET /metrics
+        metrics_mode = "--metrics" in sys.argv
+        snap0 = None
+        if metrics_mode:
+            from lightning_tpu import obs
+
+            obs.ensure_installed()
+            snap0 = obs.snapshot()
         r = run_bench(platform)
         guard.cancel()
+        extra = {}
+        if metrics_mode:
+            from lightning_tpu import obs
+
+            from tools.obs_snapshot import diff_snapshots
+
+            extra["metrics"] = diff_snapshots(snap0, obs.snapshot())
         label = platform if platform not in ("cpu",) else "cpu-fallback"
         emit(round(r["throughput"], 1),
              round(r["throughput"] / BASELINE_CPU_OPS, 3),
              n_sigs=r["n_sigs"], seconds=round(r["seconds"], 3),
              platform=label, kernel_only=r.get("kernel_only"),
-             impl=r.get("impl"), bucket=r.get("bucket"))
+             impl=r.get("impl"), bucket=r.get("bucket"), **extra)
     except Exception as e:
         guard.cancel()
         traceback.print_exc()

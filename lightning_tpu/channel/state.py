@@ -13,6 +13,7 @@ from __future__ import annotations
 import enum
 from dataclasses import dataclass, field
 
+from .. import obs
 from .commitment import (
     ANCHOR_OUTPUT_SAT,
     COMMITMENT_HTLC_WEIGHT,
@@ -160,6 +161,15 @@ _ON_SEND_REVOKE = {
 
 _FINAL_REMOVED = {HS.RCVD_REMOVE_ACK_REVOCATION, HS.SENT_REMOVE_ACK_REVOCATION}
 
+_M_CHANNEL_TRANSITIONS = obs.counter(
+    "clntpu_channel_state_transitions_total",
+    "Channel lifecycle transitions, by destination state",
+    labelnames=("to",))
+_M_HTLC_TRANSITIONS = obs.counter(
+    "clntpu_htlc_transitions_total",
+    "HTLC state-machine advances, by commitment-flow event",
+    labelnames=("event",))
+
 
 class ChannelError(Exception):
     pass
@@ -238,6 +248,7 @@ class ChannelCore:
         if new not in _LIFECYCLE[self.state]:
             raise ChannelError(f"illegal transition {self.state} → {new}")
         old, self.state = self.state, new
+        _M_CHANNEL_TRANSITIONS.labels(new.name).inc()
         from ..utils import events
 
         # channel_state_changed notification (lightningd/notification.c;
@@ -343,13 +354,15 @@ class ChannelCore:
 
     # -- commitment flow events -------------------------------------------
 
-    def _apply(self, table) -> list[LiveHtlc]:
+    def _apply(self, table, event: str) -> list[LiveHtlc]:
         changed = []
         for lh in self.htlcs.values():
             new = table.get(lh.state)
             if new is not None:
                 lh.state = new
                 changed.append(lh)
+        if changed:
+            _M_HTLC_TRANSITIONS.labels(event).inc(len(changed))
         return changed
 
     def pending_for_commit(self) -> bool:
@@ -358,7 +371,7 @@ class ChannelCore:
         return any(lh.state in _ON_SEND_COMMIT for lh in self.htlcs.values())
 
     def send_commit(self) -> list[LiveHtlc]:
-        changed = self._apply(_ON_SEND_COMMIT)
+        changed = self._apply(_ON_SEND_COMMIT, "send_commit")
         if self._fee_before_uncommitted is not None \
                 and self._fee_before_uncommitted[1]:
             self._fee_before_uncommitted = None  # our fee now committed
@@ -369,7 +382,7 @@ class ChannelCore:
         return changed
 
     def recv_revoke(self) -> list[LiveHtlc]:
-        changed = self._apply(_ON_RECV_REVOKE)
+        changed = self._apply(_ON_RECV_REVOKE, "recv_revoke")
         self._settle_removed()
         return changed
 
@@ -377,10 +390,10 @@ class ChannelCore:
         if self._fee_before_uncommitted is not None \
                 and not self._fee_before_uncommitted[1]:
             self._fee_before_uncommitted = None  # their fee now committed
-        return self._apply(_ON_RECV_COMMIT)
+        return self._apply(_ON_RECV_COMMIT, "recv_commit")
 
     def send_revoke(self) -> list[LiveHtlc]:
-        changed = self._apply(_ON_SEND_REVOKE)
+        changed = self._apply(_ON_SEND_REVOKE, "send_revoke")
         self._settle_removed()
         return changed
 

@@ -16,9 +16,22 @@ import logging
 import os
 import time
 
+from .. import obs
 from ..gossip.gossmap import scid_str
 
 log = logging.getLogger("lightning_tpu.jsonrpc")
+
+# the command table is ~180 methods deep and each can see 4 outcomes,
+# so this family gets a far wider cardinality cap than the default 64 —
+# method names are code-bounded, not attacker-controlled
+_M_RPC_CALLS = obs.counter(
+    "clntpu_rpc_requests_total",
+    "JSON-RPC requests dispatched, by method and outcome",
+    labelnames=("method", "status"), max_label_sets=1024)
+_M_RPC_SECONDS = obs.histogram(
+    "clntpu_rpc_latency_seconds",
+    "JSON-RPC handler latency, by method",
+    labelnames=("method",), max_label_sets=256)
 
 # JSON-RPC error codes (common/jsonrpc_errors.h)
 PARSE_ERROR = -32700
@@ -236,18 +249,31 @@ class JsonRpcServer:
             # connection-scoped commands get their client's identity
             # (AFTER positional mapping, so array-form calls get it too)
             params = dict(params, _writer=writer)
+        t0 = time.perf_counter()
+        # "aborted" survives when a BaseException (task cancellation on
+        # shutdown/disconnect) bypasses every except clause below but
+        # still runs the metrics finally-block
+        status = "aborted"
         try:
             result = handler(**params)
             if inspect.isawaitable(result):
                 result = await result
+            status = "ok"
             return {"jsonrpc": "2.0", "id": rid, "result": result}
         except RpcError as e:
+            status = "rpc_error"
             return _err(rid, e.code, str(e))
         except TypeError as e:
+            status = "invalid_params"
             return _err(rid, INVALID_PARAMS, str(e))
         except Exception as e:
+            status = "internal_error"
             log.exception("rpc %s failed", method)
             return _err(rid, INTERNAL_ERROR, f"{type(e).__name__}: {e}")
+        finally:
+            _M_RPC_CALLS.labels(method, status).inc()
+            _M_RPC_SECONDS.labels(method).observe(
+                time.perf_counter() - t0)
 
 
 def _err(rid, code: int, message: str) -> dict:
@@ -814,6 +840,16 @@ def attach_admin_commands(rpc: JsonRpcServer, cfg, ring) -> None:
         except ValueError as e:
             raise RpcError(INVALID_PARAMS, str(e))
 
+    # wire the logring into the obs collector here: the admin surface is
+    # where the daemon's ring and the metrics registry first meet
+    obs.ensure_installed(ring=ring)
+
+    async def getmetrics() -> dict:
+        """Full metrics snapshot (same registry the REST /metrics
+        endpoint renders; doc/observability.md for the naming scheme)."""
+        return obs.snapshot()
+
     rpc.register("listconfigs", listconfigs)
     rpc.register("setconfig", setconfig)
     rpc.register("getlog", getlog)
+    rpc.register("getmetrics", getmetrics)

@@ -11,12 +11,18 @@ import asyncio
 import logging
 import time
 
+from .. import obs
 from ..bolt import noise
 from ..wire import codec
 from ..wire import messages as M
 from .transport import NoiseStream
 
 log = logging.getLogger("lightning_tpu.peer")
+
+_M_MSGS = obs.counter(
+    "clntpu_peer_msgs_total",
+    "Lightning wire messages, by direction and peer",
+    labelnames=("direction", "peer"), max_label_sets=256)
 
 ZERO_CHANNEL_ID = b"\x00" * 32
 MAX_PONG_REPLY = 65532  # BOLT#1: >= this means "don't reply"
@@ -40,6 +46,10 @@ class Peer:
         self.node_id = node_id
         self.remote_features = remote_features
         self.incoming = incoming
+        # short prefix keeps the exposition readable; collisions only
+        # merge two peers' counters, never misroute traffic
+        self._obs_peer = node_id.hex()[:16]
+        stream.obs_peer = self._obs_peer
         self.inbox: asyncio.Queue = asyncio.Queue()
         self.connected = True
         self.connected_at = time.monotonic()
@@ -68,6 +78,7 @@ class Peer:
                 await self.disconnect()
                 raise ConnectionError("dev_disconnect")
             self._dev_disconnect_after -= 1
+        _M_MSGS.labels("out", self._obs_peer).inc()
         await self.stream.send_msg(msg.serialize())
 
     async def send_error(self, data: bytes, channel_id: bytes = ZERO_CHANNEL_ID):
@@ -147,9 +158,11 @@ class Peer:
     async def send_raw(self, raw: bytes) -> None:
         """Forward pre-serialized bytes (gossip fan-out path: connectd
         streams store records without re-encoding)."""
+        _M_MSGS.labels("out", self._obs_peer).inc()
         await self.stream.send_msg(raw)
 
     async def _handle_raw(self, raw: bytes) -> None:
+        _M_MSGS.labels("in", self._obs_peer).inc()
         try:
             t = codec.msg_type(raw)
         except codec.WireError:

@@ -56,12 +56,18 @@ class RestServer:
             log.exception("rest request failed")
             status, body = 500, {"error": "internal error"}
         try:
-            payload = json.dumps(body).encode()
+            if isinstance(body, bytes):
+                # pre-rendered non-JSON body (the /metrics exposition)
+                payload = body
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                payload = json.dumps(body).encode()
+                ctype = "application/json"
             reason = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
                       404: "Not Found", 500: "Error"}.get(status, "?")
             writer.write(
                 f"HTTP/1.1 {status} {reason}\r\n"
-                f"Content-Type: application/json\r\n"
+                f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(payload)}\r\n"
                 f"Connection: close\r\n\r\n".encode() + payload)
             await writer.drain()
@@ -90,6 +96,24 @@ class RestServer:
             return 400, {"error": "too many headers"}
 
         custom = self.custom_paths.get("/" + target.strip("/"))
+        if custom is None \
+                and target.split("?", 1)[0].rstrip("/") == "/metrics":
+            # Prometheus text exposition (GET; scrape-friendly; a
+            # clnrest-register-path mapping of /metrics takes
+            # precedence).  Under rune auth the scraper must send a
+            # rune permitting the equivalent `getmetrics` command in
+            # the `Rune` header.
+            if method_verb != "GET":
+                return 400, {"error": "use GET for /metrics"}
+            if self.commando is not None:
+                why = self.commando.check_rune(
+                    headers.get("rune") or "", "getmetrics", {}, b"")
+                if why is not None:
+                    return 401, {"error": f"rune rejected: {why}"}
+            from .. import obs
+
+            return 200, obs.render_prometheus().encode()
+
         if custom is not None:
             method = custom
         elif target.startswith("/v1/"):

@@ -12,10 +12,20 @@ from __future__ import annotations
 import asyncio
 import os
 
+from .. import obs
 from ..bolt import noise
 from ..crypto import ref_python as ref
 
 HANDSHAKE_TIMEOUT = 30.0
+
+# wire-level accounting: encrypted frame bytes per direction per peer
+# (the label is set by Peer once the node_id is known; pre-init traffic
+# books under the handshake placeholder).  Label cardinality is capped
+# by the registry, so a churning peer set folds into `<other>`.
+_M_BYTES = obs.counter(
+    "clntpu_peer_bytes_total",
+    "Encrypted transport bytes, by direction and peer",
+    labelnames=("direction", "peer"), max_label_sets=256)
 
 
 def random_keypair() -> noise.Keypair:
@@ -30,6 +40,7 @@ class NoiseStream:
         self.reader = reader
         self.writer = writer
         self.cm = cm
+        self.obs_peer = "handshake"   # Peer overwrites with the node_id
 
     @property
     def remote_pub_bytes(self) -> bytes:
@@ -39,10 +50,13 @@ class NoiseStream:
         hdr = await self.reader.readexactly(18)
         ln = self.cm.decrypt_length(hdr)
         body = await self.reader.readexactly(ln + 16)
+        _M_BYTES.labels("in", self.obs_peer).inc(18 + ln + 16)
         return self.cm.decrypt_body(body)
 
     async def send_msg(self, msg: bytes) -> None:
-        self.writer.write(self.cm.encrypt(msg))
+        frame = self.cm.encrypt(msg)
+        _M_BYTES.labels("out", self.obs_peer).inc(len(frame))
+        self.writer.write(frame)
         await self.writer.drain()
 
     async def close(self) -> None:

@@ -23,12 +23,30 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .. import obs
 from ..utils import native
 from . import store as gstore
 from . import verify as gverify
 from . import wire
 
 log = logging.getLogger("lightning_tpu.gossip.ingest")
+
+_M_FLUSH_SECONDS = obs.histogram(
+    "clntpu_gossip_flush_seconds",
+    "End-to-end wall time of one ingest flush "
+    "(build + device verify + apply + store append)")
+_M_FLUSH_SIGS = obs.histogram(
+    "clntpu_gossip_flush_sigs",
+    "Signatures per ingest flush", buckets=obs.SIZE_BUCKETS)
+_M_ACCEPTED = obs.counter(
+    "clntpu_gossip_accepted_total", "Gossip messages accepted")
+_M_DROPPED = obs.counter(
+    "clntpu_gossip_dropped_total",
+    "Gossip messages dropped/held before acceptance, by reason",
+    labelnames=("reason",))
+_M_QUEUE = obs.gauge(
+    "clntpu_gossip_queue_sigs",
+    "Signatures currently queued awaiting a verify flush")
 
 # Drop reasons (observable in tests/metrics).
 R_DUP = "duplicate"
@@ -64,6 +82,7 @@ class IngestStats:
 
     def drop(self, reason: str) -> None:
         self.dropped[reason] = self.dropped.get(reason, 0) + 1
+        _M_DROPPED.labels(reason).inc()
 
 
 class GossipIngest:
@@ -140,6 +159,7 @@ class GossipIngest:
         n_sigs = 4 if kind == wire.MSG_CHANNEL_ANNOUNCEMENT else 1
         self._queue.append(_QItem(kind, parsed, raw, source, n_sigs))
         self._queued_sigs += n_sigs
+        _M_QUEUE.set(self._queued_sigs)
         if self._flush_due is None:
             self._flush_due = self.now() + self.flush_ms / 1000.0
             # the loop may be parked on an indefinite wait — rearm it so
@@ -229,19 +249,23 @@ class GossipIngest:
         batch, self._queue = self._queue, []
         self._queued_sigs = 0
         self._flush_due = None
+        _M_QUEUE.set(0)
         if not batch:
             return
         self._flushing = True
+        t0 = time.perf_counter()
         try:
             await self._flush_batch(batch)
         finally:
             self._flushing = False
+            _M_FLUSH_SECONDS.observe(time.perf_counter() - t0)
 
     async def _flush_batch(self, batch: list[_QItem]) -> None:
         items = self._build_items(batch)
         self.stats.flushes += 1
         self.stats.batched_sigs += len(items)
         self.stats.max_batch = max(self.stats.max_batch, len(items))
+        _M_FLUSH_SIGS.observe(len(items))
         ok = await asyncio.to_thread(gverify.verify_items, items, self.bucket)
         # fold per-sig results to per-message (CAs have 4 sigs)
         sig_ok: list[bool] = []
@@ -264,6 +288,7 @@ class GossipIngest:
                  for it in self._accepted])
             self.writer.sync()
             self.stats.accepted += len(self._accepted)
+            _M_ACCEPTED.inc(len(self._accepted))
             if self.on_accept is not None:
                 for it in self._accepted:
                     self.on_accept(it.raw, it.source)

@@ -15,6 +15,7 @@ come back — hashes never round-trip to the host.
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -22,6 +23,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..crypto import field as F
 from ..crypto import secp256k1 as S
 from ..crypto import sha256 as H
@@ -37,6 +39,44 @@ import os as _os
 
 DEFAULT_BUCKET = int(_os.environ.get("LIGHTNING_TPU_VERIFY_BUCKET", str(S.VERIFY_BUCKET)))
 MAX_BLOCKS = 8  # 512-byte signed regions cover all standard gossip msgs
+
+# -- observability (doc/observability.md) ----------------------------------
+_M_FLUSH_SECONDS = obs.histogram(
+    "clntpu_verify_flush_seconds",
+    "Wall time of one verify_items dispatch (hash + verify phases)")
+_M_BATCH_SIGS = obs.histogram(
+    "clntpu_verify_batch_sigs",
+    "Signatures per verify_items call", buckets=obs.SIZE_BUCKETS)
+_M_OCCUPANCY = obs.histogram(
+    "clntpu_verify_batch_occupancy_ratio",
+    "Real lanes / padded lanes per verify_items call "
+    "(1.0 = no bucket padding waste)", buckets=obs.RATIO_BUCKETS)
+_M_LANES = obs.counter(
+    "clntpu_verify_lanes_total",
+    "Device lanes dispatched (real + pad), by kind",
+    labelnames=("kind",))
+_M_DEVICE_BYTES = obs.counter(
+    "clntpu_verify_device_bytes_total",
+    "Host->device bytes staged for verify dispatches")
+_M_OVERSIZED = obs.counter(
+    "clntpu_verify_oversized_host_total",
+    "Oversized rows (n_blocks == 0) verified on the host fallback path")
+_M_COMPILE = obs.counter(
+    "clntpu_verify_compile_events_total",
+    "New program shapes compiled (warmup or live), by program",
+    labelnames=("program",))
+
+# every (program, shape) jax compiles exactly once per process; tracking
+# first-sights here turns "did the live path hit a compile stall?" into
+# a scrape (warmup pre-populates the expected shapes, so a LIVE
+# increment means a flush paid a compile)
+_seen_shapes: set = set()
+
+
+def _note_shape(program: str, key: tuple) -> None:
+    if (program, key) not in _seen_shapes:
+        _seen_shapes.add((program, key))
+        _M_COMPILE.labels(program).inc()
 
 
 def gossip_hash_kernel(blocks, n_blocks):
@@ -61,15 +101,32 @@ def warmup(bucket: int = DEFAULT_BUCKET) -> None:
     first compiles it inside a live flush stalls gossip acceptance far
     past peer/test timeouts (found via test_gossip_origination on a
     fresh cache).  Call from startup — idempotent and cheap once the
-    jit caches are warm."""
+    jit caches are warm.
+
+    Residual per-K compile: the z-row gather's operand shape scales
+    with K = ceil(M / bucket) hash buckets, so each distinct K compiles
+    its own (tiny, sub-second) gather program on first sight.  We warm
+    K=1 and K=2 here (single- and multi-bucket flushes); a live flush
+    with K > 2 still pays one small gather compile, surfaced by the
+    ``clntpu_verify_compile_events_total{program="gather"}`` counter —
+    a LIVE increment after warmup means a flush hit a compile stall."""
     blocks = jnp.zeros((bucket, MAX_BLOCKS, 16), jnp.uint32)
     nb = jnp.ones((bucket,), jnp.int32)
+    _note_shape("hash", (bucket, MAX_BLOCKS))
     z = _jit_hash()(blocks, nb)
+    _note_shape("hash", (bucket, 4))
     _jit_hash()(blocks[:, :4], nb)   # the quantized small-row shape
     idx = jnp.zeros((bucket,), jnp.int32)
+    _note_shape("gather", (int(z.shape[0]), bucket))
     z = S._jit_gather_rows()(z, idx)
+    # multi-bucket flushes (M > bucket) gather from a K·bucket z plane;
+    # warm the K=2 shape so the first such live flush doesn't compile
+    z2 = jnp.concatenate([z, z])
+    _note_shape("gather", (int(z2.shape[0]), bucket))
+    S._jit_gather_rows()(z2, idx)
     sigs = jnp.zeros((bucket, 64), jnp.uint8)
     pubs = jnp.zeros((bucket, 33), jnp.uint8)
+    _note_shape("verify", (bucket,))
     np.asarray(S._jit_verify_from_bytes()(z, sigs, pubs))
 
 
@@ -287,6 +344,7 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
     N = len(items)
     if N == 0:
         return np.zeros(0, bool)
+    t_start = time.perf_counter()
     roi = items.row_of_item
     if roi is None:
         roi = np.arange(N, dtype=np.int64)
@@ -295,6 +353,7 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
 
     # --- hash phase (per unique row); z stays on device
     zs = []
+    staged_bytes = 0
     for start in range(0, M, bucket):
         end = min(start + bucket, M)
         sl = slice(start, end)
@@ -308,6 +367,8 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
         mb = 4 if 0 < mb <= 4 else MAX_BLOCKS
         blocks = _bytes_to_blocks(
             S._pad_rows(items.rows[sl], bucket)[:, :mb * 64], mb)
+        _note_shape("hash", (bucket, mb))
+        staged_bytes += blocks.nbytes + bucket * 4
         zs.append(_jit_hash()(
             jnp.asarray(blocks),
             jnp.asarray(S._pad_rows(items.n_blocks[sl],
@@ -319,6 +380,8 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
     out = np.zeros(N, bool)
     gather = S._jit_gather_rows()
     kern = S._jit_verify_from_bytes()
+    _note_shape("gather", (int(z_rows.shape[0]), bucket))
+    _note_shape("verify", (bucket,))
     pending = []
     for start in range(0, N, bucket):
         end = min(start + bucket, N)
@@ -331,6 +394,7 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
             jnp.asarray(S._pad_rows(items.sigs[sl], bucket)),
             jnp.asarray(S._pad_rows(items.pubkeys[sl], bucket)),
         )
+        staged_bytes += bucket * (4 + 64 + 33)
         pending.append((sl, end - start, ok))
     for sl, n_real, ok in pending:
         out[sl] = np.asarray(ok)[:n_real]
@@ -339,12 +403,26 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
     # sha256d was computed at extraction — verify those few serially.
     # A builder that marks rows oversized MUST supply z_host, or valid
     # signatures would silently verify as False off the garbage hash.
+    # An explicit raise, not assert: the contract must survive
+    # `python -O` (stripped asserts made this fail as an incidental
+    # TypeError on the None subscript).
     ovs = items.n_blocks[roi] == 0
     if ovs.any():
-        assert items.z_host is not None, \
-            "oversized rows (n_blocks == 0) require z_host"
+        if items.z_host is None:
+            raise ValueError(
+                "oversized rows (n_blocks == 0) require z_host")
+        _M_OVERSIZED.inc(int(ovs.sum()))
         out[ovs] = S._host_verify(items.z_host[roi[ovs]],
                                   items.sigs[ovs], items.pubkeys[ovs])
+
+    verify_lanes = ((N + bucket - 1) // bucket) * bucket
+    hash_lanes = ((M + bucket - 1) // bucket) * bucket
+    _M_BATCH_SIGS.observe(N)
+    _M_OCCUPANCY.observe(N / verify_lanes)
+    _M_LANES.labels("verify").inc(verify_lanes)
+    _M_LANES.labels("hash").inc(hash_lanes)
+    _M_DEVICE_BYTES.inc(staged_bytes)
+    _M_FLUSH_SECONDS.observe(time.perf_counter() - t_start)
     return out & tag_ok
 
 

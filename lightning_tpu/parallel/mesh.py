@@ -23,6 +23,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax < 0.6 ships shard_map under experimental only; the top-level alias
+# this module was written against does not exist on the pinned 0.4.x.
+# Public on purpose: __graft_entry__.py shares this compat shim.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map
+
 BATCH_AXIS = "batch"
 
 
@@ -75,7 +83,7 @@ def sharded_verify_fn(mesh: Mesh, compiler_options: tuple = ()):
     # would implement with cross-device collectives; per-shard it is a
     # pure-local Montgomery product tree, and the ONLY collective left is
     # the explicit psum of the valid-count.
-    sm = jax.shard_map(step, mesh=mesh,
-                       in_specs=(P(BATCH_AXIS),) * 5,
-                       out_specs=(P(BATCH_AXIS), P()))
+    sm = shard_map(step, mesh=mesh,
+                   in_specs=(P(BATCH_AXIS),) * 5,
+                   out_specs=(P(BATCH_AXIS), P()))
     return jax.jit(sm, compiler_options=dict(compiler_options) or None)

@@ -19,7 +19,19 @@ import logging
 import time
 from dataclasses import dataclass, field
 
+from .. import obs
+
 log = logging.getLogger("lightning_tpu.htlc_set")
+
+_M_PARTS = obs.counter(
+    "clntpu_htlc_set_parts_total",
+    "MPP parts offered to the accumulator, by outcome",
+    labelnames=("result",))
+_M_TIMEOUTS = obs.counter(
+    "clntpu_htlc_set_timeouts_total",
+    "MPP sets that timed out before completing")
+_M_OPEN = obs.gauge(
+    "clntpu_htlc_set_open", "MPP sets currently accumulating parts")
 
 MPP_TIMEOUT_SECONDS = 60
 MPP_TIMEOUT = 23   # BOLT#4 mpp_timeout failure code (0x17)
@@ -69,6 +81,8 @@ class HtlcSets:
         s = self.sets.pop(payment_hash, None)
         if s is None:
             return
+        _M_TIMEOUTS.inc()
+        _M_OPEN.set(len(self.sets))
         log.info("MPP set %s timed out with %d/%d msat",
                  payment_hash.hex()[:16], s.received, s.total_msat)
         for p in s.parts:
@@ -86,6 +100,16 @@ class HtlcSets:
                        fulfill callback (including this one's) has run
           "reject"   — not a valid part; caller fails the HTLC itself
         """
+        result = await self._add_part(payment_hash, amount_msat,
+                                      payment_secret, total_msat,
+                                      fulfill, fail)
+        _M_PARTS.labels(result).inc()
+        _M_OPEN.set(len(self.sets))
+        return result
+
+    async def _add_part(self, payment_hash: bytes, amount_msat: int,
+                        payment_secret: bytes | None, total_msat: int,
+                        fulfill, fail) -> str:
         rec = self.invoices.by_hash.get(payment_hash)
         if rec is None or rec.status != "unpaid":
             return "reject"

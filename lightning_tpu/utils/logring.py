@@ -50,6 +50,9 @@ class LogRing(logging.Handler):
         self.default_level = LEVELS[default_level]
         self.overrides: dict[str, int] = {}   # subsystem prefix -> levelno
         self.n_skipped = 0
+        # total records accepted per level name, monotone — the ring
+        # itself is bounded, so the obs collector reads emit rates here
+        self.n_emitted: dict[str, int] = {}
 
     # -- configuration ----------------------------------------------------
 
@@ -80,6 +83,7 @@ class LogRing(logging.Handler):
             msg = record.getMessage()
         except Exception:
             msg = str(record.msg)
+        self._count(record.levelno)
         self.entries.append(LogEntry(record.created, record.levelno,
                                      sub, msg))
 
@@ -87,8 +91,13 @@ class LogRing(logging.Handler):
             level: str = "info") -> None:
         """Direct structured append (non-stdlib paths)."""
         if LEVELS[level] >= self.threshold_for(subsystem):
+            self._count(LEVELS[level])
             self.entries.append(LogEntry(time.time(), LEVELS[level],
                                          subsystem, message))
+
+    def _count(self, levelno: int) -> None:
+        name = level_name(levelno)
+        self.n_emitted[name] = self.n_emitted.get(name, 0) + 1
 
     # -- RPC surface ------------------------------------------------------
 

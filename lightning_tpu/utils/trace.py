@@ -31,6 +31,10 @@ _records: list[dict] = []
 _MAX_RECORDS = 10_000
 _sink = None          # None → ring buffer; else callable(record)
 _file = None
+# taps see EVERY record regardless of the sink (the obs collector feeds
+# span-duration histograms from here; a tap must never raise into the
+# traced code path)
+_taps: list = []
 
 
 def set_sink(sink) -> None:
@@ -48,6 +52,18 @@ def set_sink(sink) -> None:
         _sink = sink
 
 
+def add_tap(fn) -> None:
+    """Register fn(record) to observe every completed span, independent
+    of (and in addition to) the configured sink."""
+    if fn not in _taps:
+        _taps.append(fn)
+
+
+def remove_tap(fn) -> None:
+    if fn in _taps:
+        _taps.remove(fn)
+
+
 def records() -> list[dict]:
     return list(_records)
 
@@ -57,6 +73,11 @@ def reset() -> None:
 
 
 def _emit(rec: dict) -> None:
+    for tap in list(_taps):
+        try:
+            tap(rec)
+        except Exception:
+            pass
     if _sink is not None:
         _sink(rec)
         return

@@ -1,0 +1,384 @@
+"""Observability layer: registry semantics, silo collector, RPC/REST
+exposition after a synthetic gossip flush, and the oversized-row
+contract (ISSUE 1 acceptance surface)."""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from lightning_tpu import obs
+from lightning_tpu.obs.registry import (OVERFLOW_LABEL, Registry,
+                                        log2_buckets)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 120))
+
+
+# ---------------------------------------------------------------------------
+# registry semantics (fresh private registries: no global-state coupling)
+
+def test_counter_gauge_basics():
+    r = Registry()
+    c = r.counter("clntpu_t_total", "help")
+    c.inc()
+    c.inc(2.5)
+    assert c.collect() == [((), 3.5)]
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("clntpu_t_gauge")
+    g.set(7)
+    g.dec(2)
+    assert g.collect() == [((), 5.0)]
+    # same name re-registers to the SAME family; kind clash is an error
+    assert r.counter("clntpu_t_total") is c
+    with pytest.raises(ValueError):
+        r.gauge("clntpu_t_total")
+    with pytest.raises(ValueError):
+        r.counter("0bad name")
+
+
+def test_histogram_bucket_boundaries():
+    r = Registry()
+    h = r.histogram("clntpu_t_seconds", buckets=(1.0, 2.0, 4.0))
+    # le is an INCLUSIVE upper bound (Prometheus): 1.0 lands in le=1
+    for v in (0.5, 1.0, 1.5, 4.0, 100.0):
+        h.observe(v)
+    ((_, sample),) = h.collect()
+    assert sample["buckets"] == [(1.0, 2), (2.0, 3), (4.0, 4)]
+    assert sample["count"] == 5
+    assert sample["sum"] == pytest.approx(107.0)
+    text = r.render_prometheus()
+    assert 'clntpu_t_seconds_bucket{le="+Inf"} 5' in text
+    assert "clntpu_t_seconds_count 5" in text
+
+
+def test_log2_buckets_fixed_ladder():
+    assert log2_buckets(1.0, 8.0) == (1.0, 2.0, 4.0, 8.0)
+    # non-power-of-two endpoints widen outward
+    assert log2_buckets(0.9, 5.0)[0] == 0.5
+    assert log2_buckets(0.9, 5.0)[-1] == 8.0
+
+
+def test_label_cardinality_cap_folds_to_other():
+    r = Registry()
+    c = r.counter("clntpu_t_peers_total", labelnames=("peer",),
+                  max_label_sets=3)
+    for i in range(10):
+        c.labels(f"peer{i}").inc()
+    collected = dict(c.collect())
+    # 3 real children + one overflow bucket holding the other 7
+    assert len(collected) == 4
+    assert collected[(OVERFLOW_LABEL,)] == 7.0
+    # existing children still addressable after the cap
+    c.labels("peer0").inc()
+    assert dict(c.collect())[("peer0",)] == 2.0
+
+
+def test_labels_keyword_form_and_validation():
+    r = Registry()
+    c = r.counter("clntpu_t_kw_total", labelnames=("a", "b"))
+    c.labels(a="x", b="y").inc()
+    assert dict(c.collect())[("x", "y")] == 1.0
+    with pytest.raises(ValueError):
+        c.labels("only-one")
+    with pytest.raises(ValueError):
+        c.inc()   # labeled family has no solo child
+
+
+def test_prometheus_escaping():
+    r = Registry()
+    c = r.counter("clntpu_t_esc_total", 'help with "quotes"',
+                  labelnames=("x",))
+    c.labels('va"l\nue').inc()
+    text = r.render_prometheus()
+    assert 'x="va\\"l\\nue"' in text
+
+
+def test_concurrent_emit_exact_counts():
+    """Counters mutate under per-instrument locks: worker threads
+    (asyncio.to_thread verify flushes) and the loop never lose incs."""
+    r = Registry()
+    c = r.counter("clntpu_t_mt_total")
+    h = r.histogram("clntpu_t_mt_seconds", buckets=(1.0,))
+
+    def worker():
+        for _ in range(5000):
+            c.inc()
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.collect() == [((), 40000.0)]
+    ((_, sample),) = h.collect()
+    assert sample["count"] == 40000
+
+    async def tasks():
+        async def bump():
+            for _ in range(100):
+                c.inc()
+        await asyncio.gather(*[bump() for _ in range(10)])
+
+    run(tasks())
+    assert c.collect() == [((), 41000.0)]
+
+
+def test_snapshot_shape_and_on_collect_hook():
+    r = Registry()
+    g = r.gauge("clntpu_t_pull")
+    r.on_collect(lambda: g.set(42))
+    snap = r.snapshot()
+    assert snap["metrics"]["clntpu_t_pull"]["samples"][0]["value"] == 42
+    assert snap["metrics"]["clntpu_t_pull"]["kind"] == "gauge"
+
+
+# ---------------------------------------------------------------------------
+# collector: the three silos feed the default registry
+
+def test_collector_spans_events_logring():
+    from lightning_tpu.utils import events, trace
+    from lightning_tpu.utils.logring import LogRing
+
+    ring = LogRing()
+    obs.ensure_installed(ring=ring)
+
+    def span_count():
+        fams = obs.snapshot()["metrics"]
+        fam = fams.get("clntpu_span_duration_seconds", {"samples": []})
+        return {tuple(s["labels"].items()): s["count"]
+                for s in fam["samples"]}
+
+    before = span_count().get((("name", "obs-test/span"),), 0)
+    with trace.span("obs-test/span"):
+        pass
+    with pytest.raises(RuntimeError):
+        with trace.span("obs-test/span"):
+            raise RuntimeError("boom")
+    after = span_count()[(("name", "obs-test/span"),)]
+    assert after == before + 2
+
+    snap = obs.snapshot()["metrics"]
+    errs = {tuple(s["labels"].items()): s["value"]
+            for s in snap["clntpu_span_errors_total"]["samples"]}
+    assert errs[(("name", "obs-test/span"),)] >= 1
+
+    # events tap survives events.reset() via ensure_installed
+    events.reset()
+    obs.ensure_installed()
+    events.emit("obs_test_topic", {})
+    snap = obs.snapshot()["metrics"]
+    topics = {tuple(s["labels"].items()): s["value"]
+              for s in snap["clntpu_events_total"]["samples"]}
+    assert topics[(("topic", "obs_test_topic"),)] >= 1
+
+    # logring emit counts surface as counters at collect time
+    ring.add("gossipd", "hello world", level="info")
+    snap = obs.snapshot()["metrics"]
+    emitted = {tuple(s["labels"].items()): s["value"]
+               for s in snap["clntpu_log_emitted_total"]["samples"]}
+    assert emitted[(("level", "INFO"),)] >= 1
+
+
+# ---------------------------------------------------------------------------
+# oversized-row contract (ADVICE round 5): explicit ValueError, not a
+# stripped assert decaying into TypeError under python -O
+
+def test_oversized_rows_require_z_host_valueerror():
+    from lightning_tpu.gossip import verify as gv
+
+    n = 2
+    items = gv.VerifyItems(
+        rows=np.zeros((n, gv.MAX_BLOCKS * 64), np.uint8),
+        n_blocks=np.zeros(n, np.uint32),      # 0 = oversized
+        sigs=np.zeros((n, 64), np.uint8),
+        pubkeys=np.full((n, 33), 2, np.uint8),
+        msg_index=np.arange(n, dtype=np.int64),
+        z_host=None,
+    )
+    # must raise the CONTRACT error (works identically under -O), never
+    # the incidental TypeError from subscripting None
+    with pytest.raises(ValueError, match="require z_host"):
+        gv.verify_items(items, bucket=8)
+
+    # counter increments when the contract IS satisfied
+    def oversized_count():
+        fam = obs.snapshot()["metrics"].get(
+            "clntpu_verify_oversized_host_total", {"samples": []})
+        return sum(s["value"] for s in fam["samples"])
+
+    before = oversized_count()
+    items.z_host = np.zeros((n, 32), np.uint8)
+    ok = gv.verify_items(items, bucket=8)
+    assert not ok.any()          # garbage sigs must not verify
+    assert oversized_count() == before + n
+
+
+# ---------------------------------------------------------------------------
+# integration: synthetic gossip flush → getmetrics RPC + REST /metrics
+
+def _fam_count(snap: dict, name: str) -> float:
+    fam = snap["metrics"].get(name, {"samples": []})
+    return sum(s.get("count", s.get("value", 0)) for s in fam["samples"])
+
+
+def test_flush_then_getmetrics_and_prometheus(tmp_path):
+    import test_ingest as TI
+
+    from lightning_tpu.daemon.jsonrpc import JsonRpcServer
+    from lightning_tpu.daemon.rest import RestServer
+    from lightning_tpu.gossip import ingest as gi
+    from lightning_tpu.gossip import verify as gverify
+
+    # compile (or cache-load) the bucket-64 programs OUTSIDE the async
+    # timeout: a cold standalone run otherwise spends minutes compiling
+    # inside the first flush and trips the 120 s harness timeout
+    gverify.warmup(64)
+
+    async def body():
+        snap0 = obs.snapshot()
+        ing = gi.GossipIngest(str(tmp_path / "obs.gs"), flush_ms=1.0,
+                              bucket=64)
+        ing.start()
+        await ing.submit(TI.make_ca(TI.K1, TI.K2, TI.SCID))
+        await ing.submit(TI.make_cu(TI.K1, TI.K2, TI.SCID, 0, ts=100))
+        await ing.submit(TI.make_na(TI.K1, ts=100))
+        await ing.drain()
+        await ing.close()
+
+        # -- getmetrics over a real unix-socket JSON-RPC roundtrip
+        rpc = JsonRpcServer(str(tmp_path / "rpc.sock"))
+        from lightning_tpu.utils.config import node_options
+        from lightning_tpu.utils.logring import LogRing
+
+        from lightning_tpu.daemon.jsonrpc import attach_admin_commands
+
+        attach_admin_commands(rpc, node_options(), LogRing())
+        await rpc.start()
+
+        async def call_getmetrics() -> dict:
+            reader, writer = await asyncio.open_unix_connection(
+                rpc.rpc_path)
+            writer.write(json.dumps({"jsonrpc": "2.0", "id": 1,
+                                     "method": "getmetrics"}).encode())
+            await writer.drain()
+            buf = b""
+            while b"\n\n" not in buf:
+                chunk = await reader.read(1 << 20)
+                if not chunk:
+                    break
+                buf += chunk
+            writer.close()
+            return json.loads(buf.decode().strip())["result"]
+
+        try:
+            await call_getmetrics()
+            # the snapshot is taken INSIDE the handler, before the
+            # dispatcher's finally-block counts the call — so only the
+            # second response can show the first call's bookkeeping
+            snap = await call_getmetrics()
+        finally:
+            await rpc.close()
+
+        for name in ("clntpu_verify_flush_seconds",
+                     "clntpu_verify_batch_occupancy_ratio",
+                     "clntpu_gossip_flush_seconds"):
+            assert _fam_count(snap, name) > _fam_count(snap0, name), name
+        accepted = snap["metrics"]["clntpu_gossip_accepted_total"]
+        assert accepted["samples"][0]["value"] >= 3
+        assert _fam_count(snap, "clntpu_verify_compile_events_total") > 0
+
+        # the getmetrics call itself is instrumented
+        rpc_calls = snap["metrics"].get("clntpu_rpc_requests_total",
+                                        {"samples": []})
+        labels = [s["labels"] for s in rpc_calls["samples"]]
+        assert {"method": "getmetrics", "status": "ok"} in labels
+
+        # -- Prometheus text over a real HTTP GET
+        srv = RestServer(rpc)
+        port = await srv.start()
+        try:
+            r2, w2 = await asyncio.open_connection("127.0.0.1", port)
+            w2.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+            await w2.drain()
+            raw = await r2.read()
+            w2.close()
+        finally:
+            await srv.close()
+        head, _, text = raw.partition(b"\r\n\r\n")
+        assert b"200 OK" in head.split(b"\r\n")[0]
+        assert b"text/plain" in head
+        body_text = text.decode()
+        assert "clntpu_verify_flush_seconds_bucket{" in body_text
+        assert "clntpu_verify_batch_occupancy_ratio_sum" in body_text
+        assert "clntpu_verify_compile_events_total{" in body_text
+
+    run(body())
+
+
+def test_metrics_rest_wrong_verb(tmp_path):
+    from lightning_tpu.daemon.jsonrpc import JsonRpcServer
+    from lightning_tpu.daemon.rest import RestServer
+
+    async def body():
+        rpc = JsonRpcServer(str(tmp_path / "r2.sock"))
+        srv = RestServer(rpc)
+        port = await srv.start()
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(b"POST /metrics HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Length: 0\r\n\r\n")
+            await w.drain()
+            raw = await r.read()
+            w.close()
+        finally:
+            await srv.close()
+        assert b"400" in raw.split(b"\r\n")[0]
+        assert b"use GET" in raw
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# obs_snapshot diff (the bench-side consumer)
+
+def test_obs_snapshot_diff():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from obs_snapshot import diff_snapshots
+
+    a = {"metrics": {
+        "clntpu_x_total": {"kind": "counter", "samples": [
+            {"labels": {"k": "a"}, "value": 1.0}]},
+        "clntpu_h_seconds": {"kind": "histogram", "samples": [
+            {"labels": {}, "buckets": [], "sum": 1.0, "count": 2}]},
+    }}
+    b = {"metrics": {
+        "clntpu_x_total": {"kind": "counter", "samples": [
+            {"labels": {"k": "a"}, "value": 4.0},
+            {"labels": {"k": "new"}, "value": 2.0}]},
+        "clntpu_h_seconds": {"kind": "histogram", "samples": [
+            {"labels": {}, "buckets": [], "sum": 7.0, "count": 4}]},
+        "clntpu_g": {"kind": "gauge", "samples": [
+            {"labels": {}, "value": 5.0}]},
+    }}
+    d = diff_snapshots(a, b)
+    deltas = {tuple(s["labels"].items()): s["delta"]
+              for s in d["clntpu_x_total"]["samples"]}
+    assert deltas[(("k", "a"),)] == 3.0
+    assert deltas[(("k", "new"),)] == 2.0
+    h = d["clntpu_h_seconds"]["samples"][0]
+    assert h["count"] == 2 and h["sum"] == pytest.approx(6.0)
+    assert h["mean"] == pytest.approx(3.0)
+    assert d["clntpu_g"]["samples"][0]["value"] == 5.0
+
+

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Capture and diff metrics snapshots (the bench-side consumer of the
+lightning_tpu.obs registry).
+
+Subcommands:
+  capture --rpc <unix-socket> [-o out.json]
+      Call `getmetrics` on a running daemon and write the snapshot.
+  capture --url http://host:port [-o out.json]
+      Scrape the REST `getmetrics` POST surface instead.
+  capture --local [-o out.json]
+      Snapshot THIS process's registry (only useful under -c/import).
+  diff a.json b.json
+      Print per-metric deltas b-a: counters as deltas, gauges as the
+      new value, histograms as count/sum deltas plus the mean.
+
+The diff output is the "what did this flush/bench actually do" view:
+two snapshots bracket a workload and the delta is attributable to it.
+`bench.py --metrics` embeds the same diff in its emitted JSON line so
+offline bench rounds and live scrapes finally share one vocabulary.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture_rpc(rpc_path: str) -> dict:
+    """getmetrics over the daemon's unix JSON-RPC socket."""
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(30)
+    s.connect(rpc_path)
+    s.sendall(json.dumps({"jsonrpc": "2.0", "id": 1,
+                          "method": "getmetrics"}).encode())
+    buf = b""
+    while b"\n\n" not in buf:
+        chunk = s.recv(1 << 20)
+        if not chunk:
+            break
+        buf += chunk
+    s.close()
+    resp = json.loads(buf.split(b"\n\n")[0])
+    if "error" in resp:
+        raise SystemExit(f"getmetrics failed: {resp['error']}")
+    return resp["result"]
+
+
+def capture_url(url: str, rune: str | None = None) -> dict:
+    """getmetrics over the REST gateway (POST /v1/getmetrics).  A
+    rune-gated daemon (commando configured) needs --rune."""
+    import urllib.request
+
+    headers = {"Rune": rune} if rune else {}
+    req = urllib.request.Request(url.rstrip("/") + "/v1/getmetrics",
+                                 data=b"{}", method="POST",
+                                 headers=headers)
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.load(r)
+
+
+def capture_local() -> dict:
+    from lightning_tpu import obs
+
+    return obs.snapshot()
+
+
+def _sample_key(rec: dict) -> tuple:
+    return tuple(sorted(rec.get("labels", {}).items()))
+
+
+def diff_snapshots(a: dict, b: dict) -> dict:
+    """Per-metric delta of two snapshot dicts (the getmetrics shape).
+    Metrics/samples absent from `a` count from zero."""
+    out: dict = {}
+    am = a.get("metrics", {})
+    for name, fam in b.get("metrics", {}).items():
+        prev = {_sample_key(s): s
+                for s in am.get(name, {}).get("samples", [])}
+        rows = []
+        for s in fam["samples"]:
+            p = prev.get(_sample_key(s), {})
+            labels = s.get("labels", {})
+            if fam["kind"] == "histogram":
+                dc = s["count"] - p.get("count", 0)
+                ds = s["sum"] - p.get("sum", 0.0)
+                if dc == 0:
+                    continue
+                rows.append({"labels": labels, "count": dc,
+                             "sum": round(ds, 6),
+                             "mean": round(ds / dc, 6)})
+            elif fam["kind"] == "counter":
+                d = s["value"] - p.get("value", 0.0)
+                if d == 0:
+                    continue
+                rows.append({"labels": labels, "delta": d})
+            else:  # gauge: point-in-time, report the new value
+                rows.append({"labels": labels, "value": s["value"]})
+        if rows:
+            out[name] = {"kind": fam["kind"], "samples": rows}
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(prog="obs_snapshot")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    cap = sub.add_parser("capture")
+    cap.add_argument("--rpc", help="daemon unix socket (lightning-rpc)")
+    cap.add_argument("--url", help="REST base url (http://127.0.0.1:PORT)")
+    cap.add_argument("--rune", help="rune for a commando-gated REST "
+                                    "server (with --url)")
+    cap.add_argument("--local", action="store_true",
+                     help="snapshot this process's registry")
+    cap.add_argument("-o", "--out", default="-")
+    d = sub.add_parser("diff")
+    d.add_argument("a")
+    d.add_argument("b")
+    args = p.parse_args()
+
+    if args.cmd == "capture":
+        if args.rpc:
+            snap = capture_rpc(args.rpc)
+        elif args.url:
+            snap = capture_url(args.url, rune=args.rune)
+        elif args.local:
+            snap = capture_local()
+        else:
+            p.error("need --rpc, --url, or --local")
+        text = json.dumps(snap, indent=1)
+        if args.out == "-":
+            print(text)
+        else:
+            with open(args.out, "w") as f:
+                f.write(text)
+    else:
+        with open(args.a) as f:
+            a = json.load(f)
+        with open(args.b) as f:
+            b = json.load(f)
+        print(json.dumps(diff_snapshots(a, b), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
