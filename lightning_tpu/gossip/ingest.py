@@ -91,12 +91,18 @@ class GossipIngest:
     def __init__(self, store_path: str, *, utxo_check=None,
                  flush_size: int = 256, flush_ms: float = 2.0,
                  bucket: int = gverify.DEFAULT_BUCKET,
+                 replay_depth: int | None = None,
                  on_accept=None, now=time.monotonic):
         self.writer = gstore.StoreWriter(store_path)
         self.utxo_check = utxo_check      # async (scid)->sat|None, or None
         self.flush_size = flush_size
         self.flush_ms = flush_ms
         self.bucket = bucket
+        # prepared-bucket pipeline depth for the verify flush (None =
+        # verify_items' default double-buffering; catch-up syncs whose
+        # flushes span many buckets overlap host pack with device
+        # compute, single-bucket live flushes are unaffected)
+        self.replay_depth = replay_depth
         self.on_accept = on_accept        # callback(raw, source)
         self.now = now
         self.stats = IngestStats()
@@ -127,7 +133,7 @@ class GossipIngest:
         self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def warmup(self) -> None:
-        """Pre-compile the hash+verify programs at this ingest's bucket
+        """Pre-compile the fused verify program at this ingest's bucket
         (see verify.warmup: a cold compile inside a live flush stalls
         acceptance for minutes).  Daemons call this at startup; safe to
         skip for pure-CPU library use where the caller prefers lazy
@@ -266,7 +272,8 @@ class GossipIngest:
         self.stats.batched_sigs += len(items)
         self.stats.max_batch = max(self.stats.max_batch, len(items))
         _M_FLUSH_SIGS.observe(len(items))
-        ok = await asyncio.to_thread(gverify.verify_items, items, self.bucket)
+        ok = await asyncio.to_thread(gverify.verify_items, items,
+                                     self.bucket, depth=self.replay_depth)
         # fold per-sig results to per-message (CAs have 4 sigs)
         sig_ok: list[bool] = []
         pos = 0

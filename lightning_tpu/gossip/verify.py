@@ -7,14 +7,25 @@ channel_update / node_announcement; each preceded by a sha256d).  Here the
 whole store (or any batch of messages) becomes flat arrays:
 
   host:   mmap store → native scan → vectorized field gathers
-  device: fused sha256d + batched ECDSA verify (one jit program)
+  device: fused sha256d + z-gather + batched ECDSA verify
+          (ONE jit program per bucket)
 
-The fused kernel means message bytes are uploaded once and only booleans
-come back — hashes never round-trip to the host.
+The replay is a streaming bucket pipeline (doc/replay_pipeline.md):
+signatures are sorted by message row and cut into self-contained
+buckets (a bucket's signatures reference only the bucket's own rows),
+so each bucket is one fused device dispatch with no inter-bucket data
+flow.  Host-side bucket prep (extraction slice, byte→block pack, pad)
+runs on a producer thread ahead of the dispatch loop — while bucket i
+verifies on device, bucket i+1 is being packed — and the only
+device→host transfer of the whole replay is the final boolean
+readback.  With >1 device the EC stage routes through the
+parallel/mesh.py batch sharding (sharded_verify_fn).
 """
 from __future__ import annotations
 
 import functools
+import queue as _queue
+import threading
 import time
 from dataclasses import dataclass
 
@@ -43,7 +54,8 @@ MAX_BLOCKS = 8  # 512-byte signed regions cover all standard gossip msgs
 # -- observability (doc/observability.md) ----------------------------------
 _M_FLUSH_SECONDS = obs.histogram(
     "clntpu_verify_flush_seconds",
-    "Wall time of one verify_items dispatch (hash + verify phases)")
+    "End-to-end wall time of one verify_items replay (plan + stream + "
+    "readback + host fallback)")
 _M_BATCH_SIGS = obs.histogram(
     "clntpu_verify_batch_sigs",
     "Signatures per verify_items call", buckets=obs.SIZE_BUCKETS)
@@ -66,6 +78,41 @@ _M_COMPILE = obs.counter(
     "New program shapes compiled (warmup or live), by program",
     labelnames=("program",))
 
+# -- streaming-replay pipeline stages (doc/replay_pipeline.md) -------------
+# Vocabulary: "prep" is host bucket build (extraction slice + byte→block
+# pack + pad), "stall" is the slice of prep that was VISIBLE on the
+# dispatch thread's critical path (waiting on the prepared-bucket queue;
+# in serial mode stall == prep by definition), "dispatch" is upload +
+# program enqueue, "readback" is the single end-of-replay block on the
+# device booleans.  overlap_ratio = 1 - stall/prep: the fraction of host
+# prep wall time hidden behind device compute.
+_M_R_PREP = obs.counter(
+    "clntpu_replay_prep_seconds_total",
+    "Host bucket-prep busy time (slice + pack + pad), all buckets")
+_M_R_STALL = obs.counter(
+    "clntpu_replay_prep_stall_seconds_total",
+    "Prep time visible on the dispatch critical path (queue-empty waits; "
+    "== prep time when the pipeline is serial/depth 0)")
+_M_R_DISPATCH = obs.counter(
+    "clntpu_replay_dispatch_seconds_total",
+    "Dispatch-thread time spent uploading + enqueueing bucket programs")
+_M_R_READBACK = obs.counter(
+    "clntpu_replay_readback_seconds_total",
+    "Time blocked on the single end-of-replay device readback")
+_M_R_OVERLAP = obs.histogram(
+    "clntpu_replay_overlap_ratio",
+    "Per-replay fraction of host prep hidden behind device compute "
+    "(1 - stall/prep; serial pipelines observe 0)",
+    buckets=obs.RATIO_BUCKETS)
+_M_R_QDEPTH = obs.histogram(
+    "clntpu_replay_queue_depth",
+    "Prepared-bucket queue depth sampled at each dispatch",
+    buckets=obs.log2_buckets(1.0, 16.0))
+_M_R_BUCKETS = obs.counter(
+    "clntpu_replay_buckets_total",
+    "Fused bucket dispatches, by device path",
+    labelnames=("path",))
+
 # every (program, shape) jax compiles exactly once per process; tracking
 # first-sights here turns "did the live path hit a compile stall?" into
 # a scrape (warmup pre-populates the expected shapes, so a LIVE
@@ -80,11 +127,10 @@ def _note_shape(program: str, key: tuple) -> None:
 
 
 def gossip_hash_kernel(blocks, n_blocks):
-    """sha256d(signed region) → z limbs.  Kept as a separate jit program
-    from the EC verify: one fused program is beyond what XLA:CPU compiles
-    in reasonable time.  The digest handoff to the verify phase is
-    device-resident (verify_items concatenates the padded z buckets on
-    device and S._jit_gather_rows gathers rows device-side)."""
+    """sha256d(signed region) → z limbs.  Still a standalone jit program
+    for the unfused fallback path (LIGHTNING_TPU_REPLAY_FUSED=0), the
+    mesh hash stage, and bench isolation; the default replay path runs
+    the fused bucket program below instead."""
     digest = H.sha256d_blocks(blocks, n_blocks)
     return H.digest_words_to_limbs(digest)
 
@@ -94,40 +140,120 @@ def _jit_hash():
     return jax.jit(gossip_hash_kernel)
 
 
-def warmup(bucket: int = DEFAULT_BUCKET) -> None:
-    """Compile (or load from the persistent cache) the hash + verify
-    programs at the given bucket, off the live path.  A cold XLA:CPU
-    compile of the EC verify program takes minutes; a daemon that
-    first compiles it inside a live flush stalls gossip acceptance far
-    past peer/test timeouts (found via test_gossip_origination on a
-    fresh cache).  Call from startup — idempotent and cheap once the
-    jit caches are warm.
+def fused_verify_kernel(blocks, n_blocks, roi, sig_bytes, pub_bytes,
+                        dual_mul_impl=None, prep_impl=None):
+    """ONE device program per bucket: sha256d(signed regions) → z-row
+    gather by local row index → byte→limb unpack → batched ECDSA verify.
 
-    Residual per-K compile: the z-row gather's operand shape scales
-    with K = ceil(M / bucket) hash buckets, so each distinct K compiles
-    its own (tiny, sub-second) gather program on first sight.  We warm
-    K=1 and K=2 here (single- and multi-bucket flushes); a live flush
-    with K > 2 still pays one small gather compile, surfaced by the
-    ``clntpu_verify_compile_events_total{program="gather"}`` counter —
-    a LIVE increment after warmup means a flush hit a compile stall."""
-    blocks = jnp.zeros((bucket, MAX_BLOCKS, 16), jnp.uint32)
+    Replaces the previous 3-program chain (_jit_hash → _jit_gather_rows
+    → _jit_verify_from_bytes) on the default path.  Fusing became
+    possible once buckets were made self-contained (a bucket's
+    signatures reference only the bucket's own rows, so the gather's
+    operand shape is the static (bucket, NLIMBS) — the old chain kept
+    the gather separate precisely because its z plane scaled with the
+    GLOBAL hash-bucket count K and would have recompiled the
+    multi-minute EC program per K).  A cold XLA:CPU compile of this
+    program takes ~4 min at full opt — warmup() covers both quantized
+    block widths, and the persistent cache serves every later process.
+    """
+    z_rows = H.digest_words_to_limbs(H.sha256d_blocks(blocks, n_blocks))
+    z = jnp.take(z_rows, roi, axis=0)
+    r = F.from_bytes_be_dev(sig_bytes[:, :32])
+    s = F.from_bytes_be_dev(sig_bytes[:, 32:])
+    qx = F.from_bytes_be_dev(pub_bytes[:, 1:])
+    parity = (pub_bytes[:, 0] & 1).astype(jnp.uint32)
+    return S.ecdsa_verify_kernel(z, r, s, qx, parity,
+                                 dual_mul_impl=dual_mul_impl,
+                                 prep_impl=prep_impl)
+
+
+@functools.lru_cache(maxsize=8)
+def _jit_fused_resolved(impl_name: str, prep_name: str, donate: bool):
+    impl = S.resolve_dual_mul(impl_name)
+    prep = S.resolve_prep(prep_name)
+    kern = functools.partial(fused_verify_kernel,
+                             dual_mul_impl=impl, prep_impl=prep)
+    # donate the big upload buffers (blocks/sigs/pubs) so the device
+    # runtime can reuse their memory inside the program; donation is a
+    # no-op (plus a per-call warning) on the CPU backend, so only ask
+    # for it where it does something
+    return jax.jit(kern, donate_argnums=(0, 3, 4) if donate else ())
+
+
+def _jit_fused():
+    donate = jax.default_backend() not in ("cpu",)
+    return _jit_fused_resolved(*S._resolve_engine_names(None, None), donate)
+
+
+def warmup(bucket: int = DEFAULT_BUCKET) -> None:
+    """Compile (or load from the persistent cache) the replay programs
+    at the given bucket, off the live path.  A cold XLA:CPU compile of
+    an EC program takes minutes; a daemon that first compiles one
+    inside a live flush stalls gossip acceptance far past peer/test
+    timeouts (found via test_gossip_origination on a fresh cache).
+    Call from startup — idempotent and cheap once the jit caches are
+    warm.
+
+    The default path needs exactly TWO programs per bucket: the fused
+    sha256d+gather+verify program at both quantized SHA block widths
+    (the bucket planner guarantees those are the only live shapes).
+    The unfused 3-program chain is warmed only when the fallback is
+    selected (LIGHTNING_TPU_REPLAY_FUSED=0) — eagerly tracing programs
+    the process will never dispatch costs seconds per warmup call."""
     nb = jnp.ones((bucket,), jnp.int32)
-    _note_shape("hash", (bucket, MAX_BLOCKS))
-    z = _jit_hash()(blocks, nb)
-    _note_shape("hash", (bucket, 4))
-    _jit_hash()(blocks[:, :4], nb)   # the quantized small-row shape
     idx = jnp.zeros((bucket,), jnp.int32)
-    _note_shape("gather", (int(z.shape[0]), bucket))
-    z = S._jit_gather_rows()(z, idx)
-    # multi-bucket flushes (M > bucket) gather from a K·bucket z plane;
-    # warm the K=2 shape so the first such live flush doesn't compile
-    z2 = jnp.concatenate([z, z])
-    _note_shape("gather", (int(z2.shape[0]), bucket))
-    S._jit_gather_rows()(z2, idx)
-    sigs = jnp.zeros((bucket, 64), jnp.uint8)
-    pubs = jnp.zeros((bucket, 33), jnp.uint8)
-    _note_shape("verify", (bucket,))
-    np.asarray(S._jit_verify_from_bytes()(z, sigs, pubs))
+    fused_on = _os.environ.get("LIGHTNING_TPU_REPLAY_FUSED", "1") != "0"
+    if fused_on:
+        for mb in (4, MAX_BLOCKS):
+            _note_shape("fused", (bucket, mb))
+            # fresh operand arrays EVERY call: the production program
+            # donates blocks/sigs/pubs on accelerators, so a reused
+            # array would be a deleted buffer on the second iteration
+            np.asarray(_jit_fused()(
+                jnp.zeros((bucket, mb, 16), jnp.uint32), nb, idx,
+                jnp.zeros((bucket, 64), jnp.uint8),
+                jnp.zeros((bucket, 33), jnp.uint8)))
+    else:
+        # the fallback 3-program chain — selected precisely to AVOID
+        # the fused program's compile, so don't warm the fused one
+        blocks = jnp.zeros((bucket, MAX_BLOCKS, 16), jnp.uint32)
+        _note_shape("hash", (bucket, MAX_BLOCKS))
+        z = _jit_hash()(blocks, nb)
+        _note_shape("hash", (bucket, 4))
+        _jit_hash()(blocks[:, :4], nb)   # the quantized small-row shape
+        _note_shape("gather", (int(z.shape[0]), bucket))
+        z = S._jit_gather_rows()(z, idx)
+        # multi-bucket flushes (M > bucket) gather from a K·bucket z
+        # plane; warm K=2 so the first such live flush doesn't compile
+        z2 = jnp.concatenate([z, z])
+        _note_shape("gather", (int(z2.shape[0]), bucket))
+        S._jit_gather_rows()(z2, idx)
+        _note_shape("verify", (bucket,))
+        np.asarray(S._jit_verify_from_bytes()(
+            z, jnp.zeros((bucket, 64), jnp.uint8),
+            jnp.zeros((bucket, 33), jnp.uint8)))
+    # if flushes would route through the mesh (>1 usable device and not
+    # opted out), warm THAT path's programs too — hash at both widths,
+    # the local z gather, and the sharded EC program — by pushing dummy
+    # prepared buckets through the real dispatcher (metrics suppressed:
+    # warmup buckets are not replay dispatches); otherwise the first
+    # multi-device flush pays the multi-minute cold compile this
+    # function exists to keep off the live path.  The unfused fallback
+    # never reaches the mesh (verify_items routes it first), so skip.
+    if (fused_on
+            and _os.environ.get("LIGHTNING_TPU_MESH_VERIFY", "auto")
+            != "off"):
+        mesh_fn = _mesh_device_fn(bucket, count_metrics=False)
+        if mesh_fn is not None:
+            for mb in (4, MAX_BLOCKS):
+                np.asarray(mesh_fn(_PreparedBucket(
+                    sel=np.arange(bucket), n_real=bucket, mb=mb,
+                    blocks=np.zeros((bucket, mb, 16), np.uint32),
+                    n_blocks=np.ones(bucket, np.int32),
+                    roi_local=np.zeros(bucket, np.int32),
+                    sigs=np.zeros((bucket, 64), np.uint8),
+                    pubkeys=np.zeros((bucket, 33), np.uint8),
+                    staged_bytes=0, prep_seconds=0.0)))
 
 
 def _bytes_to_blocks(rows: np.ndarray, max_blocks: int) -> np.ndarray:
@@ -324,45 +450,296 @@ def make_scid_map(ca_idx: StoreIndex):
     return lookup
 
 
-def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray:
-    """Two bucketed device phases with a DEVICE-RESIDENT handoff:
-    sha256d per unique MESSAGE row, then ECDSA verify per SIGNATURE
-    with the hash gathered by row_of_item ON DEVICE
-    (S._jit_gather_rows) and sig/pubkey bytes unpacked on-device.
+# ---------------------------------------------------------------------------
+# The streaming bucket pipeline (doc/replay_pipeline.md)
 
-    The z plane never visits the host: each padded hash bucket covers
-    rows [k·bucket, (k+1)·bucket), so concatenating the padded outputs
-    preserves global row indices and the verify phase gathers straight
-    from the concatenated device array (S._jit_gather_rows — a separate
-    tiny program so the shape-static EC program never recompiles).  The
-    whole replay is therefore one enqueue stream with a SINGLE readback
-    at the end — the previous z readback + re-upload between the phases
-    was a full sync point and ~30% of the measured 25k-store e2e wall
-    clock.  Oversized rows (n_blocks == 0, hashed host-side at
-    extraction) are re-checked on the host afterward.
-    Returns bool (N,)."""
+
+@dataclass
+class _PreparedBucket:
+    """One self-contained, fully host-prepped bucket: hash rows, local
+    row indices and signature operands, all padded to the bucket."""
+
+    sel: np.ndarray        # (n_real,) item indices, dispatch order
+    n_real: int
+    mb: int                # quantized SHA block width (4 or MAX_BLOCKS)
+    blocks: np.ndarray     # (bucket, mb, 16) uint32
+    n_blocks: np.ndarray   # (bucket,) int32
+    roi_local: np.ndarray  # (bucket,) int32 — row index WITHIN the bucket
+    sigs: np.ndarray       # (bucket, 64) uint8
+    pubkeys: np.ndarray    # (bucket, 33) uint8
+    staged_bytes: int
+    prep_seconds: float
+
+
+def _plan_buckets(roi_sorted: np.ndarray, bucket: int) -> list[tuple]:
+    """Cut the row-sorted signature stream into self-contained buckets:
+    ≤ bucket signatures AND ≤ bucket distinct rows each, so every
+    bucket's fused program sees static (bucket, ·) shapes.  A message
+    row whose signatures straddle a cut is simply hashed by both
+    buckets (≤ 3 duplicate rows per cut — CAs carry 4 sigs/row).
+    Returns [(sig_start, sig_end, row_start, row_end), ...]."""
+    N = len(roi_sorted)
+    out = []
+    start = 0
+    while start < N:
+        cap = min(start + bucket, N)
+        r0 = int(roi_sorted[start])
+        # signatures referencing rows beyond r0 + bucket can't gather
+        # from this bucket's (bucket,)-shaped z plane; cut before them
+        end = start + int(np.searchsorted(roi_sorted[start:cap],
+                                          r0 + bucket, side="left"))
+        out.append((start, end, r0, int(roi_sorted[end - 1]) + 1))
+        start = end
+    return out
+
+
+def _prep_bucket(items: VerifyItems, order: np.ndarray,
+                 roi_sorted: np.ndarray, bucket: int,
+                 chunk: tuple) -> _PreparedBucket:
+    """Host side of one bucket: slice rows, byte→block pack, pad.  Runs
+    on the producer thread in the overlapped pipeline."""
+    start, end, r0, r1 = chunk
+    t0 = time.perf_counter()
+    sel = order[start:end]
+    nb = items.n_blocks[r0:r1]
+    # rows arrive type-sorted (CA | NA | CU), so most buckets need far
+    # fewer SHA blocks than the 8-block pad: channel_updates fit in 3,
+    # node_announcements usually in 4.  Slicing the block axis halves
+    # the host→device bytes for those buckets; quantizing to
+    # {4, MAX_BLOCKS} bounds the fused-program shapes at two (both
+    # precompiled by warmup).
+    mbv = int(nb.max(initial=0))
+    mb = 4 if 0 < mbv <= 4 else MAX_BLOCKS
+    blocks = _bytes_to_blocks(
+        S._pad_rows(items.rows[r0:r1], bucket)[:, :mb * 64], mb)
+    nb_p = S._pad_rows(nb, bucket).astype(np.int32)
+    roi_l = S._pad_rows((roi_sorted[start:end] - r0).astype(np.int32),
+                        bucket)
+    sigs = S._pad_rows(items.sigs[sel], bucket)
+    pubs = S._pad_rows(items.pubkeys[sel], bucket)
+    staged = (blocks.nbytes + nb_p.nbytes + roi_l.nbytes
+              + sigs.nbytes + pubs.nbytes)
+    return _PreparedBucket(sel, end - start, mb, blocks, nb_p, roi_l,
+                           sigs, pubs, staged,
+                           time.perf_counter() - t0)
+
+
+def _fused_device_fn(bucket: int):
+    """Default device path: one fused program per prepared bucket."""
+    kern = _jit_fused()
+
+    def dispatch(pb: _PreparedBucket):
+        _note_shape("fused", (bucket, pb.mb))
+        _M_R_BUCKETS.labels("fused").inc()
+        return kern(jnp.asarray(pb.blocks), jnp.asarray(pb.n_blocks),
+                    jnp.asarray(pb.roi_local), jnp.asarray(pb.sigs),
+                    jnp.asarray(pb.pubkeys))
+
+    return dispatch
+
+
+@functools.lru_cache(maxsize=2)
+def _cached_mesh(n_devices: int):
+    from ..parallel import mesh as pmesh
+
+    return pmesh.make_mesh(jax.devices()[:n_devices])
+
+
+def _mesh_compiler_opts() -> tuple:
+    """Compiler options for the sharded EC program.  Defaults to cheap
+    LLVM options on the CPU backend (a virtual CPU mesh is a sharding
+    rig, not a perf rig; full opt quadruples its multi-minute compile)
+    and full optimization elsewhere.  LIGHTNING_TPU_MESH_COMPILE=
+    cheap|full overrides."""
+    from ..utils.jaxcfg import CHEAP_COMPILE_OPTS
+
+    mode = _os.environ.get("LIGHTNING_TPU_MESH_COMPILE", "")
+    if not mode:
+        mode = "cheap" if jax.default_backend() == "cpu" else "full"
+    return tuple(sorted(CHEAP_COMPILE_OPTS.items())) if mode == "cheap" \
+        else ()
+
+
+def _mesh_device_fn(bucket: int, count_metrics: bool = True):
+    """Multi-device path: hash + local z gather stay single-device jit
+    programs, the EC verify — ~99% of the device FLOPs — runs batch-
+    sharded over the mesh via parallel/mesh.py sharded_verify_fn (the
+    psum valid-count collective included).  Host converts sig/pubkey
+    bytes to limbs (the sharded program's operand contract); the z
+    plane moves device→mesh as a resharding device_put, never through
+    numpy.  Returns None when no usable mesh exists (then the caller
+    falls back to the fused single-device path).  count_metrics=False
+    suppresses the bucket counter (warmup's dummy dispatches are not
+    replay buckets; compile-event first-sights still record)."""
+    from ..parallel import mesh as pmesh
+
+    limit = _os.environ.get("LIGHTNING_TPU_MESH_DEVICES")
+    n = pmesh.usable_device_count(bucket,
+                                  int(limit) if limit else None)
+    if n < 2:
+        return None
+    mesh = _cached_mesh(n)
+    vfn = pmesh.sharded_verify_fn(mesh, _mesh_compiler_opts())
+
+    def dispatch(pb: _PreparedBucket):
+        _note_shape("hash", (bucket, pb.mb))
+        _note_shape("gather", (bucket, bucket))
+        _note_shape("mesh_verify", (bucket, n))
+        if count_metrics:
+            _M_R_BUCKETS.labels("mesh").inc()
+        z_rows = _jit_hash()(jnp.asarray(pb.blocks),
+                             jnp.asarray(pb.n_blocks))
+        z = S._jit_gather_rows()(z_rows, jnp.asarray(pb.roi_local))
+        r = F.from_bytes_be(pb.sigs[:, :32])
+        s = F.from_bytes_be(pb.sigs[:, 32:])
+        qx = F.from_bytes_be(pb.pubkeys[:, 1:])
+        parity = (pb.pubkeys[:, 0] & 1).astype(np.uint32)
+        zs, rs, ss, qxs, ps = pmesh.shard_batch(mesh, z, r, s, qx, parity)
+        ok, _count = vfn(zs, rs, ss, qxs, ps)
+        return ok
+
+    return dispatch
+
+
+def _select_device_fn(bucket: int, n_sigs: int):
+    """Route buckets to the mesh-sharded EC stage when the process has
+    >1 device and the batch is worth sharding; LIGHTNING_TPU_MESH_VERIFY
+    = auto (default) | on | off.  The auto threshold
+    (LIGHTNING_TPU_MESH_MIN_SIGS, default one full bucket) keeps
+    protocol-path one-off checks on the single-device program."""
+    mode = _os.environ.get("LIGHTNING_TPU_MESH_VERIFY", "auto")
+    if mode != "off":
+        try:
+            ndev = len(jax.devices())
+        except Exception:
+            ndev = 1
+        if ndev > 1:
+            min_sigs = int(_os.environ.get("LIGHTNING_TPU_MESH_MIN_SIGS",
+                                           str(bucket)))
+            if mode == "on" or n_sigs >= min_sigs:
+                fn = _mesh_device_fn(bucket)
+                if fn is not None:
+                    return fn
+    return _fused_device_fn(bucket)
+
+
+_DONE = object()
+
+
+def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
+                  depth: int | None, device_fn) -> tuple[np.ndarray, int]:
+    """Sort signatures by row, cut self-contained buckets, and stream
+    them: a producer thread preps bucket i+1 while bucket i's fused
+    program runs on device.  depth bounds the prepared-bucket queue
+    (HBM staging for ~depth in-flight buckets); depth 0 = serial
+    (prep inline on the dispatch thread — the measured baseline the
+    overlap metrics are asserted against).  Returns (out, n_buckets)."""
     N = len(items)
-    if N == 0:
-        return np.zeros(0, bool)
-    t_start = time.perf_counter()
-    roi = items.row_of_item
-    if roi is None:
-        roi = np.arange(N, dtype=np.int64)
-    M = items.rows.shape[0]
-    tag_ok = (items.pubkeys[:, 0] == 2) | (items.pubkeys[:, 0] == 3)
+    order = np.argsort(roi, kind="stable")
+    roi_sorted = roi[order]
+    chunks = _plan_buckets(roi_sorted, bucket)
+    if depth is None:
+        depth = int(_os.environ.get("LIGHTNING_TPU_REPLAY_DEPTH", "2"))
+    if device_fn is None:
+        device_fn = _select_device_fn(bucket, N)
+    prep = functools.partial(_prep_bucket, items, order, roi_sorted, bucket)
 
-    # --- hash phase (per unique row); z stays on device
+    out = np.zeros(N, bool)
+    # pending holds only (sel, n_real, device_ok): keeping the whole
+    # _PreparedBucket would pin every bucket's packed host arrays (≈ the
+    # re-packed store) in memory until the final readback
+    pending: list[tuple[np.ndarray, int, object]] = []
+    t_prep = t_stall = t_dispatch = 0.0
+    staged_bytes = 0
+
+    if depth > 0 and len(chunks) > 1:
+        q: _queue.Queue = _queue.Queue(maxsize=depth)
+        stop = threading.Event()  # dispatch failed: stop prepping
+
+        def _producer():
+            try:
+                for c in chunks:
+                    if stop.is_set():
+                        break
+                    q.put(prep(c))
+                q.put(_DONE)
+            except BaseException as e:  # surface on the dispatch thread
+                q.put(e)
+
+        th = threading.Thread(target=_producer, name="replay-prep",
+                              daemon=True)
+        th.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                pb = q.get()
+                t_stall += time.perf_counter() - t0
+                if pb is _DONE:
+                    break
+                if isinstance(pb, BaseException):
+                    raise pb
+                _M_R_QDEPTH.observe(q.qsize() + 1)
+                t0 = time.perf_counter()
+                ok = device_fn(pb)
+                t_dispatch += time.perf_counter() - t0
+                t_prep += pb.prep_seconds
+                staged_bytes += pb.staged_bytes
+                pending.append((pb.sel, pb.n_real, ok))
+        finally:
+            # the producer may be parked on a full queue if the
+            # dispatch loop raised — tell it to stop after the
+            # in-flight bucket and drain until it exits
+            stop.set()
+            while th.is_alive():
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    pass
+                th.join(timeout=0.005)
+    else:
+        for c in chunks:
+            pb = prep(c)
+            t_prep += pb.prep_seconds
+            t_stall += pb.prep_seconds  # serial: all prep is visible
+            t0 = time.perf_counter()
+            ok = device_fn(pb)
+            t_dispatch += time.perf_counter() - t0
+            staged_bytes += pb.staged_bytes
+            pending.append((pb.sel, pb.n_real, ok))
+
+    # the ONLY device→host transfer of the replay: drain the enqueued
+    # booleans in dispatch order
+    t0 = time.perf_counter()
+    for sel, n_real, ok in pending:
+        out[sel[:n_real]] = np.asarray(ok)[:n_real]
+    _M_R_READBACK.inc(time.perf_counter() - t0)
+
+    _M_R_PREP.inc(t_prep)
+    _M_R_STALL.inc(t_stall)
+    _M_R_DISPATCH.inc(t_dispatch)
+    if t_prep > 0:
+        _M_R_OVERLAP.observe(max(0.0, 1.0 - t_stall / t_prep))
+    lanes = len(chunks) * bucket
+    _M_LANES.labels("verify").inc(lanes)
+    _M_LANES.labels("hash").inc(lanes)
+    _M_DEVICE_BYTES.inc(staged_bytes)
+    return out, len(chunks)
+
+
+def _verify_items_unfused(items: VerifyItems, roi: np.ndarray,
+                          bucket: int) -> tuple[np.ndarray, int]:
+    """The pre-pipeline 3-program chain (hash buckets → device-resident
+    z concat → per-signature gather + verify).  Kept as the
+    LIGHTNING_TPU_REPLAY_FUSED=0 fallback: it needs no fused-program
+    compile, which matters on a backend whose persistent cache has only
+    the old programs.  Same device-resident z handoff, same single
+    readback."""
+    N, M = len(items), items.rows.shape[0]
     zs = []
     staged_bytes = 0
     for start in range(0, M, bucket):
         end = min(start + bucket, M)
         sl = slice(start, end)
-        # rows arrive type-sorted (CA | NA | CU), so most buckets need
-        # far fewer SHA blocks than the 8-block pad: channel_updates
-        # fit in 3, node_announcements usually in 4.  Slicing the block
-        # axis per bucket halves the host→device bytes for those
-        # buckets; quantizing to {4, MAX_BLOCKS} bounds the number of
-        # hash-program shapes at two (both precompiled by warmup).
         mb = int(items.n_blocks[sl].max(initial=0))
         mb = 4 if 0 < mb <= 4 else MAX_BLOCKS
         blocks = _bytes_to_blocks(
@@ -376,7 +753,6 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
         ))
     z_rows = zs[0] if len(zs) == 1 else jnp.concatenate(zs)
 
-    # --- verify phase (per signature), z gathered device-side
     out = np.zeros(N, bool)
     gather = S._jit_gather_rows()
     kern = S._jit_verify_from_bytes()
@@ -395,9 +771,52 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
             jnp.asarray(S._pad_rows(items.pubkeys[sl], bucket)),
         )
         staged_bytes += bucket * (4 + 64 + 33)
+        _M_R_BUCKETS.labels("unfused").inc()
         pending.append((sl, end - start, ok))
     for sl, n_real, ok in pending:
         out[sl] = np.asarray(ok)[:n_real]
+
+    verify_lanes = ((N + bucket - 1) // bucket) * bucket
+    hash_lanes = ((M + bucket - 1) // bucket) * bucket
+    _M_LANES.labels("verify").inc(verify_lanes)
+    _M_LANES.labels("hash").inc(hash_lanes)
+    _M_DEVICE_BYTES.inc(staged_bytes)
+    return out, (N + bucket - 1) // bucket
+
+
+def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET, *,
+                 depth: int | None = None, device_fn=None) -> np.ndarray:
+    """Streaming fused-bucket replay (doc/replay_pipeline.md).
+
+    Signatures are sorted by message row and cut into self-contained
+    buckets; each bucket is ONE fused device program (sha256d → local
+    z gather → ECDSA verify — sig/pubkey bytes unpack on-device), so
+    the z plane never leaves the device and the whole replay is one
+    enqueue stream with a SINGLE boolean readback at the end.  Host
+    bucket prep runs on a producer thread `depth` buckets ahead of the
+    dispatch loop (double-buffered by default), overlapping pack/pad
+    work with device compute — observable via the clntpu_replay_*
+    stage counters.  With >1 device, buckets route the EC stage
+    through parallel/mesh.py batch sharding (LIGHTNING_TPU_MESH_VERIFY).
+
+    Oversized rows (n_blocks == 0, hashed host-side at extraction) are
+    re-checked on the host afterward.  `device_fn` injects a bucket
+    dispatcher (tests); `depth` overrides LIGHTNING_TPU_REPLAY_DEPTH
+    (0 = serial prep, the overlap baseline).  Returns bool (N,)."""
+    N = len(items)
+    if N == 0:
+        return np.zeros(0, bool)
+    t_start = time.perf_counter()
+    roi = items.row_of_item
+    if roi is None:
+        roi = np.arange(N, dtype=np.int64)
+    tag_ok = (items.pubkeys[:, 0] == 2) | (items.pubkeys[:, 0] == 3)
+
+    if (device_fn is None
+            and _os.environ.get("LIGHTNING_TPU_REPLAY_FUSED", "1") == "0"):
+        out, n_buckets = _verify_items_unfused(items, roi, bucket)
+    else:
+        out, n_buckets = _run_pipeline(items, roi, bucket, depth, device_fn)
 
     # oversized rows: the device hashed garbage for them; their host
     # sha256d was computed at extraction — verify those few serially.
@@ -415,13 +834,8 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET) -> np.ndarray
         out[ovs] = S._host_verify(items.z_host[roi[ovs]],
                                   items.sigs[ovs], items.pubkeys[ovs])
 
-    verify_lanes = ((N + bucket - 1) // bucket) * bucket
-    hash_lanes = ((M + bucket - 1) // bucket) * bucket
     _M_BATCH_SIGS.observe(N)
-    _M_OCCUPANCY.observe(N / verify_lanes)
-    _M_LANES.labels("verify").inc(verify_lanes)
-    _M_LANES.labels("hash").inc(hash_lanes)
-    _M_DEVICE_BYTES.inc(staged_bytes)
+    _M_OCCUPANCY.observe(N / (n_buckets * bucket))
     _M_FLUSH_SECONDS.observe(time.perf_counter() - t_start)
     return out & tag_ok
 

@@ -40,6 +40,22 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.array(devices), (BATCH_AXIS,))
 
 
+def usable_device_count(batch: int, limit: int | None = None) -> int:
+    """Largest device count ≤ limit (default: all devices) that divides
+    the batch evenly — shard_map rejects ragged shards, so a bucket
+    must split exactly.  Returns 1 when no multi-device split fits
+    (callers fall back to the single-device program)."""
+    try:
+        n = len(jax.devices())
+    except Exception:
+        return 1
+    if limit is not None:
+        n = min(n, limit)
+    while n > 1 and batch % n:
+        n -= 1
+    return max(1, n)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(BATCH_AXIS))
 
@@ -67,11 +83,17 @@ def shard_batch(mesh: Mesh, *arrays):
 def sharded_verify_fn(mesh: Mesh, compiler_options: tuple = ()):
     """jit-compiled ECDSA verify step sharded over the mesh's batch axis.
 
-    Inputs: z, r, s, qx (B,16) uint32; parity (B,) uint32 — B divisible by
-    mesh size.  Output: bool (B,) with the same sharding, plus a replicated
-    scalar count of valid sigs (forces a psum collective, which doubles as
-    the aggregate "how many failed" signal gossipd wants).
-    """
+    Inputs: z, r, s, qx (B, NLIMBS) uint32 limb planes; parity (B,)
+    uint32 — B divisible by the mesh size.  Output: bool (B,) with the
+    same sharding, plus a replicated scalar count of valid sigs (forces
+    a psum collective, which doubles as the aggregate "how many failed"
+    signal gossipd wants).
+
+    Production consumer: gossip/verify.py verify_items routes replay
+    buckets here when the process has >1 device (the mesh path of the
+    streaming pipeline, doc/replay_pipeline.md); __graft_entry__'s
+    multichip dryrun exercises the same program on the virtual CPU
+    mesh."""
     from ..crypto import secp256k1 as S
 
     def step(z, r, s, qx, parity):

@@ -24,10 +24,33 @@ CHEAP_COMPILE_OPTS = {
 
 
 def setup_cache(path: str | None = None) -> None:
+    """Point jax at the persistent compilation cache.
+
+    LIGHTNING_TPU_JAX_CACHE_MODE gates how the process uses it:
+      rw (default) — read + write (daemons, benches, warmup scripts)
+      ro           — read-only: warm programs still load instantly,
+                     but nothing new is serialized.  The suite runs in
+                     this mode (tests/conftest.py): the cache-WRITE
+                     path (executable serialization on a box this
+                     loaded) is where the long-standing 1-in-2 pytest
+                     SIGSEGV fired, and a test run has no business
+                     mutating the shared cache anyway — new programs
+                     are warmed into it once, out-of-band, via
+                     `python -c "from lightning_tpu.gossip.verify
+                     import warmup; warmup(8)"`.
+      off          — no persistent cache at all (cold compiles every
+                     process; only for debugging the cache itself).
+    """
+    mode = os.environ.get("LIGHTNING_TPU_JAX_CACHE_MODE", "rw")
+    if mode == "off":
+        return
     path = path or os.environ.get("LIGHTNING_TPU_JAX_CACHE", _DEFAULT_CACHE)
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # read-only: an absurd write threshold keeps every lookup live but
+    # makes no compile ever eligible for serialization
+    min_secs = 1.0 if mode != "ro" else 1e9
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_secs)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 

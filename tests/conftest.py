@@ -18,6 +18,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("LIGHTNING_TPU_VERIFY_BUCKET", "8")
 os.environ.setdefault("LIGHTNING_TPU_SIGN_BUCKET", "8")
 
+# The persistent compile cache is READ-ONLY under pytest: the cache
+# write path (executable serialization) is where the flaky ~1-in-2
+# suite SIGSEGV fired, and warm reads are all the suite needs — new
+# program shapes are warmed into the cache out-of-band (see
+# jaxcfg.setup_cache for the knob and doc/replay_pipeline.md §testing).
+os.environ.setdefault("LIGHTNING_TPU_JAX_CACHE_MODE", "ro")
+
+# The virtual 8-device mesh exists to exercise sharding CORRECTNESS,
+# not to route every little verify through shard_map: the suite pins
+# the single-device fused path; tests/test_zz_mesh_parity.py flips
+# this on explicitly and asserts bit-identical output.
+os.environ.setdefault("LIGHTNING_TPU_MESH_VERIFY", "off")
+
 from lightning_tpu.utils.jaxcfg import force_cpu, setup_cache
 
 force_cpu(n_devices=8)

@@ -1,0 +1,262 @@
+"""Streaming replay-pipeline tests: bucket planner invariants, the
+host-prep/device-compute overlap contract (clntpu_replay_* metrics),
+the device-resident z handoff, and fused-vs-unfused parity.
+
+Named test_zz_* to sort LAST: the overlap test drives a 25k-row
+synthetic replay and the tier-1 runner has a hard wall-clock budget —
+heavy tests mid-alphabet displace cheaper tests past the cutoff.
+
+The overlap contract (ISSUE 2 acceptance): host prep wall time must be
+≤ 20% VISIBLE on the end-to-end critical path with the double-buffered
+pipeline, vs ≥ 90% visible in the serial baseline.  "Visible" is the
+clntpu_replay_prep_stall_seconds_total counter — dispatch-thread time
+spent waiting on the prepared-bucket queue (== all of prep when
+serial).  The device side is a stub dispatcher so the assertion holds
+on any backend: it measures the pipeline MACHINERY, which is exactly
+what the issue asks to demonstrate ("measurable via obs counters on
+any backend").
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import functools
+
+from lightning_tpu import obs
+from lightning_tpu.gossip import verify
+
+
+@functools.lru_cache(maxsize=1)
+def _signed_batch27():
+    from lightning_tpu.gossip import synth
+
+    return synth.make_signed_batch(27)
+
+
+def _counter(snap: dict, name: str) -> float:
+    fam = snap["metrics"].get(name, {"samples": []})
+    return sum(s["value"] for s in fam["samples"])
+
+
+def _hist(snap: dict, name: str) -> tuple[float, float]:
+    fam = snap["metrics"].get(name, {"samples": []})
+    return (sum(s["count"] for s in fam["samples"]),
+            sum(s["sum"] for s in fam["samples"]))
+
+
+# ---------------------------------------------------------------------------
+# bucket planner
+
+
+def test_plan_buckets_self_contained():
+    """Every bucket: ≤ bucket sigs, ≤ bucket rows, rows cover its sigs."""
+    rng = np.random.default_rng(3)
+    # CA-style fan-out: 4 sigs per row, row-sorted
+    roi = np.sort(np.tile(np.arange(1000, dtype=np.int64), 4))
+    chunks = verify._plan_buckets(roi, 64)
+    covered = 0
+    for start, end, r0, r1 in chunks:
+        assert end - start <= 64
+        assert r1 - r0 <= 64
+        assert int(roi[start]) >= r0 and int(roi[end - 1]) < r1
+        covered += end - start
+    assert covered == len(roi)
+
+
+def test_plan_buckets_row_straddle_is_safe():
+    """A row whose sigs straddle a cut appears in both buckets' row
+    ranges (hashed twice, never mis-gathered)."""
+    roi = np.sort(np.tile(np.arange(6, dtype=np.int64), 4))  # 24 sigs
+    chunks = verify._plan_buckets(roi, 10)
+    for start, end, r0, r1 in chunks:
+        assert {int(x) for x in roi[start:end]} <= set(range(r0, r1))
+
+
+def test_plan_buckets_sparse_rows():
+    """Signatures referencing far-apart rows force row-span cuts."""
+    roi = np.array([0, 1, 900, 901, 902, 1800], dtype=np.int64)
+    chunks = verify._plan_buckets(roi, 8)
+    assert [c[:2] for c in chunks] == [(0, 2), (2, 5), (5, 6)]
+    for start, end, r0, r1 in chunks:
+        assert r1 - r0 <= 8
+
+
+# ---------------------------------------------------------------------------
+# overlap contract (stub device, any backend)
+
+
+def _synthetic_items(n_rows: int) -> verify.VerifyItems:
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, 256, (n_rows, verify.MAX_BLOCKS * 64),
+                        dtype=np.uint16).astype(np.uint8)
+    nb = np.full(n_rows, 3, np.uint32)
+    sigs = np.zeros((n_rows, 64), np.uint8)
+    pubs = np.zeros((n_rows, 33), np.uint8)
+    pubs[:, 0] = 2
+    return verify.VerifyItems(rows, nb, sigs, pubs,
+                              np.arange(n_rows, dtype=np.int64))
+
+
+def _stub_device(sleep_s: float):
+    def dispatch(pb):
+        if sleep_s:
+            time.sleep(sleep_s)
+        return np.ones(pb.blocks.shape[0], bool)
+
+    return dispatch
+
+
+def test_overlap_metrics_25k_row_replay():
+    items = _synthetic_items(25_000)
+    bucket = 512  # 49 buckets
+
+    # serial baseline (depth 0): prep is inline on the dispatch thread,
+    # so ALL of it is visible on the critical path
+    s0 = obs.snapshot()
+    ok = verify.verify_items(items, bucket=bucket, depth=0,
+                             device_fn=_stub_device(0.0))
+    s1 = obs.snapshot()
+    assert ok.all() and len(ok) == 25_000
+    prep = _counter(s1, "clntpu_replay_prep_seconds_total") - \
+        _counter(s0, "clntpu_replay_prep_seconds_total")
+    stall = _counter(s1, "clntpu_replay_prep_stall_seconds_total") - \
+        _counter(s0, "clntpu_replay_prep_stall_seconds_total")
+    assert prep > 0
+    assert stall >= 0.9 * prep, (stall, prep)
+
+    # overlapped pipeline (double-buffered): device time per bucket is
+    # 4× the measured average prep, so prep has ample room to hide
+    # behind it.  The assertion is about thread scheduling on a 1-core
+    # box, so allow a couple of attempts before calling it a failure —
+    # a single preempted producer wakeup must not fail the gate.
+    n_chunks = len(verify._plan_buckets(np.arange(25_000), bucket))
+    sleep = max(4.0 * prep / n_chunks, 0.005)
+    last = None
+    for _attempt in range(3):
+        s2 = obs.snapshot()
+        ok = verify.verify_items(items, bucket=bucket, depth=2,
+                                 device_fn=_stub_device(sleep))
+        s3 = obs.snapshot()
+        assert ok.all()
+        prep2 = _counter(s3, "clntpu_replay_prep_seconds_total") - \
+            _counter(s2, "clntpu_replay_prep_seconds_total")
+        stall2 = _counter(s3, "clntpu_replay_prep_stall_seconds_total") - \
+            _counter(s2, "clntpu_replay_prep_stall_seconds_total")
+        dispatch2 = _counter(s3,
+                             "clntpu_replay_dispatch_seconds_total") - \
+            _counter(s2, "clntpu_replay_dispatch_seconds_total")
+        assert prep2 > 0
+        # non-timing invariants hold on every attempt: one overlap
+        # observation per replay, one queue-depth sample per bucket
+        cnt_a, sum_a = _hist(s2, "clntpu_replay_overlap_ratio")
+        cnt_b, sum_b = _hist(s3, "clntpu_replay_overlap_ratio")
+        assert cnt_b == cnt_a + 1
+        qcnt_a, _ = _hist(s2, "clntpu_replay_queue_depth")
+        qcnt_b, _ = _hist(s3, "clntpu_replay_queue_depth")
+        assert qcnt_b - qcnt_a == n_chunks
+        # the acceptance numbers: ≤ 20% of host prep visible overlapped
+        # (≥ 90% visible serial, above), and invisible relative to the
+        # e2e critical path too (the dispatch thread spent its time in
+        # device waits, not prep waits)
+        last = (stall2, prep2, dispatch2, sum_b - sum_a)
+        if (stall2 <= 0.2 * prep2
+                and stall2 <= 0.2 * (stall2 + dispatch2)
+                and (sum_b - sum_a) >= 0.8):
+            break
+    else:
+        raise AssertionError(f"overlap never reached the 80% hidden "
+                             f"contract in 3 runs: {last}")
+
+
+def test_pipeline_propagates_prep_errors():
+    """A producer-thread failure must surface on the caller, not hang."""
+    items = _synthetic_items(64)
+    # corrupt: pubkey table shorter than the signature count, so a
+    # later bucket's prep gather raises on the producer thread
+    items.pubkeys = items.pubkeys[:10]
+
+    import pytest
+
+    with pytest.raises(Exception):
+        verify.verify_items(items, bucket=8, depth=2,
+                            device_fn=_stub_device(0.0))
+
+
+def test_pipeline_survives_device_errors():
+    """A dispatch failure mid-stream must not deadlock the producer."""
+    items = _synthetic_items(64)
+    calls = []
+
+    def bad_dispatch(pb):
+        calls.append(1)
+        if len(calls) == 2:
+            raise RuntimeError("device fell over")
+        return np.ones(pb.blocks.shape[0], bool)
+
+    import pytest
+
+    with pytest.raises(RuntimeError, match="device fell over"):
+        verify.verify_items(items, bucket=8, depth=2,
+                            device_fn=bad_dispatch)
+
+
+# ---------------------------------------------------------------------------
+# device-resident z handoff (real fused program)
+
+
+def test_z_handoff_stays_on_device():
+    """Zero z bytes cross the host boundary between the hash and verify
+    phases: the whole fused dispatch runs under a device→host transfer
+    guard, and the staged-bytes counter accounts for every uploaded
+    byte — a z readback + re-upload (the pre-round-5 sync point) would
+    both trip the guard and inflate the exact byte count."""
+    import jax
+
+    # n=27 everywhere in the zz device tests: each distinct batch size
+    # costs its own sign/derive-pubkey program shape, and the compile
+    # cache is read-only under pytest
+    n = 27
+    rows, nb, sigs, pubs = _signed_batch27()
+    items = verify.VerifyItems(rows, nb, sigs, pubs,
+                               np.arange(n, dtype=np.int64))
+    real = verify._fused_device_fn(8)
+
+    def guarded(pb):
+        with jax.transfer_guard_device_to_host("disallow"):
+            return real(pb)
+
+    s0 = obs.snapshot()
+    ok = verify.verify_items(items, bucket=8, depth=2, device_fn=guarded)
+    s1 = obs.snapshot()
+    assert ok.all()
+
+    staged = _counter(s1, "clntpu_verify_device_bytes_total") - \
+        _counter(s0, "clntpu_verify_device_bytes_total")
+    mb = 4  # 130-byte regions → 3 SHA blocks → quantized width 4
+    per_bucket = 8 * (mb * 16 * 4 + 4 + 4 + 64 + 33)
+    assert staged == 4 * per_bucket, staged
+
+
+# ---------------------------------------------------------------------------
+# fused path parity with the unfused 3-program chain
+
+
+def test_fused_matches_unfused(monkeypatch):
+    n = 27  # shared batch shape across the zz device tests (see above)
+    rows, nb, sigs, pubs = _signed_batch27()
+    sigs = sigs.copy()
+    sigs[5, 10] ^= 0x40  # corrupt exactly one signature
+    items = verify.VerifyItems(rows, nb, sigs, pubs,
+                               np.arange(n, dtype=np.int64))
+
+    ok_fused = verify.verify_items(items, bucket=8)
+    monkeypatch.setenv("LIGHTNING_TPU_REPLAY_FUSED", "0")
+    ok_unfused = verify.verify_items(items, bucket=8)
+
+    assert ok_fused.dtype == np.bool_ and ok_unfused.dtype == np.bool_
+    assert (ok_fused == ok_unfused).all()
+    expected = np.ones(n, bool)
+    expected[5] = False
+    assert (ok_fused == expected).all()

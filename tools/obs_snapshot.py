@@ -12,6 +12,14 @@ Subcommands:
   diff a.json b.json
       Print per-metric deltas b-a: counters as deltas, gauges as the
       new value, histograms as count/sum deltas plus the mean.
+  capture ... --watch N
+      Periodic-diff mode: re-capture every N seconds and print the
+      delta since the previous capture (one JSON object per tick,
+      prefixed with an ISO timestamp comment).  This is how replay
+      overlap is observed live during a run: watch the
+      clntpu_replay_prep/_stall/_dispatch stage counters and the
+      overlap-ratio histogram move while verify_store streams buckets
+      (doc/replay_pipeline.md).  Ctrl-C exits cleanly.
 
 The diff output is the "what did this flush/bench actually do" view:
 two snapshots bracket a workload and the delta is attributable to it.
@@ -104,6 +112,27 @@ def diff_snapshots(a: dict, b: dict) -> dict:
     return out
 
 
+def watch(capture, interval: float, out=sys.stdout) -> None:
+    """Capture every `interval` seconds, printing the per-tick delta
+    (the live view of a replay's clntpu_replay_* stage counters)."""
+    import datetime
+    import time
+
+    prev = capture()
+    try:
+        while True:
+            time.sleep(interval)
+            cur = capture()
+            stamp = datetime.datetime.now().isoformat(timespec="seconds")
+            delta = diff_snapshots(prev, cur)
+            print(f"# {stamp} (+{interval:g}s)", file=out, flush=False)
+            print(json.dumps(delta if delta else {}, indent=1),
+                  file=out, flush=True)
+            prev = cur
+    except KeyboardInterrupt:
+        pass
+
+
 def main() -> int:
     p = argparse.ArgumentParser(prog="obs_snapshot")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -114,6 +143,10 @@ def main() -> int:
                                     "server (with --url)")
     cap.add_argument("--local", action="store_true",
                      help="snapshot this process's registry")
+    cap.add_argument("--watch", type=float, metavar="N",
+                     help="periodic-diff mode: re-capture every N "
+                          "seconds and print the delta since the "
+                          "previous capture")
     cap.add_argument("-o", "--out", default="-")
     d = sub.add_parser("diff")
     d.add_argument("a")
@@ -122,13 +155,23 @@ def main() -> int:
 
     if args.cmd == "capture":
         if args.rpc:
-            snap = capture_rpc(args.rpc)
+            capture = lambda: capture_rpc(args.rpc)
         elif args.url:
-            snap = capture_url(args.url, rune=args.rune)
+            capture = lambda: capture_url(args.url, rune=args.rune)
         elif args.local:
-            snap = capture_local()
+            capture = capture_local
         else:
             p.error("need --rpc, --url, or --local")
+        if args.watch is not None:
+            if args.watch <= 0:
+                p.error("--watch interval must be positive")
+            if args.out == "-":
+                watch(capture, args.watch)
+            else:
+                with open(args.out, "w") as f:
+                    watch(capture, args.watch, out=f)
+            return 0
+        snap = capture()
         text = json.dumps(snap, indent=1)
         if args.out == "-":
             print(text)
