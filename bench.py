@@ -63,6 +63,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_CPU_OPS = 50_000.0
 METRIC = "gossip_store_replay_sig_verify_throughput"
 UNIT = "sig_verifies_per_sec"
+# `bench.py route` workload (PR-3): batched device pathfinding vs the
+# single-query host dijkstra over the same synth gossmap
+ROUTE_METRIC = "getroute_batched_throughput"
+ROUTE_UNIT = "routes_per_sec"
 LAST_TPU_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_last_tpu.json")
 
@@ -77,9 +81,16 @@ def _load_last_tpu() -> dict | None:
     return None
 
 
+# which workload this process is measuring — error/watchdog lines must
+# carry the metric they were running, not the default replay headline
+# (a failed `route` round attributed to the sig-verify metric would
+# poison that series in the driver's dashboards)
+_ACTIVE = {"metric": METRIC, "unit": UNIT}
+
+
 def emit(value: float, vs_baseline: float, **extra):
-    line = {"metric": METRIC, "value": value, "unit": UNIT,
-            "vs_baseline": vs_baseline}
+    line = {"metric": _ACTIVE["metric"], "value": value,
+            "unit": _ACTIVE["unit"], "vs_baseline": vs_baseline}
     last = _load_last_tpu()
     if last is not None:
         line["last_measured_tpu"] = last
@@ -133,15 +144,31 @@ def compose_line(value: float, platform: str, *, engine=None, bucket=None,
 
 REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline", "platform",
                  "measurement", "engine", "bucket")
+ROUTE_REQUIRED_KEYS = ("metric", "value", "unit", "platform",
+                       "measurement", "batch", "n_channels",
+                       "host_baseline_rps", "speedup_vs_host")
 
 
 def check_bench_line(line: dict) -> list[str]:
     """Return the list of schema violations in one emitted bench record
     (empty = ok).  Error/watchdog lines (an `error` key) only promise
-    metric/value/unit and are exempt from the measurement contract."""
+    metric/value/unit and are exempt from the measurement contract.
+    `route` workload records carry their own key set: the baseline is
+    the measured single-query host rate, not BASELINE_CPU_OPS."""
     if "error" in line:
         return [f"error line missing key: {k}" for k in
                 ("metric", "value", "unit") if k not in line]
+    if line.get("metric") == ROUTE_METRIC:
+        problems = [f"missing/empty key: {k}" for k in ROUTE_REQUIRED_KEYS
+                    if line.get(k) in (None, "")]
+        v, hb, sp = (line.get("value"), line.get("host_baseline_rps"),
+                     line.get("speedup_vs_host"))
+        if all(isinstance(x, (int, float)) for x in (v, hb, sp)) and hb:
+            if abs(sp - v / hb) > max(0.01, 0.01 * abs(sp)):
+                problems.append(
+                    "speedup_vs_host inconsistent with "
+                    "value/host_baseline_rps")
+        return problems
     problems = [f"missing/empty key: {k}" for k in REQUIRED_KEYS
                 if line.get(k) in (None, "")]
     last = line.get("last_measured_tpu") or {}
@@ -449,6 +476,108 @@ def run_bench(platform: str) -> dict:
     return out
 
 
+def compose_route_line(qps: float, platform: str, *, batch: int,
+                       n_channels: int, host_rps: float,
+                       extra: dict | None = None) -> dict:
+    """Emitted record for the `route` workload.  Always a LIVE
+    measurement (there is no replay store for this metric yet); the
+    PR-2 convention for cpu-fallback rounds is a projection note in
+    BENCH_NOTES.md, not a synthetic headline."""
+    label = platform if platform not in ("cpu",) else "cpu-fallback"
+    line = {"metric": ROUTE_METRIC, "unit": ROUTE_UNIT,
+            "value": round(qps, 1), "platform": label,
+            "measurement": "live",
+            "measured_at": time.strftime("%Y-%m-%d"),
+            "batch": batch, "n_channels": n_channels,
+            "host_baseline_rps": round(host_rps, 2),
+            "speedup_vs_host": round(qps / host_rps, 3) if host_rps
+            else 0.0}
+    line.update(extra or {})
+    return line
+
+
+def run_route_bench(platform: str) -> dict:
+    """`bench.py route`: batched device pathfinding throughput over a
+    synth gossmap vs the single-query host dijkstra baseline.
+
+    Env knobs: BENCH_ROUTE_CHANNELS (default 10000), BENCH_ROUTE_BATCH
+    (device query bucket, default 64), BENCH_ROUTE_BATCHES (timed
+    device dispatches, default 4), BENCH_ROUTE_HOST_QUERIES (baseline
+    sample, default 24)."""
+    import numpy as np
+
+    from lightning_tpu.gossip import gossmap as GM
+    from lightning_tpu.gossip import store as gstore
+    from lightning_tpu.gossip import synth
+    from lightning_tpu.routing import device as RD
+    from lightning_tpu.routing import dijkstra as DJ
+    from lightning_tpu.routing.planes import RoutePlanes
+
+    n_channels = int(os.environ.get("BENCH_ROUTE_CHANNELS", "10000"))
+    batch = int(os.environ.get("BENCH_ROUTE_BATCH", "64"))
+    n_batches = int(os.environ.get("BENCH_ROUTE_BATCHES", "4"))
+    n_host = int(os.environ.get("BENCH_ROUTE_HOST_QUERIES", "24"))
+
+    path = os.path.join(tempfile.gettempdir(),
+                        f"bench_route_{n_channels}.gs")
+    if not os.path.exists(path):
+        tmp = path + f".tmp.{os.getpid()}"
+        # sign=False: routing never verifies; zero-sig synthesis keeps
+        # the workload graph-shaped instead of EC-bound
+        synth.make_network_store(
+            tmp, n_channels=n_channels, n_nodes=max(2, n_channels // 8),
+            updates_per_channel=2, sign=False)
+        os.replace(tmp, path)
+    g = GM.from_store(gstore.load_store(path))
+
+    rng = np.random.default_rng(11)
+    amount = 1_000_000
+    queries = []
+    for _ in range(batch * (n_batches + 1)):
+        a, b = rng.integers(0, g.n_nodes, 2)
+        if a == b:
+            b = (b + 1) % g.n_nodes
+        queries.append(RD.RouteQuery(bytes(g.node_ids[a]),
+                                     bytes(g.node_ids[b]), amount))
+
+    # host baseline: the serial per-payment path this PR batches away
+    t0 = time.perf_counter()
+    host_done = 0
+    for q in queries[:n_host]:
+        try:
+            DJ.getroute(g, q.source, q.destination, q.amount_msat)
+        except DJ.NoRoute:
+            pass
+        host_done += 1
+    host_rps = host_done / (time.perf_counter() - t0)
+
+    planes = RoutePlanes.build(g)
+    RD.solve_batch(planes, queries[:batch], batch=batch)  # compile+warm
+    t0 = time.perf_counter()
+    solved = fellback = 0
+    for i in range(1, n_batches + 1):
+        res = RD.solve_batch(planes, queries[i * batch:(i + 1) * batch],
+                             batch=batch)
+        # honest headline: only lanes the device actually ANSWERED
+        # (route or proven-unreachable) count; fallback/error lanes
+        # would need a host re-solve and must not inflate routes/s
+        solved += sum(1 for r in res if r[0] in ("ok", "noroute"))
+        fellback += sum(1 for r in res if r[0] not in ("ok", "noroute"))
+    dt = time.perf_counter() - t0
+    qps = solved / dt
+    out = {"qps": qps, "host_rps": host_rps, "batch": batch,
+           "n_channels": n_channels, "n_nodes": g.n_nodes,
+           "queries": solved, "fallbacks": fellback, "seconds": dt,
+           "planes": {"n_pad": planes.n_pad, "e_pad": planes.e_pad}}
+    if platform not in ("cpu",):
+        record_tpu_measurement({"route": {
+            "routes_per_sec": round(qps, 1),
+            "host_baseline_rps": round(host_rps, 2),
+            "batch": batch, "n_channels": n_channels,
+            "date": time.strftime("%Y-%m-%d")}})
+    return out
+
+
 def run_sweep(platform: str) -> None:
     """Manual mode (`bench.py --sweep`): kernel-only throughput for each
     dual-mul implementation × bucket, printed as a table.  Used to pick
@@ -497,6 +626,10 @@ def main():
     # hard-exits before the driver deadline so `parsed` is never null.
     import threading
 
+    if "route" in sys.argv[1:]:
+        # scope error/watchdog lines to the workload being measured
+        _ACTIVE.update(metric=ROUTE_METRIC, unit=ROUTE_UNIT)
+
     t_start = time.monotonic()
     deadline = float(os.environ.get("BENCH_DEADLINE", "2400"))
 
@@ -517,6 +650,17 @@ def main():
         if "--sweep" in sys.argv:
             guard.cancel()
             run_sweep(platform)
+            return
+        if "route" in sys.argv[1:]:
+            r = run_route_bench(platform)
+            guard.cancel()
+            print(json.dumps(compose_route_line(
+                r["qps"], platform, batch=r["batch"],
+                n_channels=r["n_channels"], host_rps=r["host_rps"],
+                extra={"n_nodes": r["n_nodes"], "queries": r["queries"],
+                       "fallbacks": r["fallbacks"],
+                       "seconds": round(r["seconds"], 3),
+                       "planes": r["planes"]})), flush=True)
             return
         # --metrics: bracket the run with obs snapshots and embed the
         # diff, so an offline bench round reports through the SAME
@@ -563,7 +707,8 @@ def main():
             if remaining > 60:
                 try:
                     child = subprocess.run(
-                        [sys.executable, os.path.abspath(__file__)],
+                        [sys.executable, os.path.abspath(__file__)]
+                        + (["route"] if "route" in sys.argv[1:] else []),
                         env=dict(os.environ, BENCH_FORCE_CPU="1",
                                  BENCH_DEADLINE=str(int(remaining))),
                         capture_output=True, text=True, timeout=remaining,

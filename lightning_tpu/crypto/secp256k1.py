@@ -456,7 +456,7 @@ def derive_pubkeys_kernel(d):
 
 def derive_pubkeys(seckeys: np.ndarray) -> np.ndarray:
     """(B, 20) canonical seckey limbs → (B, 33) compressed SEC1 pubkeys."""
-    x, y = jax.jit(derive_pubkeys_kernel)(jnp.asarray(seckeys))
+    x, y = _jit_derive()(jnp.asarray(seckeys))
     xb = F.to_bytes_be(np.asarray(x))
     parity = (np.asarray(y)[:, 0] & 1).astype(np.uint8)
     out = np.empty((len(xb), 33), np.uint8)
@@ -734,6 +734,25 @@ def schnorr_verify_batch(msgs32: np.ndarray, sigs64: np.ndarray,
 SIGN_BUCKET = int(_os.environ.get("LIGHTNING_TPU_SIGN_BUCKET", "16"))
 
 
+@functools.lru_cache(maxsize=1)
+def _jit_sign():
+    """Module-level cached jit of the grinding sign kernel (same pattern
+    as _jit_verify_resolved): re-wrapping jax.jit per ecdsa_sign_batch
+    call discarded the trace cache, so every batched sign re-traced the
+    whole EC program before the executable-cache lookup."""
+    return jax.jit(ecdsa_sign_kernel)
+
+
+@functools.lru_cache(maxsize=1)
+def _jit_sign_simple():
+    return jax.jit(ecdsa_sign_simple_kernel)
+
+
+@functools.lru_cache(maxsize=1)
+def _jit_derive():
+    return jax.jit(derive_pubkeys_kernel)
+
+
 def ecdsa_sign_batch(msg_hashes: np.ndarray, seckeys: list[int],
                      bucket: int = SIGN_BUCKET):
     """Batched deterministic ECDSA sign (RFC6979 nonces host-side, point
@@ -755,7 +774,7 @@ def ecdsa_sign_batch(msg_hashes: np.ndarray, seckeys: list[int],
             ks[i, c] = F.int_to_limbs(ref.rfc6979_nonce(h, seckeys[i], extra))
     z = F.from_bytes_be(msg_hashes)
     d = F.from_int_array(seckeys)
-    kern = jax.jit(ecdsa_sign_kernel)
+    kern = _jit_sign()
     out = np.empty((B, 64), np.uint8)
     for start in range(0, B, bucket):
         end = min(start + bucket, B)

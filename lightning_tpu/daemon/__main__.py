@@ -118,6 +118,33 @@ async def amain(args) -> int:
         print(f"gossmap: {g.n_channels} channels, {g.n_nodes} nodes",
               flush=True)
 
+    # batching route solver: concurrent getroute/pay queries coalesce
+    # into vmapped device dispatches (routing/device.py); single
+    # queries fall through to host dijkstra below the occupancy floor
+    from ..routing.device import RouteService
+
+    # --cpu daemons pin the service host-only: batched CPU-jax routing
+    # is slower than the dijkstra it displaces, and its warmup is
+    # skipped below for the same 1-core-startup reason as verify's
+    # (None = defer to the LIGHTNING_TPU_ROUTE_DEVICE env kill-switch)
+    router = RouteService(lambda: gossmap_ref.get("map"),
+                          device=False if args.cpu else None)
+    router.start()
+    if gossmap_ref["map"] is not None and not args.cpu:
+        # pre-compile the route program for this graph's padded shape
+        # off the live path (same rationale as the verify warmup below);
+        # anchored on the router so GC cannot drop the task mid-await
+        router._warmup_task = asyncio.get_running_loop().create_task(
+            router.warmup())
+
+        def _route_warmup_done(t):
+            if not t.cancelled() and t.exception() is not None:
+                print(f"route warmup failed: {t.exception()!r} (first "
+                      "batched getroute will pay the cold compile)",
+                      file=sys.stderr, flush=True)
+
+        router._warmup_task.add_done_callback(_route_warmup_done)
+
     # live gossipd: ingest from peers, serve BOLT#7 queries, stream out
     # (gossip_init, lightningd.c:1375 — previously only tests wired this)
     gossipd = None
@@ -127,7 +154,7 @@ async def amain(args) -> int:
 
         gpath = args.gossip_store or _os.path.join(args.data_dir,
                                                    "gossip_store")
-        gossipd = Gossipd(node, gpath)
+        gossipd = Gossipd(node, gpath, gossmap_ref=gossmap_ref)
         loaded = gossipd.load_existing(gpath, idx=store_idx)
         gossipd.start()
         # pre-compile the verify kernels off the live path (a cold
@@ -220,7 +247,7 @@ async def amain(args) -> int:
             chain_backend=chain_backend, topology=topology,
             invoices=invoices, relay=relay_svc,
             htlc_sets=HtlcSets(invoices), gossmap_ref=gossmap_ref,
-            funder_policy=funder_policy, gossipd=gossipd)
+            funder_policy=funder_policy, gossipd=gossipd, router=router)
         restored = await manager.restore_all()
         if restored:
             print(f"restored {restored} live channel(s)", flush=True)
@@ -241,7 +268,8 @@ async def amain(args) -> int:
         rpc = RPC.JsonRpcServer(rpc_path)
         RPC.attach_core_commands(rpc, node, gossmap_ref,
                                  stop_event=stop_event,
-                                 manager=manager, topology=topology)
+                                 manager=manager, topology=topology,
+                                 router=router)
         RPC.attach_utility_commands(rpc, node, hsm=hsm,
                                     topology=topology, relay=relay_svc,
                                     wallet=wallet, gossipd=gossipd)
@@ -510,6 +538,7 @@ async def amain(args) -> int:
         await seeker.close()
     if gossipd is not None:
         await gossipd.close()
+    await router.close()
     if topology is not None:
         await topology.stop()
     await node.close()

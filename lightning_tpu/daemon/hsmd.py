@@ -26,6 +26,22 @@ from ..btc import tx as T
 from ..crypto import field as F
 from ..crypto import ref_python as ref
 from ..crypto import secp256k1 as S
+from ..obs import families as _families
+
+# Observability for the batched-sign paths: until now only a trace span
+# covered sign_htlc_batch, so "did this commitment fan-out actually hit
+# the device?" was unanswerable from a scrape.  `path` mirrors
+# ecdsa_sign_batch's HOST_VERIFY_MAX micro-batch rule: batches at or
+# below the threshold sign on the host oracle, larger ones on device.
+# (Families declared in obs.families so jax-free consumers see them.)
+_M_SIGN_SIGS = _families.SIGN_BATCH_SIGS
+_M_SIGN_CALLS = _families.SIGN_CALLS
+
+
+def _note_sign(op: str, n_sigs: int) -> None:
+    _M_SIGN_SIGS.labels(op).observe(n_sigs)
+    path = "device" if n_sigs > S.HOST_VERIFY_MAX else "host"
+    _M_SIGN_CALLS.labels(op, path).inc()
 
 # Capability bits (shape mirrors hsmd/permissions.h)
 CAP_ECDH = 1
@@ -145,6 +161,7 @@ class Hsm:
             return np.zeros((0, 64), np.uint8)
         from ..utils import trace
 
+        _note_sign("htlc", len(sighashes))
         with trace.span("hsmd/sign_htlc_batch", n=len(sighashes)):
             secs = self.channel_secrets(client)
             htlc_priv = K.derive_privkey(secs.htlc,
@@ -248,6 +265,8 @@ class Hsm:
             return k
 
         items = wallet_input_digests(tx, utxo_meta, key_for_index)
+        if items:
+            _note_sign("withdrawal", len(items))
         if len(items) > 1:
             hashes = np.stack([np.frombuffer(d, np.uint8)
                                for _, d, _, _ in items])

@@ -301,9 +301,12 @@ VERSION = "lightning-tpu-0.2"
 def attach_core_commands(rpc: JsonRpcServer, node, gossmap_ref: dict,
                          started_at: float | None = None,
                          stop_event: "asyncio.Event | None" = None,
-                         manager=None, topology=None) -> None:
+                         manager=None, topology=None, router=None) -> None:
     """Register the first-wave commands against a LightningNode and a
-    mutable {'map': Gossmap|None} holder (hot-swapped on gossip load)."""
+    mutable {'map': Gossmap|None} holder (hot-swapped on gossip load).
+    `router` is an optional routing.device.RouteService: getroute then
+    coalesces concurrent queries into batched device dispatches instead
+    of solving each serially on the host."""
     t0 = started_at or time.time()
 
     async def getinfo() -> dict:
@@ -381,8 +384,13 @@ def attach_core_commands(rpc: JsonRpcServer, node, gossmap_ref: dict,
                     "pass fromid to route between known nodes",
                 )
         try:
-            hops = DJ.getroute(g, src, _hex(id), amount_msat,
-                               final_cltv=cltv, riskfactor=riskfactor)
+            if router is not None:
+                hops = await router.getroute(
+                    src, _hex(id), amount_msat, final_cltv=cltv,
+                    riskfactor=riskfactor)
+            else:
+                hops = DJ.getroute(g, src, _hex(id), amount_msat,
+                                   final_cltv=cltv, riskfactor=riskfactor)
         except (DJ.NoRoute, KeyError) as e:
             raise RpcError(ROUTE_NOT_FOUND, e.args[0] if e.args else str(e))
         return {"route": [

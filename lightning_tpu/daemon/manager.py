@@ -57,7 +57,7 @@ class ChannelManager:
     def __init__(self, node, hsm, wallet=None, onchain=None,
                  chain_backend=None, topology=None, invoices=None,
                  relay=None, htlc_sets=None, gossmap_ref=None,
-                 funder_policy=None, gossipd=None):
+                 funder_policy=None, gossipd=None, router=None):
         self.node = node
         self.hsm = hsm
         self.wallet = wallet
@@ -70,6 +70,7 @@ class ChannelManager:
         self.gossmap_ref = gossmap_ref or {"map": None}
         self.funder_policy = funder_policy
         self.gossipd = gossipd   # own-channel gossip origination
+        self.router = router     # batching RouteService (routing.device)
         # channel_id -> (Channeld, loop task)
         self.channels: dict[bytes, tuple] = {}
         # peer_id -> Channeld awaiting fundchannel_complete
@@ -1353,14 +1354,31 @@ class ChannelManager:
             if g is None:
                 raise ManagerError("no route: payee is not a direct peer "
                                    "and no gossip graph is loaded")
+            # fire every candidate first-hop's route query CONCURRENTLY:
+            # with a RouteService they coalesce into one batched device
+            # dispatch instead of N serial host dijkstra runs
+            cands = [cand for cand, _task in self.channels.values()]
+            solved = await asyncio.gather(
+                *(PAYER.route_via(g, cand.peer.node_id, inv.payee,
+                                  amount, inv.min_final_cltv,
+                                  blockheight, router=self.router)
+                  for cand in cands),
+                return_exceptions=True)
             best = None
-            for cand, _task in self.channels.values():
-                try:
-                    tail, src_amount, src_cltv = PAYER.route_from_gossmap(
-                        g, cand.peer.node_id, inv.payee, amount,
-                        inv.min_final_cltv, blockheight)
-                except Exception:
+            for cand, res in zip(cands, solved):
+                if isinstance(res, BaseException):
                     continue
+                # the gather yielded to the loop: a candidate may have
+                # disconnected and been popped from self.channels since
+                # the snapshot — don't pay over a dead Channeld when a
+                # live one has a route.  IDENTITY, not key membership: a
+                # reestablish replaces the entry with a fresh Channeld
+                # under the same channel_id (the cleanup at the channel
+                # loop's finally uses `is` for the same reason)
+                if self.channels.get(cand.channel_id,
+                                     (None, None))[0] is not cand:
+                    continue
+                tail, src_amount, src_cltv = res
                 if best is None or src_amount < best[1]:
                     best = (cand, src_amount, src_cltv, tail)
             if best is None:

@@ -56,12 +56,17 @@ class Gossipd:
     def __init__(self, node, store_path: str,
                  chain_hash: bytes = gwire.MAINNET_CHAIN_HASH,
                  utxo_check=None, flush_ms: float = 2.0,
-                 flush_size: int = 256, bucket: int | None = None):
+                 flush_size: int = 256, bucket: int | None = None,
+                 gossmap_ref: dict | None = None):
         from . import verify as _gv
 
         bucket = bucket if bucket is not None else _gv.DEFAULT_BUCKET
         self.node = node
         self.chain_hash = chain_hash
+        # mutable {'map': Gossmap|None} holder (the daemon's routing
+        # view): accepted channel_updates are folded into it live so
+        # the route planes refresh instead of waiting for a reload
+        self.gossmap_ref = gossmap_ref or {}
         self.ingest = GossipIngest(
             store_path, utxo_check=utxo_check, flush_ms=flush_ms,
             flush_size=flush_size, bucket=bucket,
@@ -159,6 +164,17 @@ class Gossipd:
         elif t == gwire.MSG_CHANNEL_UPDATE:
             self.msgs.setdefault(p.short_channel_id, {})[
                 f"cu{p.direction}"] = raw
+            g = self.gossmap_ref.get("map")
+            if g is not None:
+                g.apply_channel_update(
+                    p.short_channel_id, p.direction,
+                    timestamp=p.timestamp,
+                    disabled=bool(p.channel_flags & 2),
+                    cltv_delta=p.cltv_expiry_delta,
+                    htlc_min_msat=p.htlc_minimum_msat,
+                    htlc_max_msat=p.htlc_maximum_msat,
+                    fee_base_msat=p.fee_base_msat,
+                    fee_ppm=p.fee_proportional_millionths)
         else:
             self.node_msgs[p.node_id] = raw
         ts = getattr(p, "timestamp", int(time.time()))

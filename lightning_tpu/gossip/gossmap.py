@@ -60,6 +60,15 @@ class Gossmap:
     adj_chan: np.ndarray = field(default=None)  # (E,) int32 channel index
     adj_dir: np.ndarray = field(default=None)  # (E,) int8 direction
     adj_src: np.ndarray = field(default=None)  # (E,) int32 source node
+    # version counters (routing.planes freshness gate): params bumps on
+    # any accepted update's field change, topology on edge-set changes
+    topology_version: int = 0
+    params_version: int = 0
+    # set instead of rebuilding eagerly: a gossip-sync burst of
+    # first-in-direction updates would otherwise pay one O(E log E)
+    # _build_adjacency per message on the event loop — readers call
+    # ensure_adjacency() and the batch costs ONE rebuild
+    _adjacency_dirty: bool = False
 
     @property
     def n_nodes(self) -> int:
@@ -103,6 +112,58 @@ class Gossmap:
         self.adj_src = np.concatenate(srcs)[order].astype(np.int32)
         counts = np.bincount(dst, minlength=self.n_nodes)
         self.adj_off = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        self.topology_version += 1
+        self.params_version += 1
+        self._adjacency_dirty = False
+
+    def ensure_adjacency(self) -> None:
+        """Rebuild the CSR if updates marked it dirty (or it was never
+        built).  Every adjacency reader — dijkstra, RoutePlanes.build —
+        enters through here."""
+        if self._adjacency_dirty or self.adj_off is None:
+            self._build_adjacency()
+
+    def apply_channel_update(self, scid: int, direction: int, *,
+                             timestamp: int, disabled: bool,
+                             cltv_delta: int, htlc_min_msat: int,
+                             htlc_max_msat: int, fee_base_msat: int,
+                             fee_ppm: int) -> bool:
+        """Fold one ACCEPTED (signature-verified) channel_update into
+        the live graph, bumping the version counters consumers key on
+        (routing.planes re-uploads parameter planes on params bumps and
+        rebuilds on topology bumps).
+
+        Returns False for stale timestamps and for scids this graph
+        does not carry.  The latter includes channels ANNOUNCED after
+        the graph was built: the SoA arrays are fixed-size, so new
+        channels only enter through a map rebuild (`loadgossip` /
+        `from_store`) — until then their updates are durably in the
+        store but invisible to routing.  Live announcement folding
+        (growing node/channel arrays in place) is an open follow-on."""
+        try:
+            c = self.channel_index(scid)
+        except KeyError:
+            return False
+        d = int(direction) & 1
+        if timestamp <= int(self.timestamps[d, c]):
+            return False
+        first_update = self.timestamps[d, c] == 0
+        self.timestamps[d, c] = timestamp
+        self.enabled[d, c] = not disabled
+        self.cltv_delta[d, c] = cltv_delta
+        self.htlc_min_msat[d, c] = htlc_min_msat
+        self.htlc_max_msat[d, c] = htlc_max_msat
+        self.fee_base_msat[d, c] = fee_base_msat
+        self.fee_ppm[d, c] = fee_ppm
+        if first_update:
+            # a direction gained its first update: new directed edge.
+            # Mark dirty (readers rebuild once per batch, not per msg);
+            # bump the topology counter NOW so planes snapshots taken
+            # before the rebuild are already invalidated.
+            self._adjacency_dirty = True
+            self.topology_version += 1
+        self.params_version += 1
+        return True
 
     # -- views (plugins/topology.c:270 listchannels / :408 listnodes) -----
 

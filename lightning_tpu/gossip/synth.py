@@ -15,7 +15,6 @@ import hashlib
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from ..crypto import field as F
@@ -39,7 +38,7 @@ def _sign_bulk(hashes: list[bytes], keys: list[int], rng,
     """Batched device sign → (N, 64) compact sigs."""
     N = len(hashes)
     out = np.empty((N, 64), np.uint8)
-    kern = jax.jit(S.ecdsa_sign_simple_kernel)
+    kern = S._jit_sign_simple()   # cached: re-wrapping loses the traces
     for start in range(0, N, bucket):
         end = min(start + bucket, N)
         B = bucket

@@ -254,6 +254,16 @@ def warmup(bucket: int = DEFAULT_BUCKET) -> None:
                     sigs=np.zeros((bucket, 64), np.uint8),
                     pubkeys=np.zeros((bucket, 33), np.uint8),
                     staged_bytes=0, prep_seconds=0.0)))
+    # the hsmd batched-sign path (sign_htlc_batch / sign_withdrawal)
+    # shares the startup warmup: one grinding-sign compile per process
+    # at the production SIGN_BUCKET, so a channel's first commitment
+    # fan-out never pays a cold EC compile mid-dance
+    one = F.int_to_limbs(1).astype(np.uint32)
+    zb = np.tile(one, (S.SIGN_BUCKET, 1))
+    kb = np.tile(one, (S.SIGN_BUCKET, S.GRIND_CANDIDATES, 1))
+    _note_shape("sign", (S.SIGN_BUCKET,))
+    np.asarray(S._jit_sign()(
+        jnp.asarray(zb), jnp.asarray(zb), jnp.asarray(kb))[0])
 
 
 def _bytes_to_blocks(rows: np.ndarray, max_blocks: int) -> np.ndarray:

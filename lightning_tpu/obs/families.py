@@ -1,0 +1,55 @@
+"""Well-known instrument families whose hot-path owners are heavyweight
+imports.
+
+`routing/device.py` and `daemon/hsmd.py` pull in the full jax/crypto
+stack at import time; declaring their metric families HERE (the obs
+package imports nothing heavy) lets lightweight consumers — the
+`tools/obs_snapshot.py` capture CLI, exposition-only processes — make
+the series present-at-zero without paying those imports.  The registry
+re-registers same-name families to the same object, so owner modules
+import these instruments rather than re-declaring them.
+"""
+from __future__ import annotations
+
+from . import registry as _r
+from .registry import SIZE_BUCKETS
+
+from . import REGISTRY
+
+RATIO_BUCKETS = _r.RATIO_BUCKETS
+DURATION_BUCKETS = _r.DURATION_BUCKETS
+
+# -- routing/device.py: the batched route solver (doc/routing.md) ----------
+ROUTE_FLUSH_SECONDS = REGISTRY.histogram(
+    "clntpu_route_flush_seconds",
+    "End-to-end wall time of one route flush (plane refresh + solve + "
+    "reconstruct, device and host paths together)",
+    buckets=DURATION_BUCKETS)
+ROUTE_BATCH_QUERIES = REGISTRY.histogram(
+    "clntpu_route_batch_queries",
+    "Route queries coalesced per flush", buckets=SIZE_BUCKETS)
+ROUTE_OCCUPANCY = REGISTRY.histogram(
+    "clntpu_route_batch_occupancy_ratio",
+    "Real queries / padded device lanes per dispatch",
+    buckets=RATIO_BUCKETS)
+ROUTE_QUERIES = REGISTRY.counter(
+    "clntpu_route_queries_total",
+    "Route queries solved, by execution path and outcome",
+    labelnames=("path", "outcome"))
+ROUTE_FALLBACK = REGISTRY.counter(
+    "clntpu_route_fallback_total",
+    "Queries diverted from the device solver to host dijkstra, by reason",
+    labelnames=("reason",))
+ROUTE_QUEUE = REGISTRY.gauge(
+    "clntpu_route_queue_queries",
+    "Route queries currently queued awaiting a flush")
+
+# -- daemon/hsmd.py: the batched-sign paths --------------------------------
+SIGN_BATCH_SIGS = REGISTRY.histogram(
+    "clntpu_sign_batch_sigs",
+    "Signatures per hsmd batched-sign call, by operation",
+    labelnames=("op",), buckets=SIZE_BUCKETS)
+SIGN_CALLS = REGISTRY.counter(
+    "clntpu_sign_total",
+    "hsmd batched-sign calls, by operation and host/device path",
+    labelnames=("op", "path"))

@@ -73,6 +73,14 @@ class RouteStep:
     delay: int
 
 
+def _steps_from_hops(hops, src_amount: int, src_delay: int,
+                     blockheight: int) \
+        -> tuple[list[RouteStep], int, int]:
+    steps = [RouteStep(h.node_id, h.scid, h.amount_msat,
+                       blockheight + h.delay) for h in hops]
+    return steps, src_amount, blockheight + src_delay
+
+
 def route_from_gossmap(g, source: bytes, dest: bytes, amount_msat: int,
                        final_cltv: int, blockheight: int = 0) \
         -> tuple[list[RouteStep], int, int]:
@@ -84,9 +92,22 @@ def route_from_gossmap(g, source: bytes, dest: bytes, amount_msat: int,
     hops, (src_amount, src_delay) = DJ.getroute(
         g, source, dest, amount_msat, final_cltv=final_cltv,
         with_source=True)
-    steps = [RouteStep(h.node_id, h.scid, h.amount_msat,
-                       blockheight + h.delay) for h in hops]
-    return steps, src_amount, blockheight + src_delay
+    return _steps_from_hops(hops, src_amount, src_delay, blockheight)
+
+
+async def route_via(g, source: bytes, dest: bytes, amount_msat: int,
+                    final_cltv: int, blockheight: int = 0, router=None) \
+        -> tuple[list[RouteStep], int, int]:
+    """route_from_gossmap, optionally through a batching RouteService
+    (routing.device): concurrent payment route queries then coalesce
+    into one device dispatch instead of serial host dijkstra runs."""
+    if router is None:
+        return route_from_gossmap(g, source, dest, amount_msat,
+                                  final_cltv, blockheight)
+    hops, (src_amount, src_delay) = await router.getroute(
+        source, dest, amount_msat, final_cltv=final_cltv,
+        with_source=True)
+    return _steps_from_hops(hops, src_amount, src_delay, blockheight)
 
 
 def build_payment_onion(route: list[RouteStep], payment_hash: bytes,

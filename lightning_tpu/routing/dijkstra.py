@@ -26,6 +26,15 @@ class NoRoute(Exception):
     pass
 
 
+def noroute_msg(source: bytes, destination: bytes,
+                amount_msat: int) -> str:
+    """The one NoRoute message format — shared with the device solver
+    (routing.device) so host- and device-path RPC errors for the same
+    query never diverge."""
+    return (f"no route {source.hex()[:8]} → {destination.hex()[:8]} "
+            f"for {amount_msat} msat")
+
+
 @dataclass
 class RouteHop:
     """One forwarding step; mirrors the reference's getroute output:
@@ -60,6 +69,7 @@ def getroute(g: Gossmap, source: bytes, destination: bytes,
     with_source=True additionally returns (amount_msat, delay) AT the
     source — what a payer one hop before `source` must deliver to it
     (used when our own unannounced channel feeds the public route)."""
+    g.ensure_adjacency()   # fold any accepted first-direction updates
     src = g.node_index(source)
     dst = g.node_index(destination)
     if src == dst:
@@ -124,10 +134,7 @@ def getroute(g: Gossmap, source: bytes, destination: bytes,
                 heapq.heappush(pq, (cost, u))
 
     if dist[src] == INF:
-        raise NoRoute(
-            f"no route {source.hex()[:8]} → {destination.hex()[:8]} "
-            f"for {amount_msat} msat"
-        )
+        raise NoRoute(noroute_msg(source, destination, amount_msat))
 
     route: list[RouteHop] = []
     u = src

@@ -1,0 +1,191 @@
+"""RoutePlanes: the routing graph's directed edges as device-resident
+structure-of-arrays, ready for the batched Bellman-Ford sweep.
+
+Derived from ``Gossmap._build_adjacency``'s destination-keyed CSR — the
+same directed-edge universe the host dijkstra scans — flattened into
+per-EDGE parameter planes (fee base/ppm, cltv delta, htlc min/max,
+enabled, capacity) so the device kernel never chases (direction,
+channel) indices per sweep.  Shapes are quantized (nodes and edges pad
+to powers of two) so graphs of similar size share one compiled program
+and a growing gossmap recompiles O(log) times, not per update.
+
+Freshness rides the Gossmap version counters: a param-only
+channel_update (fees/enabled flip) re-uploads just the parameter
+planes; a topology change (new channel / first update in a direction)
+rebuilds everything.  ``RoutePlanes.current()`` is the one entry point
+— callers always hold planes that match the map they were given.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..gossip.gossmap import Gossmap
+
+# htlc_max is u64 on the wire; the device cost model runs in int64.
+# Values past the clamp are "effectively unlimited" (2^62 msat is
+# ~4.6e9 BTC) so clamping preserves routing semantics exactly.
+_I64_CLAMP = (1 << 62) - 1
+
+_MIN_NODE_PAD = 64
+_MIN_EDGE_PAD = 256
+
+
+def _pow2_pad(n: int, floor: int) -> int:
+    p = floor
+    while p < n:
+        p <<= 1
+    return p
+
+
+@dataclass
+class RoutePlanes:
+    """Edge-plane SoA view of one Gossmap revision.
+
+    ``edge_*`` arrays are length ``e_pad``; rows past ``e_real`` are
+    padding (``edge_enabled`` False, src/dst 0).  Node indices run to
+    ``n_pad``; nodes past ``g.n_nodes`` have no in-edges and stay
+    unreachable.  ``dev`` holds the uploaded jax copies (int64 planes
+    uploaded under an x64 scope by routing.device)."""
+
+    g: Gossmap
+    topo_version: int
+    params_version: int
+    n_real: int
+    n_pad: int
+    e_real: int
+    e_pad: int
+    # host planes (numpy, canonical)
+    edge_src: np.ndarray    # (E,) int32 — forwarding node u of u→v
+    edge_dst: np.ndarray    # (E,) int32 — receiving node v
+    edge_chan: np.ndarray   # (E,) int32 — channel index into g.scids
+    edge_dir: np.ndarray    # (E,) int8
+    edge_base: np.ndarray   # (E,) int64 msat
+    edge_ppm: np.ndarray    # (E,) int64
+    edge_cltv: np.ndarray   # (E,) int64
+    edge_hmin: np.ndarray   # (E,) int64 msat
+    edge_hmax: np.ndarray   # (E,) int64 msat (0 = no cap)
+    edge_enabled: np.ndarray  # (E,) bool
+    edge_cap_sat: np.ndarray  # (E,) float32 (mcf consumers; not in cost)
+    dev: dict = field(default_factory=dict)
+    # channel→edge lookup (exclusion masks): edge indices sorted by chan
+    _chan_order: np.ndarray = None
+    _chan_sorted: np.ndarray = None
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def build(cls, g: Gossmap) -> "RoutePlanes":
+        g.ensure_adjacency()
+        e_real = len(g.adj_chan)
+        n_real = g.n_nodes
+        n_pad = _pow2_pad(max(n_real, 1), _MIN_NODE_PAD)
+        e_pad = _pow2_pad(max(e_real, 1), _MIN_EDGE_PAD)
+
+        # destination node of each CSR edge = the CSR row it lives in
+        counts = np.diff(g.adj_off)
+        edge_dst = np.repeat(np.arange(n_real, dtype=np.int32),
+                             counts.astype(np.int64))
+
+        def _padded(a, dtype, fill=0):
+            out = np.full(e_pad, fill, dtype)
+            out[:e_real] = a
+            return out
+
+        c, d = g.adj_chan, g.adj_dir
+        planes = cls(
+            g=g,
+            topo_version=getattr(g, "topology_version", 0),
+            params_version=getattr(g, "params_version", 0),
+            n_real=n_real, n_pad=n_pad, e_real=e_real, e_pad=e_pad,
+            edge_src=_padded(g.adj_src, np.int32),
+            edge_dst=_padded(edge_dst, np.int32),
+            edge_chan=_padded(c, np.int32),
+            edge_dir=_padded(d, np.int8),
+            edge_base=_padded(g.fee_base_msat[d, c], np.int64),
+            edge_ppm=_padded(g.fee_ppm[d, c], np.int64),
+            edge_cltv=_padded(g.cltv_delta[d, c], np.int64),
+            edge_hmin=_padded(
+                np.minimum(g.htlc_min_msat[d, c], _I64_CLAMP), np.int64),
+            edge_hmax=_padded(
+                np.minimum(g.htlc_max_msat[d, c], _I64_CLAMP), np.int64),
+            edge_enabled=_padded(g.enabled[d, c], bool, False),
+            edge_cap_sat=_padded(g.capacity_sat[c], np.float32),
+        )
+        planes._chan_order = np.argsort(
+            planes.edge_chan[:e_real], kind="stable").astype(np.int64)
+        planes._chan_sorted = planes.edge_chan[:e_real][planes._chan_order]
+        return planes
+
+    def with_fresh_params(self) -> "RoutePlanes":
+        """Re-derive ONLY the per-edge parameter planes from the (same
+        topology revision of the) gossmap — the incremental path for
+        accepted channel_updates.  Returns a NEW planes object sharing
+        the topology arrays: an in-flight solve on a worker thread keeps
+        reading its own consistent revision (mutating in place would
+        tear a dispatch between two parameter revisions)."""
+        import dataclasses
+
+        g = self.g
+        c = self.edge_chan[:self.e_real]
+        d = self.edge_dir[:self.e_real]
+
+        def _padded(a, dtype):
+            out = np.zeros(self.e_pad, dtype)
+            out[:self.e_real] = a
+            return out
+
+        return dataclasses.replace(
+            self,
+            params_version=getattr(g, "params_version", 0),
+            edge_base=_padded(g.fee_base_msat[d, c], np.int64),
+            edge_ppm=_padded(g.fee_ppm[d, c], np.int64),
+            edge_cltv=_padded(g.cltv_delta[d, c], np.int64),
+            edge_hmin=_padded(
+                np.minimum(g.htlc_min_msat[d, c], _I64_CLAMP), np.int64),
+            edge_hmax=_padded(
+                np.minimum(g.htlc_max_msat[d, c], _I64_CLAMP), np.int64),
+            edge_enabled=_padded(g.enabled[d, c], bool),
+            # parameter planes re-upload lazily; the topology uploads
+            # are shared by construction and carry over — a param-only
+            # gossip bump must not re-stage the unchanged src/dst planes
+            dev={k: v for k, v in self.dev.items()
+                 if k in ("edge_src", "edge_dst")},
+        )
+
+    @classmethod
+    def current(cls, g: Gossmap,
+                cached: "RoutePlanes | None") -> "RoutePlanes":
+        """The freshness gate: reuse `cached` when it matches `g`'s
+        version counters, derive fresh param planes (shared topology)
+        on a param-only bump, rebuild on topology change or a different
+        map object.  Never mutates `cached`."""
+        if (cached is None or cached.g is not g
+                or cached.topo_version != getattr(g, "topology_version", 0)):
+            return cls.build(g)
+        if cached.params_version != getattr(g, "params_version", 0):
+            return cached.with_fresh_params()
+        return cached
+
+    # -- query-side helpers ----------------------------------------------
+
+    def edges_of_channel(self, chan_index: int) -> np.ndarray:
+        """Edge indices (≤2) carrying channel `chan_index`."""
+        lo = np.searchsorted(self._chan_sorted, chan_index, "left")
+        hi = np.searchsorted(self._chan_sorted, chan_index, "right")
+        return self._chan_order[lo:hi]
+
+    def edge_ok_mask(self, excluded_scids=None) -> np.ndarray:
+        """(e_pad,) bool: enabled minus the query's exclusions.  Unknown
+        scids are ignored, matching dijkstra's set-membership check."""
+        mask = self.edge_enabled
+        if excluded_scids:
+            mask = mask.copy()
+            for scid in excluded_scids:
+                try:
+                    c = self.g.channel_index(int(scid))
+                except KeyError:
+                    continue
+                mask[self.edges_of_channel(c)] = False
+        return mask

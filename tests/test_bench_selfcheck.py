@@ -78,6 +78,47 @@ def test_error_lines_exempt():
          "vs_baseline": 0.0, "error": "watchdog: exceeded 2400s"}) == []
 
 
+def test_route_line_passes():
+    line = bench.compose_route_line(4000.0, "axon-tpu", batch=64,
+                                    n_channels=10_000, host_rps=850.0)
+    assert line["metric"] == bench.ROUTE_METRIC
+    assert line["unit"] == bench.ROUTE_UNIT
+    assert line["platform"] == "axon-tpu"
+    assert line["measurement"] == "live"
+    assert line["speedup_vs_host"] == round(4000.0 / 850.0, 3)
+    assert bench.check_bench_line(line) == []
+
+
+def test_route_line_cpu_fallback_labeled():
+    line = bench.compose_route_line(120.0, "cpu", batch=64,
+                                    n_channels=2_000, host_rps=300.0)
+    assert line["platform"] == "cpu-fallback"
+    assert bench.check_bench_line(line) == []
+
+
+def test_route_line_missing_keys_and_bad_speedup_flagged():
+    probs = bench.check_bench_line({"metric": bench.ROUTE_METRIC})
+    assert any("value" in p for p in probs)
+    assert any("host_baseline_rps" in p for p in probs)
+    line = bench.compose_route_line(4000.0, "axon-tpu", batch=64,
+                                    n_channels=10_000, host_rps=850.0)
+    line["speedup_vs_host"] = 99.0
+    assert any("speedup_vs_host" in p
+               for p in bench.check_bench_line(line))
+
+
+def test_route_selfcheck_cli(tmp_path):
+    good = bench.compose_route_line(500.0, "cpu", batch=64,
+                                    n_channels=2_000, host_rps=250.0)
+    bad = dict(good)
+    del bad["host_baseline_rps"]
+    pg, pb = tmp_path / "route_good.json", tmp_path / "route_bad.json"
+    pg.write_text(json.dumps({"parsed": good}))   # driver-artifact wrap
+    pb.write_text(json.dumps(bad))
+    assert bench.run_selfcheck([str(pg)]) == 0
+    assert bench.run_selfcheck([str(pb)]) == 1
+
+
 def test_selfcheck_cli(tmp_path, capsys):
     good = bench.compose_line(50.0, "cpu-fallback", engine="glv",
                               bucket=64, last=None)

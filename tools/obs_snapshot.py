@@ -72,6 +72,11 @@ def capture_url(url: str, rune: str | None = None) -> dict:
 
 def capture_local() -> dict:
     from lightning_tpu import obs
+    # well-known families owned by heavyweight modules (routing.device,
+    # daemon.hsmd) are declared in this jax-free module so they appear
+    # present-at-zero in a fresh capture process — a diff against a
+    # later in-daemon snapshot then attributes deltas correctly
+    from lightning_tpu.obs import families  # noqa: F401
 
     return obs.snapshot()
 
