@@ -753,6 +753,22 @@ def _jit_derive():
     return jax.jit(derive_pubkeys_kernel)
 
 
+def host_sign_batch(msg_hashes: np.ndarray,
+                    seckeys: list[int]) -> np.ndarray:
+    """The host signing oracle: ref RFC6979 + low-R/low-S grinding,
+    bit-identical to the device grinding-sign kernel.  The single place
+    host-signed compact sigs are produced — the micro-batch branch of
+    ecdsa_sign_batch and hsmd's sign-breaker fallback both route here,
+    so their wire bytes can never diverge."""
+    B = msg_hashes.shape[0]
+    out = np.empty((B, 64), np.uint8)
+    for i in range(B):
+        r, s = ref.ecdsa_sign(bytes(msg_hashes[i]), seckeys[i])
+        out[i, :32] = np.frombuffer(r.to_bytes(32, "big"), np.uint8)
+        out[i, 32:] = np.frombuffer(s.to_bytes(32, "big"), np.uint8)
+    return out
+
+
 def ecdsa_sign_batch(msg_hashes: np.ndarray, seckeys: list[int],
                      bucket: int = SIGN_BUCKET):
     """Batched deterministic ECDSA sign (RFC6979 nonces host-side, point
@@ -760,12 +776,7 @@ def ecdsa_sign_batch(msg_hashes: np.ndarray, seckeys: list[int],
     Micro-batches sign on the host (same rationale as HOST_VERIFY_MAX)."""
     B = msg_hashes.shape[0]
     if B <= HOST_VERIFY_MAX:
-        out = np.empty((B, 64), np.uint8)
-        for i in range(B):
-            r, s = ref.ecdsa_sign(bytes(msg_hashes[i]), seckeys[i])
-            out[i, :32] = np.frombuffer(r.to_bytes(32, "big"), np.uint8)
-            out[i, 32:] = np.frombuffer(s.to_bytes(32, "big"), np.uint8)
-        return out
+        return host_sign_batch(msg_hashes, seckeys)
     ks = np.zeros((B, GRIND_CANDIDATES, NLIMBS), np.uint32)
     for i in range(B):
         h = bytes(msg_hashes[i])

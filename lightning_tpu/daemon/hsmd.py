@@ -21,27 +21,67 @@ from dataclasses import dataclass
 
 import numpy as np
 
+import logging
+
 from ..btc import keys as K
 from ..btc import tx as T
 from ..crypto import field as F
 from ..crypto import ref_python as ref
 from ..crypto import secp256k1 as S
 from ..obs import families as _families
+from ..resilience import breaker as _breaker
+from ..resilience import faultinject as _fault
+from ..resilience import quarantine as _quarantine
+
+log = logging.getLogger("lightning_tpu.daemon.hsmd")
 
 # Observability for the batched-sign paths: until now only a trace span
 # covered sign_htlc_batch, so "did this commitment fan-out actually hit
 # the device?" was unanswerable from a scrape.  `path` mirrors
 # ecdsa_sign_batch's HOST_VERIFY_MAX micro-batch rule: batches at or
-# below the threshold sign on the host oracle, larger ones on device.
+# below the threshold sign on the host oracle, larger ones on device —
+# unless the "sign" circuit breaker diverts them host-side.
 # (Families declared in obs.families so jax-free consumers see them.)
 _M_SIGN_SIGS = _families.SIGN_BATCH_SIGS
 _M_SIGN_CALLS = _families.SIGN_CALLS
 
 
-def _note_sign(op: str, n_sigs: int) -> None:
+def _note_sign(op: str, n_sigs: int, path: str) -> None:
     _M_SIGN_SIGS.labels(op).observe(n_sigs)
-    path = "device" if n_sigs > S.HOST_VERIFY_MAX else "host"
     _M_SIGN_CALLS.labels(op, path).inc()
+
+
+def _sign_batch_resilient(op: str, msg_hashes: np.ndarray,
+                          seckeys: list[int]) -> np.ndarray:
+    """Batched sign under the "sign" circuit breaker
+    (doc/resilience.md).  Unlike verify — where quarantine must bisect
+    on-device because the host oracle is slower by orders of magnitude
+    at store scale — the host signer IS the oracle the device kernel is
+    tested against, so a failed device dispatch simply re-signs the
+    whole batch host-side (metered as quarantined rows) with identical
+    output bytes."""
+    B = msg_hashes.shape[0]
+    if B <= S.HOST_VERIFY_MAX:
+        # micro-batches already sign host-side inside ecdsa_sign_batch
+        _note_sign(op, B, "host")
+        return S.ecdsa_sign_batch(msg_hashes, seckeys)
+    brk = _breaker.get("sign")
+    if not brk.allow():
+        _note_sign(op, B, "host")
+        return S.host_sign_batch(msg_hashes, seckeys)
+    try:
+        _fault.fire("sign", "sign")
+        out = S.ecdsa_sign_batch(msg_hashes, seckeys)
+    except Exception as e:
+        brk.record_failure()
+        _quarantine.note("sign", type(e).__name__, B)
+        log.warning("device sign dispatch failed (%s); re-signing %d "
+                    "hashes on the host oracle", e, B)
+        _note_sign(op, B, "host")
+        return S.host_sign_batch(msg_hashes, seckeys)
+    brk.record_success()
+    _note_sign(op, B, "device")
+    return out
 
 # Capability bits (shape mirrors hsmd/permissions.h)
 CAP_ECDH = 1
@@ -161,14 +201,14 @@ class Hsm:
             return np.zeros((0, 64), np.uint8)
         from ..utils import trace
 
-        _note_sign("htlc", len(sighashes))
         with trace.span("hsmd/sign_htlc_batch", n=len(sighashes)):
             secs = self.channel_secrets(client)
             htlc_priv = K.derive_privkey(secs.htlc,
                                          remote_per_commitment_point)
             hashes = np.stack([np.frombuffer(h, np.uint8)
                                for h in sighashes])
-            return S.ecdsa_sign_batch(hashes, [htlc_priv] * len(sighashes))
+            return _sign_batch_resilient("htlc", hashes,
+                                         [htlc_priv] * len(sighashes))
 
     def sign_remote_commitment(
         self, client: HsmClient, sighash: bytes
@@ -265,17 +305,18 @@ class Hsm:
             return k
 
         items = wallet_input_digests(tx, utxo_meta, key_for_index)
-        if items:
-            _note_sign("withdrawal", len(items))
         if len(items) > 1:
             hashes = np.stack([np.frombuffer(d, np.uint8)
                                for _, d, _, _ in items])
-            sigs = S.ecdsa_sign_batch(hashes, [k for _, _, k, _ in items])
+            sigs = _sign_batch_resilient("withdrawal", hashes,
+                                         [k for _, _, k, _ in items])
             for (i, _, _, pub), sig64 in zip(items, np.asarray(sigs)):
                 r = int.from_bytes(bytes(sig64[:32]), "big")
                 s = int.from_bytes(bytes(sig64[32:]), "big")
                 tx.inputs[i].witness = [sig_to_der(r, s), pub]
         else:
+            if items:
+                _note_sign("withdrawal", len(items), "host")
             for i, digest, k, pub in items:
                 r, s = ref.ecdsa_sign(digest, k)
                 tx.inputs[i].witness = [sig_to_der(r, s), pub]

@@ -854,8 +854,15 @@ def attach_admin_commands(rpc: JsonRpcServer, cfg, ring) -> None:
 
     async def getmetrics() -> dict:
         """Full metrics snapshot (same registry the REST /metrics
-        endpoint renders; doc/observability.md for the naming scheme)."""
-        return obs.snapshot()
+        endpoint renders; doc/observability.md for the naming scheme),
+        plus a `resilience` section: live circuit-breaker states for
+        every dispatch family and any armed fault-injection specs
+        (doc/resilience.md)."""
+        from ..resilience import resilience_snapshot
+
+        snap = obs.snapshot()
+        snap["resilience"] = resilience_snapshot()
+        return snap
 
     rpc.register("listconfigs", listconfigs)
     rpc.register("setconfig", setconfig)

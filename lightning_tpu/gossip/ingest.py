@@ -24,7 +24,9 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .. import obs
-from ..utils import native
+from ..obs import families as _families
+from ..resilience import deadline as _deadline
+from ..utils import events, native
 from . import store as gstore
 from . import verify as gverify
 from . import wire
@@ -47,6 +49,7 @@ _M_DROPPED = obs.counter(
 _M_QUEUE = obs.gauge(
     "clntpu_gossip_queue_sigs",
     "Signatures currently queued awaiting a verify flush")
+_M_FLUSH_ERRORS = _families.INGEST_FLUSH_ERRORS
 
 # Drop reasons (observable in tests/metrics).
 R_DUP = "duplicate"
@@ -56,6 +59,7 @@ R_NO_CHANNEL = "pending_no_channel"   # queued, not dropped
 R_NO_UTXO = "utxo_check_failed"
 R_RATELIMIT = "ratelimited"
 R_MALFORMED = "malformed"
+R_FLUSH_ERROR = "flush_error"         # batch lost to a flush exception
 
 # BOLT#7 suggests limiting spammy channel_updates; the reference tracks
 # per-channel tokens.  We allow a burst then 1 update per interval.
@@ -225,21 +229,52 @@ class GossipIngest:
     # -- the flush loop ---------------------------------------------------
 
     async def _run(self) -> None:
+        """Supervised flush loop: an exception escaping a flush used to
+        kill this task SILENTLY — every later submit queued forever
+        with no signal.  Now the error is metered
+        (clntpu_ingest_flush_errors_total), emitted on the events bus
+        (topic `ingest_flush_error`), and the loop restarts with capped
+        exponential backoff."""
+        backoff = _deadline.RestartBackoff()
         while not self._closed:
-            if self._flush_due is None:
-                await self._wakeup.wait()
-                self._wakeup.clear()
-                continue
-            timeout = self._flush_due - self.now()
-            if timeout > 0 and self._queued_sigs < self.flush_size:
-                try:
-                    await asyncio.wait_for(self._wakeup.wait(), timeout)
-                except asyncio.TimeoutError:
-                    pass
-                self._wakeup.clear()
-                continue  # re-evaluate: deadline, size, or shutdown
-            if self._queue:
+            try:
+                await self._step()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                delay = backoff.next()
+                _M_FLUSH_ERRORS.inc()
+                _deadline.note_restart("ingest_flush", e, delay)
+                events.emit("ingest_flush_error",
+                            {"error": repr(e),
+                             "restart_delay_s": round(delay, 3)})
+                await asyncio.sleep(delay)
+            else:
+                backoff.reset()
+        if self._queue:
+            try:
                 await self.flush()
+            except Exception as e:  # shutting down: surface, don't retry
+                _M_FLUSH_ERRORS.inc()
+                events.emit("ingest_flush_error",
+                            {"error": repr(e), "restart_delay_s": 0.0})
+                log.exception("final ingest flush failed on close")
+
+    async def _step(self) -> None:
+        """One flush-loop iteration (wait for a deadline/size trigger,
+        flush if due)."""
+        if self._flush_due is None:
+            await self._wakeup.wait()
+            self._wakeup.clear()
+            return
+        timeout = self._flush_due - self.now()
+        if timeout > 0 and self._queued_sigs < self.flush_size:
+            try:
+                await asyncio.wait_for(self._wakeup.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+            self._wakeup.clear()
+            return  # re-evaluate: deadline, size, or shutdown
         if self._queue:
             await self.flush()
 
@@ -262,6 +297,13 @@ class GossipIngest:
         t0 = time.perf_counter()
         try:
             await self._flush_batch(batch)
+        except BaseException:
+            # the batch was already popped; account for the loss so a
+            # scrape can tell "dropped by policy" from "lost to a crash"
+            # (application may have partially happened — approximate)
+            for _ in batch:
+                self.stats.drop(R_FLUSH_ERROR)
+            raise
         finally:
             self._flushing = False
             _M_FLUSH_SECONDS.observe(time.perf_counter() - t0)
@@ -272,8 +314,17 @@ class GossipIngest:
         self.stats.batched_sigs += len(items)
         self.stats.max_batch = max(self.stats.max_batch, len(items))
         _M_FLUSH_SIGS.observe(len(items))
-        ok = await asyncio.to_thread(gverify.verify_items, items,
-                                     self.bucket, depth=self.replay_depth)
+        # dispatch deadline (LIGHTNING_TPU_DEADLINE_INGEST_S, off by
+        # default): a hung verify worker surfaces as a metered
+        # DeadlineExceeded — handled by _run's restart supervision —
+        # instead of wedging the loop forever.  The guard bounds ONLY
+        # the (pure) verify dispatch: a blown deadline here cancels
+        # nothing stateful, so apply + durable store append below can
+        # never be split by the timeout.
+        ok = await _deadline.guard(
+            asyncio.to_thread(gverify.verify_items, items,
+                              self.bucket, depth=self.replay_depth),
+            family="ingest", seam="flush")
         # fold per-sig results to per-message (CAs have 4 sigs)
         sig_ok: list[bool] = []
         pos = 0

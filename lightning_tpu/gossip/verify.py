@@ -24,6 +24,7 @@ parallel/mesh.py batch sharding (sharded_verify_fn).
 from __future__ import annotations
 
 import functools
+import logging
 import queue as _queue
 import threading
 import time
@@ -38,9 +39,15 @@ from .. import obs
 from ..crypto import field as F
 from ..crypto import secp256k1 as S
 from ..crypto import sha256 as H
+from ..resilience import breaker as _breaker
+from ..resilience import deadline as _deadline
+from ..resilience import faultinject as _fault
+from ..resilience import quarantine as _quarantine
 from ..utils import native
 from . import wire
 from .store import StoreIndex
+
+log = logging.getLogger("lightning_tpu.gossip.verify")
 
 # Default verify bucket: fixed batch shape so one compiled program serves
 # any store size (remainder padded with dummy always-False rows that are
@@ -510,6 +517,7 @@ def _prep_bucket(items: VerifyItems, order: np.ndarray,
     on the producer thread in the overlapped pipeline."""
     start, end, r0, r1 = chunk
     t0 = time.perf_counter()
+    _fault.fire("prep", "verify")
     sel = order[start:end]
     nb = items.n_blocks[r0:r1]
     # rows arrive type-sorted (CA | NA | CU), so most buckets need far
@@ -591,7 +599,7 @@ def _mesh_device_fn(bucket: int, count_metrics: bool = True):
     mesh = _cached_mesh(n)
     vfn = pmesh.sharded_verify_fn(mesh, _mesh_compiler_opts())
 
-    def dispatch(pb: _PreparedBucket):
+    def mesh_dispatch(pb: _PreparedBucket):
         _note_shape("hash", (bucket, pb.mb))
         _note_shape("gather", (bucket, bucket))
         _note_shape("mesh_verify", (bucket, n))
@@ -606,6 +614,27 @@ def _mesh_device_fn(bucket: int, count_metrics: bool = True):
         parity = (pb.pubkeys[:, 0] & 1).astype(np.uint32)
         zs, rs, ss, qxs, ps = pmesh.shard_batch(mesh, z, r, s, qx, parity)
         ok, _count = vfn(zs, rs, ss, qxs, ps)
+        return ok
+
+    # supervision: the mesh is an OPTIMIZATION over the fused
+    # single-device program, so its breaker degrades mesh→fused (the
+    # outer "verify" breaker still guards fused→host).  A failing
+    # collective or dead mesh device trips this after N consecutive
+    # failures and the replay keeps streaming on one device.
+    fused = _fused_device_fn(bucket)
+
+    def dispatch(pb: _PreparedBucket):
+        brk = _breaker.get("mesh")
+        if not brk.allow():
+            return fused(pb)
+        try:
+            ok = mesh_dispatch(pb)
+        except Exception as e:
+            brk.record_failure()
+            log.warning("mesh-sharded verify failed (%s); this bucket "
+                        "runs on the fused single-device program", e)
+            return fused(pb)
+        brk.record_success()
         return ok
 
     return dispatch
@@ -636,6 +665,106 @@ def _select_device_fn(bucket: int, n_sigs: int):
 _DONE = object()
 
 
+def _host_verify_selected(items: VerifyItems, roi: np.ndarray,
+                          idx: np.ndarray) -> np.ndarray:
+    """The trustworthy host escape hatch: sha256d + exact-int ECDSA for
+    the given signature indices, straight off the packed host rows.
+
+    The packer (native.sha256_pack) stores standard SHA-256 padding —
+    0x80, zeros, 64-bit big-endian bit length closing block n_blocks-1
+    — so the original signed region is recoverable from the row itself
+    and no extraction-time buffer needs to be retained.  Rows flagged
+    oversized (n_blocks == 0) hash to zero here; verify_items re-checks
+    those against items.z_host afterward, exactly as it does for the
+    device result.  Bit-identical to the device path by construction
+    (S._host_verify mirrors the kernel's low-S/tag semantics)."""
+    import hashlib
+
+    idx = np.asarray(idx, np.int64)
+    z = np.zeros((len(idx), 32), np.uint8)
+    cache: dict[int, bytes] = {}
+    for j, r in enumerate(roi[idx]):
+        r = int(r)
+        d = cache.get(r)
+        if d is None:
+            nbr = int(items.n_blocks[r])
+            if nbr == 0:
+                d = b"\0" * 32
+            else:
+                row = items.rows[r]
+                bitlen = int.from_bytes(
+                    row[nbr * 64 - 8: nbr * 64].tobytes(), "big")
+                msg = row[: bitlen // 8].tobytes()
+                d = hashlib.sha256(hashlib.sha256(msg).digest()).digest()
+            cache[r] = d
+        z[j] = np.frombuffer(d, np.uint8)
+    return S._host_verify(z, items.sigs[idx], items.pubkeys[idx])
+
+
+def _subbucket(pb: _PreparedBucket, lanes: np.ndarray,
+               bucket: int) -> _PreparedBucket:
+    """Re-pad a subset of a prepared bucket's signature lanes into a
+    dispatchable bucket (same static shapes, so no new compile).  The
+    hash-row planes are shared — only the per-signature operands and
+    their row indices narrow."""
+    return _PreparedBucket(
+        sel=pb.sel[lanes], n_real=len(lanes), mb=pb.mb,
+        blocks=pb.blocks, n_blocks=pb.n_blocks,
+        roi_local=S._pad_rows(pb.roi_local[lanes], bucket),
+        sigs=S._pad_rows(pb.sigs[lanes], bucket),
+        pubkeys=S._pad_rows(pb.pubkeys[lanes], bucket),
+        staged_bytes=0, prep_seconds=0.0)
+
+
+def _wrap_resilient(device_fn, items: VerifyItems, roi: np.ndarray,
+                    bucket: int):
+    """Supervise one bucket dispatcher with the "verify" circuit
+    breaker and poisoned-batch quarantine (doc/resilience.md):
+
+    * breaker open → the whole bucket verifies on the host oracle
+      (metered as a `host_breaker` bucket), bit-identical results;
+    * dispatch raises → breaker records the failure and the bucket
+      bisects: clean halves complete on the device, isolated rows are
+      quarantined + re-checked host-side.  The replay completes either
+      way — a single poisoned row no longer fails the whole store.
+    """
+    brk = _breaker.get("verify")
+
+    def host_lanes(pb: _PreparedBucket, lanes: np.ndarray) -> np.ndarray:
+        return _host_verify_selected(items, roi, pb.sel[lanes])
+
+    def dispatch(pb: _PreparedBucket):
+        if not brk.allow():
+            _M_R_BUCKETS.labels("host_breaker").inc()
+            ok = np.zeros(bucket, bool)
+            if pb.n_real:
+                ok[:pb.n_real] = host_lanes(pb, np.arange(pb.n_real))
+            return ok
+        try:
+            _fault.fire("dispatch", "verify")
+            ok = device_fn(pb)
+        except Exception as e:
+            brk.record_failure()
+            log.warning("verify bucket dispatch failed (%s); bisecting "
+                        "%d lanes", e, pb.n_real)
+            out = np.zeros(bucket, bool)
+            parts, bad = _quarantine.bisect(
+                np.arange(pb.n_real),
+                lambda lanes: np.asarray(
+                    device_fn(_subbucket(pb, lanes, bucket)))[:len(lanes)],
+                family="verify")
+            for lanes, res in parts:
+                out[lanes] = res
+            if bad:
+                lanes = np.asarray(bad, np.int64)
+                out[lanes] = host_lanes(pb, lanes)
+            return out
+        brk.record_success()
+        return ok
+
+    return dispatch
+
+
 def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
                   depth: int | None, device_fn) -> tuple[np.ndarray, int]:
     """Sort signatures by row, cut self-contained buckets, and stream
@@ -652,6 +781,9 @@ def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
         depth = int(_os.environ.get("LIGHTNING_TPU_REPLAY_DEPTH", "2"))
     if device_fn is None:
         device_fn = _select_device_fn(bucket, N)
+    # every bucket dispatch (injected test doubles included) runs under
+    # the verify breaker + quarantine supervision
+    device_fn = _wrap_resilient(device_fn, items, roi, bucket)
     prep = functools.partial(_prep_bucket, items, order, roi_sorted, bucket)
 
     out = np.zeros(N, bool)
@@ -661,20 +793,39 @@ def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
     pending: list[tuple[np.ndarray, int, object]] = []
     t_prep = t_stall = t_dispatch = 0.0
     staged_bytes = 0
+    # dispatch-deadline on the prepared-bucket queue: a producer that
+    # hangs (or dies without surfacing) must not park the replay forever
+    prod_deadline = _deadline.deadline_for("verify")
+    n_done = 0          # buckets dispatched from the producer stream
+    timed_out = False
 
     if depth > 0 and len(chunks) > 1:
         q: _queue.Queue = _queue.Queue(maxsize=depth)
         stop = threading.Event()  # dispatch failed: stop prepping
 
+        def _put(item) -> bool:
+            # stop-aware put: a producer abandoned by the deadline path
+            # (or raced by a dispatch failure) must never block forever
+            # on a full queue nobody drains — at ANY depth
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except _queue.Full:
+                    pass
+            return False
+
         def _producer():
             try:
                 for c in chunks:
                     if stop.is_set():
-                        break
-                    q.put(prep(c))
-                q.put(_DONE)
+                        return
+                    _fault.fire("producer", "verify")
+                    if not _put(prep(c)):
+                        return
+                _put(_DONE)
             except BaseException as e:  # surface on the dispatch thread
-                q.put(e)
+                _put(e)
 
         th = threading.Thread(target=_producer, name="replay-prep",
                               daemon=True)
@@ -682,7 +833,13 @@ def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
         try:
             while True:
                 t0 = time.perf_counter()
-                pb = q.get()
+                try:
+                    pb = q.get(timeout=prod_deadline)
+                except _queue.Empty:
+                    _deadline.note_exceeded("verify", "producer",
+                                            prod_deadline)
+                    timed_out = True
+                    break
                 t_stall += time.perf_counter() - t0
                 if pb is _DONE:
                     break
@@ -695,17 +852,28 @@ def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
                 t_prep += pb.prep_seconds
                 staged_bytes += pb.staged_bytes
                 pending.append((pb.sel, pb.n_real, ok))
+                n_done += 1
         finally:
             # the producer may be parked on a full queue if the
-            # dispatch loop raised — tell it to stop after the
-            # in-flight bucket and drain until it exits
+            # dispatch loop raised — tell it to stop and drain until it
+            # exits (its puts are stop-aware, so it unparks on its
+            # own).  A HUNG producer (deadline path) is abandoned
+            # instead — a daemon thread stuck in prep that the join
+            # below would wait on; when (if) its prep ever returns, the
+            # stop-aware put lets it exit without a consumer.
             stop.set()
-            while th.is_alive():
+            while th.is_alive() and not timed_out:
                 try:
                     q.get_nowait()
                 except _queue.Empty:
                     pass
                 th.join(timeout=0.005)
+            if timed_out:
+                while True:
+                    try:
+                        q.get_nowait()
+                    except _queue.Empty:
+                        break
     else:
         for c in chunks:
             pb = prep(c)
@@ -716,12 +884,43 @@ def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
             t_dispatch += time.perf_counter() - t0
             staged_bytes += pb.staged_bytes
             pending.append((pb.sel, pb.n_real, ok))
+            n_done += 1
+
+    if timed_out:
+        # restart semantics for the replay: abandon the wedged producer
+        # and prep the remaining buckets inline on this thread.  A
+        # bucket the producer managed to deliver concurrently is simply
+        # verified twice (idempotent) — never skipped, never hung.
+        log.warning("replay producer missed its %.3fs deadline after "
+                    "%d/%d buckets; prepping the rest inline",
+                    prod_deadline, n_done, len(chunks))
+        for c in chunks[n_done:]:
+            pb = prep(c)
+            t_prep += pb.prep_seconds
+            t_stall += pb.prep_seconds
+            t0 = time.perf_counter()
+            ok = device_fn(pb)
+            t_dispatch += time.perf_counter() - t0
+            staged_bytes += pb.staged_bytes
+            pending.append((pb.sel, pb.n_real, ok))
 
     # the ONLY device→host transfer of the replay: drain the enqueued
-    # booleans in dispatch order
+    # booleans in dispatch order.  A readback failure (an enqueued
+    # program that died after dispatch) diverts just that bucket's rows
+    # to the host oracle instead of failing the replay.
     t0 = time.perf_counter()
+    brk = _breaker.get("verify")
     for sel, n_real, ok in pending:
-        out[sel[:n_real]] = np.asarray(ok)[:n_real]
+        idx = sel[:n_real]
+        try:
+            _fault.fire("readback", "verify")
+            out[idx] = np.asarray(ok)[:n_real]
+        except Exception as e:
+            brk.record_failure()
+            _quarantine.note("verify", "readback", n_real)
+            log.warning("replay readback failed (%s); re-checking %d "
+                        "rows on the host", e, n_real)
+            out[idx] = _host_verify_selected(items, roi, idx)
     _M_R_READBACK.inc(time.perf_counter() - t0)
 
     _M_R_PREP.inc(t_prep)
@@ -812,7 +1011,18 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET, *,
     Oversized rows (n_blocks == 0, hashed host-side at extraction) are
     re-checked on the host afterward.  `device_fn` injects a bucket
     dispatcher (tests); `depth` overrides LIGHTNING_TPU_REPLAY_DEPTH
-    (0 = serial prep, the overlap baseline).  Returns bool (N,)."""
+    (0 = serial prep, the overlap baseline).  Returns bool (N,).
+
+    Every bucket dispatch runs supervised (doc/resilience.md): the
+    "verify" circuit breaker short-circuits to the host oracle when the
+    device path is flapping, a raising dispatch bisects to quarantine
+    the poisoned rows and complete the rest, readback failures re-check
+    just their bucket host-side, and a hung producer thread trips the
+    LIGHTNING_TPU_DEADLINE_VERIFY_S deadline into inline prep — so a
+    replay COMPLETES, bit-identically, under any single-path failure.
+    (The LIGHTNING_TPU_REPLAY_FUSED=0 legacy chain is supervised
+    coarsely: breaker-open or a raising chain re-checks the whole
+    replay on the host oracle, without per-bucket bisection.)"""
     N = len(items)
     if N == 0:
         return np.zeros(0, bool)
@@ -824,7 +1034,27 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET, *,
 
     if (device_fn is None
             and _os.environ.get("LIGHTNING_TPU_REPLAY_FUSED", "1") == "0"):
-        out, n_buckets = _verify_items_unfused(items, roi, bucket)
+        # the legacy chain has no per-bucket dispatcher to wrap, so its
+        # supervision is coarse: breaker-open short-circuits the whole
+        # replay to the host oracle, and a raising chain falls back the
+        # same way (no bisect — all rows are re-checked host-side)
+        n_buckets = (N + bucket - 1) // bucket
+        brk = _breaker.get("verify")
+        if not brk.allow():
+            _M_R_BUCKETS.labels("host_breaker").inc(n_buckets)
+            out = _host_verify_selected(items, roi, np.arange(N))
+        else:
+            try:
+                _fault.fire("dispatch", "verify")
+                out, n_buckets = _verify_items_unfused(items, roi, bucket)
+            except Exception as e:
+                brk.record_failure()
+                _quarantine.note("verify", type(e).__name__, N)
+                log.warning("unfused verify chain failed (%s); "
+                            "re-checking all %d rows on the host", e, N)
+                out = _host_verify_selected(items, roi, np.arange(N))
+            else:
+                brk.record_success()
     else:
         out, n_buckets = _run_pipeline(items, roi, bucket, depth, device_fn)
 

@@ -53,3 +53,45 @@ SIGN_CALLS = REGISTRY.counter(
     "clntpu_sign_total",
     "hsmd batched-sign calls, by operation and host/device path",
     labelnames=("op", "path"))
+
+# -- resilience/: the device-path supervision layer (doc/resilience.md) ----
+BREAKER_STATE = REGISTRY.gauge(
+    "clntpu_breaker_state",
+    "Circuit-breaker state per dispatch family "
+    "(0 = closed, 1 = open, 2 = half-open)",
+    labelnames=("family",))
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "clntpu_breaker_transitions_total",
+    "Circuit-breaker state transitions, by family and target state",
+    labelnames=("family", "to"))
+BREAKER_FAILURES = REGISTRY.counter(
+    "clntpu_breaker_failures_total",
+    "Device dispatch failures recorded against a breaker",
+    labelnames=("family",))
+BREAKER_SHORT_CIRCUITS = REGISTRY.counter(
+    "clntpu_breaker_short_circuits_total",
+    "Dispatches diverted to the host fallback because the breaker was "
+    "open (or a half-open probe was already in flight)",
+    labelnames=("family",))
+QUARANTINE = REGISTRY.counter(
+    "clntpu_quarantine_total",
+    "Rows diverted off a failing device dispatch (bisect-isolated or "
+    "readback-lost) and re-checked host-side, by family and reason",
+    labelnames=("family", "reason"))
+FAULT_INJECTED = REGISTRY.counter(
+    "clntpu_fault_injected_total",
+    "Faults fired by the LIGHTNING_TPU_FAULT injection harness",
+    labelnames=("seam", "family", "action"))
+DEADLINE_EXCEEDED = REGISTRY.counter(
+    "clntpu_deadline_exceeded_total",
+    "Dispatch deadlines blown (a hung/slow worker surfaced instead of a "
+    "silent stall), by family and seam",
+    labelnames=("family", "seam"))
+LOOP_RESTARTS = REGISTRY.counter(
+    "clntpu_loop_restarts_total",
+    "Supervised flush/producer loop restarts after an escaped exception",
+    labelnames=("loop",))
+INGEST_FLUSH_ERRORS = REGISTRY.counter(
+    "clntpu_ingest_flush_errors_total",
+    "GossipIngest flush-loop iterations that raised (the loop restarts "
+    "with backoff instead of dying silently)")

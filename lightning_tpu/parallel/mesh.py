@@ -75,6 +75,12 @@ def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0):
 def shard_batch(mesh: Mesh, *arrays):
     """device_put each array with leading-axis sharding over the mesh.
     Arrays must already be padded to a multiple of the mesh size."""
+    # named fault seam (doc/resilience.md): the resharding device_put is
+    # the first mesh-only step of a sharded dispatch, so an injected
+    # failure here exercises the mesh breaker's mesh→fused degradation
+    from ..resilience import faultinject as _fault
+
+    _fault.fire("mesh", "mesh")
     sh = batch_sharding(mesh)
     return tuple(jax.device_put(a, sh) for a in arrays)
 

@@ -1,0 +1,48 @@
+"""Device-path resilience: supervision around the batched dispatch
+families (doc/resilience.md).
+
+Every batched device path in this repo — the store-replay verify
+pipeline (gossip/verify.py), the GossipIngest and RouteService flush
+loops, the hsmd batched sign, the mesh-sharded EC stage — treats the
+accelerator as a peer that can fail, hang, or poison a batch.  This
+package is the common machinery:
+
+* ``breaker``      — per-family circuit breakers (closed → open →
+                     half-open probe with exponential backoff + jitter)
+                     gating device dispatch vs. the host fallback;
+* ``deadline``     — configurable dispatch deadlines + loop restart
+                     backoff, so a hung worker surfaces as a metered
+                     event instead of a silent stall;
+* ``quarantine``   — host-side bisection of a poisoned batch: isolate
+                     the offending rows, complete the remainder;
+* ``faultinject``  — deterministic fault injectors at named seams
+                     (``LIGHTNING_TPU_FAULT=dispatch:verify:raise:0.1``)
+                     driving the scripted fault matrix in
+                     tools/run_suite.sh.
+
+Deliberately jax-free: hot-path modules import this at module scope and
+exposition-only consumers (tools/obs_snapshot.py) can reach the metric
+families without paying the crypto-stack import.
+"""
+from __future__ import annotations
+
+from . import breaker, deadline, faultinject, quarantine  # noqa: F401
+
+# the canonical dispatch families every daemon has (a breaker exists
+# for each even before its first dispatch, so getmetrics' resilience
+# section and a fresh scrape agree on the vocabulary)
+FAMILIES = ("verify", "route", "sign", "mesh")
+
+
+def resilience_snapshot() -> dict:
+    """The `resilience` section of the getmetrics RPC result: breaker
+    states plus whatever fault specs are currently armed."""
+    return {
+        "breakers": {f: breaker.get(f).snapshot() for f in FAMILIES},
+        "faults_armed": faultinject.active_specs(),
+    }
+
+
+def reset_for_tests() -> None:
+    breaker.reset_for_tests()
+    faultinject.reset_for_tests()

@@ -1,0 +1,195 @@
+"""Per-family circuit breakers for the batched device paths.
+
+Every dispatch family (``verify``, ``route``, ``sign``, ``mesh``) has
+an exact host fallback — the bigint verify oracle, host dijkstra, ref
+ECDSA sign, the single-device fused program.  A breaker decides WHICH
+side runs: after ``threshold`` consecutive device failures it opens and
+every dispatch short-circuits to the host path; after an exponential
+backoff (with deterministic per-family jitter so herds of breakers
+don't probe in lockstep) it half-opens and lets exactly one probe
+through — success closes it, failure re-opens with a doubled backoff.
+
+CLN's supervision story is subdaemons that crash and restart
+independently; this is the same posture for an accelerator: a flapping
+or wedged device degrades ONE family to its host path instead of
+wedging the daemon.
+
+State transitions are metered (``clntpu_breaker_*``) and emitted on the
+events bus (topic ``breaker_transition``); the `getmetrics` RPC carries
+a ``resilience`` section with every breaker's live state.
+
+Knobs::
+
+    LIGHTNING_TPU_BREAKER_THRESHOLD      consecutive failures to trip (5)
+    LIGHTNING_TPU_BREAKER_BACKOFF_S      first open→half-open delay (1.0)
+    LIGHTNING_TPU_BREAKER_MAX_BACKOFF_S  backoff ceiling (30.0)
+    LIGHTNING_TPU_BREAKER_DISABLE=1      breakers never trip (record only)
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from ..obs import families as _f
+from ..utils import events
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+_STATE_CODE = {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0}
+
+# cap the exponent so a breaker that flaps for days can't overflow
+_MAX_TRIP_EXP = 16
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class CircuitBreaker:
+    """Thread-safe three-state breaker (dispatches run on asyncio
+    worker threads AND the replay dispatch thread)."""
+
+    def __init__(self, family: str, *, threshold: int | None = None,
+                 base_backoff: float | None = None,
+                 max_backoff: float | None = None,
+                 disabled: bool | None = None,
+                 clock=time.monotonic):
+        self.family = family
+        self.threshold = int(threshold if threshold is not None else
+                             _env_float("LIGHTNING_TPU_BREAKER_THRESHOLD", 5))
+        self.base_backoff = (base_backoff if base_backoff is not None else
+                             _env_float("LIGHTNING_TPU_BREAKER_BACKOFF_S",
+                                        1.0))
+        self.max_backoff = (max_backoff if max_backoff is not None else
+                            _env_float("LIGHTNING_TPU_BREAKER_MAX_BACKOFF_S",
+                                       30.0))
+        self.disabled = (disabled if disabled is not None else
+                         os.environ.get("LIGHTNING_TPU_BREAKER_DISABLE")
+                         == "1")
+        self._clock = clock
+        self._lock = threading.Lock()
+        # deterministic per-family jitter stream: reproducible tests,
+        # and distinct families still decorrelate their probe times
+        self._rng = random.Random(family)
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.trips = 0
+        self._retry_at = 0.0
+        self._open_backoff = 0.0
+        _f.BREAKER_STATE.labels(family).set(_STATE_CODE[CLOSED])
+
+    # -- the dispatch-side protocol ---------------------------------------
+
+    def allow(self) -> bool:
+        """True → caller may try the device; False → short-circuit to
+        the host fallback.  An open breaker whose backoff has elapsed
+        half-opens and grants exactly one probe."""
+        if self.disabled:
+            return True
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN and self._clock() >= self._retry_at:
+                self._transition(HALF_OPEN)
+                return True
+            # open-and-waiting, or a half-open probe already in flight
+            _f.BREAKER_SHORT_CIRCUITS.labels(self.family).inc()
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.consecutive_failures = 0
+            if self.state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        _f.BREAKER_FAILURES.labels(self.family).inc()
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.disabled:
+                return
+            if self.state == HALF_OPEN or (
+                    self.state == CLOSED
+                    and self.consecutive_failures >= self.threshold):
+                self._trip()
+
+    # -- internals (lock held) --------------------------------------------
+
+    def _trip(self) -> None:
+        self.trips += 1
+        backoff = min(self.max_backoff,
+                      self.base_backoff
+                      * 2.0 ** min(self.trips - 1, _MAX_TRIP_EXP))
+        backoff *= 1.0 + 0.1 * self._rng.random()
+        self._open_backoff = backoff
+        self._retry_at = self._clock() + backoff
+        self._transition(OPEN)
+
+    def _transition(self, to: str) -> None:
+        self.state = to
+        _f.BREAKER_STATE.labels(self.family).set(_STATE_CODE[to])
+        _f.BREAKER_TRANSITIONS.labels(self.family, to).inc()
+        events.emit("breaker_transition", {
+            "family": self.family, "to": to,
+            "consecutive_failures": self.consecutive_failures,
+            "backoff_s": round(self._open_backoff, 3) if to == OPEN
+            else 0.0,
+        })
+
+    # -- introspection ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "trips": self.trips,
+                "threshold": self.threshold,
+            }
+            if self.state == OPEN:
+                out["retry_in_s"] = round(
+                    max(0.0, self._retry_at - self._clock()), 3)
+            return out
+
+    def force_open(self) -> None:
+        """Test/ops helper: trip immediately regardless of history."""
+        with self._lock:
+            self._trip()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.consecutive_failures = 0
+            self.trips = 0
+            if self.state != CLOSED:
+                self._transition(CLOSED)
+
+
+_breakers: dict[str, CircuitBreaker] = {}
+_registry_lock = threading.Lock()
+
+
+def get(family: str) -> CircuitBreaker:
+    """Process-wide breaker for a dispatch family (created on first
+    use with the env-derived knobs)."""
+    brk = _breakers.get(family)
+    if brk is None:
+        with _registry_lock:
+            brk = _breakers.get(family)
+            if brk is None:
+                brk = _breakers[family] = CircuitBreaker(family)
+    return brk
+
+
+def all_breakers() -> dict[str, CircuitBreaker]:
+    return dict(_breakers)
+
+
+def reset_for_tests() -> None:
+    with _registry_lock:
+        for brk in _breakers.values():
+            brk.reset()
+        _breakers.clear()

@@ -1,0 +1,176 @@
+"""Deterministic fault injection at the device-path seams.
+
+The reference hardens its channel machinery with dev_disconnect scripts
+(`-`/`+` crashes at every protocol message — tests/test_fault_matrix.py
+reproduces that matrix); this module is the same idea for the BATCHED
+DEVICE paths: named seams inside the dispatch pipelines call
+``fire(seam, family)``, and an armed spec makes that call raise or hang
+on a deterministic schedule.  The resilience layer (breakers,
+quarantine, deadlines) is then exercised end-to-end by re-running the
+real workload tests with a representative spec
+(tools/run_suite.sh fault-matrix pass).
+
+Spec grammar (comma-separated specs in ``LIGHTNING_TPU_FAULT`` or
+``arm()``):
+
+    seam:family:action:rate[:arg]
+
+* ``seam``   — where: ``prep``, ``dispatch``, ``readback``, ``mesh``,
+               ``sign``, ``producer`` (or ``*``).
+* ``family`` — which dispatch family: ``verify``, ``route``, ``sign``,
+               ``mesh``, ``ingest`` (or ``*``).
+* ``action`` — ``raise`` (throw ``FaultInjected``) or ``hang``
+               (sleep ``arg`` seconds, default 0.05, then continue).
+* ``rate``   — fraction of matching calls that fire, in (0, 1];
+               default 1.  Firing is DETERMINISTIC, not random: spec
+               call counts walk a Bresenham schedule
+               (fire iff ⌊n·rate⌋ > ⌊(n−1)·rate⌋), so a given spec
+               fires on the same calls in every run.
+
+Examples::
+
+    LIGHTNING_TPU_FAULT=dispatch:verify:raise:0.1
+    LIGHTNING_TPU_FAULT=sign:sign:raise:0.5,mesh:mesh:raise:1
+    LIGHTNING_TPU_FAULT=producer:verify:hang:1:30     # 30 s hang, every call
+
+Disarmed (no env, nothing ``arm()``-ed), ``fire()`` is one dict lookup
+— cheap enough for per-bucket dispatch sites.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import math
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..obs import families as _f
+from ..utils import events
+
+log = logging.getLogger("lightning_tpu.resilience.faultinject")
+
+SEAMS = ("prep", "dispatch", "readback", "mesh", "sign", "producer")
+ACTIONS = ("raise", "hang")
+
+
+class FaultInjected(RuntimeError):
+    """The injected failure: deliberately a RuntimeError subclass so it
+    walks the exact handler paths a real XlaRuntimeError would."""
+
+
+@dataclass
+class _Spec:
+    seam: str
+    family: str
+    action: str
+    rate: float
+    arg: float
+    raw: str
+    calls: int = 0
+    fired: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False)
+
+    def should_fire(self) -> bool:
+        with self._lock:
+            self.calls += 1
+            n = self.calls
+            hit = math.floor(n * self.rate) > math.floor((n - 1) * self.rate)
+            if hit:
+                self.fired += 1
+            return hit
+
+
+def parse(spec_str: str) -> list[_Spec]:
+    """Parse a spec string; raises ValueError on bad grammar."""
+    out = []
+    for part in spec_str.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) < 3 or len(fields) > 5:
+            raise ValueError(
+                f"fault spec {part!r}: want seam:family:action:rate[:arg]")
+        seam, family, action = fields[0], fields[1], fields[2]
+        if action not in ACTIONS:
+            raise ValueError(
+                f"fault spec {part!r}: action must be one of {ACTIONS}")
+        rate = float(fields[3]) if len(fields) > 3 and fields[3] else 1.0
+        if not 0.0 < rate <= 1.0:
+            raise ValueError(f"fault spec {part!r}: rate must be in (0, 1]")
+        arg = float(fields[4]) if len(fields) > 4 else 0.05
+        out.append(_Spec(seam, family, action, rate, arg, part))
+    return out
+
+
+# programmatically armed specs (tests: the arm() context manager)
+_armed: list[_Spec] = []
+# env specs, cached against the env string so monkeypatch.setenv works
+# and per-spec Bresenham counters survive across fire() calls
+_env_cache: tuple[str | None, list[_Spec]] = (None, [])
+_env_lock = threading.Lock()
+
+
+def _env_specs() -> list[_Spec]:
+    global _env_cache
+    env = os.environ.get("LIGHTNING_TPU_FAULT", "")
+    cached_env, specs = _env_cache
+    if env == cached_env:
+        return specs
+    with _env_lock:
+        cached_env, specs = _env_cache
+        if env == cached_env:
+            return specs
+        try:
+            specs = parse(env)
+        except ValueError as e:
+            log.warning("ignoring bad LIGHTNING_TPU_FAULT: %s", e)
+            specs = []
+        _env_cache = (env, specs)
+        return specs
+
+
+def fire(seam: str, family: str) -> None:
+    """Injection point: no-op unless an armed spec matches this seam
+    and family AND its deterministic schedule says fire."""
+    if not _armed and not os.environ.get("LIGHTNING_TPU_FAULT"):
+        return
+    for spec in (*_env_specs(), *_armed):
+        if spec.seam not in ("*", seam) or spec.family not in ("*", family):
+            continue
+        if not spec.should_fire():
+            continue
+        _f.FAULT_INJECTED.labels(seam, family, spec.action).inc()
+        events.emit("fault_injected",
+                    {"seam": seam, "family": family, "spec": spec.raw})
+        if spec.action == "hang":
+            time.sleep(spec.arg)
+        else:
+            raise FaultInjected(
+                f"injected fault at {seam}:{family} (spec {spec.raw!r})")
+
+
+@contextlib.contextmanager
+def arm(spec_str: str):
+    """Programmatic arming for tests: faults active inside the with
+    block (composes with any env specs)."""
+    specs = parse(spec_str)
+    _armed.extend(specs)
+    try:
+        yield specs
+    finally:
+        for s in specs:
+            _armed.remove(s)
+
+
+def active_specs() -> list[str]:
+    return [s.raw for s in (*_env_specs(), *_armed)]
+
+
+def reset_for_tests() -> None:
+    global _env_cache
+    _armed.clear()
+    _env_cache = (None, [])

@@ -1,0 +1,74 @@
+"""Poisoned-batch quarantine: host-side bisection of a failing device
+dispatch.
+
+A batched dispatch fails as a UNIT — one malformed row (a shape the
+packer mis-flagged, an input that trips a kernel guard, a buffer the
+runtime rejects) takes the other few hundred rows of the bucket down
+with it.  The reference never has this problem (it verifies serially);
+the batched pipelines get the serial behavior back only when they need
+it: re-dispatch halves of the failing index set, recursing into
+whichever half still raises, until the poison is isolated to single
+rows.  Clean subsets complete on the device at most ⌈log2 n⌉ levels
+deep (≤ 2·log2 n extra dispatches); the isolated rows are quarantined —
+metered per family/reason and handed back to the caller, which
+re-checks them on its exact host path (or fails them closed).
+
+``clntpu_quarantine_total{family,reason}`` counts every diverted row;
+the events bus carries a ``quarantine`` topic per isolated row.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..obs import families as _f
+from ..utils import events
+
+log = logging.getLogger("lightning_tpu.resilience.quarantine")
+
+
+def note(family: str, reason: str, rows: int = 1) -> None:
+    """Meter rows diverted off a device result without a bisect (e.g.
+    a readback failure after the dispatch stream already completed)."""
+    _f.QUARANTINE.labels(family, reason).inc(rows)
+
+
+def bisect(indices, attempt, family: str):
+    """Recursively isolate the rows a batched ``attempt`` cannot
+    process.
+
+    ``attempt(idx)`` takes an int index array and returns per-index
+    results (len == len(idx)), raising if the subset still contains a
+    poisoned row.  Returns ``(parts, quarantined)`` where ``parts`` is
+    a list of ``(idx, results)`` for every subset that succeeded and
+    ``quarantined`` is the list of isolated indices (metered, in
+    ascending order).  The caller decides what a quarantined row means
+    — the verify path re-checks them on the host oracle, so quarantine
+    degrades accuracy never, only throughput.
+    """
+    parts: list[tuple[np.ndarray, object]] = []
+    bad: list[int] = []
+    stack = [np.asarray(indices)]
+    while stack:
+        idx = stack.pop()
+        if len(idx) == 0:
+            continue
+        try:
+            parts.append((idx, attempt(idx)))
+        except Exception as e:
+            if len(idx) == 1:
+                row = int(idx[0])
+                reason = type(e).__name__
+                _f.QUARANTINE.labels(family, reason).inc()
+                events.emit("quarantine", {"family": family, "row": row,
+                                           "reason": reason})
+                log.warning("%s: quarantined row %d (%s: %s)",
+                            family, row, reason, e)
+                bad.append(row)
+            else:
+                mid = len(idx) // 2
+                stack.append(idx[mid:])
+                stack.append(idx[:mid])
+    bad.sort()
+    return parts, bad

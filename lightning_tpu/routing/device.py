@@ -68,6 +68,10 @@ import jax.numpy as jnp
 from jax.experimental import enable_x64
 
 from ..obs import families as _families
+from ..resilience import breaker as _breaker
+from ..resilience import deadline as _deadline
+from ..resilience import faultinject as _fault
+from ..utils import events
 from . import dijkstra as DJ
 from .dijkstra import BLOCKS_PER_YEAR, NoRoute, RouteHop
 from .planes import RoutePlanes
@@ -113,6 +117,8 @@ R_OVERFLOW = "overflow"
 R_DEVICE_ERROR = "device_error"
 R_RECONSTRUCT = "reconstruct"
 R_NOT_RUNNING = "not_running"
+R_BREAKER = "breaker_open"
+R_DEADLINE = "deadline"
 
 
 def _device_enabled() -> bool:
@@ -505,21 +511,26 @@ class RouteService:
 
     async def _run(self) -> None:
         try:
+            # supervised (flush() already resolves ITS batch's futures
+            # on an exception; this layer keeps the loop itself alive —
+            # a dead loop would strand every later getroute): escaped
+            # errors meter a restart and the loop resumes with capped
+            # backoff, queued queries intact for the next flush
+            backoff = _deadline.RestartBackoff()
             while not self._closed:
-                if self._flush_due is None:
-                    await self._wakeup.wait()
-                    self._wakeup.clear()
-                    continue
-                timeout = self._flush_due - self.now()
-                if timeout > 0 and len(self._queue) < self.batch:
-                    try:
-                        await asyncio.wait_for(self._wakeup.wait(), timeout)
-                    except asyncio.TimeoutError:
-                        pass
-                    self._wakeup.clear()
-                    continue
-                if self._queue:
-                    await self.flush()
+                try:
+                    await self._step()
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    delay = backoff.next()
+                    _deadline.note_restart("route_flush", e, delay)
+                    events.emit("route_flush_error",
+                                {"error": repr(e),
+                                 "restart_delay_s": round(delay, 3)})
+                    await asyncio.sleep(delay)
+                else:
+                    backoff.reset()
             if self._queue:
                 await self.flush()
         finally:
@@ -531,6 +542,23 @@ class RouteService:
                 if not q.future.done():
                     q.future.set_exception(
                         RuntimeError("route service stopped"))
+
+    async def _step(self) -> None:
+        """One flush-loop iteration."""
+        if self._flush_due is None:
+            await self._wakeup.wait()
+            self._wakeup.clear()
+            return
+        timeout = self._flush_due - self.now()
+        if timeout > 0 and len(self._queue) < self.batch:
+            try:
+                await asyncio.wait_for(self._wakeup.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+            self._wakeup.clear()
+            return
+        if self._queue:
+            await self.flush()
 
     async def flush(self) -> None:
         batch, self._queue = self._queue, []
@@ -564,6 +592,7 @@ class RouteService:
                 self._resolve(q, "host", ("noroute",
                                           "no gossip graph loaded"))
             return
+        brk = _breaker.get("route")
         if not self.device:
             host = [(q, R_DISABLED) for q in batch]
         elif len(batch) <= self.host_max:
@@ -583,16 +612,39 @@ class RouteService:
                     host.append((q, R_MAX_HOPS))
                 else:
                     device.append(q)
+        if device and not brk.allow():
+            # route breaker open: the device share takes the host
+            # dijkstra (bit-identical results, doc/resilience.md).
+            # allow() is consulted only once a dispatch is certain to
+            # follow — a half-open probe token must always be settled
+            # by the record_success/record_failure below, or the
+            # breaker would wedge half-open forever.
+            host.extend((q, R_BREAKER) for q in device)
+            device = []
         if device:
             try:
+                _fault.fire("dispatch", "route")
                 self._planes = RoutePlanes.current(g, self._planes)
-                results = await asyncio.to_thread(
-                    solve_batch, self._planes, device, self.batch)
+                # deadline (LIGHTNING_TPU_DEADLINE_ROUTE_S, off by
+                # default): a hung solver thread fails THIS batch to the
+                # host path instead of wedging every future getroute
+                results = await _deadline.guard(
+                    asyncio.to_thread(solve_batch, self._planes, device,
+                                      self.batch),
+                    family="route", seam="dispatch")
                 _M_OCCUPANCY.observe(
                     len(device)
                     / (((len(device) + self.batch - 1) // self.batch)
                        * self.batch))
+                brk.record_success()
+            except _deadline.DeadlineExceeded:
+                brk.record_failure()
+                log.warning("device route dispatch blew its deadline; "
+                            "batch re-solves on host dijkstra")
+                host.extend((q, R_DEADLINE) for q in device)
+                results, device = [], []
             except Exception:
+                brk.record_failure()
                 log.exception("device route dispatch failed; "
                               "falling back to host dijkstra")
                 host.extend((q, R_DEVICE_ERROR) for q in device)

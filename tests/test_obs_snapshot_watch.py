@@ -1,0 +1,159 @@
+"""tools/obs_snapshot.py `capture --watch N` periodic-diff mode.
+
+The watch loop had no coverage: it is how a live replay's overlap
+counters — and now the clntpu_breaker_* / clntpu_quarantine_*
+resilience families — are observed while a run is in flight.  These
+tests drive watch() with scripted capture functions (no daemon, no
+jax) and check the tick framing, the per-tick delta math against
+breaker-style counters, and clean Ctrl-C / --ticks termination.
+"""
+from __future__ import annotations
+
+import importlib.util
+import io
+import json
+import os
+import sys
+
+import pytest
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "obs_snapshot.py")
+_spec = importlib.util.spec_from_file_location("obs_snapshot", _TOOL)
+obs_snapshot = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(obs_snapshot)
+
+
+def _snap(breaker_failures: float, quarantined: float,
+          state: float) -> dict:
+    """A getmetrics-shaped snapshot with the resilience families."""
+    return {"metrics": {
+        "clntpu_breaker_failures_total": {
+            "kind": "counter", "help": "",
+            "samples": [{"labels": {"family": "verify"},
+                         "value": breaker_failures}]},
+        "clntpu_quarantine_total": {
+            "kind": "counter", "help": "",
+            "samples": [{"labels": {"family": "verify",
+                                    "reason": "RuntimeError"},
+                         "value": quarantined}]},
+        "clntpu_breaker_state": {
+            "kind": "gauge", "help": "",
+            "samples": [{"labels": {"family": "verify"},
+                         "value": state}]},
+        "clntpu_verify_flush_seconds": {
+            "kind": "histogram", "help": "",
+            "samples": [{"labels": {}, "count": int(breaker_failures),
+                         "sum": breaker_failures * 0.5}]},
+    }}
+
+
+def _ticks_of(text: str) -> list[dict]:
+    """Split watch output into per-tick JSON deltas (each tick is one
+    `# <iso>` comment line followed by one JSON object)."""
+    out, buf = [], []
+    for line in text.splitlines():
+        if line.startswith("# "):
+            if buf:
+                out.append(json.loads("\n".join(buf)))
+                buf = []
+        else:
+            buf.append(line)
+    if buf:
+        out.append(json.loads("\n".join(buf)))
+    return out
+
+
+def test_watch_prints_breaker_counter_deltas():
+    snaps = [_snap(0, 0, 0), _snap(3, 2, 1), _snap(3, 2, 1),
+             _snap(10, 2, 0)]
+    it = iter(snaps)
+    out = io.StringIO()
+    obs_snapshot.watch(lambda: next(it), 30.0, out=out, ticks=3,
+                       sleep=lambda s: None)
+    text = out.getvalue()
+    # tick framing: one ISO-stamped comment per tick, interval echoed
+    assert text.count("# ") == 3 and "(+30s)" in text
+    t1, t2, t3 = _ticks_of(text)
+
+    # tick 1: breaker failures +3, quarantine +2, state gauge = 1 (open)
+    assert t1["clntpu_breaker_failures_total"]["samples"][0]["delta"] == 3
+    assert t1["clntpu_quarantine_total"]["samples"][0] == {
+        "labels": {"family": "verify", "reason": "RuntimeError"},
+        "delta": 2}
+    assert t1["clntpu_breaker_state"]["samples"][0]["value"] == 1
+    hist = t1["clntpu_verify_flush_seconds"]["samples"][0]
+    assert hist["count"] == 3 and hist["mean"] == pytest.approx(0.5)
+
+    # tick 2: counters idle → families with zero delta are elided
+    # (gauges always report their current value)
+    assert "clntpu_breaker_failures_total" not in t2
+    assert "clntpu_quarantine_total" not in t2
+    assert t2["clntpu_breaker_state"]["samples"][0]["value"] == 1
+
+    # tick 3: the breaker recovered (state back to 0) while failures
+    # kept counting — exactly the trip/recover sequence the fault
+    # matrix watches for
+    assert t3["clntpu_breaker_failures_total"]["samples"][0]["delta"] == 7
+    assert t3["clntpu_breaker_state"]["samples"][0]["value"] == 0
+
+
+def test_watch_ticks_bound_and_sleep_cadence():
+    calls = {"sleep": [], "capture": 0}
+
+    def capture():
+        calls["capture"] += 1
+        return _snap(calls["capture"], 0, 0)
+
+    out = io.StringIO()
+    obs_snapshot.watch(capture, 2.5, out=out, ticks=2,
+                       sleep=calls["sleep"].append)
+    assert calls["sleep"] == [2.5, 2.5]
+    assert calls["capture"] == 3      # baseline + one per tick
+    assert len(_ticks_of(out.getvalue())) == 2
+
+
+def test_watch_keyboard_interrupt_exits_cleanly():
+    snaps = [_snap(0, 0, 0), _snap(1, 0, 0)]
+
+    def capture():
+        if not snaps:
+            raise KeyboardInterrupt
+        return snaps.pop(0)
+
+    out = io.StringIO()
+    # no ticks bound: termination comes from Ctrl-C alone, no traceback
+    obs_snapshot.watch(capture, 1.0, out=out, sleep=lambda s: None)
+    assert len(_ticks_of(out.getvalue())) == 1
+
+
+def test_watch_empty_delta_prints_empty_object():
+    same = _snap(5, 5, 0)
+    # identical snapshots → counters elide entirely; the tick still
+    # prints (an empty dict would hide the gauge, so gauges remain)
+    out = io.StringIO()
+    obs_snapshot.watch(lambda: same, 1.0, out=out, ticks=1,
+                       sleep=lambda s: None)
+    (t1,) = _ticks_of(out.getvalue())
+    assert "clntpu_breaker_failures_total" not in t1
+    assert t1["clntpu_breaker_state"]["samples"][0]["value"] == 0
+
+
+def test_cli_watch_local_with_ticks(capsys, monkeypatch):
+    """End-to-end through main(): --local --watch --ticks captures this
+    process's registry (the resilience families are present-at-zero via
+    obs.families) and exits after K deltas."""
+    monkeypatch.setattr(sys, "argv",
+                        ["obs_snapshot", "capture", "--local",
+                         "--watch", "0.01", "--ticks", "1"])
+    assert obs_snapshot.main() == 0
+    out = capsys.readouterr().out
+    assert out.count("# ") == 1
+
+
+def test_cli_rejects_nonpositive_ticks(monkeypatch):
+    monkeypatch.setattr(sys, "argv",
+                        ["obs_snapshot", "capture", "--local",
+                         "--watch", "1", "--ticks", "0"])
+    with pytest.raises(SystemExit):
+        obs_snapshot.main()

@@ -185,7 +185,14 @@ def test_pipeline_propagates_prep_errors():
 
 
 def test_pipeline_survives_device_errors():
-    """A dispatch failure mid-stream must not deadlock the producer."""
+    """A dispatch failure mid-stream must not deadlock the producer —
+    and since the resilience layer (doc/resilience.md), it must not
+    fail the replay either: the failing bucket bisects, the transient
+    error clears on re-dispatch, and the replay completes with the
+    failure recorded against the verify breaker."""
+    from lightning_tpu.resilience import breaker as RB
+
+    RB.reset_for_tests()
     items = _synthetic_items(64)
     calls = []
 
@@ -195,11 +202,20 @@ def test_pipeline_survives_device_errors():
             raise RuntimeError("device fell over")
         return np.ones(pb.blocks.shape[0], bool)
 
-    import pytest
+    s0 = obs.snapshot()
+    ok = verify.verify_items(items, bucket=8, depth=2,
+                             device_fn=bad_dispatch)
+    s1 = obs.snapshot()
+    assert ok.all() and len(ok) == 64
 
-    with pytest.raises(RuntimeError, match="device fell over"):
-        verify.verify_items(items, bucket=8, depth=2,
-                            device_fn=bad_dispatch)
+    def _brk_failures(snap):
+        fam = snap["metrics"].get("clntpu_breaker_failures_total",
+                                  {"samples": []})
+        return sum(s["value"] for s in fam["samples"]
+                   if s["labels"].get("family") == "verify")
+
+    assert _brk_failures(s1) == _brk_failures(s0) + 1
+    RB.reset_for_tests()
 
 
 # ---------------------------------------------------------------------------
@@ -231,6 +247,16 @@ def test_z_handoff_stays_on_device():
     ok = verify.verify_items(items, bucket=8, depth=2, device_fn=guarded)
     s1 = obs.snapshot()
     assert ok.all()
+    # a transfer-guard trip would no longer propagate (the resilience
+    # layer would bisect + host-recover it) — it would show up here
+    def _fails(snap):
+        fam = snap["metrics"].get("clntpu_breaker_failures_total",
+                                  {"samples": []})
+        return sum(s["value"] for s in fam["samples"]
+                   if s["labels"].get("family") == "verify")
+
+    assert _fails(s1) == _fails(s0), \
+        "device dispatch failed under the transfer guard"
 
     staged = _counter(s1, "clntpu_verify_device_bytes_total") - \
         _counter(s0, "clntpu_verify_device_bytes_total")

@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Repo lint: no NEW bare `assert` statements as input contracts in
-`lightning_tpu/gossip/` and `lightning_tpu/crypto/`.
+`lightning_tpu/gossip/`, `lightning_tpu/crypto/`,
+`lightning_tpu/routing/`, and `lightning_tpu/resilience/`.
 
 A bare assert is stripped under `python -O`, so a contract like
 "oversized rows require z_host" silently degrades into an incidental
@@ -26,7 +27,8 @@ import os
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SCAN_DIRS = ("lightning_tpu/gossip", "lightning_tpu/crypto")
+SCAN_DIRS = ("lightning_tpu/gossip", "lightning_tpu/crypto",
+             "lightning_tpu/routing", "lightning_tpu/resilience")
 
 # (relpath, enclosing function, unparsed condition) — grandfathered.
 ALLOWLIST = {

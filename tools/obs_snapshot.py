@@ -117,16 +117,25 @@ def diff_snapshots(a: dict, b: dict) -> dict:
     return out
 
 
-def watch(capture, interval: float, out=sys.stdout) -> None:
+def watch(capture, interval: float, out=None,
+          ticks: int | None = None, sleep=None) -> None:
     """Capture every `interval` seconds, printing the per-tick delta
-    (the live view of a replay's clntpu_replay_* stage counters)."""
+    (the live view of a replay's clntpu_replay_* stage counters, or of
+    the clntpu_breaker_* / clntpu_quarantine_* resilience families
+    while a fault plays out).  `ticks` bounds the number of deltas
+    printed (None = until Ctrl-C); `sleep` injects a waiter (tests)."""
     import datetime
     import time
 
+    if out is None:
+        out = sys.stdout
+    if sleep is None:
+        sleep = time.sleep
     prev = capture()
+    printed = 0
     try:
-        while True:
-            time.sleep(interval)
+        while ticks is None or printed < ticks:
+            sleep(interval)
             cur = capture()
             stamp = datetime.datetime.now().isoformat(timespec="seconds")
             delta = diff_snapshots(prev, cur)
@@ -134,6 +143,7 @@ def watch(capture, interval: float, out=sys.stdout) -> None:
             print(json.dumps(delta if delta else {}, indent=1),
                   file=out, flush=True)
             prev = cur
+            printed += 1
     except KeyboardInterrupt:
         pass
 
@@ -152,6 +162,9 @@ def main() -> int:
                      help="periodic-diff mode: re-capture every N "
                           "seconds and print the delta since the "
                           "previous capture")
+    cap.add_argument("--ticks", type=int, metavar="K",
+                     help="with --watch: stop after K deltas instead "
+                          "of running until Ctrl-C")
     cap.add_argument("-o", "--out", default="-")
     d = sub.add_parser("diff")
     d.add_argument("a")
@@ -170,11 +183,13 @@ def main() -> int:
         if args.watch is not None:
             if args.watch <= 0:
                 p.error("--watch interval must be positive")
+            if args.ticks is not None and args.ticks <= 0:
+                p.error("--ticks must be positive")
             if args.out == "-":
-                watch(capture, args.watch)
+                watch(capture, args.watch, ticks=args.ticks)
             else:
                 with open(args.out, "w") as f:
-                    watch(capture, args.watch, out=f)
+                    watch(capture, args.watch, out=f, ticks=args.ticks)
             return 0
         snap = capture()
         text = json.dumps(snap, indent=1)

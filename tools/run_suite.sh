@@ -69,4 +69,20 @@ run_slice() {
 
 run_slice A tests/test_[a-f]*.py || exit $?
 run_slice B tests/test_[g-z]*.py || exit $?
-echo "suite green (2 slices)"
+
+# Fault-matrix pass (doc/resilience.md): re-run the resilience suite
+# with deterministic faults armed at every named device seam — dispatch
+# raises for verify/route, the mesh reshard and the sign kernel fail
+# half the time — plus generous dispatch deadlines so the deadline
+# plumbing is live without firing spuriously.  The workload tests in
+# tests/test_zz_resilience.py assert OUTPUT correctness, so this pass
+# proves the breakers/quarantine/host-fallback paths complete every
+# replay/route/sign workload bit-identically under sustained failure.
+echo "fault-matrix pass (LIGHTNING_TPU_FAULT armed)"
+LIGHTNING_TPU_FAULT="dispatch:verify:raise:0.25,dispatch:route:raise:0.5,mesh:mesh:raise:0.5,sign:sign:raise:0.5,readback:verify:raise:0.125" \
+LIGHTNING_TPU_DEADLINE_VERIFY_S=120 \
+LIGHTNING_TPU_DEADLINE_ROUTE_S=120 \
+LIGHTNING_TPU_DEADLINE_INGEST_S=240 \
+  timeout 1800 python -m pytest tests/test_zz_resilience.py -x -q \
+  || { echo "fault-matrix pass failed"; exit 1; }
+echo "suite green (2 slices + fault matrix)"
