@@ -29,9 +29,11 @@ from ..crypto import field as F
 from ..crypto import ref_python as ref
 from ..crypto import secp256k1 as S
 from ..obs import families as _families
+from ..obs import flight as _flight
 from ..resilience import breaker as _breaker
 from ..resilience import faultinject as _fault
 from ..resilience import quarantine as _quarantine
+from ..utils import trace
 
 log = logging.getLogger("lightning_tpu.daemon.hsmd")
 
@@ -59,14 +61,38 @@ def _sign_batch_resilient(op: str, msg_hashes: np.ndarray,
     at store scale — the host signer IS the oracle the device kernel is
     tested against, so a failed device dispatch simply re-signs the
     whole batch host-side (metered as quarantined rows) with identical
-    output bytes."""
+    output bytes.
+
+    Every call is one flight-recorded "sign" dispatch (obs/flight.py):
+    the caller's span (sign_htlc_batch / sign_withdrawal) is the
+    enqueue point, the record's outcome says which path actually signed
+    — host by design, host_breaker, ok, or host-with-error after a
+    failed device dispatch — and listdispatches shows the batch shape
+    the counters only aggregate."""
     B = msg_hashes.shape[0]
+    brk = _breaker.get("sign")
+    # the carrier links the caller's span (the enqueue point) to this
+    # dispatch span with a flow arrow in the exported timeline
+    corr = trace.new_corr()
+    with _flight.dispatch("sign", n_real=B, lanes=B,
+                          shape=(B, 32), corr_ids=(corr.corr_id,),
+                          breaker_state=brk.state) as rec:
+        with trace.span("sign/dispatch", corr=corr, op=op,
+                        dispatch_id=rec["dispatch_id"]):
+            with trace.annotation("sign/dispatch"):
+                return _sign_dispatch(op, msg_hashes, seckeys, brk, rec,
+                                      B)
+
+
+def _sign_dispatch(op: str, msg_hashes: np.ndarray, seckeys: list[int],
+                   brk, rec: dict, B: int) -> np.ndarray:
     if B <= S.HOST_VERIFY_MAX:
         # micro-batches already sign host-side inside ecdsa_sign_batch
+        rec["outcome"] = "host"
         _note_sign(op, B, "host")
         return S.ecdsa_sign_batch(msg_hashes, seckeys)
-    brk = _breaker.get("sign")
     if not brk.allow():
+        rec["outcome"] = "host_breaker"
         _note_sign(op, B, "host")
         return S.host_sign_batch(msg_hashes, seckeys)
     try:
@@ -75,11 +101,16 @@ def _sign_batch_resilient(op: str, msg_hashes: np.ndarray,
     except Exception as e:
         brk.record_failure()
         _quarantine.note("sign", type(e).__name__, B)
+        # recovered on the host oracle: outcome "host" + the error name
+        # (the "error" outcome is reserved for unrecovered failures)
+        rec["outcome"] = "host"
+        rec["error"] = type(e).__name__
         log.warning("device sign dispatch failed (%s); re-signing %d "
                     "hashes on the host oracle", e, B)
         _note_sign(op, B, "host")
         return S.host_sign_batch(msg_hashes, seckeys)
     brk.record_success()
+    rec["outcome"] = "ok"
     _note_sign(op, B, "device")
     return out
 
@@ -199,8 +230,6 @@ class Hsm:
         client._need(CAP_SIGN_COMMITMENT)
         if not sighashes:
             return np.zeros((0, 64), np.uint8)
-        from ..utils import trace
-
         with trace.span("hsmd/sign_htlc_batch", n=len(sighashes)):
             secs = self.channel_secrets(client)
             htlc_priv = K.derive_privkey(secs.htlc,

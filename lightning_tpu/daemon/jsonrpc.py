@@ -855,16 +855,65 @@ def attach_admin_commands(rpc: JsonRpcServer, cfg, ring) -> None:
     async def getmetrics() -> dict:
         """Full metrics snapshot (same registry the REST /metrics
         endpoint renders; doc/observability.md for the naming scheme),
-        plus a `resilience` section: live circuit-breaker states for
-        every dispatch family and any armed fault-injection specs
-        (doc/resilience.md)."""
+        plus a `resilience` section (live circuit-breaker states for
+        every dispatch family and any armed fault-injection specs,
+        doc/resilience.md) and a `dispatches` section (per-family
+        flight-ring occupancy + the latest DispatchRecord,
+        doc/tracing.md)."""
+        from ..obs import flight
         from ..resilience import resilience_snapshot
 
         snap = obs.snapshot()
         snap["resilience"] = resilience_snapshot()
+        snap["dispatches"] = flight.summary()
         return snap
+
+    async def listdispatches(family: str | None = None,
+                             limit: int = 50) -> dict:
+        """The dispatch flight ring (doc/tracing.md): the last `limit`
+        DispatchRecords — batched device dispatches with their shape,
+        occupancy, queue-wait/prep/dispatch/readback timing split,
+        breaker state at dispatch, injected faults, quarantined rows,
+        and outcome.  `family` filters to verify|route|sign|mesh."""
+        from ..obs import flight
+
+        if family is not None and family not in ("verify", "route",
+                                                 "sign", "mesh"):
+            raise RpcError(INVALID_PARAMS,
+                           f"unknown dispatch family {family!r}")
+        try:
+            limit = int(limit)
+        except (TypeError, ValueError):
+            raise RpcError(INVALID_PARAMS, "limit must be an integer")
+        if limit < 0:
+            raise RpcError(INVALID_PARAMS, "limit must be >= 0")
+        return {"dispatches": flight.recent(family, limit),
+                "ring_size": flight.summary()["ring_size"]}
+
+    async def gettrace(dispatches: int | None = None) -> dict:
+        """Chrome trace-event export of the span ring + flight ring
+        (doc/tracing.md): load the result straight into Perfetto or
+        chrome://tracing — one lane per thread, flow arrows along
+        correlation ids, one synthetic lane per dispatch family.
+        `dispatches` bounds the flight records included (default: the
+        whole ring)."""
+        from ..obs import flight, traceexport
+        from ..utils import trace as _trace
+
+        if dispatches is not None:
+            try:
+                dispatches = int(dispatches)
+            except (TypeError, ValueError):
+                raise RpcError(INVALID_PARAMS,
+                               "dispatches must be an integer")
+            if dispatches < 0:
+                raise RpcError(INVALID_PARAMS, "dispatches must be >= 0")
+        return traceexport.chrome_trace(
+            _trace.records(), flight.recent(limit=dispatches))
 
     rpc.register("listconfigs", listconfigs)
     rpc.register("setconfig", setconfig)
     rpc.register("getlog", getlog)
     rpc.register("getmetrics", getmetrics)
+    rpc.register("listdispatches", listdispatches)
+    rpc.register("gettrace", gettrace)

@@ -26,7 +26,7 @@ import numpy as np
 from .. import obs
 from ..obs import families as _families
 from ..resilience import deadline as _deadline
-from ..utils import events, native
+from ..utils import events, native, trace
 from . import store as gstore
 from . import verify as gverify
 from . import wire
@@ -74,6 +74,10 @@ class _QItem:
     raw: bytes
     source: object             # opaque peer handle (None = local/store)
     n_sigs: int
+    # correlation carrier minted at submit time (trace.new_corr): links
+    # this message's enqueue span to the flush/dispatch spans that
+    # eventually verify it, across the to_thread hop (doc/tracing.md)
+    corr: object = None
 
 
 @dataclass
@@ -154,21 +158,27 @@ class GossipIngest:
     # -- submission -------------------------------------------------------
 
     async def submit(self, raw: bytes, source=None) -> None:
-        """Queue one raw gossip message for verification."""
-        try:
-            parsed = wire.parse_gossip(raw)
-        except Exception:
-            self.stats.drop(R_MALFORMED)
-            return
-        if parsed is None:
-            self.stats.drop(R_MALFORMED)
-            return
-        kind = wire.msg_type(raw)
-        if not self._precheck(kind, parsed, raw, source):
-            return
-        n_sigs = 4 if kind == wire.MSG_CHANNEL_ANNOUNCEMENT else 1
-        self._queue.append(_QItem(kind, parsed, raw, source, n_sigs))
-        self._queued_sigs += n_sigs
+        """Queue one raw gossip message for verification.  The submit
+        span is the message's enqueue point: the correlation carrier
+        minted here rides the queue item into the flush, so the
+        exported timeline draws a flow arrow from this span to the
+        device dispatch that verified the message."""
+        with trace.span("gossip/submit"):
+            try:
+                parsed = wire.parse_gossip(raw)
+            except Exception:
+                self.stats.drop(R_MALFORMED)
+                return
+            if parsed is None:
+                self.stats.drop(R_MALFORMED)
+                return
+            kind = wire.msg_type(raw)
+            if not self._precheck(kind, parsed, raw, source):
+                return
+            n_sigs = 4 if kind == wire.MSG_CHANNEL_ANNOUNCEMENT else 1
+            self._queue.append(_QItem(kind, parsed, raw, source, n_sigs,
+                                      corr=trace.new_corr()))
+            self._queued_sigs += n_sigs
         _M_QUEUE.set(self._queued_sigs)
         if self._flush_due is None:
             self._flush_due = self.now() + self.flush_ms / 1000.0
@@ -309,6 +319,7 @@ class GossipIngest:
             _M_FLUSH_SECONDS.observe(time.perf_counter() - t0)
 
     async def _flush_batch(self, batch: list[_QItem]) -> None:
+        corrs = [it.corr for it in batch if it.corr is not None]
         items = self._build_items(batch)
         self.stats.flushes += 1
         self.stats.batched_sigs += len(items)
@@ -320,11 +331,16 @@ class GossipIngest:
         # instead of wedging the loop forever.  The guard bounds ONLY
         # the (pure) verify dispatch: a blown deadline here cancels
         # nothing stateful, so apply + durable store append below can
-        # never be split by the timeout.
-        ok = await _deadline.guard(
-            asyncio.to_thread(gverify.verify_items, items,
-                              self.bucket, depth=self.replay_depth),
-            family="ingest", seam="flush")
+        # never be split by the timeout.  The batch's corr carriers
+        # cross the to_thread hop explicitly (contextvars won't), so
+        # every bucket dispatched for this flush flows back to the
+        # submit spans in the exported timeline.
+        with trace.span("gossip/flush", corr=corrs, sigs=len(items)):
+            ok = await _deadline.guard(
+                asyncio.to_thread(gverify.verify_items, items,
+                                  self.bucket, depth=self.replay_depth,
+                                  corr=corrs),
+                family="ingest", seam="flush")
         # fold per-sig results to per-message (CAs have 4 sigs)
         sig_ok: list[bool] = []
         pos = 0

@@ -39,11 +39,12 @@ from .. import obs
 from ..crypto import field as F
 from ..crypto import secp256k1 as S
 from ..crypto import sha256 as H
+from ..obs import flight as _flight
 from ..resilience import breaker as _breaker
 from ..resilience import deadline as _deadline
 from ..resilience import faultinject as _fault
 from ..resilience import quarantine as _quarantine
-from ..utils import native
+from ..utils import native, trace
 from . import wire
 from .store import StoreIndex
 
@@ -512,9 +513,18 @@ def _plan_buckets(roi_sorted: np.ndarray, bucket: int) -> list[tuple]:
 
 def _prep_bucket(items: VerifyItems, order: np.ndarray,
                  roi_sorted: np.ndarray, bucket: int,
-                 chunk: tuple) -> _PreparedBucket:
+                 chunk: tuple, corrs=()) -> _PreparedBucket:
     """Host side of one bucket: slice rows, byte→block pack, pad.  Runs
-    on the producer thread in the overlapped pipeline."""
+    on the producer thread in the overlapped pipeline (the corr
+    carriers keep its spans causally linked to the enqueue point —
+    contextvars don't follow us onto that thread)."""
+    with trace.span("replay/prep", corr=corrs):
+        return _prep_bucket_inner(items, order, roi_sorted, bucket, chunk)
+
+
+def _prep_bucket_inner(items: VerifyItems, order: np.ndarray,
+                       roi_sorted: np.ndarray, bucket: int,
+                       chunk: tuple) -> _PreparedBucket:
     start, end, r0, r1 = chunk
     t0 = time.perf_counter()
     _fault.fire("prep", "verify")
@@ -623,19 +633,38 @@ def _mesh_device_fn(bucket: int, count_metrics: bool = True):
     # failures and the replay keeps streaming on one device.
     fused = _fused_device_fn(bucket)
 
-    def dispatch(pb: _PreparedBucket):
+    def _supervised(pb: _PreparedBucket, rec: dict):
         brk = _breaker.get("mesh")
+        rec["breaker_state"] = brk.state
         if not brk.allow():
+            # mesh's fallback is the fused single-device program, not
+            # the host; breaker_state="open" records the cause
+            rec["outcome"] = "fused"
             return fused(pb)
         try:
             ok = mesh_dispatch(pb)
         except Exception as e:
             brk.record_failure()
+            rec["outcome"] = "fused"
+            rec["error"] = type(e).__name__
             log.warning("mesh-sharded verify failed (%s); this bucket "
                         "runs on the fused single-device program", e)
             return fused(pb)
         brk.record_success()
+        rec["outcome"] = "ok"
         return ok
+
+    def dispatch(pb: _PreparedBucket):
+        if not count_metrics:      # warmup's dummy buckets: no records
+            return _supervised(pb, {})
+        # a nested flight record: the mesh shard links to its parent
+        # verify dispatch via parent_dispatch_id (thread-local nesting)
+        with _flight.dispatch("mesh", shape=(bucket, pb.mb),
+                              n_real=pb.n_real, lanes=bucket) as rec:
+            with trace.span("mesh/dispatch",
+                            dispatch_id=rec["dispatch_id"]):
+                with trace.annotation("mesh/dispatch"):
+                    return _supervised(pb, rec)
 
     return dispatch
 
@@ -717,7 +746,7 @@ def _subbucket(pb: _PreparedBucket, lanes: np.ndarray,
 
 
 def _wrap_resilient(device_fn, items: VerifyItems, roi: np.ndarray,
-                    bucket: int):
+                    bucket: int, corrs=(), sink: list | None = None):
     """Supervise one bucket dispatcher with the "verify" circuit
     breaker and poisoned-batch quarantine (doc/resilience.md):
 
@@ -727,14 +756,25 @@ def _wrap_resilient(device_fn, items: VerifyItems, roi: np.ndarray,
       bisects: clean halves complete on the device, isolated rows are
       quarantined + re-checked host-side.  The replay completes either
       way — a single poisoned row no longer fails the whole store.
+
+    Every call is one flight-recorded dispatch (obs/flight.py): the
+    record lands in ``sink`` (dispatch order) and its span carries the
+    replay's corr carriers, so each bucket shows up once in the
+    exported timeline with a flow arrow back to the enqueue span.
+    Records of successful dispatches are NOT sealed here — the
+    readback at end-of-replay decides the final outcome (a failed
+    readback is ``readback_host``), so sealing/metering waits for it
+    (flight.defer); only a raising dispatch seals immediately.
     """
     brk = _breaker.get("verify")
+    corr_ids = _flight.corr_ids(corrs)
 
     def host_lanes(pb: _PreparedBucket, lanes: np.ndarray) -> np.ndarray:
         return _host_verify_selected(items, roi, pb.sel[lanes])
 
-    def dispatch(pb: _PreparedBucket):
+    def _dispatch_inner(pb: _PreparedBucket, rec: dict):
         if not brk.allow():
+            rec["outcome"] = "host_breaker"
             _M_R_BUCKETS.labels("host_breaker").inc()
             ok = np.zeros(bucket, bool)
             if pb.n_real:
@@ -745,6 +785,8 @@ def _wrap_resilient(device_fn, items: VerifyItems, roi: np.ndarray,
             ok = device_fn(pb)
         except Exception as e:
             brk.record_failure()
+            rec["outcome"] = "bisect"
+            rec["error"] = type(e).__name__
             log.warning("verify bucket dispatch failed (%s); bisecting "
                         "%d lanes", e, pb.n_real)
             out = np.zeros(bucket, bool)
@@ -760,13 +802,40 @@ def _wrap_resilient(device_fn, items: VerifyItems, roi: np.ndarray,
                 out[lanes] = host_lanes(pb, lanes)
             return out
         brk.record_success()
+        rec["outcome"] = "ok"
+        return ok
+
+    def dispatch(pb: _PreparedBucket, queue_wait: float = 0.0):
+        rec = _flight.begin(
+            "verify", corr_ids=corr_ids, shape=(bucket, pb.mb),
+            n_real=pb.n_real, lanes=bucket,
+            queue_wait_ms=queue_wait * 1e3,
+            prep_ms=pb.prep_seconds * 1e3, breaker_state=brk.state)
+        if sink is not None:
+            sink.append(rec)
+        t0 = time.perf_counter()
+        try:
+            with trace.span("verify/dispatch", corr=corrs,
+                            dispatch_id=rec["dispatch_id"]):
+                with trace.annotation("verify/dispatch"):
+                    ok = _dispatch_inner(pb, rec)
+        except BaseException as e:
+            if rec["outcome"] is None:
+                rec["outcome"] = "error"
+            _flight.finish(rec,
+                           dispatch_ms=(time.perf_counter() - t0) * 1e3,
+                           error=type(e).__name__)
+            raise
+        rec["dispatch_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        _flight.defer(rec)
         return ok
 
     return dispatch
 
 
 def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
-                  depth: int | None, device_fn) -> tuple[np.ndarray, int]:
+                  depth: int | None, device_fn,
+                  corrs=()) -> tuple[np.ndarray, int]:
     """Sort signatures by row, cut self-contained buckets, and stream
     them: a producer thread preps bucket i+1 while bucket i's fused
     program runs on device.  depth bounds the prepared-bucket queue
@@ -782,9 +851,14 @@ def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
     if device_fn is None:
         device_fn = _select_device_fn(bucket, N)
     # every bucket dispatch (injected test doubles included) runs under
-    # the verify breaker + quarantine supervision
-    device_fn = _wrap_resilient(device_fn, items, roi, bucket)
-    prep = functools.partial(_prep_bucket, items, order, roi_sorted, bucket)
+    # the verify breaker + quarantine supervision, and each is one
+    # flight-recorded dispatch whose record lands in `flight_recs`
+    # (dispatch order, so the readback loop below can set late fields)
+    flight_recs: list[dict] = []
+    device_fn = _wrap_resilient(device_fn, items, roi, bucket,
+                                corrs=corrs, sink=flight_recs)
+    prep = functools.partial(_prep_bucket, items, order, roi_sorted,
+                             bucket, corrs=corrs)
 
     out = np.zeros(N, bool)
     # pending holds only (sel, n_real, device_ok): keeping the whole
@@ -840,14 +914,15 @@ def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
                                             prod_deadline)
                     timed_out = True
                     break
-                t_stall += time.perf_counter() - t0
+                wait = time.perf_counter() - t0
+                t_stall += wait
                 if pb is _DONE:
                     break
                 if isinstance(pb, BaseException):
                     raise pb
                 _M_R_QDEPTH.observe(q.qsize() + 1)
                 t0 = time.perf_counter()
-                ok = device_fn(pb)
+                ok = device_fn(pb, queue_wait=wait)
                 t_dispatch += time.perf_counter() - t0
                 t_prep += pb.prep_seconds
                 staged_bytes += pb.staged_bytes
@@ -910,17 +985,38 @@ def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
     # to the host oracle instead of failing the replay.
     t0 = time.perf_counter()
     brk = _breaker.get("verify")
-    for sel, n_real, ok in pending:
-        idx = sel[:n_real]
-        try:
-            _fault.fire("readback", "verify")
-            out[idx] = np.asarray(ok)[:n_real]
-        except Exception as e:
-            brk.record_failure()
-            _quarantine.note("verify", "readback", n_real)
-            log.warning("replay readback failed (%s); re-checking %d "
-                        "rows on the host", e, n_real)
-            out[idx] = _host_verify_selected(items, roi, idx)
+    try:
+        with trace.span("replay/readback", corr=corrs,
+                        buckets=len(pending)):
+            for (sel, n_real, ok), rec in zip(pending, flight_recs):
+                idx = sel[:n_real]
+                t0b = time.perf_counter()
+                try:
+                    _fault.fire("readback", "verify")
+                    out[idx] = np.asarray(ok)[:n_real]
+                except Exception as e:
+                    brk.record_failure()
+                    _quarantine.note("verify", "readback", n_real)
+                    rec["outcome"] = "readback_host"
+                    rec["error"] = type(e).__name__
+                    rec["quarantined"] += n_real
+                    log.warning("replay readback failed (%s); re-checking "
+                                "%d rows on the host", e, n_real)
+                    out[idx] = _host_verify_selected(items, roi, idx)
+                rec["readback_ms"] = round(
+                    (time.perf_counter() - t0b) * 1e3, 3)
+                # the deferred seal: the final outcome (ok / bisect /
+                # host_breaker from dispatch, or readback_host above) is
+                # only known now, so the ring insert + counter + watchdog
+                # all see it — listdispatches and clntpu_dispatches_total
+                # reconcile even on readback failures
+                _flight.finish(rec)
+    finally:
+        # a raising host re-check must not leave the remaining deferred
+        # records unsealed and invisible to the ring (finish() is
+        # idempotent, so already-sealed ones are untouched)
+        for rec in flight_recs:
+            _flight.finish(rec)
     _M_R_READBACK.inc(time.perf_counter() - t0)
 
     _M_R_PREP.inc(t_prep)
@@ -994,7 +1090,8 @@ def _verify_items_unfused(items: VerifyItems, roi: np.ndarray,
 
 
 def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET, *,
-                 depth: int | None = None, device_fn=None) -> np.ndarray:
+                 depth: int | None = None, device_fn=None,
+                 corr=None) -> np.ndarray:
     """Streaming fused-bucket replay (doc/replay_pipeline.md).
 
     Signatures are sorted by message row and cut into self-contained
@@ -1022,41 +1119,72 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET, *,
     replay COMPLETES, bit-identically, under any single-path failure.
     (The LIGHTNING_TPU_REPLAY_FUSED=0 legacy chain is supervised
     coarsely: breaker-open or a raising chain re-checks the whole
-    replay on the host oracle, without per-bucket bisection.)"""
+    replay on the host oracle, without per-bucket bisection.)
+
+    ``corr`` (a trace.Carrier or list of them, minted at the enqueue
+    point — ingest submit, the store-replay span) rides every prep /
+    dispatch / readback span and flight record of this replay, so the
+    exported timeline links each bucket back to its enqueue span
+    across the producer/dispatch threads (doc/tracing.md).  When
+    LIGHTNING_TPU_PROFILE=<dir> is set the whole replay runs inside a
+    jax.profiler session with per-dispatch TraceAnnotations."""
     N = len(items)
     if N == 0:
         return np.zeros(0, bool)
+    corrs = trace.as_carriers(corr)
     t_start = time.perf_counter()
     roi = items.row_of_item
     if roi is None:
         roi = np.arange(N, dtype=np.int64)
     tag_ok = (items.pubkeys[:, 0] == 2) | (items.pubkeys[:, 0] == 3)
 
-    if (device_fn is None
-            and _os.environ.get("LIGHTNING_TPU_REPLAY_FUSED", "1") == "0"):
-        # the legacy chain has no per-bucket dispatcher to wrap, so its
-        # supervision is coarse: breaker-open short-circuits the whole
-        # replay to the host oracle, and a raising chain falls back the
-        # same way (no bisect — all rows are re-checked host-side)
-        n_buckets = (N + bucket - 1) // bucket
-        brk = _breaker.get("verify")
-        if not brk.allow():
-            _M_R_BUCKETS.labels("host_breaker").inc(n_buckets)
-            out = _host_verify_selected(items, roi, np.arange(N))
+    with trace.profile_session():
+        if (device_fn is None
+                and _os.environ.get("LIGHTNING_TPU_REPLAY_FUSED",
+                                    "1") == "0"):
+            # the legacy chain has no per-bucket dispatcher to wrap, so
+            # its supervision is coarse: breaker-open short-circuits the
+            # whole replay to the host oracle, and a raising chain falls
+            # back the same way (no bisect — all rows re-check host-side)
+            # — one coarse flight record covers the whole replay
+            n_buckets = (N + bucket - 1) // bucket
+            brk = _breaker.get("verify")
+            with _flight.dispatch(
+                    "verify", corr_ids=_flight.corr_ids(corrs),
+                    shape=(bucket, MAX_BLOCKS), n_real=N,
+                    lanes=n_buckets * bucket,
+                    breaker_state=brk.state) as frec:
+                with trace.span("verify/dispatch", corr=corrs,
+                                dispatch_id=frec["dispatch_id"]):
+                    if not brk.allow():
+                        frec["outcome"] = "host_breaker"
+                        _M_R_BUCKETS.labels("host_breaker").inc(n_buckets)
+                        out = _host_verify_selected(items, roi,
+                                                    np.arange(N))
+                    else:
+                        try:
+                            _fault.fire("dispatch", "verify")
+                            out, n_buckets = _verify_items_unfused(
+                                items, roi, bucket)
+                        except Exception as e:
+                            brk.record_failure()
+                            _quarantine.note("verify", type(e).__name__, N)
+                            # recovered on the host oracle — "error" is
+                            # reserved for unrecovered failures
+                            frec["outcome"] = "host"
+                            frec["error"] = type(e).__name__
+                            log.warning(
+                                "unfused verify chain failed (%s); "
+                                "re-checking all %d rows on the host",
+                                e, N)
+                            out = _host_verify_selected(items, roi,
+                                                        np.arange(N))
+                        else:
+                            brk.record_success()
+                            frec["outcome"] = "ok"
         else:
-            try:
-                _fault.fire("dispatch", "verify")
-                out, n_buckets = _verify_items_unfused(items, roi, bucket)
-            except Exception as e:
-                brk.record_failure()
-                _quarantine.note("verify", type(e).__name__, N)
-                log.warning("unfused verify chain failed (%s); "
-                            "re-checking all %d rows on the host", e, N)
-                out = _host_verify_selected(items, roi, np.arange(N))
-            else:
-                brk.record_success()
-    else:
-        out, n_buckets = _run_pipeline(items, roi, bucket, depth, device_fn)
+            out, n_buckets = _run_pipeline(items, roi, bucket, depth,
+                                           device_fn, corrs=corrs)
 
     # oversized rows: the device hashed garbage for them; their host
     # sha256d was computed at extraction — verify those few serially.
@@ -1094,8 +1222,6 @@ def verify_store(idx: StoreIndex, bucket: int = DEFAULT_BUCKET) -> StoreVerifyRe
     message (the reference's store *load* skips re-verification; its
     *ingest* path verifies serially — this is the ingest cost model run at
     load scale, the BASELINE.md target workload)."""
-    from ..utils import trace
-
     alive = idx.select(idx.alive())
     ca = alive.select(alive.types == wire.MSG_CHANNEL_ANNOUNCEMENT)
     na = alive.select(alive.types == wire.MSG_NODE_ANNOUNCEMENT)
@@ -1106,7 +1232,10 @@ def verify_store(idx: StoreIndex, bucket: int = DEFAULT_BUCKET) -> StoreVerifyRe
         items_cu = extract_channel_updates(cu, make_scid_map(ca))
         all_items = VerifyItems.concat([items_ca, items_na, items_cu])
     with trace.span("gossip/verify", sigs=int(len(all_items.sigs))):
-        ok = verify_items(all_items, bucket)
+        # the replay's enqueue point: every bucket's prep/dispatch/
+        # readback span flows back here in the exported timeline
+        corr = trace.new_corr()
+        ok = verify_items(all_items, bucket, corr=corr)
     n_ca, n_na, n_cu = len(items_ca), len(items_na), len(items_cu)
     ca_ok = ok[:n_ca].reshape(4, -1).all(axis=0) if n_ca else np.zeros(0, bool)
     na_ok = ok[n_ca : n_ca + n_na]

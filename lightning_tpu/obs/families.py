@@ -95,3 +95,15 @@ INGEST_FLUSH_ERRORS = REGISTRY.counter(
     "clntpu_ingest_flush_errors_total",
     "GossipIngest flush-loop iterations that raised (the loop restarts "
     "with backoff instead of dying silently)")
+
+# -- obs/flight.py: the dispatch flight recorder (doc/tracing.md) ----------
+DISPATCHES = REGISTRY.counter(
+    "clntpu_dispatches_total",
+    "Flight-recorded dispatches, by family and outcome (the aggregate "
+    "view of the listdispatches ring)",
+    labelnames=("family", "outcome"))
+SLOW_DISPATCH = REGISTRY.counter(
+    "clntpu_slow_dispatch_total",
+    "Dispatches flagged by the slow-dispatch watchdog (over "
+    "LIGHTNING_TPU_SLOW_DISPATCH_S, or the rolling per-family p99)",
+    labelnames=("family",))

@@ -79,10 +79,14 @@ def shard_batch(mesh: Mesh, *arrays):
     # the first mesh-only step of a sharded dispatch, so an injected
     # failure here exercises the mesh breaker's mesh→fused degradation
     from ..resilience import faultinject as _fault
+    from ..utils import trace
 
     _fault.fire("mesh", "mesh")
     sh = batch_sharding(mesh)
-    return tuple(jax.device_put(a, sh) for a in arrays)
+    # under LIGHTNING_TPU_PROFILE the reshard cost shows up as its own
+    # host-lane slice next to the shard_map program (doc/tracing.md)
+    with trace.annotation("mesh/reshard"):
+        return tuple(jax.device_put(a, sh) for a in arrays)
 
 
 @functools.lru_cache(maxsize=16)

@@ -47,6 +47,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..obs import families as _f
+from ..obs import flight as _flight
 from ..utils import events
 
 log = logging.getLogger("lightning_tpu.resilience.faultinject")
@@ -144,6 +145,9 @@ def fire(seam: str, family: str) -> None:
         if not spec.should_fire():
             continue
         _f.FAULT_INJECTED.labels(seam, family, spec.action).inc()
+        # stamp the in-flight DispatchRecord (if any) so the flight
+        # ring shows WHICH dispatch ate this injection (doc/tracing.md)
+        _flight.note_fault(seam, family)
         events.emit("fault_injected",
                     {"seam": seam, "family": family, "spec": spec.raw})
         if spec.action == "hang":

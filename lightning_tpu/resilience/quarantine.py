@@ -23,6 +23,7 @@ import logging
 import numpy as np
 
 from ..obs import families as _f
+from ..obs import flight as _flight
 from ..utils import events
 
 log = logging.getLogger("lightning_tpu.resilience.quarantine")
@@ -32,6 +33,7 @@ def note(family: str, reason: str, rows: int = 1) -> None:
     """Meter rows diverted off a device result without a bisect (e.g.
     a readback failure after the dispatch stream already completed)."""
     _f.QUARANTINE.labels(family, reason).inc(rows)
+    _flight.note_quarantine(rows)
 
 
 def bisect(indices, attempt, family: str):
@@ -61,6 +63,7 @@ def bisect(indices, attempt, family: str):
                 row = int(idx[0])
                 reason = type(e).__name__
                 _f.QUARANTINE.labels(family, reason).inc()
+                _flight.note_quarantine(1)
                 events.emit("quarantine", {"family": family, "row": row,
                                            "reason": reason})
                 log.warning("%s: quarantined row %d (%s: %s)",

@@ -68,10 +68,11 @@ import jax.numpy as jnp
 from jax.experimental import enable_x64
 
 from ..obs import families as _families
+from ..obs import flight as _flight
 from ..resilience import breaker as _breaker
 from ..resilience import deadline as _deadline
 from ..resilience import faultinject as _fault
-from ..utils import events
+from ..utils import events, trace
 from . import dijkstra as DJ
 from .dijkstra import BLOCKS_PER_YEAR, NoRoute, RouteHop
 from .planes import RoutePlanes
@@ -229,6 +230,9 @@ class RouteQuery:
     # (solve_batch always returns the payer-side (amount, delay) pair;
     # getroute's with_source only shapes ITS return value)
     future: object = None
+    # correlation carrier minted in getroute's enqueue span — links the
+    # caller's span to the coalesced flush dispatch (doc/tracing.md)
+    corr: object = None
 
 
 def _reconstruct(planes: RoutePlanes, via: np.ndarray, src: int, dst: int,
@@ -482,26 +486,32 @@ class RouteService:
             raise NoRoute("no gossip graph loaded")
         if source == destination:
             raise NoRoute("source is destination")
-        q = RouteQuery(source, destination, int(amount_msat),
-                       int(final_cltv), int(riskfactor), int(max_hops),
-                       excluded_scids,
-                       future=asyncio.get_running_loop().create_future())
-        if self._closed or self._task is None or self._task.done():
-            # no flush loop to resolve the future (pre-start, shutdown
-            # teardown ordering, or a crashed task): behave like the
-            # plain host dijkstra instead of queueing forever
-            _M_FALLBACK.labels(R_NOT_RUNNING).inc()
-            res = self._host_solve(g, q)
-            self._resolve(q, "host", res)
-            route, src_info = await q.future
-            return (route, src_info) if with_source else route
-        self._queue.append(q)
-        _M_QUEUE.set(len(self._queue))
-        if self._flush_due is None:
-            self._flush_due = self.now() + self.flush_ms / 1000.0
-            self._wakeup.set()
-        if len(self._queue) >= self.batch:
-            self._wakeup.set()
+        # the enqueue span: the carrier minted here rides the query into
+        # the coalesced flush, so the exported timeline flows this call
+        # to the batched dispatch that solved it
+        with trace.span("route/enqueue"):
+            q = RouteQuery(
+                source, destination, int(amount_msat),
+                int(final_cltv), int(riskfactor), int(max_hops),
+                excluded_scids,
+                future=asyncio.get_running_loop().create_future(),
+                corr=trace.new_corr())
+            if self._closed or self._task is None or self._task.done():
+                # no flush loop to resolve the future (pre-start,
+                # shutdown teardown ordering, or a crashed task): behave
+                # like the plain host dijkstra instead of queueing forever
+                _M_FALLBACK.labels(R_NOT_RUNNING).inc()
+                res = self._host_solve(g, q)
+                self._resolve(q, "host", res)
+                route, src_info = await q.future
+                return (route, src_info) if with_source else route
+            self._queue.append(q)
+            _M_QUEUE.set(len(self._queue))
+            if self._flush_due is None:
+                self._flush_due = self.now() + self.flush_ms / 1000.0
+                self._wakeup.set()
+            if len(self._queue) >= self.batch:
+                self._wakeup.set()
         route, src_info = await q.future
         if with_source:
             return route, src_info
@@ -583,6 +593,28 @@ class RouteService:
             _M_FLUSH_SECONDS.observe(time.perf_counter() - t0)
 
     async def _flush_batch(self, batch: list[RouteQuery]) -> None:
+        # every route flush is one flight-recorded dispatch: the record
+        # carries the coalesced queries' corr ids and the outcome of
+        # whichever path (device / host / breaker / deadline) ran, and
+        # the flush span flow-links back to each route/enqueue span
+        corrs = trace.as_carriers(q.corr for q in batch)
+        brk = _breaker.get("route")
+        with _flight.dispatch(
+                "route", corr_ids=_flight.corr_ids(corrs),
+                n_real=len(batch), lanes=len(batch),
+                breaker_state=brk.state) as rec:
+            with trace.span("route/flush", corr=corrs,
+                            dispatch_id=rec["dispatch_id"],
+                            queries=len(batch)):
+                await self._flush_batch_inner(batch, brk, rec)
+            # a flush that completed without a device dispatch ran the
+            # host path; only set on success so a crashed flush seals
+            # as "error", not "host"
+            if rec["outcome"] is None:
+                rec["outcome"] = "host"
+
+    async def _flush_batch_inner(self, batch: list[RouteQuery], brk,
+                                 rec: dict) -> None:
         _M_BATCH.observe(len(batch))
         g = self.get_map()
         host: list[tuple[RouteQuery, str]] = []
@@ -592,7 +624,6 @@ class RouteService:
                 self._resolve(q, "host", ("noroute",
                                           "no gossip graph loaded"))
             return
-        brk = _breaker.get("route")
         if not self.device:
             host = [(q, R_DISABLED) for q in batch]
         elif len(batch) <= self.host_max:
@@ -619,32 +650,42 @@ class RouteService:
             # follow — a half-open probe token must always be settled
             # by the record_success/record_failure below, or the
             # breaker would wedge half-open forever.
+            rec["outcome"] = "host_breaker"
             host.extend((q, R_BREAKER) for q in device)
             device = []
         if device:
+            lanes = (((len(device) + self.batch - 1) // self.batch)
+                     * self.batch)
+            rec["n_real"] = len(device)
+            rec["lanes"] = lanes
+            rec["occupancy"] = round(len(device) / lanes, 4)
             try:
                 _fault.fire("dispatch", "route")
                 self._planes = RoutePlanes.current(g, self._planes)
                 # deadline (LIGHTNING_TPU_DEADLINE_ROUTE_S, off by
                 # default): a hung solver thread fails THIS batch to the
                 # host path instead of wedging every future getroute
-                results = await _deadline.guard(
-                    asyncio.to_thread(solve_batch, self._planes, device,
-                                      self.batch),
-                    family="route", seam="dispatch")
-                _M_OCCUPANCY.observe(
-                    len(device)
-                    / (((len(device) + self.batch - 1) // self.batch)
-                       * self.batch))
+                with trace.annotation("route/dispatch"):
+                    results = await _deadline.guard(
+                        asyncio.to_thread(solve_batch, self._planes,
+                                          device, self.batch),
+                        family="route", seam="dispatch")
+                _M_OCCUPANCY.observe(len(device) / lanes)
                 brk.record_success()
+                rec["outcome"] = "ok"
             except _deadline.DeadlineExceeded:
                 brk.record_failure()
+                rec["outcome"] = "deadline"
                 log.warning("device route dispatch blew its deadline; "
                             "batch re-solves on host dijkstra")
                 host.extend((q, R_DEADLINE) for q in device)
                 results, device = [], []
-            except Exception:
+            except Exception as e:
                 brk.record_failure()
+                # recovered on the host dijkstra below — "error" is
+                # reserved for unrecovered failures
+                rec["outcome"] = "host"
+                rec["error"] = type(e).__name__
                 log.exception("device route dispatch failed; "
                               "falling back to host dijkstra")
                 host.extend((q, R_DEVICE_ERROR) for q in device)

@@ -296,6 +296,19 @@ COMMANDS: dict[str, dict] = {
         "params": {"level": "str?"},
         "result": {"log": "list"},
     },
+    "getmetrics": {
+        "params": {},
+        "result": {"metrics": "dict", "resilience": "dict",
+                   "dispatches": "dict"},
+    },
+    "listdispatches": {
+        "params": {"family": "str?", "limit": "int?"},
+        "result": {"dispatches": "list", "ring_size": "int"},
+    },
+    "gettrace": {
+        "params": {"dispatches": "int?"},
+        "result": {"traceEvents": "list", "displayTimeUnit": "str"},
+    },
     "listnodes": {
         "params": {},
         "result": {"nodes": "list"},

@@ -1,0 +1,68 @@
+"""tools/lint_spans.py: the span/label cardinality lint stays green on
+the tree and actually catches interpolated names (ISSUE 5 satellite)."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import lint_spans as LS
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tree_is_clean():
+    p = subprocess.run([sys.executable,
+                        os.path.join(ROOT, "tools", "lint_spans.py")],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def _scan(tmp_path, src: str):
+    f = tmp_path / "mod.py"
+    f.write_text(src)
+    return LS.scan_file(os.path.relpath(str(f), LS.ROOT))
+
+
+def test_detects_interpolated_span_name(tmp_path):
+    hits = _scan(tmp_path, (
+        "def f(scid):\n"
+        "    with trace.span(f'verify/{scid}'):\n"
+        "        pass\n"
+    ))
+    assert [(h[2]) for h in hits] == ["trace.span"]
+
+
+def test_detects_concatenated_topic_and_family(tmp_path):
+    hits = _scan(tmp_path, (
+        "def f(peer):\n"
+        "    events.emit('drop_' + peer, {})\n"
+        "    with flight.dispatch('fam_%s' % peer):\n"
+        "        pass\n"
+    ))
+    assert sorted(h[2] for h in hits) == ["events.emit",
+                                         "flight.dispatch"]
+
+
+def test_detects_constructed_label_values(tmp_path):
+    hits = _scan(tmp_path, (
+        "def f(m, peer, reason):\n"
+        "    m.labels(f'peer-{peer}').inc()\n"
+        "    m.labels('x'.join(peer)).inc()\n"
+        "    m.labels(reason).inc()\n"          # variable: legal
+    ))
+    assert [h[2] for h in hits] == ["labels", "labels"]
+
+
+def test_allows_fixed_vocabulary(tmp_path):
+    assert _scan(tmp_path, (
+        "def f(m, outcome):\n"
+        "    with trace.span('verify/dispatch', corr=c):\n"
+        "        pass\n"
+        "    events.emit('slow_dispatch', {})\n"
+        "    m.labels('verify', outcome).inc()\n"
+        "    other.begin(x)\n"                  # not a flight base
+    )) == []

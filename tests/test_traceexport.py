@@ -1,0 +1,159 @@
+"""obs/traceexport.py: the Chrome trace-event export schema, pinned by
+a golden file so Perfetto never silently rejects (or silently
+half-renders) what `gettrace`/tools/trace_export.py emit.
+
+The golden input is a hand-written cross-thread session: an enqueue
+span minting corr 7, a producer-thread prep span and a dispatch span
+carrying it, plus one verify flight record — the exact shape the
+exporter exists for.  chrome_trace() is deterministic for a given
+input, so the serialized export is compared byte-for-byte; any field
+rename, reorder, or unit change shows up as a golden diff to review,
+not a blank Perfetto timeline three PRs later.
+
+Regenerate after an INTENTIONAL schema change with:
+    python tests/test_traceexport.py --regen
+"""
+from __future__ import annotations
+
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightning_tpu.obs import traceexport
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "vectors", "trace_export_golden.json")
+
+# a fixed cross-thread session (ns timestamps as utils/trace.py emits)
+SPANS = [
+    {"name": "gossip/submit", "parent": None, "span_id": 1,
+     "parent_id": None, "tid": 100, "thread": "MainThread",
+     "start_ns": 1_000_000, "duration_ns": 50_000,
+     "corr_ids": [7], "corr_id": 7},
+    {"name": "replay/prep", "parent": None, "span_id": 2,
+     "parent_id": None, "tid": 200, "thread": "replay-prep",
+     "start_ns": 1_200_000, "duration_ns": 400_000,
+     "corr_ids": [7], "corr_id": 7},
+    {"name": "verify/dispatch", "parent": None, "span_id": 3,
+     "parent_id": None, "tid": 300, "thread": "dispatch",
+     "start_ns": 1_700_000, "duration_ns": 900_000,
+     "corr_ids": [7], "corr_id": 7, "dispatch_id": 42,
+     "attributes": {"sigs": 96}},
+    {"name": "uncorrelated", "parent": "verify/dispatch", "span_id": 4,
+     "parent_id": 3, "tid": 300, "thread": "dispatch",
+     "start_ns": 1_800_000, "duration_ns": 10_000, "error": "ValueError"},
+]
+FLIGHTS = [
+    {"dispatch_id": 42, "family": "verify", "ts": 1700.0,
+     "ts_ns": 1_700_000, "tid": 300, "thread": "dispatch",
+     "shape": [64, 12], "n_real": 96, "lanes": 128, "occupancy": 0.75,
+     "queue_wait_ms": 0.1, "prep_ms": 0.4, "dispatch_ms": 0.9,
+     "readback_ms": 0.05, "breaker_state": "closed", "faults": [],
+     "quarantined": 0, "outcome": "ok", "corr_ids": [7]},
+]
+
+
+def _export() -> dict:
+    return traceexport.chrome_trace(copy.deepcopy(SPANS),
+                                    copy.deepcopy(FLIGHTS))
+
+
+def _dump(obj: dict) -> str:
+    return json.dumps(obj, indent=1, sort_keys=True) + "\n"
+
+
+def test_matches_golden():
+    with open(GOLDEN) as f:
+        assert _dump(_export()) == f.read(), \
+            "trace-event schema drift — if intentional, regenerate " \
+            "with: python tests/test_traceexport.py --regen"
+
+
+def test_golden_is_valid():
+    with open(GOLDEN) as f:
+        assert traceexport.validate(json.load(f)) == []
+
+
+def test_export_shape():
+    """The structural guarantees the golden bytes encode, stated
+    explicitly: required fields per ph, one flow chain for corr 7
+    binding inside slices, one synthetic flight lane."""
+    obj = _export()
+    evs = obj["traceEvents"]
+    assert obj["displayTimeUnit"] == "ms"
+    for ev in evs:
+        assert ev["ph"] in ("M", "X", "s", "t", "f")
+        if ev["ph"] != "M":
+            assert isinstance(ev["ts"], (int, float))
+            assert "pid" in ev and "tid" in ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    flows = [e for e in evs if e["ph"] in ("s", "t", "f")]
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    assert {e["id"] for e in flows} == {7}
+    assert [e["tid"] for e in flows] == [100, 200, 300]
+    assert flows[-1]["bp"] == "e"
+    lanes = [e for e in evs if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert "flight:verify" in {e["args"]["name"] for e in lanes}
+    disp = [e for e in evs if e["ph"] == "X"
+            and e["name"] == "dispatch/verify"]
+    assert len(disp) == 1
+    assert disp[0]["args"]["outcome"] == "ok"
+    assert disp[0]["args"]["breaker_state"] == "closed"
+
+
+def test_validate_rejects_malformed():
+    """Each invariant Perfetto enforces must be individually caught."""
+    good = _export()
+    assert traceexport.validate(good) == []
+
+    def broken(mutate):
+        obj = copy.deepcopy(good)
+        mutate(obj["traceEvents"])
+        return traceexport.validate(obj)
+
+    def drop_dur(evs):
+        next(e for e in evs if e["ph"] == "X").pop("dur")
+
+    def drop_ts(evs):
+        next(e for e in evs if e["ph"] == "X").pop("ts")
+
+    def unpair_flow(evs):
+        evs.remove(next(e for e in evs if e["ph"] == "f"))
+
+    def unbind_flow(evs):
+        next(e for e in evs if e["ph"] == "s")["ts"] = 9e9
+
+    def bad_bp(evs):
+        next(e for e in evs if e["ph"] == "f").pop("bp")
+
+    def bad_ph(evs):
+        evs.append({"ph": "Q", "name": "x", "ts": 1, "pid": 1, "tid": 1})
+
+    for mutate in (drop_dur, drop_ts, unpair_flow, unbind_flow,
+                   bad_bp, bad_ph):
+        assert broken(mutate), f"validate() missed {mutate.__name__}"
+    assert traceexport.validate({"traceEvents": "nope"})
+    assert traceexport.validate([])
+
+
+def test_records_without_start_ns_are_skipped():
+    """Half-written sink lines (crash mid-emit) must not poison the
+    export."""
+    obj = traceexport.chrome_trace([{"name": "torn"}] + copy.deepcopy(SPANS))
+    assert traceexport.validate(obj) == []
+    assert not any(e.get("name") == "torn" for e in obj["traceEvents"])
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        with open(GOLDEN, "w") as f:
+            f.write(_dump(_export()))
+        print(f"wrote {GOLDEN}")
+    else:
+        sys.exit(pytest.main([__file__, "-q"]))

@@ -20,6 +20,11 @@ Subcommands:
       clntpu_replay_prep/_stall/_dispatch stage counters and the
       overlap-ratio histogram move while verify_store streams buckets
       (doc/replay_pipeline.md).  Ctrl-C exits cleanly.
+  capture ... --dispatches N
+      Fold the last N flight records (listdispatches, doc/tracing.md)
+      into the capture as `dispatch_log`; diff/--watch then print only
+      the dispatches NEW since the previous snapshot — the "which
+      dispatch blew up that counter delta" view.
 
 The diff output is the "what did this flush/bench actually do" view:
 two snapshots bracket a workload and the delta is attributable to it.
@@ -37,13 +42,12 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def capture_rpc(rpc_path: str) -> dict:
-    """getmetrics over the daemon's unix JSON-RPC socket."""
+def rpc_call(rpc_path: str, method: str, params: dict | None = None) -> dict:
     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     s.settimeout(30)
     s.connect(rpc_path)
-    s.sendall(json.dumps({"jsonrpc": "2.0", "id": 1,
-                          "method": "getmetrics"}).encode())
+    s.sendall(json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                          "params": params or {}}).encode())
     buf = b""
     while b"\n\n" not in buf:
         chunk = s.recv(1 << 20)
@@ -53,32 +57,57 @@ def capture_rpc(rpc_path: str) -> dict:
     s.close()
     resp = json.loads(buf.split(b"\n\n")[0])
     if "error" in resp:
-        raise SystemExit(f"getmetrics failed: {resp['error']}")
+        raise SystemExit(f"{method} failed: {resp['error']}")
     return resp["result"]
 
 
-def capture_url(url: str, rune: str | None = None) -> dict:
+def capture_rpc(rpc_path: str, dispatches: int | None = None) -> dict:
+    """getmetrics over the daemon's unix JSON-RPC socket;
+    --dispatches N folds the last N flight records in (listdispatches,
+    doc/tracing.md)."""
+    snap = rpc_call(rpc_path, "getmetrics")
+    if dispatches:
+        snap["dispatch_log"] = rpc_call(
+            rpc_path, "listdispatches",
+            {"limit": dispatches})["dispatches"]
+    return snap
+
+
+def capture_url(url: str, rune: str | None = None,
+                dispatches: int | None = None) -> dict:
     """getmetrics over the REST gateway (POST /v1/getmetrics).  A
     rune-gated daemon (commando configured) needs --rune."""
     import urllib.request
 
     headers = {"Rune": rune} if rune else {}
-    req = urllib.request.Request(url.rstrip("/") + "/v1/getmetrics",
-                                 data=b"{}", method="POST",
-                                 headers=headers)
-    with urllib.request.urlopen(req, timeout=30) as r:
-        return json.load(r)
+
+    def post(method: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            url.rstrip("/") + "/v1/" + method,
+            data=json.dumps(body).encode(), method="POST",
+            headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.load(r)
+
+    snap = post("getmetrics", {})
+    if dispatches:
+        snap["dispatch_log"] = post(
+            "listdispatches", {"limit": dispatches})["dispatches"]
+    return snap
 
 
-def capture_local() -> dict:
+def capture_local(dispatches: int | None = None) -> dict:
     from lightning_tpu import obs
     # well-known families owned by heavyweight modules (routing.device,
     # daemon.hsmd) are declared in this jax-free module so they appear
     # present-at-zero in a fresh capture process — a diff against a
     # later in-daemon snapshot then attributes deltas correctly
-    from lightning_tpu.obs import families  # noqa: F401
+    from lightning_tpu.obs import families, flight  # noqa: F401
 
-    return obs.snapshot()
+    snap = obs.snapshot()
+    if dispatches:
+        snap["dispatch_log"] = flight.recent(limit=dispatches)
+    return snap
 
 
 def _sample_key(rec: dict) -> tuple:
@@ -114,6 +143,15 @@ def diff_snapshots(a: dict, b: dict) -> dict:
                 rows.append({"labels": labels, "value": s["value"]})
         if rows:
             out[name] = {"kind": fam["kind"], "samples": rows}
+    # flight records captured with --dispatches: the diff keeps only
+    # the dispatches NEW since `a`, so a --watch tick shows WHICH
+    # dispatch blew up a counter delta, not just that one did
+    if "dispatch_log" in b:
+        seen = {r.get("dispatch_id") for r in a.get("dispatch_log", [])}
+        new = [r for r in b["dispatch_log"]
+               if r.get("dispatch_id") not in seen]
+        if new:
+            out["dispatch_log"] = new
     return out
 
 
@@ -165,6 +203,11 @@ def main() -> int:
     cap.add_argument("--ticks", type=int, metavar="K",
                      help="with --watch: stop after K deltas instead "
                           "of running until Ctrl-C")
+    cap.add_argument("--dispatches", type=int, metavar="N",
+                     help="include the last N flight records "
+                          "(listdispatches) in the capture; with "
+                          "--watch, each tick prints only the "
+                          "dispatches NEW since the previous tick")
     cap.add_argument("-o", "--out", default="-")
     d = sub.add_parser("diff")
     d.add_argument("a")
@@ -172,12 +215,16 @@ def main() -> int:
     args = p.parse_args()
 
     if args.cmd == "capture":
+        if args.dispatches is not None and args.dispatches <= 0:
+            p.error("--dispatches must be positive")
         if args.rpc:
-            capture = lambda: capture_rpc(args.rpc)
+            capture = lambda: capture_rpc(args.rpc,
+                                          dispatches=args.dispatches)
         elif args.url:
-            capture = lambda: capture_url(args.url, rune=args.rune)
+            capture = lambda: capture_url(args.url, rune=args.rune,
+                                          dispatches=args.dispatches)
         elif args.local:
-            capture = capture_local
+            capture = lambda: capture_local(dispatches=args.dispatches)
         else:
             p.error("need --rpc, --url, or --local")
         if args.watch is not None:

@@ -70,6 +70,18 @@ run_slice() {
 run_slice A tests/test_[a-f]*.py || exit $?
 run_slice B tests/test_[g-z]*.py || exit $?
 
+# Trace-export schema pass (doc/tracing.md): a synthetic cross-thread
+# workload is exported as Chrome trace-event JSON and validated against
+# the fields Perfetto actually enforces (ph/ts/dur/pid/tid, flow-arrow
+# pairing and slice binding) plus corr-id flow connectivity — schema
+# drift fails the suite instead of silently rendering an empty
+# timeline.  The span-cardinality lint rides the same pass.
+echo "trace-export schema pass"
+timeout 300 python tools/trace_export.py --selfcheck \
+  || { echo "trace-export selfcheck failed"; exit 1; }
+timeout 300 python tools/lint_spans.py \
+  || { echo "span-cardinality lint failed"; exit 1; }
+
 # Fault-matrix pass (doc/resilience.md): re-run the resilience suite
 # with deterministic faults armed at every named device seam — dispatch
 # raises for verify/route, the mesh reshard and the sign kernel fail

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Export the daemon's span + dispatch flight rings as Chrome
+trace-event JSON (Perfetto-loadable; doc/tracing.md).
+
+The reference ships cln-tracer (contrib/cln-tracer) to turn
+common/trace.c's USDT probes into a scrubabble timeline; this CLI is
+the same operator tool for our batched pipelines: one lane per
+thread/flush loop, flow arrows along correlation ids from each enqueue
+span to the prep/dispatch/readback spans it caused, and one synthetic
+lane per dispatch family carrying the full DispatchRecords
+(obs/flight.py).  Open the output at https://ui.perfetto.dev or
+chrome://tracing.
+
+Modes:
+  --rpc <unix-socket> [-o trace.json] [--dispatches N]
+      Call `gettrace` on a running daemon and write its export.
+  --spans spans.jsonl [-o trace.json]
+      Export from a span sink file (trace.set_sink(path) JSON lines) —
+      the post-mortem path when the daemon is already gone.
+  --validate trace.json
+      Schema-check an existing export (the fields Perfetto actually
+      enforces: ph/ts/dur/pid/tid, flow arrow pairing + binding).
+  --selfcheck
+      Run a synthetic cross-thread workload in-process, export it, and
+      validate both the schema and the corr-id flow connectivity.
+      Exit 1 on any problem — wired into tools/run_suite.sh so a
+      schema drift fails the suite instead of silently rendering an
+      empty timeline in Perfetto.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from obs_snapshot import rpc_call  # noqa: E402  (shared unix-RPC helper)
+
+
+def export_rpc(rpc_path: str, dispatches: int | None = None) -> dict:
+    """gettrace over the daemon's unix JSON-RPC socket."""
+    params = {} if dispatches is None else {"dispatches": dispatches}
+    return rpc_call(rpc_path, "gettrace", params)
+
+
+def export_spans_file(path: str) -> dict:
+    """Export from a trace.set_sink(path) JSON-lines file."""
+    from lightning_tpu.obs import traceexport
+
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return traceexport.chrome_trace(records)
+
+
+def selfcheck() -> list[str]:
+    """Synthesize the cross-thread shape the exporter exists for — an
+    enqueue span minting a carrier, a worker thread opening the
+    prep/dispatch spans with it, a flight record for the dispatch —
+    then export and validate.  Returns problems (empty == pass)."""
+    from lightning_tpu.obs import flight, traceexport
+    from lightning_tpu.utils import trace
+
+    records: list[dict] = []
+    trace.add_tap(records.append)
+    try:
+        with trace.span("selfcheck/enqueue") as enq:
+            corr = trace.new_corr()
+
+        def worker():
+            with trace.span("selfcheck/prep", corr=corr):
+                pass
+            with flight.dispatch("verify", corr_ids=(corr.corr_id,),
+                                 n_real=3, lanes=8,
+                                 shape=(8, 4)) as rec:
+                with trace.span("selfcheck/dispatch", corr=corr,
+                                dispatch_id=rec["dispatch_id"]):
+                    rec["outcome"] = "ok"
+
+        th = threading.Thread(target=worker, name="selfcheck-worker")
+        th.start()
+        th.join()
+    finally:
+        trace.remove_tap(records.append)
+
+    flights = flight.recent("verify", 1)
+    trace_obj = traceexport.chrome_trace(records, flights)
+    errs = traceexport.validate(trace_obj)
+
+    # beyond the schema: the corr chain must actually CONNECT the
+    # enqueue span to the cross-thread dispatch span
+    flows = [e for e in trace_obj["traceEvents"]
+             if e.get("ph") in ("s", "t", "f")
+             and e.get("id") == corr.corr_id]
+    if len(flows) != 3:
+        errs.append(f"corr {corr.corr_id}: want s+t+f hops, got "
+                    f"{[e['ph'] for e in flows]}")
+    tids = {e["tid"] for e in flows}
+    if len(tids) != 2:
+        errs.append("corr flow stayed on one thread — cross-thread "
+                    "correlation is broken")
+    if not any(e["ph"] == "X" and e["name"] == "dispatch/verify"
+               for e in trace_obj["traceEvents"]):
+        errs.append("flight record missing from the export")
+    return errs
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(prog="trace_export")
+    p.add_argument("--rpc", help="daemon unix socket (lightning-rpc)")
+    p.add_argument("--spans", help="span sink file (JSON lines)")
+    p.add_argument("--dispatches", type=int, metavar="N",
+                   help="with --rpc: include only the last N flight "
+                        "records")
+    p.add_argument("--validate", metavar="TRACE_JSON",
+                   help="schema-check an existing export and exit")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="synthetic export + schema/connectivity check")
+    p.add_argument("-o", "--out", default="-")
+    args = p.parse_args()
+
+    if args.selfcheck:
+        errs = selfcheck()
+        if errs:
+            print("trace_export selfcheck FAILED:")
+            for e in errs:
+                print(f"  {e}")
+            return 1
+        print("trace_export selfcheck: export valid, corr flow "
+              "connected across threads")
+        return 0
+
+    if args.validate:
+        from lightning_tpu.obs import traceexport
+
+        with open(args.validate) as f:
+            errs = traceexport.validate(json.load(f))
+        if errs:
+            print(f"{args.validate}: INVALID")
+            for e in errs:
+                print(f"  {e}")
+            return 1
+        print(f"{args.validate}: valid Chrome trace-event JSON")
+        return 0
+
+    if args.rpc:
+        trace_obj = export_rpc(args.rpc, args.dispatches)
+    elif args.spans:
+        trace_obj = export_spans_file(args.spans)
+    else:
+        p.error("need --rpc, --spans, --validate, or --selfcheck")
+
+    text = json.dumps(trace_obj, indent=1)
+    if args.out == "-":
+        print(text)
+    else:
+        with open(args.out, "w") as f:
+            f.write(text)
+        n = len(trace_obj.get("traceEvents", []))
+        print(f"wrote {args.out} ({n} events) — open at "
+              "https://ui.perfetto.dev", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
