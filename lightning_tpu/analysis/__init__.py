@@ -1,0 +1,44 @@
+"""graftlint — unified static analysis for this repo's dispatch-path
+invariants (doc/static_analysis.md).
+
+Stdlib-only by design: the framework runs inside the test suite and as
+a `tools/run_suite.sh` pass, where importing jax would cost ~20 s and a
+device runtime.  One shared AST walk per file (core.Engine) feeds six
+passes; findings are grandfathered by line-number-independent
+fingerprints in a baseline store where every entry must carry a
+justification.
+
+Entry points: ``tools/graftlint.py`` (CLI), :func:`run_repo` (tests,
+shims).
+"""
+from __future__ import annotations
+
+from . import baseline as _baseline
+from .core import Config, Engine, REPO_ROOT
+from .findings import AnalysisResult, Finding
+from .passes import ALL_PASSES, PASSES_BY_NAME
+
+DEFAULT_BASELINE = "tools/graftlint_baseline.json"
+
+__all__ = ["Config", "Engine", "Finding", "AnalysisResult",
+           "ALL_PASSES", "PASSES_BY_NAME", "DEFAULT_BASELINE",
+           "REPO_ROOT", "run_repo"]
+
+
+def run_repo(pass_names=None, config: Config | None = None,
+             baseline_path: str | None = None) -> AnalysisResult:
+    """Run graftlint and apply the baseline.  ``pass_names`` None →
+    every pass.  Returns the AnalysisResult with baselined findings
+    marked and stale/unjustified entries collected."""
+    import os
+
+    cfg = config or Config()
+    names = tuple(pass_names) if pass_names else tuple(
+        cls.name for cls in ALL_PASSES)
+    passes = [PASSES_BY_NAME[n]() for n in names]
+    result = Engine(passes, cfg).run()
+    bpath = baseline_path or cfg.baseline_path or os.path.join(
+        cfg.root, DEFAULT_BASELINE)
+    data = _baseline.load(bpath)
+    _baseline.apply(result, data, names)
+    return result

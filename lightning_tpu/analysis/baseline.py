@@ -1,0 +1,113 @@
+"""Fingerprint baseline store.
+
+One JSON file grandfathers known findings.  Every entry MUST carry a
+non-empty justification — an unjustified entry fails the run exactly
+like a new finding (the acceptance bar: intentional means *stated*).
+Stale entries (fingerprint matches nothing on the current tree) also
+fail: the workflow is fix one → delete its fingerprint, and staleness
+is how the tool enforces the deletion (doc/static_analysis.md).
+
+The store is keyed by fingerprint; the location/detail fields are
+redundant context for reviewers diffing the file, refreshed on
+``--baseline-update``.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .findings import AnalysisResult, Finding
+
+VERSION = 1
+
+
+def load(path: str) -> dict:
+    if not os.path.exists(path):
+        return {"version": VERSION, "entries": {}}
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != VERSION:
+        raise ValueError(f"baseline {path}: unsupported version "
+                         f"{data.get('version')!r}")
+    return data
+
+
+def save(path: str, data: dict) -> None:
+    data = {"version": VERSION,
+            "entries": dict(sorted(data["entries"].items(),
+                                   key=lambda kv: (kv[1]["pass"],
+                                                   kv[1]["file"],
+                                                   kv[0])))}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def apply(result: AnalysisResult, data: dict,
+          passes_run: tuple) -> None:
+    """Mark baselined findings and collect stale/unjustified entries.
+
+    Staleness only considers entries belonging to the passes that
+    actually ran: `tools/lint_asserts.py` (asserts pass only) must not
+    report every other pass's entries as stale."""
+    entries = data.get("entries", {})
+    seen: set[str] = set()
+    for f in result.findings:
+        entry = entries.get(f.fingerprint)
+        if entry is not None:
+            seen.add(f.fingerprint)
+            just = (entry.get("justification") or "").strip()
+            f.baselined = True      # suppressed from new_findings
+            f.justification = just  # "" when unjustified
+            if not just:
+                # reported ONCE, as an unjustified entry (not again as
+                # a new finding) — the fix is to annotate the entry
+                result.unjustified.append(
+                    {"fingerprint": f.fingerprint, **entry})
+    for fp, entry in entries.items():
+        if fp in seen:
+            continue
+        if entry.get("pass") not in passes_run:
+            continue
+        result.stale_baseline.append({"fingerprint": fp, **entry})
+
+
+def update(data: dict, result: AnalysisResult,
+           justification: str) -> tuple[int, int]:
+    """--baseline-update: drop stale entries for the passes that ran,
+    add entries for new findings (requires a justification), refresh
+    context fields on survivors.  Returns (added, removed)."""
+    entries = data.setdefault("entries", {})
+    removed = 0
+    for stale in result.stale_baseline:
+        if stale["fingerprint"] in entries:
+            del entries[stale["fingerprint"]]
+            removed += 1
+    added = 0
+    for f in result.findings:
+        prev = entries.get(f.fingerprint)
+        just = (prev or {}).get("justification", "").strip() \
+            or justification.strip()
+        if not just:
+            raise ValueError(
+                f"new finding {f.fingerprint} ({f.location()} "
+                f"[{f.pass_name}/{f.code}]) needs --justification")
+        if prev is None:
+            added += 1
+        entries[f.fingerprint] = {
+            "pass": f.pass_name,
+            "code": f.code,
+            "file": f.path,
+            "scope": f.scope,
+            "detail": f.detail,
+            "justification": just,
+        }
+    return added, removed
+
+
+def entry_for(f: Finding, justification: str) -> dict:
+    return {
+        "pass": f.pass_name, "code": f.code, "file": f.path,
+        "scope": f.scope, "detail": f.detail,
+        "justification": justification,
+    }

@@ -1,0 +1,379 @@
+"""graftlint core: one shared AST walk per file, fanned out to passes.
+
+The framework exists because the repo's lint needs outgrew two ad-hoc
+scripts that each re-implemented SCAN_DIRS + os.walk + ast.parse.  Here
+the engine owns file discovery, parsing, comment extraction, and ONE
+recursive AST traversal per file; passes subscribe to node types and
+receive each node exactly once, together with a FileContext exposing
+the lexical stacks (enclosing functions, classes, ``with`` items) that
+every dispatch-path invariant in this repo turns out to need.
+
+Deliberately stdlib-only (ast + tokenize): graftlint runs in the test
+suite and pre-commit where importing jax would cost ~20 s and a device
+runtime.  Passes reason about jax *syntactically* — which is the point:
+the bug classes we lint for (doc/static_analysis.md) are visible in the
+source, not the traced program.
+
+Shared jax facts: several passes need to know which functions are
+*kernel builders* (functions traced by jit/vmap/shard_map, so their
+bodies execute at trace time on device abstractions).  The engine
+collects wrap-site references and def nesting during the same walk and
+resolves the kernel-builder set once per file in ``end_file`` — passes
+consume ``ctx.kernel_builder_ids()`` instead of re-walking.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from dataclasses import dataclass, field
+
+from .findings import AnalysisResult, Finding
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# decorators that make a function-body jax.jit wrap legal: the wrap
+# runs once per distinct arg tuple, not once per call (the PR-3 fix
+# idiom — `@functools.lru_cache def _jit_sign(): return jax.jit(...)`)
+CACHING_DECORATORS = {"lru_cache", "cache"}
+
+# call targets that trace their function argument
+JIT_WRAPPERS = {"jit", "vmap", "pmap", "pjit", "shard_map"}
+
+# function-name convention for kernels invoked only from other kernels
+KERNEL_NAME_SUFFIX = "_kernel"
+KERNEL_NAMES = {"kern", "kernel"}
+
+
+def is_jit_wrapper(func: ast.AST) -> str | None:
+    """'jit'/'vmap'/'shard_map'/... when ``func`` is a reference to a
+    jax tracing wrapper (``jax.jit``, bare ``jit``, ``jax.experimental.
+    shard_map.shard_map`` ...), else None."""
+    if isinstance(func, ast.Name) and func.id in JIT_WRAPPERS:
+        return func.id
+    if isinstance(func, ast.Attribute) and func.attr in JIT_WRAPPERS:
+        return func.attr
+    return None
+
+
+def _wrapped_function_names(call: ast.Call) -> set[str]:
+    """Names of functions a jit/vmap/shard_map call site traces:
+    ``jax.jit(f)``, ``jax.jit(jax.vmap(f))``, ``jax.jit(partial(f,
+    ...))``, ``shard_map(f, mesh=...)``."""
+    out: set[str] = set()
+    stack = list(call.args[:1]) + [
+        kw.value for kw in call.keywords if kw.arg in ("fun", "f")]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Call):
+            fname = is_jit_wrapper(node.func)
+            inner_partial = (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "partial") or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "partial")
+            if fname or inner_partial:
+                stack.extend(node.args[:1])
+    return out
+
+
+def has_caching_decorator(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = None
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Attribute):
+            name = target.attr
+        if name in CACHING_DECORATORS:
+            return True
+    return False
+
+
+def jit_decorator(fn: ast.AST) -> str | None:
+    """'jit'/'vmap'/... when ``fn`` is decorated by a jax tracing
+    wrapper — ``@jax.jit``, ``@jit(static_argnums=...)``, or
+    ``@partial(jax.jit, ...)`` — else None."""
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        wrapper = is_jit_wrapper(target)
+        if wrapper:
+            return wrapper
+        if isinstance(dec, ast.Call):
+            name = target.id if isinstance(target, ast.Name) else (
+                target.attr if isinstance(target, ast.Attribute)
+                else None)
+            if name == "partial":
+                for arg in dec.args[:1]:
+                    wrapper = is_jit_wrapper(arg)
+                    if wrapper:
+                        return wrapper
+    return None
+
+
+@dataclass
+class FileContext:
+    """Everything passes may ask about the file under analysis."""
+    root: str
+    relpath: str
+    tree: ast.Module
+    source: str
+    comments: dict[int, str]          # lineno -> comment text (w/o '#')
+    # lexical stacks, maintained by the engine during the walk
+    func_stack: list = field(default_factory=list)
+    class_stack: list = field(default_factory=list)
+    with_stack: list = field(default_factory=list)   # list[list[str]]
+    # shared jax facts (engine-collected)
+    _defs: list = field(default_factory=list)        # (node, chain ids)
+    _wrapped_names: set = field(default_factory=set)
+    _kernel_ids: set | None = None
+
+    def scope(self) -> str:
+        parts = [c.name for c in self.class_stack] + [
+            getattr(f, "name", "<lambda>") for f in self.func_stack]
+        return ".".join(parts)
+
+    def in_function(self) -> bool:
+        return bool(self.func_stack)
+
+    def held_locks(self) -> set[str]:
+        return {expr for frame in self.with_stack for expr in frame}
+
+    def comment_for(self, lineno: int) -> str:
+        """The comment on ``lineno``, falling back to the line above
+        (annotation comments may sit on their own line)."""
+        return self.comments.get(lineno) or self.comments.get(
+            lineno - 1) or ""
+
+    def kernel_builder_ids(self) -> set[int]:
+        """ids of FunctionDef/Lambda nodes whose bodies run at jax
+        trace time: wrapped by jit/vmap/shard_map (by name reference,
+        decorator — incl. ``@partial(jax.jit, ...)`` — or direct
+        lambda), named per the kernel convention, or nested inside such
+        a function.  Resolved lazily once per file."""
+        if self._kernel_ids is not None:
+            return self._kernel_ids
+        kernels: set[int] = set()
+        for node, chain in self._defs:
+            name = getattr(node, "name", "")
+            if (name in self._wrapped_names
+                    or name.endswith(KERNEL_NAME_SUFFIX)
+                    or name in KERNEL_NAMES
+                    or jit_decorator(node) is not None):
+                kernels.add(id(node))
+        # nesting closure: a def lexically inside a kernel builder is
+        # itself traced (helper closures, scan bodies)
+        changed = True
+        while changed:
+            changed = False
+            for node, chain in self._defs:
+                if id(node) in kernels:
+                    continue
+                if any(cid in kernels for cid in chain):
+                    kernels.add(id(node))
+                    changed = True
+        self._kernel_ids = kernels
+        return kernels
+
+    def enclosing_kernel_builder(self) -> bool:
+        kernels = self.kernel_builder_ids()
+        return any(id(f) in kernels for f in self.func_stack)
+
+
+class Pass:
+    """Base class for graftlint passes.
+
+    Subclasses set ``name``, ``default_scope`` (relpath prefixes; ""
+    matches everything) and ``node_types``, then implement ``visit``.
+    ``begin_file``/``end_file`` bracket each file; ``finish`` runs once
+    after all files for cross-file passes (registry-sync)."""
+
+    name = "base"
+    description = ""
+    default_scope: tuple = ("",)
+    node_types: tuple = ()
+
+    def __init__(self):
+        self.findings: list[Finding] = []
+        self.config: "Config | None" = None   # set by the engine
+
+    def wants(self, relpath: str, scope: tuple) -> bool:
+        return any(relpath == p or relpath.startswith(p)
+                   for p in scope)
+
+    def emit(self, ctx_or_path, lineno: int, code: str, message: str,
+             detail: str, scope: str | None = None) -> Finding:
+        if isinstance(ctx_or_path, FileContext):
+            path = ctx_or_path.relpath
+            scope = ctx_or_path.scope() if scope is None else scope
+        else:
+            path = ctx_or_path
+            scope = scope or ""
+        f = Finding(pass_name=self.name, code=code, path=path,
+                    lineno=lineno, scope=scope, message=message,
+                    detail=detail)
+        self.findings.append(f)
+        return f
+
+    # hooks ---------------------------------------------------------------
+    def begin_file(self, ctx: FileContext) -> None:
+        pass
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        pass
+
+    def end_file(self, ctx: FileContext) -> None:
+        pass
+
+    def finish(self, config: "Config") -> None:
+        pass
+
+
+@dataclass
+class Config:
+    """One engine run.  Everything is overridable so the fixture corpus
+    can point the same passes at a miniature tree."""
+    root: str = REPO_ROOT
+    scan_roots: tuple = ("lightning_tpu", "tools")
+    baseline_path: str | None = None      # default set by the CLI
+    scopes: dict = field(default_factory=dict)   # pass name -> prefixes
+    # registry-sync knobs (repo defaults; fixtures override)
+    doc_globs: tuple = ("README.md", "doc/*.md")
+    knobs_md: str = "doc/knobs.md"
+    families_file: str = "lightning_tpu/obs/families.py"
+
+    def scope_for(self, p: Pass) -> tuple:
+        return tuple(self.scopes.get(p.name, p.default_scope))
+
+
+def _extract_comments(source: str) -> dict[int, str]:
+    comments: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments[tok.start[0]] = tok.string.lstrip("#").strip()
+    except tokenize.TokenError:
+        pass
+    return comments
+
+
+def discover_files(config: Config) -> list[str]:
+    out = []
+    for entry in config.scan_roots:
+        path = os.path.join(config.root, entry) if entry else config.root
+        if os.path.isfile(path):
+            out.append(os.path.relpath(path, config.root))
+            continue
+        for dirpath, dirnames, files in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fname in sorted(files):
+                if fname.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fname), config.root))
+    return sorted(set(out))
+
+
+class Engine:
+    def __init__(self, passes, config: Config):
+        self.passes = list(passes)
+        self.config = config
+
+    def run(self) -> AnalysisResult:
+        for p in self.passes:
+            p.config = self.config
+        files = discover_files(self.config)
+        n = 0
+        for relpath in files:
+            interested = [p for p in self.passes if p.wants(
+                relpath, self.config.scope_for(p))]
+            if not interested:
+                continue
+            with open(os.path.join(self.config.root, relpath)) as f:
+                source = f.read()
+            try:
+                tree = ast.parse(source, relpath)
+            except SyntaxError as e:
+                for p in interested:
+                    p.emit(relpath, e.lineno or 0, "syntax-error",
+                           f"unparseable file: {e.msg}", str(e.msg))
+                continue
+            n += 1
+            ctx = FileContext(root=self.config.root, relpath=relpath,
+                              tree=tree, source=source,
+                              comments=_extract_comments(source))
+            by_type: dict[type, list[Pass]] = {}
+            for p in interested:
+                p.begin_file(ctx)
+                for t in p.node_types:
+                    by_type.setdefault(t, []).append(p)
+            self._walk(tree, ctx, by_type)
+            for p in interested:
+                p.end_file(ctx)
+        for p in self.passes:
+            p.finish(self.config)
+        findings = [f for p in self.passes for f in p.findings]
+        findings.sort(key=lambda f: (f.path, f.lineno, f.pass_name,
+                                     f.code, f.detail))
+        # disambiguate identical violations (same pass/code/path/scope/
+        # detail) by source order, so one baseline entry cannot
+        # grandfather a second instance added later
+        counts: dict[tuple, int] = {}
+        for f in findings:
+            key = (f.pass_name, f.code, f.path, f.scope, f.detail)
+            counts[key] = counts.get(key, 0) + 1
+            f.occurrence = counts[key]
+        return AnalysisResult(
+            findings=findings, files_scanned=n,
+            passes_run=tuple(p.name for p in self.passes))
+
+    def _dispatch(self, node, ctx, by_type):
+        for p in by_type.get(type(node), ()):
+            p.visit(node, ctx)
+
+    def _walk(self, node, ctx: FileContext, by_type) -> None:
+        # engine-owned jax facts, collected for every file once
+        if isinstance(node, ast.Call):
+            if is_jit_wrapper(node.func):
+                ctx._wrapped_names |= _wrapped_function_names(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            self._dispatch(node, ctx, by_type)
+            ctx._defs.append((node, tuple(id(f)
+                                          for f in ctx.func_stack)))
+            ctx.func_stack.append(node)
+            try:
+                for child in ast.iter_child_nodes(node):
+                    self._walk(child, ctx, by_type)
+            finally:
+                ctx.func_stack.pop()
+        elif isinstance(node, ast.ClassDef):
+            self._dispatch(node, ctx, by_type)
+            ctx.class_stack.append(node)
+            try:
+                for child in ast.iter_child_nodes(node):
+                    self._walk(child, ctx, by_type)
+            finally:
+                ctx.class_stack.pop()
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            self._dispatch(node, ctx, by_type)
+            # context expressions evaluate OUTSIDE the acquired locks
+            for item in node.items:
+                self._walk(item.context_expr, ctx, by_type)
+                if item.optional_vars is not None:
+                    self._walk(item.optional_vars, ctx, by_type)
+            ctx.with_stack.append(
+                [ast.unparse(item.context_expr) for item in node.items])
+            try:
+                for child in node.body:
+                    self._walk(child, ctx, by_type)
+            finally:
+                ctx.with_stack.pop()
+        else:
+            self._dispatch(node, ctx, by_type)
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, ctx, by_type)

@@ -1,0 +1,86 @@
+"""Findings and fingerprints — the currency of graftlint.
+
+A Finding is one rule violation at one source location.  Its
+*fingerprint* is deliberately line-number independent (pass, file,
+enclosing scope, rule code, normalized detail) so a finding survives
+unrelated edits above it: baselining grandfathers the VIOLATION, not a
+coordinate.  Move or reword the offending code and the fingerprint
+changes — the baseline entry goes stale and the run fails, which is
+the workflow (doc/static_analysis.md): fix one → delete its entry.
+
+IDENTICAL violations in the same scope are disambiguated by an
+occurrence ordinal (assigned in source order by the engine) folded
+into the fingerprint from the second instance on — so baselining one
+unlocked ``_ring [load]`` does not silently grandfather a SECOND one
+added later to the same function.  The first instance's fingerprint is
+unchanged by later duplicates; removing it promotes the next one
+(whose entry then goes stale — the workflow again).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    pass_name: str          # e.g. "host-sync"
+    code: str               # rule id within the pass, e.g. "item-call"
+    path: str               # repo-relative path (source file or doc)
+    lineno: int
+    scope: str              # dotted enclosing scope ("mod.fn.inner"), or ""
+    message: str            # one-line human explanation
+    detail: str = ""        # normalized offending source (fingerprint input)
+    occurrence: int = 1     # ordinal among identical violations (engine)
+    baselined: bool = False
+    justification: str = ""  # from the baseline entry, when baselined
+
+    @property
+    def fingerprint(self) -> str:
+        parts = [self.pass_name, self.code, self.path, self.scope,
+                 self.detail]
+        if self.occurrence > 1:
+            parts.append(f"#{self.occurrence}")
+        h = hashlib.sha256("|".join(parts).encode()).hexdigest()
+        return h[:16]
+
+    def location(self) -> str:
+        return f"{self.path}:{self.lineno}"
+
+    def as_dict(self) -> dict:
+        return {
+            "pass": self.pass_name,
+            "code": self.code,
+            "path": self.path,
+            "lineno": self.lineno,
+            "scope": self.scope,
+            "message": self.message,
+            "detail": self.detail,
+            "fingerprint": self.fingerprint,
+            "baselined": self.baselined,
+            **({"justification": self.justification}
+               if self.baselined else {}),
+        }
+
+
+@dataclass
+class AnalysisResult:
+    """What one engine run produced."""
+    findings: list[Finding] = field(default_factory=list)
+    stale_baseline: list[dict] = field(default_factory=list)
+    unjustified: list[dict] = field(default_factory=list)
+    files_scanned: int = 0
+    passes_run: tuple = ()
+
+    @property
+    def new_findings(self) -> list[Finding]:
+        return [f for f in self.findings if not f.baselined]
+
+    @property
+    def baselined_findings(self) -> list[Finding]:
+        return [f for f in self.findings if f.baselined]
+
+    @property
+    def clean(self) -> bool:
+        return (not self.new_findings and not self.stale_baseline
+                and not self.unjustified)

@@ -1,0 +1,24 @@
+"""graftlint passes — one module per invariant (doc/static_analysis.md).
+
+``ALL_PASSES`` is the canonical order: deterministic reports, and the
+two ported lints first (their shims run them standalone).
+"""
+from __future__ import annotations
+
+from .asserts import InputContractAssertPass
+from .spans import SpanVocabularyPass
+from .jit_hygiene import JitHygienePass
+from .host_sync import HostSyncPass
+from .lock_discipline import LockDisciplinePass
+from .registry_sync import RegistrySyncPass
+
+ALL_PASSES = (
+    InputContractAssertPass,
+    SpanVocabularyPass,
+    JitHygienePass,
+    HostSyncPass,
+    LockDisciplinePass,
+    RegistrySyncPass,
+)
+
+PASSES_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

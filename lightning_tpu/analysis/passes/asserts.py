@@ -1,0 +1,54 @@
+"""asserts pass: input contracts must survive ``python -O``.
+
+Ported from tools/lint_asserts.py (ISSUE 1 satellite; the shim still
+fronts this pass).  A bare ``assert`` is stripped under ``-O``, so a
+contract like "oversized rows require z_host" silently degrades into an
+incidental TypeError (ADVICE.md round 5).  Contracts on *inputs* must
+``raise ValueError(...)``.
+
+Operationalization: an ``assert`` whose condition references one of the
+enclosing function's parameters is treated as an input contract.
+Internal invariant asserts (locals-only, loop-carried bound proofs in
+the kernel builders) stay legal — they check OUR math, not a caller's
+data, and stripping them under ``-O`` is acceptable.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Pass
+
+
+def param_names(fn: ast.AST) -> set[str]:
+    a = fn.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names) - {"self", "cls"}
+
+
+class InputContractAssertPass(Pass):
+    name = "asserts"
+    description = ("input-contract asserts (param-referencing) must "
+                   "raise ValueError — bare asserts strip under -O")
+    default_scope = ("lightning_tpu/gossip", "lightning_tpu/crypto",
+                     "lightning_tpu/routing", "lightning_tpu/resilience")
+    node_types = (ast.Assert,)
+
+    def visit(self, node: ast.Assert, ctx: FileContext) -> None:
+        fns = [f for f in ctx.func_stack
+               if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        if not fns:
+            return
+        fn = fns[-1]
+        used = {n.id for n in ast.walk(node.test)
+                if isinstance(n, ast.Name)}
+        if used & param_names(fn):
+            cond = ast.unparse(node.test)
+            self.emit(
+                ctx, node.lineno, "input-contract",
+                "param-referencing assert is an input contract — "
+                "raise ValueError instead (stripped under python -O)",
+                f"{fn.name}: assert {cond}", scope=fn.name)

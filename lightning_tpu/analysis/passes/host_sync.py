@@ -1,0 +1,119 @@
+"""host-sync pass: no implicit device→host syncs inside kernel builders.
+
+The dispatch paths are fast because each batched program crosses the
+host boundary exactly once (upload) or twice (single end readback) —
+the flight recorder attributes THOSE.  A ``.item()``, scalar cast,
+``np.asarray``, ``jax.device_get`` or ``.block_until_ready()`` inside a
+kernel-builder function either (a) forces a blocking transfer at trace
+time that no span/flight record attributes — the replay overlap math
+(doc/replay_pipeline.md) silently loses it as "prep" — or (b) raises a
+ConcretizationTypeError under jit much later, when the first caller
+hits the path with a tracer.
+
+Kernel builders are detected syntactically (core.py): functions
+wrapped by jit/vmap/shard_map (by reference or decorator), named per
+the ``*_kernel`` convention, or nested inside one.
+
+Exemptions the code legitimately needs: ``np.array``/``np.asarray`` of
+an all-constant display (building a trace-time table from literals) and
+scalar casts of constants.  Anything else intentional — e.g. folding a
+host-side constant table at trace time — is a baseline entry WITH a
+justification, not a silent pass.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Pass
+
+NP_BASES = {"np", "numpy", "onp"}
+NP_SYNC_ATTRS = {"asarray", "array"}
+SCALAR_CASTS = {"float", "int", "bool"}
+SYNC_METHODS = {"item", "block_until_ready"}
+
+
+def _is_constant_expr(node: ast.AST) -> bool:
+    """Literal displays of literals: np.array([1, 2, 4, 8]) is a
+    trace-time constant, not a device sync."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return all(_is_constant_expr(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_constant_expr(node.operand)
+    if isinstance(node, ast.BinOp):
+        return (_is_constant_expr(node.left)
+                and _is_constant_expr(node.right))
+    if isinstance(node, ast.Attribute):
+        # dtype references: np.uint32 etc.
+        return isinstance(node.value, ast.Name) and \
+            node.value.id in NP_BASES
+    return False
+
+
+class HostSyncPass(Pass):
+    name = "host-sync"
+    description = ("no .item()/scalar casts/np.asarray/device_get/"
+                   "block_until_ready inside kernel builders")
+    default_scope = ("lightning_tpu/gossip", "lightning_tpu/routing",
+                     "lightning_tpu/crypto", "lightning_tpu/parallel")
+    node_types = (ast.Call,)
+
+    def __init__(self):
+        super().__init__()
+        self._candidates: list = []
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._candidates = []
+
+    def _classify(self, node: ast.Call) -> tuple[str, str] | None:
+        """(code, message) when this call is a potential host sync."""
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in SYNC_METHODS and not node.args:
+                return (fn.attr.replace("_", "-"),
+                        f".{fn.attr}() blocks on a device→host "
+                        "transfer the flight recorder cannot attribute")
+            if fn.attr == "device_get":
+                return ("device-get",
+                        "jax.device_get is an explicit sync — hoist it "
+                        "out of the kernel builder to the readback seam")
+            if (fn.attr in NP_SYNC_ATTRS
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in NP_BASES):
+                if node.args and _is_constant_expr(node.args[0]):
+                    return None
+                return ("np-materialize",
+                        f"np.{fn.attr} inside a kernel builder "
+                        "materializes on host — a hidden sync at trace "
+                        "time, a ConcretizationTypeError under jit")
+        elif isinstance(fn, ast.Name):
+            if fn.id == "device_get":
+                return ("device-get",
+                        "device_get is an explicit sync — hoist it out "
+                        "of the kernel builder to the readback seam")
+            if fn.id in SCALAR_CASTS and len(node.args) == 1:
+                if _is_constant_expr(node.args[0]):
+                    return None
+                return ("scalar-cast",
+                        f"{fn.id}() on a traced value concretizes it — "
+                        "a hidden device→host sync (or a trace-time "
+                        "error); keep kernel math in jnp")
+        return None
+
+    def visit(self, node: ast.Call, ctx: FileContext) -> None:
+        if not ctx.in_function():
+            return
+        got = self._classify(node)
+        if got is not None:
+            self._candidates.append(
+                (node, got, tuple(ctx.func_stack), ctx.scope()))
+
+    def end_file(self, ctx: FileContext) -> None:
+        kernels = ctx.kernel_builder_ids()
+        for node, (code, message), stack, scope in self._candidates:
+            if not any(id(f) in kernels for f in stack):
+                continue
+            self.emit(ctx, node.lineno, code, message,
+                      ast.unparse(node)[:120], scope=scope)
+        self._candidates = []

@@ -1,0 +1,134 @@
+"""jit-hygiene pass: no per-call jax.jit/vmap/shard_map re-wrapping.
+
+The shipped bug (PR 3): ``ecdsa_sign_batch`` wrapped ``jax.jit(
+ecdsa_sign_kernel)`` at every call.  Each wrap is a NEW PjitFunction,
+so every batched sign re-traced the whole EC program before the
+executable-cache lookup — a silent multi-second stall per sign batch
+that profiled as "compile" and was invisible in the code review.  The
+fix idiom is a module-level cached builder::
+
+    @functools.lru_cache(maxsize=1)
+    def _jit_sign():
+        return jax.jit(ecdsa_sign_kernel)
+
+Rules:
+
+* ``jit-call-wrap`` — a jit/vmap/shard_map wrap inside a function body
+  is flagged unless (a) an enclosing function carries a caching
+  decorator (``functools.lru_cache``/``cache`` — the wrap then runs
+  once per arg tuple), or (b) the wrap sits inside a kernel builder
+  (the enclosing function is itself traced, so the wrap happens once
+  at trace time under the outer cached jit).  The decorator spelling
+  of the same bug — a ``@jax.jit``-decorated def nested inside a plain
+  function body — is the same finding: the decorator runs per call of
+  the enclosing function.
+
+* ``unhashable-static`` — at an immediately-invoked jit wrap
+  (``jax.jit(f, static_argnums=...)(args...)``), a list/dict/set
+  display passed in a static position raises ``TypeError: unhashable``
+  at runtime; visible statically, so flagged statically.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import (FileContext, Pass, has_caching_decorator,
+                    is_jit_wrapper, jit_decorator)
+
+
+def _static_positions(jit_call: ast.Call) -> tuple[set[int], set[str]]:
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(
+                        e.value, int):
+                    nums.add(e.value)
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(
+                        e.value, str):
+                    names.add(e.value)
+    return nums, names
+
+
+def _is_unhashable_display(node: ast.AST) -> bool:
+    return isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp))
+
+
+class JitHygienePass(Pass):
+    name = "jit-hygiene"
+    description = ("jit/vmap/shard_map must wrap at module scope or "
+                   "under a caching decorator, never per call")
+    default_scope = ("lightning_tpu",)
+    node_types = (ast.Call, ast.FunctionDef, ast.AsyncFunctionDef)
+
+    def __init__(self):
+        super().__init__()
+        self._candidates: list = []
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._candidates = []
+
+    def _enclosing_cached(self, ctx: FileContext) -> bool:
+        return any(has_caching_decorator(f)
+                   for f in ctx.func_stack
+                   if not isinstance(f, ast.Lambda))
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # the decorator spelling of the bug: a @jax.jit-decorated
+            # def nested in a plain function body re-wraps per call
+            # (the def is dispatched BEFORE it joins func_stack, so
+            # the stack here is exactly its enclosure)
+            wrapper = jit_decorator(node)
+            if wrapper is not None and ctx.in_function() \
+                    and not self._enclosing_cached(ctx):
+                self._candidates.append(
+                    (node, wrapper, tuple(ctx.func_stack),
+                     ctx.scope(), f"@{wrapper} def {node.name}"))
+            return
+        wrapper = is_jit_wrapper(node.func)
+        if wrapper is not None and ctx.in_function():
+            if not self._enclosing_cached(ctx):
+                # defer: kernel-builder exemption resolves at end_file
+                self._candidates.append(
+                    (node, wrapper, tuple(ctx.func_stack),
+                     ctx.scope(), f"{ast.unparse(node.func)}(...)"))
+        # unhashable static args only detectable at immediate invocation
+        if isinstance(node.func, ast.Call) and is_jit_wrapper(
+                node.func.func) == "jit":
+            nums, names = _static_positions(node.func)
+            for i, arg in enumerate(node.args):
+                if i in nums and _is_unhashable_display(arg):
+                    self.emit(
+                        ctx, node.lineno, "unhashable-static",
+                        "list/dict/set literal in a static_argnums "
+                        "position — unhashable at the jit cache lookup",
+                        f"arg {i}: {ast.unparse(arg)}")
+            for kw in node.keywords:
+                if kw.arg in names and _is_unhashable_display(kw.value):
+                    self.emit(
+                        ctx, node.lineno, "unhashable-static",
+                        "list/dict/set literal for a static_argnames "
+                        "parameter — unhashable at the jit cache lookup",
+                        f"arg {kw.arg}: {ast.unparse(kw.value)}")
+
+    def end_file(self, ctx: FileContext) -> None:
+        kernels = ctx.kernel_builder_ids()
+        for node, wrapper, stack, scope, detail in self._candidates:
+            if any(id(f) in kernels for f in stack):
+                continue
+            self.emit(
+                ctx, node.lineno, "call-wrap",
+                f"{wrapper} wrap inside a function body re-traces per "
+                "call (the PR-3 sign-batch recompile bug) — hoist to "
+                "module scope or an lru_cache'd builder",
+                detail, scope=scope)
+        self._candidates = []

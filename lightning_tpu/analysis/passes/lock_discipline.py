@@ -1,0 +1,203 @@
+"""lock-discipline pass: ``# guarded-by: <lock>`` means it.
+
+The shipped bug (PR 5): the trace ring (``utils/trace.py``) was mutated
+from flush loops, the replay producer thread, and the main thread with
+a bare ``list.append``/prune pair — a lost-update race that dropped
+span records under free threading and, worse, let a ``set_sink``
+rotation close a file mid-write.  The fix serialized every touch under
+one module lock; NOTHING then stopped the next edit from adding an
+unlocked touch.  This pass makes the convention machine-checked:
+
+Annotation syntax (same line as the defining assignment, or the line
+directly above)::
+
+    _records: list[dict] = []        # guarded-by: _lock
+    self._waiters = []               # guarded-by: self._lock
+
+Rules:
+
+* a module-global annotated with ``guarded-by: <lock>`` may only be
+  referenced (load, store, delete, mutate) lexically inside a
+  ``with <lock>:`` block in that module — except the defining
+  statement itself;
+* an instance attribute annotated in a class body or ``__init__`` may
+  only be referenced as ``self.<attr>`` inside ``with <lock>:`` in
+  that class's methods — ``__init__`` itself is exempt (construction
+  happens-before publication).
+
+Deliberate dirty reads (racy fast paths) are baseline entries with a
+justification, not silent exceptions.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, Pass
+
+_ANNOT = re.compile(r"guarded-by:\s*([A-Za-z_][\w.]*)")
+
+
+class LockDisciplinePass(Pass):
+    name = "lock-discipline"
+    description = ("# guarded-by:-annotated attributes touched only "
+                   "inside `with <lock>`")
+    default_scope = ("lightning_tpu",)
+    node_types = (ast.Name, ast.Attribute)
+
+    def __init__(self):
+        super().__init__()
+        self._globals: dict = {}   # name -> (lock, def lineno)
+        self._attrs: dict = {}     # (class name, attr) -> (lock, lineno)
+        self._scope_cache: dict = {}  # id(fn) -> (bound, global decls)
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._globals = {}
+        self._attrs = {}
+        self._scope_cache = {}
+        annots = {ln: m.group(1) for ln, c in ctx.comments.items()
+                  for m in [_ANNOT.search(c)] if m}
+        if not annots:
+            return
+
+        def targets_of(stmt):
+            if isinstance(stmt, ast.Assign):
+                return stmt.targets
+            if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                return [stmt.target]
+            return []
+
+        def bind(stmt, class_name: str | None):
+            lock = annots.get(stmt.lineno)
+            if lock is None:
+                return
+            for tgt in targets_of(stmt):
+                if isinstance(tgt, ast.Name) and class_name is None:
+                    self._globals[tgt.id] = (lock, stmt.lineno)
+                elif (isinstance(tgt, ast.Attribute)
+                      and isinstance(tgt.value, ast.Name)
+                      and tgt.value.id == "self" and class_name):
+                    self._attrs[(class_name, tgt.attr)] = (
+                        lock, stmt.lineno)
+                elif isinstance(tgt, ast.Name) and class_name:
+                    # class-level attribute default
+                    self._attrs[(class_name, tgt.id)] = (
+                        lock, stmt.lineno)
+
+        # an annotation may sit on its own line directly above the
+        # assignment; only COMMENT-ONLY lines bind downward (an inline
+        # annotation must not leak onto the next statement)
+        lines = ctx.source.splitlines()
+        for ln, lock in list(annots.items()):
+            line = lines[ln - 1] if ln - 1 < len(lines) else ""
+            if line.lstrip().startswith("#") and ln + 1 not in annots:
+                annots[ln + 1] = lock
+
+        for stmt in ctx.tree.body:
+            bind(stmt, None)
+            if isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    bind(sub, stmt.name)
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) \
+                            and sub.name == "__init__":
+                        for init_stmt in ast.walk(sub):
+                            if isinstance(init_stmt, (
+                                    ast.Assign, ast.AnnAssign,
+                                    ast.AugAssign)):
+                                bind(init_stmt, stmt.name)
+
+    def _locked(self, ctx: FileContext, lock: str) -> bool:
+        return lock in ctx.held_locks()
+
+    def _in_init(self, ctx: FileContext) -> bool:
+        return any(getattr(f, "name", "") == "__init__"
+                   for f in ctx.func_stack)
+
+    def _scope_names(self, fn) -> tuple:
+        """(names bound in ``fn``'s own scope, names declared global).
+        Nested function/class/lambda bodies are separate scopes and
+        excluded; parameters count as bound."""
+        got = self._scope_cache.get(id(fn))
+        if got is not None:
+            return got
+        bound: set[str] = set()
+        decl_global: set[str] = set()
+        a = fn.args
+        for p in (*a.posonlyargs, *a.args, *a.kwonlyargs,
+                  *([a.vararg] if a.vararg else ()),
+                  *([a.kwarg] if a.kwarg else ())):
+            bound.add(p.arg)
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                if hasattr(n, "name"):
+                    bound.add(n.name)
+                continue
+            if isinstance(n, ast.Name) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)):
+                bound.add(n.id)
+            elif isinstance(n, ast.Global):
+                decl_global.update(n.names)
+            elif isinstance(n, ast.Nonlocal):
+                # binds to an outer FUNCTION scope, never the module
+                bound.update(n.names)
+            elif isinstance(n, (ast.Import, ast.ImportFrom)):
+                for alias in n.names:
+                    bound.add((alias.asname
+                               or alias.name.split(".")[0]))
+            stack.extend(ast.iter_child_nodes(n))
+        got = (bound, decl_global)
+        self._scope_cache[id(fn)] = got
+        return got
+
+    def _shadowed(self, name: str, ctx: FileContext) -> bool:
+        """True when ``name`` inside the current function refers to a
+        local/enclosing binding, not the annotated module global — a
+        purely local `_records = [...]` must not be flagged."""
+        for fn in reversed(ctx.func_stack):
+            bound, decl_global = self._scope_names(fn)
+            if name in decl_global:
+                return False        # explicit global: IS the global
+            if name in bound:
+                return True
+        return False
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, ast.Name):
+            got = self._globals.get(node.id)
+            if got is None:
+                return
+            lock, def_lineno = got
+            if node.lineno == def_lineno:
+                return
+            if self._shadowed(node.id, ctx):
+                return
+            if not self._locked(ctx, lock):
+                self.emit(
+                    ctx, node.lineno, "unlocked-access",
+                    f"`{node.id}` is annotated guarded-by: {lock} but "
+                    f"touched outside `with {lock}` (the PR-5 trace-"
+                    "ring race class)",
+                    f"{node.id} [{type(node.ctx).__name__.lower()}]")
+        elif isinstance(node, ast.Attribute):
+            if not (isinstance(node.value, ast.Name)
+                    and node.value.id == "self" and ctx.class_stack):
+                return
+            cls = ctx.class_stack[-1].name
+            got = self._attrs.get((cls, node.attr))
+            if got is None:
+                return
+            lock, def_lineno = got
+            if node.lineno == def_lineno or self._in_init(ctx):
+                return
+            if not self._locked(ctx, lock):
+                self.emit(
+                    ctx, node.lineno, "unlocked-access",
+                    f"`self.{node.attr}` is annotated guarded-by: "
+                    f"{lock} but touched outside `with {lock}` in "
+                    f"{cls}",
+                    f"{cls}.{node.attr} "
+                    f"[{type(node.ctx).__name__.lower()}]")

@@ -1,0 +1,476 @@
+"""registry-sync pass: env knobs and metric families cannot drift from
+their declarations and docs.
+
+The shipped bug (PR 4): ``LIGHTNING_TPU_DEADLINE_SIGN_S`` was
+documented in doc/resilience.md but never wired — no code path ever
+read it, so operators configuring a sign deadline got silent nothing.
+The reverse drift is just as real: knobs read in code but documented
+nowhere, and metric families declared in obs/families.py that no hot
+path ever touches.
+
+Facts extracted during the shared walk (lightning_tpu/ only):
+
+* **env reads** — literal ``LIGHTNING_TPU_*`` strings in
+  ``os.environ.get/[]``, ``os.getenv``, ``in os.environ`` positions,
+  with their default literals;
+* **derived env reads** — ``resilience.deadline`` builds knob names
+  dynamically (``LIGHTNING_TPU_DEADLINE_{family}_S``); the pass
+  resolves the concrete names from the literal ``family=`` arguments
+  at ``deadline_for()``/``guard()`` call sites, so a documented family
+  nobody passes is *unwired* (exactly the PR-4 bug).  Any OTHER
+  dynamically-built knob name is a finding (``dynamic-unresolved``)
+  until a derivation rule is taught here;
+* **metric declarations** — ``counter/gauge/histogram`` calls with a
+  literal ``clntpu_*`` name, plus the instrument variable names
+  assigned in obs/families.py;
+* **uppercase identifier usage** per module (for the unused check).
+
+Checks at ``finish``:
+
+* ``knobs-stale``   — doc/knobs.md differs from the generated table
+  (regenerate with ``tools/graftlint.py --write-knobs``);
+* ``env-undocumented`` — knob read in code, absent from doc/knobs.md;
+* ``env-unwired``   — knob named in README/doc/*.md that nothing reads
+  (the DEADLINE_SIGN_S class);
+* ``metric-undeclared`` — ``clntpu_*`` name in docs that no code
+  declares;
+* ``metric-unused`` — an instrument declared in obs/families.py that
+  no other module references.
+"""
+from __future__ import annotations
+
+import ast
+import glob as _glob
+import os
+import re
+
+from ..core import FileContext, Pass
+
+KNOB_PREFIX = "LIGHTNING_TPU_"
+METRIC_PREFIX = "clntpu_"
+KNOB_RE = re.compile(r"LIGHTNING_TPU_[A-Z0-9_]+")
+METRIC_RE = re.compile(r"clntpu_[a-z0-9_]+")
+INSTRUMENT_FACTORIES = {"counter", "gauge", "histogram"}
+
+# dynamic knob-name builders this pass knows how to resolve:
+# prefix seen in an f-string env read -> (callee names whose literal
+# `family` argument yields the suffix, name template)
+DEADLINE_PREFIX = "LIGHTNING_TPU_DEADLINE_"
+DEADLINE_CALLEES = {"deadline_for": 0, "guard": 1}   # positional index
+
+
+def _env_base(node: ast.AST) -> bool:
+    try:
+        return ast.unparse(node).endswith("environ")
+    except Exception:
+        return False
+
+
+def _param_names(fn: ast.AST) -> set[str]:
+    a = getattr(fn, "args", None)
+    if a is None:
+        return set()
+    out = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    return out
+
+
+class RegistrySyncPass(Pass):
+    name = "registry-sync"
+    description = ("LIGHTNING_TPU_* knobs and clntpu_* families must "
+                   "match code, obs/families.py, and doc/knobs.md")
+    default_scope = ("lightning_tpu",)
+    node_types = (ast.Call, ast.Subscript, ast.Compare, ast.Assign,
+                  ast.Name, ast.Attribute, ast.ImportFrom)
+
+    def __init__(self):
+        super().__init__()
+        # knob -> {"defaults": set[str], "consumers": set[str],
+        #          "pending": list[(default AST, relpath)]}
+        self.env_reads: dict[str, dict] = {}
+        # relpath -> {NAME: constant} for module-level NAME = <literal>
+        # assignments (folds `str(_RING_DEFAULT)`-style defaults)
+        self.module_consts: dict[str, dict] = {}
+        self.dynamic_prefixes: list = []   # (prefix, relpath, lineno)
+        self.deadline_families: dict[str, set[str]] = {}  # fam->modules
+        self.declared_metrics: dict[str, set[str]] = {}   # name->modules
+        self.family_instruments: list = []  # (var, metric, lineno)
+        self.used_names: set[str] = set()   # uppercase idents, non-families
+        # helper-mediated reads: `def _env_float(name, d): environ.get(
+        # name, d)` makes every `_env_float("LIGHTNING_TPU_X", 5)` call
+        # site a read of X.  Helpers are detected by an env read keyed
+        # by a PARAMETER of an enclosing function; candidate call
+        # sites resolve against the helper set in finish().  Any other
+        # variable-keyed read is statically unresolvable — a finding.
+        self.env_helpers: set[str] = set()
+        self._helper_calls: list = []   # (callee, knob, default, relpath)
+        self.unresolved_reads: list = []  # (relpath, lineno, expr)
+
+    # -- fact collection ---------------------------------------------------
+
+    def _record_read(self, knob: str, ctx: FileContext,
+                     default: str | None,
+                     default_node: ast.AST | None = None) -> None:
+        info = self.env_reads.setdefault(
+            knob, {"defaults": set(), "consumers": set(),
+                   "pending": []})
+        info["consumers"].add(ctx.relpath)
+        if default is not None:
+            info["defaults"].add(default)
+        elif default_node is not None:
+            # computed default (`str(_RING_DEFAULT)`, `str(1 << 48)`):
+            # fold in wired_knobs() once module consts are collected
+            info["pending"].append((default_node, ctx.relpath))
+
+    def _env_key(self, node: ast.AST, ctx: FileContext,
+                 default_node: ast.AST | None = None) -> None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if node.value.startswith(KNOB_PREFIX):
+                default = None
+                if isinstance(default_node, ast.Constant):
+                    default = repr(default_node.value)
+                self._record_read(node.value, ctx, default,
+                                  default_node)
+        elif isinstance(node, ast.Name):
+            # env read keyed by a PARAMETER of an enclosing function:
+            # that function is an env-read helper and its literal call
+            # sites are the real knob reads.  Keyed by anything else
+            # (a local, a module name) the knob name is statically
+            # unresolvable — a finding, not a silent skip
+            for fn in reversed(ctx.func_stack):
+                helper_name = getattr(fn, "name", None)
+                if helper_name and node.id in _param_names(fn):
+                    self.env_helpers.add(helper_name)
+                    return
+            self.unresolved_reads.append(
+                (ctx.relpath, node.lineno, node.id))
+        elif isinstance(node, ast.JoinedStr) and node.values:
+            first = node.values[0]
+            if isinstance(first, ast.Constant) and isinstance(
+                    first.value, str) and first.value.startswith(
+                    KNOB_PREFIX):
+                self.dynamic_prefixes.append(
+                    (first.value, ctx.relpath, node.lineno))
+        elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.Add):
+            # "LIGHTNING_TPU_FOO_" + fam — the concat spelling of a
+            # dynamic knob name; same treatment as the f-string form
+            left = node.left
+            while isinstance(left, ast.BinOp):
+                left = left.left
+            if isinstance(left, ast.Constant) and isinstance(
+                    left.value, str) and left.value.startswith(
+                    KNOB_PREFIX):
+                self.dynamic_prefixes.append(
+                    (left.value, ctx.relpath, node.lineno))
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        is_families = ctx.relpath == self.config.families_file
+        if isinstance(node, ast.Call):
+            fn = node.func
+            # os.environ.get("KNOB", default) / .setdefault / .pop
+            if isinstance(fn, ast.Attribute) and fn.attr in (
+                    "get", "setdefault", "pop") and _env_base(fn.value):
+                if node.args:
+                    self._env_key(node.args[0], ctx,
+                                  node.args[1] if len(node.args) > 1
+                                  else None)
+            # os.getenv("KNOB", default)
+            elif ((isinstance(fn, ast.Attribute) and fn.attr == "getenv")
+                  or (isinstance(fn, ast.Name) and fn.id == "getenv")):
+                if node.args:
+                    self._env_key(node.args[0], ctx,
+                                  node.args[1] if len(node.args) > 1
+                                  else None)
+            # deadline-family derivation sites
+            callee = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if callee in DEADLINE_CALLEES:
+                fam = None
+                idx = DEADLINE_CALLEES[callee]
+                if len(node.args) > idx and isinstance(
+                        node.args[idx], ast.Constant):
+                    fam = node.args[idx].value
+                for kw in node.keywords:
+                    if kw.arg == "family" and isinstance(
+                            kw.value, ast.Constant):
+                        fam = kw.value.value
+                if isinstance(fam, str):
+                    self.deadline_families.setdefault(
+                        fam, set()).add(ctx.relpath)
+            # candidate helper-mediated reads: a literal knob string
+            # handed to some named callee (resolved in finish())
+            if callee and callee not in ("get", "getenv", "setdefault",
+                                         "pop"):
+                knob = next((a.value for a in node.args
+                             if isinstance(a, ast.Constant)
+                             and isinstance(a.value, str)
+                             and a.value.startswith(KNOB_PREFIX)), None)
+                if knob is not None:
+                    default = next(
+                        (repr(a.value) for a in node.args
+                         if isinstance(a, ast.Constant)
+                         and not (isinstance(a.value, str)
+                                  and a.value.startswith(KNOB_PREFIX))),
+                        None)
+                    self._helper_calls.append(
+                        (callee, knob, default, ctx.relpath))
+            # metric family declarations
+            if callee in INSTRUMENT_FACTORIES and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) and isinstance(
+                        a0.value, str) and a0.value.startswith(
+                        METRIC_PREFIX):
+                    self.declared_metrics.setdefault(
+                        a0.value, set()).add(ctx.relpath)
+        elif isinstance(node, ast.Subscript):
+            if _env_base(node.value):
+                self._env_key(node.slice, ctx)
+        elif isinstance(node, ast.Compare):
+            if any(isinstance(op, (ast.In, ast.NotIn))
+                   for op in node.ops) and any(
+                    _env_base(c) for c in node.comparators):
+                self._env_key(node.left, ctx)
+        elif isinstance(node, ast.Assign):
+            if not ctx.in_function() and not ctx.class_stack \
+                    and isinstance(node.value, ast.Constant):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.module_consts.setdefault(
+                            ctx.relpath, {})[tgt.id] = node.value.value
+            if is_families:
+                v = node.value
+                if isinstance(v, ast.Call):
+                    vfn = v.func
+                    vcallee = vfn.attr if isinstance(
+                        vfn, ast.Attribute) else (
+                        vfn.id if isinstance(vfn, ast.Name) else None)
+                    if vcallee in INSTRUMENT_FACTORIES and v.args \
+                            and isinstance(v.args[0], ast.Constant):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                self.family_instruments.append(
+                                    (tgt.id, v.args[0].value,
+                                     node.lineno))
+        elif isinstance(node, ast.Name):
+            if not is_families and node.id.isupper():
+                self.used_names.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            if not is_families and node.attr.isupper():
+                self.used_names.add(node.attr)
+        elif isinstance(node, ast.ImportFrom):
+            if not is_families:
+                for alias in node.names:
+                    if alias.name.isupper():
+                        self.used_names.add(alias.name)
+
+    # -- resolution --------------------------------------------------------
+
+    _UNFOLDED = object()
+
+    def _fold(self, node: ast.AST, consts: dict):
+        """Best-effort constant fold of a computed default expression:
+        literals, module-level constants, int arithmetic, and
+        str()/int()/float() of a foldable value.  Returns _UNFOLDED
+        when the expression cannot be resolved statically."""
+        U = self._UNFOLDED
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.Name):
+            return consts.get(node.id, U)
+        if isinstance(node, ast.UnaryOp) and isinstance(
+                node.op, ast.USub):
+            v = self._fold(node.operand, consts)
+            return -v if isinstance(v, (int, float)) else U
+        if isinstance(node, ast.BinOp):
+            left = self._fold(node.left, consts)
+            right = self._fold(node.right, consts)
+            if isinstance(left, (int, float)) and isinstance(
+                    right, (int, float)):
+                import operator
+                ops = {ast.Add: operator.add, ast.Sub: operator.sub,
+                       ast.Mult: operator.mul,
+                       ast.FloorDiv: operator.floordiv,
+                       ast.LShift: operator.lshift}
+                op = ops.get(type(node.op))
+                if op is not None:
+                    try:
+                        return op(left, right)
+                    except Exception:
+                        return U
+            return U
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name) and node.func.id in (
+                "str", "int", "float") and len(node.args) == 1 \
+                and not node.keywords:
+            v = self._fold(node.args[0], consts)
+            if v is U:
+                return U
+            try:
+                return {"str": str, "int": int,
+                        "float": float}[node.func.id](v)
+            except Exception:
+                return U
+        return U
+
+    def wired_knobs(self) -> dict[str, dict]:
+        """Literal reads plus helper-mediated and derivation-resolved
+        dynamic reads."""
+        out = {k: {"defaults": set(v["defaults"]),
+                   "consumers": set(v["consumers"])}
+               for k, v in self.env_reads.items()}
+        for k, v in self.env_reads.items():
+            for default_node, relpath in v.get("pending", ()):
+                folded = self._fold(
+                    default_node, self.module_consts.get(relpath, {}))
+                if folded is not self._UNFOLDED:
+                    out[k]["defaults"].add(repr(folded))
+        for callee, knob, default, relpath in self._helper_calls:
+            if callee in self.env_helpers:
+                info = out.setdefault(
+                    knob, {"defaults": set(), "consumers": set()})
+                info["consumers"].add(relpath)
+                if default is not None:
+                    info["defaults"].add(default)
+        if any(p == DEADLINE_PREFIX for p, _, _ in
+               self.dynamic_prefixes):
+            for fam, modules in self.deadline_families.items():
+                knob = f"{DEADLINE_PREFIX}{fam.upper()}_S"
+                info = out.setdefault(
+                    knob, {"defaults": set(), "consumers": set()})
+                info["consumers"] |= modules
+                info["defaults"].add("unset (off)")
+        return out
+
+    def knobs_table(self) -> str:
+        rows = []
+        for knob, info in sorted(self.wired_knobs().items()):
+            defaults = sorted(info["defaults"]) or ["unset"]
+            default = defaults[0] if len(defaults) == 1 else "varies"
+            consumers = ", ".join(
+                f"`{c}`" for c in sorted(info["consumers"]))
+            rows.append(f"| `{knob}` | {default} | {consumers} |")
+        return "\n".join(
+            ["| knob | default | consumers |",
+             "|---|---|---|"] + rows)
+
+    def knobs_md(self) -> str:
+        return (
+            "# Runtime knobs (`LIGHTNING_TPU_*`)\n"
+            "\n"
+            "<!-- GENERATED by `python tools/graftlint.py "
+            "--write-knobs` — do not edit by hand.  The registry-sync\n"
+            "pass (doc/static_analysis.md) extracts every environment "
+            "read in `lightning_tpu/` (including the\n"
+            "deadline family's derived names) and fails the suite when "
+            "this file drifts from the code. -->\n"
+            "\n"
+            "Every knob the daemon reads, with its default and the "
+            "module(s) that consume it.  Semantics live\n"
+            "with the subsystem docs: doc/replay_pipeline.md (replay), "
+            "doc/routing.md (route), doc/resilience.md\n"
+            "(breakers/deadlines/faults), doc/tracing.md (tracing/"
+            "flight recorder), doc/observability.md (metrics).\n"
+            "\n"
+            + self.knobs_table() + "\n")
+
+    # -- cross-file checks -------------------------------------------------
+
+    def _doc_files(self, config) -> list[str]:
+        out = []
+        for pattern in config.doc_globs:
+            out.extend(sorted(_glob.glob(
+                os.path.join(config.root, pattern))))
+        return [os.path.relpath(p, config.root) for p in out]
+
+    def finish(self, config) -> None:
+        wired = self.wired_knobs()
+
+        # dynamic reads without a derivation rule
+        for prefix, relpath, lineno in self.dynamic_prefixes:
+            if prefix != DEADLINE_PREFIX:
+                self.emit(
+                    relpath, lineno, "dynamic-unresolved",
+                    f"env knob name built dynamically from {prefix!r} — "
+                    "registry-sync cannot resolve it; add a derivation "
+                    "rule (see registry_sync.py) or read literally",
+                    f"dynamic env read {prefix!r}")
+        # env reads keyed by a non-parameter variable: the knob name is
+        # invisible to extraction, so drift in it is undetectable
+        for relpath, lineno, expr in self.unresolved_reads:
+            self.emit(
+                relpath, lineno, "dynamic-unresolved",
+                f"env read keyed by variable `{expr}` — registry-sync "
+                "cannot resolve the knob name; read literally, route "
+                "through a parameterized helper, or add a derivation "
+                "rule",
+                f"dynamic env read {expr}")
+
+        # knobs.md staleness + membership
+        knobs_md_path = os.path.join(config.root, config.knobs_md)
+        documented: set[str] = set()
+        if os.path.exists(knobs_md_path):
+            with open(knobs_md_path) as f:
+                content = f.read()
+            documented = set(KNOB_RE.findall(content))
+            if content != self.knobs_md():
+                self.emit(
+                    config.knobs_md, 1, "knobs-stale",
+                    "doc/knobs.md differs from the registry-sync "
+                    "extraction — regenerate with `python "
+                    "tools/graftlint.py --write-knobs`",
+                    "knob table out of date")
+        else:
+            self.emit(
+                config.knobs_md, 1, "knobs-stale",
+                f"{config.knobs_md} missing — generate with `python "
+                "tools/graftlint.py --write-knobs`",
+                "knob table missing")
+        for knob, info in sorted(wired.items()):
+            if knob not in documented:
+                consumer = sorted(info["consumers"])[0] \
+                    if info["consumers"] else "?"
+                self.emit(
+                    config.knobs_md, 1, "env-undocumented",
+                    f"{knob} is read by {consumer} but absent from "
+                    f"{config.knobs_md}",
+                    f"undocumented {knob}")
+
+        # doc mentions: unwired knobs, undeclared metrics
+        wired_names = set(wired)
+        declared = set(self.declared_metrics)
+        for rel in self._doc_files(config):
+            with open(os.path.join(config.root, rel)) as f:
+                for lineno, line in enumerate(f, 1):
+                    for knob in KNOB_RE.findall(line):
+                        if knob.endswith("_"):
+                            continue   # prefix mention
+                        if knob not in wired_names:
+                            self.emit(
+                                rel, lineno, "env-unwired",
+                                f"{knob} is documented but nothing "
+                                "reads it (the PR-4 DEADLINE_SIGN_S "
+                                "class) — wire it or drop the doc",
+                                f"unwired {knob}")
+                    for metric in METRIC_RE.findall(line):
+                        if metric.endswith("_"):
+                            continue   # family-prefix mention
+                        if metric not in declared:
+                            self.emit(
+                                rel, lineno, "metric-undeclared",
+                                f"{metric} appears in docs but no "
+                                "code declares it",
+                                f"undeclared {metric}")
+
+        # unused families.py instruments
+        for var, metric, lineno in self.family_instruments:
+            if var not in self.used_names:
+                self.emit(
+                    config.families_file, lineno, "metric-unused",
+                    f"{var} ({metric}) is declared in families.py but "
+                    "referenced by no other module — dead series "
+                    "exposed at zero forever",
+                    f"unused instrument {var}")

@@ -1,0 +1,58 @@
+"""Text and JSON reporters for graftlint results."""
+from __future__ import annotations
+
+import json
+
+from .findings import AnalysisResult
+
+
+def text_report(result: AnalysisResult, verbose: bool = False) -> str:
+    lines: list[str] = []
+    new = result.new_findings
+    if new:
+        lines.append(f"graftlint: {len(new)} finding(s)")
+        last_path = None
+        for f in new:
+            if f.path != last_path:
+                lines.append(f"  {f.path}:")
+                last_path = f.path
+            where = f" in {f.scope}()" if f.scope else ""
+            lines.append(f"    {f.lineno}{where}: "
+                         f"[{f.pass_name}/{f.code}] {f.message}")
+            lines.append(f"        {f.detail}   "
+                         f"(fingerprint {f.fingerprint})")
+    for stale in result.stale_baseline:
+        lines.append(
+            f"stale baseline entry {stale['fingerprint']} "
+            f"[{stale.get('pass')}/{stale.get('code')}] "
+            f"{stale.get('file')} — finding no longer present; "
+            f"delete it from the baseline")
+    for uj in result.unjustified:
+        lines.append(
+            f"unjustified baseline entry {uj['fingerprint']} "
+            f"[{uj.get('pass')}/{uj.get('code')}] {uj.get('file')} — "
+            f"every baseline entry must state WHY it is intentional")
+    if verbose and result.baselined_findings:
+        lines.append(f"baselined ({len(result.baselined_findings)}):")
+        for f in result.baselined_findings:
+            lines.append(f"  {f.location()} [{f.pass_name}/{f.code}] "
+                         f"{f.fingerprint}: {f.justification}")
+    if result.clean:
+        nb = len(result.baselined_findings)
+        suffix = f", {nb} baselined" if nb else ""
+        lines.append(f"graftlint: clean ({result.files_scanned} files, "
+                     f"{len(result.passes_run)} passes{suffix})")
+    return "\n".join(lines)
+
+
+def json_report(result: AnalysisResult) -> str:
+    return json.dumps({
+        "version": 1,
+        "clean": result.clean,
+        "files_scanned": result.files_scanned,
+        "passes": list(result.passes_run),
+        "findings": [f.as_dict() for f in result.new_findings],
+        "baselined": [f.as_dict() for f in result.baselined_findings],
+        "stale_baseline": result.stale_baseline,
+        "unjustified_baseline": result.unjustified,
+    }, indent=2)

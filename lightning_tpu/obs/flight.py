@@ -73,10 +73,10 @@ _P99_MIN_SAMPLES = 32    # no p99 verdicts before the window has history
 _P99_FLOOR_S = 0.005     # p99 mode ignores sub-5ms dispatches (noise)
 
 _lock = threading.Lock()
-_ids = itertools.count(1)
-_rings: dict[str, collections.deque] = {}
-_counts: dict[str, int] = {}
-_windows: dict[str, collections.deque] = {}
+_ids = itertools.count(1)        # thread-safe without the lock (CPython)
+_rings: dict[str, collections.deque] = {}       # guarded-by: _lock
+_counts: dict[str, int] = {}                    # guarded-by: _lock
+_windows: dict[str, collections.deque] = {}     # guarded-by: _lock
 _tls = threading.local()
 
 

@@ -43,14 +43,14 @@ from contextlib import contextmanager
 
 _current = contextvars.ContextVar("trace_span", default=None)
 
-_records: list[dict] = []
+_records: list[dict] = []        # guarded-by: _lock
 _MAX_RECORDS = 10_000
-_sink = None          # None → ring buffer; else callable(record)
-_file = None
+_sink = None          # guarded-by: _lock  (None → ring; else callable)
+_file = None          # guarded-by: _lock
 # taps see EVERY record regardless of the sink (the obs collector feeds
 # span-duration histograms from here; a tap must never raise into the
 # traced code path)
-_taps: list = []
+_taps: list = []                 # guarded-by: _lock
 # one lock for ring + taps + sink swaps: flush loops, the replay
 # producer thread, and the main thread all emit concurrently, and a
 # bare list append/prune pair is a lost-update race under free threading
@@ -255,7 +255,7 @@ def device_span(name: str, **attributes):
 # the same Perfetto UI.  Both are strict no-ops unless the env knob is
 # set AND a session is active — the live path never imports jax.profiler.
 
-_profile_active = False
+_profile_active = False          # guarded-by: _lock
 
 
 @contextmanager

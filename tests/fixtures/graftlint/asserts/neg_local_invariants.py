@@ -1,0 +1,19 @@
+"""NEGATIVE [asserts]: locals-only and self asserts are internal
+invariants — legal (they check OUR math, not caller data)."""
+
+LIMIT = 64
+
+
+def fold(values):
+    total = 0
+    for v in values:
+        total += v
+    assert total >= 0                 # locals only: legal
+    assert LIMIT > 0                  # module constant: legal
+    return total
+
+
+class Ring:
+    def check(self):
+        assert self.head < self.cap   # self is exempt
+        return self.head

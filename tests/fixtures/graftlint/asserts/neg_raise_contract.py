@@ -1,0 +1,12 @@
+"""NEGATIVE [asserts]: the required idiom — contracts raise ValueError
+(survives python -O); asserts at module scope are also out of scope."""
+
+assert True  # module-level: not an input contract
+
+
+def pack(rows, width):
+    if rows is None:
+        raise ValueError("rows required")
+    if width <= 0:
+        raise ValueError("width must be positive")
+    return [r[:width] for r in rows]

@@ -1,0 +1,11 @@
+"""POSITIVE [asserts]: param-referencing asserts are input contracts."""
+
+
+def check(items, flag):
+    assert items is not None, "contract"          # HIT: param `items`
+    return flag
+
+
+async def submit(queue, msg, limit=8):
+    assert len(msg) <= limit                      # HIT: params msg+limit
+    queue.append(msg)

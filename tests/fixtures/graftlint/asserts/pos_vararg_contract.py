@@ -1,0 +1,7 @@
+"""POSITIVE [asserts]: *args/**kwargs count as parameters too."""
+
+
+def gather(*rows, **opts):
+    assert rows, "need at least one row"          # HIT: vararg `rows`
+    assert "mode" in opts                         # HIT: kwarg `opts`
+    return list(rows)

@@ -1,0 +1,17 @@
+"""Historical regression [jit-hygiene]: the PR-3 sign-batch recompile
+bug, verbatim shape.  `ecdsa_sign_batch` wrapped `jax.jit(
+ecdsa_sign_kernel)` on every call — each wrap is a new PjitFunction,
+so every batched sign re-traced the whole EC program before the
+executable-cache lookup.  Fixed in PR 3 by the module-level
+`@functools.lru_cache def _jit_sign()` builder; this fixture proves
+the pass would have caught the original."""
+import jax
+
+
+def ecdsa_sign_kernel(z, d, ks):
+    return z + d + ks
+
+
+def ecdsa_sign_batch(z, d, ks):
+    kern = jax.jit(ecdsa_sign_kernel)     # HIT: the shipped bug
+    return kern(z, d, ks)

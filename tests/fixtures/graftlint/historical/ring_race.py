@@ -1,0 +1,26 @@
+"""Historical regression [lock-discipline]: the PR-5 trace-ring race,
+verbatim shape.  utils/trace.py's ring was appended/pruned and its taps
+mutated from flush loops, the replay producer thread, and the main
+thread with no lock — a lost-update race that dropped span records and
+let a set_sink rotation close a file mid-write.  PR 5 serialized every
+touch under one module lock; this fixture (the PRE-fix shape, with the
+annotation the fix added) proves the pass would have caught it."""
+import threading
+
+_lock = threading.RLock()
+_records = []         # guarded-by: _lock
+_taps = []            # guarded-by: _lock
+_MAX_RECORDS = 10_000
+
+
+def _emit(rec):
+    for tap in list(_taps):               # HIT: unlocked tap read
+        tap(rec)
+    _records.append(rec)                  # HIT: unlocked append
+    if len(_records) > _MAX_RECORDS:      # HIT: unlocked prune check
+        del _records[: _MAX_RECORDS // 2]     # HIT: unlocked prune
+
+
+def add_tap(fn):
+    with _lock:
+        _taps.append(fn)

@@ -1,0 +1,14 @@
+"""Call sites wiring verify/route/ingest deadlines — but never sign."""
+from .dl import deadline_for
+
+
+def verify_flush():
+    return deadline_for("verify")
+
+
+def route_flush():
+    return deadline_for("route")
+
+
+def ingest_flush():
+    return deadline_for("ingest")

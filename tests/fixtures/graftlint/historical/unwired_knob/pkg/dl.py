@@ -1,0 +1,9 @@
+"""Historical regression fixture: the deadline module, PR-4 shape."""
+import os
+
+
+def deadline_for(family):
+    raw = os.environ.get(f"LIGHTNING_TPU_DEADLINE_{family.upper()}_S")
+    if raw is None:
+        raw = os.environ.get("LIGHTNING_TPU_DEADLINE_S")
+    return raw

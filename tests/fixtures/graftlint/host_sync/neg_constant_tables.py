@@ -1,0 +1,10 @@
+"""NEGATIVE [host-sync]: trace-time constant tables from literal
+displays are not device syncs."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def window_kernel(x):
+    w = jnp.asarray(np.array([1, 2, 4, 8], np.uint32))   # literal: legal
+    base = int(16)                                       # constant: legal
+    return x * w + base

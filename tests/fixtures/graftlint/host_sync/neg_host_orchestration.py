@@ -1,0 +1,17 @@
+"""NEGATIVE [host-sync]: the dispatch orchestration functions AROUND a
+kernel legitimately read back — one np.asarray at the readback seam is
+the design (doc/replay_pipeline.md), not a hidden sync."""
+import numpy as np
+
+
+def verify_batch(kern, rows, bucket):
+    out = np.zeros(len(rows), bool)
+    for start in range(0, len(rows), bucket):
+        end = min(start + bucket, len(rows))
+        ok = kern(rows[start:end])
+        out[start:end] = np.asarray(ok)[: end - start]   # readback seam
+    return out
+
+
+def summarize(ok):
+    return int(ok.sum()), float(ok.mean())   # host code: legal

@@ -1,0 +1,10 @@
+"""POSITIVE [host-sync]: syncs inside a @jax.jit-DECORATED kernel are
+the same bug as syncs inside a by-reference-wrapped one."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def fold_rows(rows):
+    total = rows.sum().item()        # HIT: .item() in decorated kernel
+    return np.asarray(rows) + total  # HIT: np-materialize

@@ -1,0 +1,11 @@
+"""POSITIVE [host-sync]: implicit device→host syncs inside a
+convention-named kernel builder."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def scale_kernel(x, s):
+    peak = float(x.max())             # HIT: scalar-cast
+    host = np.asarray(x)              # HIT: np-materialize
+    total = x.sum().item()            # HIT: item
+    return jnp.asarray(host) * s + peak + total

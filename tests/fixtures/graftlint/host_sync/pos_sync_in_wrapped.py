@@ -1,0 +1,20 @@
+"""POSITIVE [host-sync]: syncs inside functions detected as kernel
+builders by wrap-site reference and by nesting."""
+import jax
+
+
+def body(x):
+    return int(x) + 1                 # HIT: scalar-cast in traced body
+
+
+def builder(xs):
+    return jax.vmap(body)(xs)         # marks `body` as traced
+
+
+def step(z):
+    def inner(v):
+        return v.block_until_ready()  # HIT: nested inside traced step
+    return inner(z)
+
+
+_SHARDED = jax.jit(step)

@@ -1,0 +1,23 @@
+"""NEGATIVE [jit-hygiene]: the repo's legal wrap idioms — lru_cache'd
+builders, and combinators inside kernel builders (traced once under
+the outer cached jit)."""
+import functools
+
+import jax
+
+
+def body_kernel(x):
+    doubled = jax.vmap(lambda y: y * 2)(x)   # inside a kernel: legal
+    return doubled
+
+
+@functools.lru_cache(maxsize=2)
+def _jit_body():
+    return jax.jit(body_kernel)              # cached builder: legal
+
+
+@functools.lru_cache(maxsize=8)
+def _jit_route(n_nodes):
+    def single(src):
+        return src + n_nodes
+    return jax.jit(jax.vmap(single))         # cached builder: legal

@@ -1,0 +1,18 @@
+"""NEGATIVE [jit-hygiene]: module-scope wraps compile once per process;
+hashable static literals are fine."""
+import jax
+
+
+def hash_kernel(blocks, n_blocks):
+    return blocks * n_blocks
+
+
+_JIT_HASH = jax.jit(hash_kernel)                  # module scope: legal
+_JIT_STATIC = jax.jit(hash_kernel, static_argnums=(1,))
+_WARM = jax.jit(hash_kernel, static_argnums=(1,))(0, (1, 2))  # hashable
+
+
+@jax.jit
+def gather_kernel(rows):          # module-scope decorator: legal too
+    return rows + 1
+

@@ -1,0 +1,16 @@
+"""POSITIVE [jit-hygiene]: jit/vmap wraps inside plain function bodies
+re-trace per call."""
+import jax
+
+
+def sign_kernel(z, d, k):
+    return z + d + k
+
+
+def sign_batch(z, d, k):
+    kern = jax.jit(sign_kernel)       # HIT: new PjitFunction per call
+    return kern(z, d, k)
+
+
+def map_rows(rows):
+    return jax.vmap(sign_kernel)(rows, rows, rows)   # HIT: vmap re-wrap

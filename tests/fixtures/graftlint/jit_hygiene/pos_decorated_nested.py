@@ -1,0 +1,20 @@
+"""POSITIVE [jit-hygiene]: the decorator spelling of the re-wrap bug —
+a @jax.jit-decorated def nested inside a plain function body builds a
+new PjitFunction per call of the enclosing function."""
+import functools
+
+import jax
+
+
+def make_sign(d):
+    @jax.jit
+    def sign(z):                     # HIT: decorator runs per call
+        return z + d
+    return sign
+
+
+def make_mapper(rows):
+    @functools.partial(jax.vmap, in_axes=0)
+    def mapper(r):                   # HIT: partial(vmap) decorator
+        return r * rows
+    return mapper

@@ -1,0 +1,9 @@
+"""POSITIVE [jit-hygiene]: list/dict literals in static positions are
+unhashable at the jit cache lookup."""
+import jax
+
+
+def run(f, x):
+    ok = jax.jit(f, static_argnums=(1,))(x, [1, 2])          # HIT: list
+    cfg = jax.jit(f, static_argnames=("opts",))(x, opts={"a": 1})  # HIT
+    return ok, cfg

@@ -1,0 +1,37 @@
+"""NEGATIVE [lock-discipline]: every touch under the named lock (incl.
+multi-item with statements and nested functions), __init__ exempt."""
+import threading
+
+_lock = threading.RLock()
+_ring = []            # guarded-by: _lock
+
+
+def emit(rec):
+    with _lock:
+        _ring.append(rec)
+        if len(_ring) > 10:
+            del _ring[:5]
+
+
+def drain(out_file):
+    with open(out_file) as f, _lock:     # multi-item with: counts
+        return list(_ring), f
+
+
+def summarize(items):
+    _ring = [i for i in items if i]   # LOCAL shadow: not the global
+    return len(_ring)
+
+
+def count(_ring):                     # parameter shadow: fine
+    return len(_ring)
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._waiters = []    # guarded-by: self._lock
+
+    def submit(self, fut):
+        with self._lock:
+            self._waiters.append(fut)

@@ -1,0 +1,16 @@
+"""NEGATIVE [lock-discipline]: unannotated state is out of scope — the
+pass enforces declared invariants, it does not infer them."""
+import threading
+
+_lock = threading.Lock()
+_scratch = []         # no annotation: free-threaded by design (tls-ish)
+
+
+def push(x):
+    _scratch.append(x)
+
+
+def pop():
+    with _lock:
+        pass
+    return _scratch.pop()

@@ -1,0 +1,18 @@
+"""POSITIVE [lock-discipline]: guarded instance attributes touched
+outside `with self._lock` (outside __init__)."""
+import threading
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._waiters = []        # guarded-by: self._lock
+
+    def submit(self, fut):
+        self._waiters.append(fut)         # HIT: unlocked mutation
+
+    def drain(self):
+        with self._lock:
+            out = list(self._waiters)
+            self._waiters.clear()
+        return out, len(self._waiters)    # HIT: read after release

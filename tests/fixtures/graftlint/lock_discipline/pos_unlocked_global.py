@@ -1,0 +1,20 @@
+"""POSITIVE [lock-discipline]: guarded module globals touched outside
+`with <lock>`."""
+import threading
+
+_lock = threading.Lock()
+_ring = []            # guarded-by: _lock
+# guarded-by: _lock
+_counts = {}
+
+
+def emit(rec):
+    _ring.append(rec)             # HIT: unlocked mutation
+    if len(_ring) > 10:           # HIT: unlocked read
+        del _ring[:5]             # HIT: unlocked delete
+
+
+def tally(fam):
+    with _lock:
+        _counts[fam] = _counts.get(fam, 0) + 1
+    return _counts.get(fam)       # HIT: read after lock released

@@ -1,0 +1,14 @@
+"""Fixture: deadline-style dynamic knob reads (the derivation case)."""
+import os
+
+
+def deadline_for(family):
+    raw = os.environ.get(f"LIGHTNING_TPU_DEADLINE_{family.upper()}_S")
+    if raw is None:
+        raw = os.environ.get("LIGHTNING_TPU_DEADLINE_S")
+    return raw
+
+
+async def guard(aw, family, seam):
+    deadline_for(family)
+    return await aw

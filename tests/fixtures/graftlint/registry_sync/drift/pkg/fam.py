@@ -1,0 +1,20 @@
+"""Fixture families module: one used instrument, one dead one."""
+
+
+class _Reg:
+    def counter(self, name, help, labelnames=()):
+        return self
+
+    def labels(self, *a):
+        return self
+
+    def inc(self, n=1):
+        pass
+
+
+REGISTRY = _Reg()
+
+USED_TOTAL = REGISTRY.counter("clntpu_fix_used_total", "used by mod.py",
+                              labelnames=("outcome",))
+DEAD_TOTAL = REGISTRY.counter("clntpu_fix_dead_total",
+                              "declared, referenced nowhere")

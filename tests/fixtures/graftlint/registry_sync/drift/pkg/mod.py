@@ -1,0 +1,23 @@
+"""Fixture: literal + helper-mediated env reads, metric usage."""
+import os
+
+from .dl import deadline_for
+from .fam import USED_TOTAL
+
+FLUSH_MS = os.environ.get("LIGHTNING_TPU_FIX_FLUSH_MS", "2.0")
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+DEPTH = _env_int("LIGHTNING_TPU_FIX_DEPTH", 2)
+
+
+def flush(items):
+    deadline_for("verify")
+    USED_TOTAL.labels("ok").inc()
+    return len(items)

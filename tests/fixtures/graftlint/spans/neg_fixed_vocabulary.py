@@ -1,0 +1,9 @@
+"""NEGATIVE [spans]: literal names and variable label values are the
+fixed-vocabulary idiom (doc/tracing.md)."""
+
+
+def flush(m, outcome, c, trace, events):
+    with trace.span("verify/dispatch", corr=c):
+        pass
+    events.emit("slow_dispatch", {})
+    m.labels("verify", outcome).inc()   # plain variables are fine

@@ -1,0 +1,8 @@
+"""NEGATIVE [spans]: emit/begin/dispatch on NON-trace objects are out
+of scope — only the trace/events/flight module bases are linted."""
+
+
+def work(queue, item, batch):
+    queue.emit(item.name + "!", {})     # unrelated emit: legal
+    batch.begin(item.tag)               # a dataclass's own begin: legal
+    batch.dispatch(f"job/{item.id}")    # unrelated dispatch: legal

@@ -1,0 +1,7 @@
+"""POSITIVE [spans]: .labels() values constructed at the call site."""
+
+
+def meter(m, peer, parts):
+    m.labels(f"peer-{peer}").inc()                # HIT: f-string label
+    m.labels("x".join(parts)).inc()               # HIT: str.join label
+    m.labels("bucket-{}".format(peer)).inc()      # HIT: .format label

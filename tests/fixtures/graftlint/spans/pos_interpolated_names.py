@@ -1,0 +1,9 @@
+"""POSITIVE [spans]: span/topic/family names built at the call site."""
+
+
+def flush(scid, peer, trace, events, flight):
+    with trace.span(f"verify/{scid}"):            # HIT: f-string name
+        pass
+    events.emit("drop_" + peer, {})               # HIT: concatenation
+    with flight.dispatch("fam_%s" % peer):        # HIT: %-format
+        pass

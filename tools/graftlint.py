@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""graftlint CLI — the repo's unified static-analysis front end.
+
+    python tools/graftlint.py                 # all passes, text report
+    python tools/graftlint.py --json          # machine-readable
+    python tools/graftlint.py --passes jit-hygiene,host-sync
+    python tools/graftlint.py --baseline-update --justification "..."
+    python tools/graftlint.py --write-knobs   # regenerate doc/knobs.md
+
+Exit status: 0 clean (every finding baselined WITH a justification, no
+stale entries), 1 findings / stale or unjustified baseline entries,
+2 usage error.  See doc/static_analysis.md for the pass catalogue and
+the baseline workflow.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightning_tpu.analysis import (  # noqa: E402
+    ALL_PASSES, DEFAULT_BASELINE, PASSES_BY_NAME, Config, Engine,
+    baseline as B, REPO_ROOT)
+from lightning_tpu.analysis.passes.registry_sync import (  # noqa: E402
+    RegistrySyncPass)
+from lightning_tpu.analysis.report import (  # noqa: E402
+    json_report, text_report)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable findings")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass names (default: all)")
+    ap.add_argument("--list-passes", action="store_true")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline store (default {DEFAULT_BASELINE})")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="refresh fingerprints: drop stale entries, add "
+                         "new findings (requires --justification)")
+    ap.add_argument("--justification", default="",
+                    help="justification recorded for entries added by "
+                         "--baseline-update")
+    ap.add_argument("--write-knobs", action="store_true",
+                    help="regenerate doc/knobs.md from the registry-"
+                         "sync extraction and exit")
+    ap.add_argument("--root", default=REPO_ROOT, help=argparse.SUPPRESS)
+    ap.add_argument("--scan-roots", default=None,
+                    help="comma-separated path prefixes to scan "
+                         "(default: lightning_tpu,tools)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also list baselined findings")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for cls in ALL_PASSES:
+            print(f"{cls.name:16s} {cls.description}")
+        return 0
+
+    names = tuple(n.strip() for n in args.passes.split(",")
+                  if n.strip()) if args.passes else tuple(
+        cls.name for cls in ALL_PASSES)
+    unknown = [n for n in names if n not in PASSES_BY_NAME]
+    if unknown:
+        print(f"unknown pass(es): {', '.join(unknown)} "
+              f"(try --list-passes)", file=sys.stderr)
+        return 2
+
+    cfg = Config(root=args.root)
+    if args.scan_roots is not None:
+        cfg.scan_roots = tuple(s.strip() for s in
+                               args.scan_roots.split(","))
+        # explicit roots mean "lint these wherever they are": widen
+        # every pass's scope to the whole scanned set
+        cfg.scopes = {n: ("",) for n in PASSES_BY_NAME}
+    bpath = args.baseline or os.path.join(cfg.root, DEFAULT_BASELINE)
+
+    if args.write_knobs:
+        # run only registry-sync to extract; ignore its findings (the
+        # point of the write is to RESOLVE the staleness finding)
+        rs = RegistrySyncPass()
+        Engine([rs], cfg).run()
+        out = os.path.join(cfg.root, cfg.knobs_md)
+        with open(out, "w") as f:
+            f.write(rs.knobs_md())
+        print(f"wrote {cfg.knobs_md} "
+              f"({len(rs.wired_knobs())} knobs)")
+        return 0
+
+    passes = [PASSES_BY_NAME[n]() for n in names]
+    result = Engine(passes, cfg).run()
+    data = B.load(bpath)
+    B.apply(result, data, names)
+
+    if args.baseline_update:
+        try:
+            added, removed = B.update(data, result, args.justification)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        B.save(bpath, data)
+        print(f"baseline updated: +{added} −{removed} "
+              f"({os.path.relpath(bpath, cfg.root)})")
+        return 0
+
+    print(json_report(result) if args.json
+          else text_report(result, verbose=args.verbose))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

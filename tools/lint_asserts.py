@@ -1,124 +1,69 @@
 #!/usr/bin/env python
 """Repo lint: no NEW bare `assert` statements as input contracts in
-`lightning_tpu/gossip/`, `lightning_tpu/crypto/`,
-`lightning_tpu/routing/`, and `lightning_tpu/resilience/`.
+the dispatch-path packages.  Since ISSUE 6 this is a thin shim over the
+graftlint `asserts` pass (lightning_tpu/analysis/passes/asserts.py —
+rule rationale lives there and in doc/static_analysis.md); the CLI,
+exit semantics, and the grandfathered-violation model are unchanged.
 
-A bare assert is stripped under `python -O`, so a contract like
-"oversized rows require z_host" silently degrades into an incidental
-TypeError (ADVICE.md round 5 — the bug this lint exists to prevent
-recurring).  Contracts on inputs must `raise ValueError(...)`.
-
-Operationalization: an `assert` whose condition references one of the
-enclosing function's parameters is treated as an input contract.
-Internal invariant asserts (locals-only, loop-carried bound proofs in
-the kernel builders, etc.) stay legal — they check OUR math, not a
-caller's data, and stripping them under -O is acceptable.
-
-Pre-existing violations are grandfathered in ALLOWLIST by a
-line-number-independent fingerprint (file, function, condition).  Fix
-one → delete its entry; never add entries for new code.
+Grandfathered violations moved from the old in-file ALLOWLIST to the
+shared fingerprint baseline (tools/graftlint_baseline.json), each with
+a justification.  Fix one → delete its entry; never add entries for
+new code.
 
 Exit status: 0 clean, 1 new violations (listed on stdout).
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SCAN_DIRS = ("lightning_tpu/gossip", "lightning_tpu/crypto",
-             "lightning_tpu/routing", "lightning_tpu/resilience")
+sys.path.insert(0, ROOT)
 
-# (relpath, enclosing function, unparsed condition) — grandfathered.
-ALLOWLIST = {
-    ("lightning_tpu/crypto/field.py", "int_to_limbs",
-     "0 <= x < 1 << LIMB_BITS * n"),
-    ("lightning_tpu/crypto/field.py", "__init__",
-     "1 << 255 < m < 1 << 256"),
-    ("lightning_tpu/crypto/field.py", "_reduce",
-     "lbound <= STORED_LIMB_MAX and vmax <= STORED_VMAX"),
-    ("lightning_tpu/crypto/field.py", "_reduce",
-     "new_vmax < vmax"),
-    ("lightning_tpu/crypto/field.py", "mul_small",
-     "0 <= k < 6144"),
-    ("lightning_tpu/crypto/field.py", "pow_const",
-     "e >= 1"),
-    ("lightning_tpu/crypto/field.py", "from_bytes_be",
-     "data.shape[-1] == 32"),
-    ("lightning_tpu/crypto/pallas_secp.py", "_reduceT",
-     "lbound <= SLM and vmax <= SVM"),
-    ("lightning_tpu/crypto/pallas_secp.py", "_reduceT",
-     "new_vmax < vmax"),
-    ("lightning_tpu/crypto/ref_python.py", "pubkey_serialize",
-     "not pt.inf"),
-    ("lightning_tpu/crypto/ref_python.py", "pubkey_create",
-     "0 < seckey < N"),
-    ("lightning_tpu/crypto/ref_python.py", "schnorr_sign",
-     "schnorr_verify(msg, pt.x, sig)"),
-}
+from lightning_tpu.analysis import run_repo  # noqa: E402
+from lightning_tpu.analysis.core import Config, Engine  # noqa: E402
+from lightning_tpu.analysis.passes.asserts import (  # noqa: E402
+    InputContractAssertPass)
 
-
-def _param_names(fn: ast.AST) -> set[str]:
-    a = fn.args
-    names = [p.arg for p in
-             (*a.posonlyargs, *a.args, *a.kwonlyargs)]
-    if a.vararg:
-        names.append(a.vararg.arg)
-    if a.kwarg:
-        names.append(a.kwarg.arg)
-    return set(names) - {"self", "cls"}
+SCAN_DIRS = InputContractAssertPass.default_scope
 
 
 def scan_file(relpath: str) -> list[tuple[str, str, str, int]]:
     """Return (relpath, funcname, condition, lineno) for every
-    parameter-referencing assert."""
-    with open(os.path.join(ROOT, relpath)) as f:
-        tree = ast.parse(f.read(), relpath)
-    hits = []
-
-    class V(ast.NodeVisitor):
-        def __init__(self):
-            self.stack: list[ast.AST] = []
-
-        def visit_FunctionDef(self, node):
-            self.stack.append(node)
-            self.generic_visit(node)
-            self.stack.pop()
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-        def visit_Assert(self, node):
-            if self.stack:
-                fn = self.stack[-1]
-                params = _param_names(fn)
-                used = {n.id for n in ast.walk(node.test)
-                        if isinstance(n, ast.Name)}
-                if used & params:
-                    hits.append((relpath, fn.name,
-                                 ast.unparse(node.test), node.lineno))
-            self.generic_visit(node)
-
-    V().visit(tree)
-    return hits
+    parameter-referencing assert — the historical API, now answered by
+    the framework pass."""
+    p = InputContractAssertPass()
+    Engine([p], Config(root=ROOT, scan_roots=(relpath,),
+                       scopes={p.name: ("",)})).run()
+    out = []
+    for f in p.findings:
+        if f.code != "input-contract":   # e.g. syntax-error
+            continue
+        cond = f.detail.split(": assert ", 1)[1]
+        out.append((f.path, f.scope, cond, f.lineno))
+    return out
 
 
 def main() -> int:
-    violations = []
-    for d in SCAN_DIRS:
-        for dirpath, _, files in os.walk(os.path.join(ROOT, d)):
-            for fname in sorted(files):
-                if not fname.endswith(".py"):
+    result = run_repo(pass_names=(InputContractAssertPass.name,))
+    bad = result.new_findings
+    if bad or result.stale_baseline or result.unjustified:
+        if bad:
+            print("new input-contract assert(s) — raise ValueError "
+                  "instead (stripped under python -O):")
+            for f in bad:
+                if f.code != "input-contract":   # e.g. syntax-error
+                    print(f"  {f.path}:{f.lineno} {f.message}")
                     continue
-                rel = os.path.relpath(os.path.join(dirpath, fname), ROOT)
-                for relpath, fn, cond, lineno in scan_file(rel):
-                    if (relpath, fn, cond) not in ALLOWLIST:
-                        violations.append((relpath, lineno, fn, cond))
-    if violations:
-        print("new input-contract assert(s) — raise ValueError instead "
-              "(stripped under python -O):")
-        for relpath, lineno, fn, cond in violations:
-            print(f"  {relpath}:{lineno} in {fn}(): assert {cond}")
+                cond = f.detail.split(": assert ", 1)[1]
+                print(f"  {f.path}:{f.lineno} in {f.scope}(): "
+                      f"assert {cond}")
+        for stale in result.stale_baseline:
+            print(f"  stale baseline entry {stale['fingerprint']} "
+                  f"({stale.get('file')}) — violation fixed; delete it")
+        for uj in result.unjustified:
+            print(f"  unjustified baseline entry {uj['fingerprint']} "
+                  f"({uj.get('file')}) — add a justification")
         return 1
     print(f"lint_asserts: clean ({', '.join(SCAN_DIRS)})")
     return 0

@@ -1,136 +1,63 @@
 #!/usr/bin/env python
 """Repo lint: span names, events topics, dispatch families, and metric
 label values must come from a FIXED vocabulary — never constructed at
-the call site.
+the call site.  Since ISSUE 6 this is a thin shim over the graftlint
+`spans` pass (lightning_tpu/analysis/passes/spans.py — rule rationale
+lives there and in doc/static_analysis.md); CLI and exit semantics are
+unchanged.  Violations would be grandfathered in the shared baseline
+(tools/graftlint_baseline.json); currently none are.
 
-Metric cardinality is bounded only because every label value and span
-name is a code-bounded constant (doc/observability.md §vocabulary).
-One `trace.span(f"verify/{scid}")` or `.labels(peer_id)` with an
-interpolated id turns a bounded family into an unbounded one: the span
-histogram grows a bucket set per peer, the exporter draws a lane per
-scid, and the registry's cardinality cap starts silently dropping the
-labels operators actually query.  This lint rejects the construction
-itself:
-
-* `trace.span(name, ...)` / `trace.device_span` / `trace.annotation`
-  and `events.emit(topic, ...)` and `flight.dispatch/begin(family, ..)`
-  must get a STRING LITERAL first argument;
-* `.labels(...)` arguments must not be f-strings, %-formatting,
-  str.format()/join() calls, or string concatenation — plain variables
-  are fine (they carry values from fixed vocabularies; the registry's
-  max_label_sets cap backstops them), building a NEW string at the
-  call site is not.
-
-Scanned: lightning_tpu/{obs,gossip,routing,resilience,parallel}/ and
-lightning_tpu/daemon/hsmd.py — the dispatch-path modules feeding the
-span ring and flight recorder.  Pre-existing violations would be
-grandfathered in ALLOWLIST by (relpath, kind, offending source);
-currently none are.  Exit 0 clean, 1 violations (listed on stdout).
+Exit status: 0 clean, 1 violations (listed on stdout).
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SCAN = ("lightning_tpu/obs", "lightning_tpu/gossip",
-        "lightning_tpu/routing", "lightning_tpu/resilience",
-        "lightning_tpu/parallel", "lightning_tpu/daemon/hsmd.py")
+sys.path.insert(0, ROOT)
 
-# call sites whose FIRST argument names a span/topic/family
-NAMED_SITES = {"span", "device_span", "annotation", "emit",
-               "dispatch", "begin"}
-# modules the attr must hang off for NAMED_SITES to apply (so a
-# dataclass's own `begin()` or an unrelated `emit` is not flagged)
-NAMED_BASES = {"trace", "_trace", "events", "_ev", "_nev", "flight",
-               "_flight"}
+from lightning_tpu.analysis import run_repo  # noqa: E402
+from lightning_tpu.analysis.core import Config, Engine  # noqa: E402
+from lightning_tpu.analysis.passes.spans import (  # noqa: E402
+    SpanVocabularyPass)
 
-ALLOWLIST: set[tuple[str, str, str]] = set()
-
-
-def _is_constructed_str(node: ast.AST) -> bool:
-    """True if the expression BUILDS a string: f-string, %-format,
-    concatenation involving a str literal, str.format()/join()."""
-    if isinstance(node, ast.JoinedStr):
-        return True
-    if isinstance(node, ast.BinOp) and isinstance(
-            node.op, (ast.Add, ast.Mod)):
-        for side in (node.left, node.right):
-            if isinstance(side, ast.Constant) and isinstance(
-                    side.value, str):
-                return True
-            if _is_constructed_str(side):
-                return True
-    if isinstance(node, ast.Call) and isinstance(
-            node.func, ast.Attribute) and node.func.attr in (
-            "format", "join"):
-        return True
-    return False
+SCAN = SpanVocabularyPass.default_scope
 
 
 def scan_file(relpath: str) -> list[tuple[str, int, str, str]]:
-    """Return (relpath, lineno, kind, source) violations."""
-    with open(os.path.join(ROOT, relpath)) as f:
-        tree = ast.parse(f.read(), relpath)
-    hits: list[tuple[str, int, str, str]] = []
-
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if not isinstance(fn, ast.Attribute):
-            continue
-        if fn.attr in NAMED_SITES:
-            base = fn.value
-            if not (isinstance(base, ast.Name)
-                    and base.id in NAMED_BASES):
-                continue
-            if not node.args:
-                continue
-            first = node.args[0]
-            if not (isinstance(first, ast.Constant)
-                    and isinstance(first.value, str)):
-                hits.append((relpath, node.lineno,
-                             f"{base.id}.{fn.attr}",
-                             ast.unparse(first)))
-        elif fn.attr == "labels":
-            for arg in node.args:
-                if _is_constructed_str(arg):
-                    hits.append((relpath, node.lineno, "labels",
-                                 ast.unparse(arg)))
-    return hits
-
-
-def _files() -> list[str]:
+    """Return (relpath, lineno, kind, source) violations — the
+    historical API, now answered by the framework pass."""
+    p = SpanVocabularyPass()
+    Engine([p], Config(root=ROOT, scan_roots=(relpath,),
+                       scopes={p.name: ("",)})).run()
     out = []
-    for entry in SCAN:
-        path = os.path.join(ROOT, entry)
-        if os.path.isfile(path):
-            out.append(entry)
-            continue
-        for dirpath, _, files in os.walk(path):
-            for fname in sorted(files):
-                if fname.endswith(".py"):
-                    out.append(os.path.relpath(
-                        os.path.join(dirpath, fname), ROOT))
+    for f in p.findings:
+        kind, sep, src = f.detail.partition("(")
+        if not sep:                      # e.g. syntax-error
+            kind, src = f.code, f.detail + ")"
+        out.append((f.path, f.lineno, kind, src[:-1]))
     return out
 
 
 def main() -> int:
-    violations = []
-    for rel in _files():
-        for relpath, lineno, kind, src in scan_file(rel):
-            if (relpath, kind, src) not in ALLOWLIST:
-                violations.append((relpath, lineno, kind, src))
-    if violations:
-        print("span/label cardinality violations — names and label "
-              "values must be fixed-vocabulary constants "
-              "(doc/tracing.md):")
-        for relpath, lineno, kind, src in violations:
-            print(f"  {relpath}:{lineno} {kind}({src})")
+    result = run_repo(pass_names=(SpanVocabularyPass.name,))
+    bad = result.new_findings
+    if bad or result.stale_baseline or result.unjustified:
+        if bad:
+            print("span/label cardinality violations — names and label "
+                  "values must be fixed-vocabulary constants "
+                  "(doc/tracing.md):")
+            for f in bad:
+                print(f"  {f.path}:{f.lineno} {f.detail}")
+        for stale in result.stale_baseline:
+            print(f"  stale baseline entry {stale['fingerprint']} "
+                  f"({stale.get('file')}) — violation fixed; delete it")
+        for uj in result.unjustified:
+            print(f"  unjustified baseline entry {uj['fingerprint']} "
+                  f"({uj.get('file')}) — add a justification")
         return 1
-    print(f"lint_spans: clean ({len(_files())} files)")
+    print(f"lint_spans: clean ({', '.join(SCAN)})")
     return 0
 
 

@@ -75,12 +75,21 @@ run_slice B tests/test_[g-z]*.py || exit $?
 # the fields Perfetto actually enforces (ph/ts/dur/pid/tid, flow-arrow
 # pairing and slice binding) plus corr-id flow connectivity — schema
 # drift fails the suite instead of silently rendering an empty
-# timeline.  The span-cardinality lint rides the same pass.
+# timeline.
 echo "trace-export schema pass"
 timeout 300 python tools/trace_export.py --selfcheck \
   || { echo "trace-export selfcheck failed"; exit 1; }
-timeout 300 python tools/lint_spans.py \
-  || { echo "span-cardinality lint failed"; exit 1; }
+
+# Static-analysis pass (doc/static_analysis.md): graftlint runs all six
+# passes — input-contract asserts, span/label cardinality, jit hygiene,
+# host-sync leaks in kernel builders, guarded-by lock discipline, and
+# the env-knob/metric registry cross-check — and fails on any finding
+# not baselined with a justification.  Subsumes the old standalone
+# lint_asserts/lint_spans scripts (still available as shims).  Stdlib
+# only, no jax import: the 300 s budget is pure headroom.
+echo "graftlint static-analysis pass"
+timeout 300 python tools/graftlint.py \
+  || { echo "graftlint failed"; exit 1; }
 
 # Fault-matrix pass (doc/resilience.md): re-run the resilience suite
 # with deterministic faults armed at every named device seam — dispatch
@@ -97,4 +106,4 @@ LIGHTNING_TPU_DEADLINE_ROUTE_S=120 \
 LIGHTNING_TPU_DEADLINE_INGEST_S=240 \
   timeout 1800 python -m pytest tests/test_zz_resilience.py -x -q \
   || { echo "fault-matrix pass failed"; exit 1; }
-echo "suite green (2 slices + fault matrix)"
+echo "suite green (2 slices + graftlint + fault matrix)"
