@@ -18,6 +18,7 @@ import time
 
 from .. import obs
 from ..gossip.gossmap import scid_str
+from ..resilience import overload as _overload
 
 log = logging.getLogger("lightning_tpu.jsonrpc")
 
@@ -42,12 +43,17 @@ INTERNAL_ERROR = -32603
 # lightning-specific
 RPC_ERROR = -1
 ROUTE_NOT_FOUND = 205
+# retryable overload rejection (doc/overload.md): the daemon is
+# saturated; the error data carries a retry_after_s hint.  429 after
+# HTTP Too Many Requests — no reference code collides with it.
+TRY_AGAIN = 429
 
 
 class RpcError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, data: dict | None = None):
         super().__init__(message)
         self.code = code
+        self.data = data
 
 
 class JsonRpcServer:
@@ -262,7 +268,14 @@ class JsonRpcServer:
             return {"jsonrpc": "2.0", "id": rid, "result": result}
         except RpcError as e:
             status = "rpc_error"
-            return _err(rid, e.code, str(e))
+            return _err(rid, e.code, str(e), e.data)
+        except _overload.Overloaded as e:
+            # admission control (doc/overload.md): a saturated service
+            # REJECTS retryably instead of queueing unboundedly; the
+            # data field carries the drain-rate-derived retry hint
+            status = "try_again"
+            return _err(rid, TRY_AGAIN, str(e),
+                        {"retry_after_s": round(e.retry_after_s, 3)})
         except TypeError as e:
             status = "invalid_params"
             return _err(rid, INVALID_PARAMS, str(e))
@@ -276,9 +289,11 @@ class JsonRpcServer:
                 time.perf_counter() - t0)
 
 
-def _err(rid, code: int, message: str) -> dict:
-    return {"jsonrpc": "2.0", "id": rid,
-            "error": {"code": code, "message": message}}
+def _err(rid, code: int, message: str, data: dict | None = None) -> dict:
+    err = {"code": code, "message": message}
+    if data is not None:
+        err["data"] = data
+    return {"jsonrpc": "2.0", "id": rid, "error": err}
 
 
 def _err_bytes(rid, code: int, message: str) -> bytes:
@@ -857,15 +872,18 @@ def attach_admin_commands(rpc: JsonRpcServer, cfg, ring) -> None:
         endpoint renders; doc/observability.md for the naming scheme),
         plus a `resilience` section (live circuit-breaker states for
         every dispatch family and any armed fault-injection specs,
-        doc/resilience.md) and a `dispatches` section (per-family
+        doc/resilience.md), a `dispatches` section (per-family
         flight-ring occupancy + the latest DispatchRecord,
-        doc/tracing.md)."""
+        doc/tracing.md), and an `overload` section (degradation-ladder
+        states, watermarks, shed counts and the recent shed ring,
+        doc/overload.md)."""
         from ..obs import flight
-        from ..resilience import resilience_snapshot
+        from ..resilience import overload, resilience_snapshot
 
         snap = obs.snapshot()
         snap["resilience"] = resilience_snapshot()
         snap["dispatches"] = flight.summary()
+        snap["overload"] = overload.snapshot()
         return snap
 
     async def listdispatches(family: str | None = None,

@@ -1382,6 +1382,15 @@ class ChannelManager:
                 if best is None or src_amount < best[1]:
                     best = (cand, src_amount, src_cltv, tail)
             if best is None:
+                from ..resilience import overload as _ovl
+
+                for res in solved:
+                    if isinstance(res, _ovl.Overloaded):
+                        # the route service refused admission: this is
+                        # retryable saturation, NOT "no route" — let it
+                        # propagate so the RPC layer answers TRY_AGAIN
+                        # with the retry-after hint (doc/overload.md)
+                        raise res
                 raise ManagerError("no route to destination")
             cand, src_amount, src_cltv, tail = best
             ch = cand

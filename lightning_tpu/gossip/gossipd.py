@@ -70,7 +70,10 @@ class Gossipd:
         self.ingest = GossipIngest(
             store_path, utxo_check=utxo_check, flush_ms=flush_ms,
             flush_size=flush_size, bucket=bucket,
-            on_accept=self._on_accept)
+            on_accept=self._on_accept,
+            # own-node/own-channel gossip sheds LAST under overload
+            # (doc/overload.md priority classes)
+            own_node_id=getattr(node, "node_id", None))
         # raw message cache for query replies (the store is the durable
         # copy; this is the reference's gossmap offset index role)
         self.msgs: dict[int, dict] = {}       # scid -> {ca, cu0, cu1}
@@ -154,6 +157,15 @@ class Gossipd:
     # -- ingest + fan-out -------------------------------------------------
 
     async def _on_gossip(self, peer, raw: bytes) -> None:
+        # backpressure propagation (doc/overload.md): while the ingest
+        # backlog is saturated this await pauses THIS peer's read pump
+        # (the pump awaits its raw handler), so we stop draining the
+        # socket and TCP pushes back on the sender instead of us
+        # buffering its storm.  Bounded per message and released for
+        # every peer together when the backlog drains — no peer
+        # starves, and messages that still arrive saturated are shed
+        # by priority inside submit(), metered, never silently lost.
+        await self.ingest.wait_capacity()
         await self.ingest.submit(raw, source=peer.node_id)
 
     def _on_accept(self, raw: bytes, source) -> None:

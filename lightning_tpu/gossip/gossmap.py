@@ -24,6 +24,11 @@ from ..utils import native
 from . import wire
 from .store import StoreIndex
 
+# bounded (channel, direction) change log capacity: past this the log
+# halves (oldest entries dropped) and consumers whose cursor fell off
+# the base do one full parameter refresh instead of a patch
+_PARAM_LOG_CAP = 4096
+
 
 def scid_str(scid: int) -> str:
     """Display form BLOCKxTXxOUT (the reference's short_channel_id fmt)."""
@@ -69,6 +74,13 @@ class Gossmap:
     # _build_adjacency per message on the event loop — readers call
     # ensure_adjacency() and the batch costs ONE rebuild
     _adjacency_dirty: bool = False
+    # bounded (channel_index, direction) log of accepted updates since
+    # construction: RoutePlanes consumers keep a cursor into it and
+    # patch ONLY the touched edge lanes on a params bump instead of
+    # re-deriving (and re-uploading) every plane — the incremental
+    # maintenance path for channel_update bursts (doc/overload.md)
+    _param_log: list = field(default_factory=list)
+    _param_log_base: int = 0
 
     @property
     def n_nodes(self) -> int:
@@ -163,7 +175,28 @@ class Gossmap:
             self._adjacency_dirty = True
             self.topology_version += 1
         self.params_version += 1
+        # change log for incremental plane patching; bounded — on
+        # overflow the oldest half drops and stale cursors fall back
+        # to a full refresh (param_entries_since returns None)
+        self._param_log.append((c, d))
+        if len(self._param_log) > _PARAM_LOG_CAP:
+            drop = len(self._param_log) - _PARAM_LOG_CAP // 2
+            del self._param_log[:drop]
+            self._param_log_base += drop
         return True
+
+    @property
+    def param_log_pos(self) -> int:
+        """Cursor value covering every update logged so far."""
+        return self._param_log_base + len(self._param_log)
+
+    def param_entries_since(self, pos: int) -> list | None:
+        """(channel_index, direction) pairs accepted since cursor
+        `pos`, or None when the log no longer reaches back that far
+        (the caller must do a full parameter refresh)."""
+        if pos < self._param_log_base:
+            return None
+        return self._param_log[pos - self._param_log_base:]
 
     # -- views (plugins/topology.c:270 listchannels / :408 listnodes) -----
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -26,12 +27,24 @@ import numpy as np
 from .. import obs
 from ..obs import families as _families
 from ..resilience import deadline as _deadline
+from ..resilience import overload as _overload
 from ..utils import events, native, trace
 from . import store as gstore
 from . import verify as gverify
 from . import wire
 
 log = logging.getLogger("lightning_tpu.gossip.ingest")
+
+# bounded-queue watermarks, in SIGNATURES (doc/overload.md): the queue
+# sheds by priority at the high watermark and transport backpressure
+# releases below the low one.  LOW_WM=0 means "half of high".
+INGEST_HIGH_WM = int(os.environ.get("LIGHTNING_TPU_INGEST_HIGH_WM",
+                                    "4096"))
+INGEST_LOW_WM = (int(os.environ.get("LIGHTNING_TPU_INGEST_LOW_WM", "0"))
+                 or INGEST_HIGH_WM // 2)
+# pending-map bound (messages HELD for a missing channel, not queued):
+# an adversarial storm of orphan updates must not grow memory either
+PENDING_CAP = max(1024, INGEST_HIGH_WM)
 
 _M_FLUSH_SECONDS = obs.histogram(
     "clntpu_gossip_flush_seconds",
@@ -49,6 +62,7 @@ _M_DROPPED = obs.counter(
 _M_QUEUE = obs.gauge(
     "clntpu_gossip_queue_sigs",
     "Signatures currently queued awaiting a verify flush")
+_M_BACKLOG = _families.INGEST_BACKLOG
 _M_FLUSH_ERRORS = _families.INGEST_FLUSH_ERRORS
 
 # Drop reasons (observable in tests/metrics).
@@ -60,6 +74,9 @@ R_NO_UTXO = "utxo_check_failed"
 R_RATELIMIT = "ratelimited"
 R_MALFORMED = "malformed"
 R_FLUSH_ERROR = "flush_error"         # batch lost to a flush exception
+R_SHED = "shed_overload"              # priority-shed at the watermark
+                                      # (metered in clntpu_shed_total +
+                                      # the shed ring, doc/overload.md)
 
 # BOLT#7 suggests limiting spammy channel_updates; the reference tracks
 # per-channel tokens.  We allow a burst then 1 update per interval.
@@ -78,6 +95,24 @@ class _QItem:
     # this message's enqueue span to the flush/dispatch spans that
     # eventually verify it, across the to_thread hop (doc/tracing.md)
     corr: object = None
+
+
+def _shed_key(kind: int, parsed) -> dict:
+    """Message identity recorded with every shed (doc/overload.md):
+    the re-request key — a shed scid can be re-fetched later via
+    query_short_channel_ids, a node id via its next announcement —
+    and the exact-subset key loadgen's replay-parity check matches on."""
+    if kind == wire.MSG_CHANNEL_ANNOUNCEMENT:
+        return {"kind": "channel_announcement",
+                "scid": int(parsed.short_channel_id)}
+    if kind == wire.MSG_CHANNEL_UPDATE:
+        return {"kind": "channel_update",
+                "scid": int(parsed.short_channel_id),
+                "direction": int(parsed.direction),
+                "timestamp": int(parsed.timestamp)}
+    return {"kind": "node_announcement",
+            "node_id": parsed.node_id.hex(),
+            "timestamp": int(parsed.timestamp)}
 
 
 @dataclass
@@ -100,12 +135,28 @@ class GossipIngest:
                  flush_size: int = 256, flush_ms: float = 2.0,
                  bucket: int = gverify.DEFAULT_BUCKET,
                  replay_depth: int | None = None,
-                 on_accept=None, now=time.monotonic):
+                 on_accept=None, now=time.monotonic,
+                 own_node_id: bytes | None = None,
+                 high_wm: int | None = None, low_wm: int | None = None,
+                 pending_cap: int | None = None):
         self.writer = gstore.StoreWriter(store_path)
         self.utxo_check = utxo_check      # async (scid)->sat|None, or None
         self.flush_size = flush_size
         self.flush_ms = flush_ms
         self.bucket = bucket
+        # overload control (doc/overload.md): bounded queue with
+        # priority shedding; own-node/own-channel traffic (keyed on
+        # own_node_id) sheds last.  The breaker family is "verify" —
+        # an open verify breaker slows the drain, so the retry hints
+        # and the ladder snapshot consult it.
+        self.own_node_id = own_node_id
+        self.pending_cap = PENDING_CAP if pending_cap is None \
+            else pending_cap
+        self.overload = _overload.controller(
+            "ingest",
+            high_wm if high_wm is not None else INGEST_HIGH_WM,
+            low_wm if low_wm is not None else INGEST_LOW_WM,
+            breaker_family="verify", now=now)
         # prepared-bucket pipeline depth for the verify flush (None =
         # verify_items' default double-buffering; catch-up syncs whose
         # flushes span many buckets overlap host pack with device
@@ -129,6 +180,8 @@ class GossipIngest:
 
         self._queue: list[_QItem] = []
         self._queued_sigs = 0
+        self._inflight_sigs = 0          # popped batch being verified
+        self._pending_held = 0           # entries across both pending maps
         self._flush_due: float | None = None
         self._wakeup = asyncio.Event()
         self._task: asyncio.Task | None = None
@@ -157,6 +210,15 @@ class GossipIngest:
 
     # -- submission -------------------------------------------------------
 
+    async def wait_capacity(self, max_wait: float | None = None) -> float:
+        """Transport-side backpressure point (doc/overload.md): while
+        the ingest backlog is saturated, pause the calling read pump —
+        bounded per message, every waiter released together once the
+        backlog drains below the low watermark.  Gossipd awaits this
+        before submitting each peer message, which stops that peer's
+        socket reads and lets TCP push back on the remote."""
+        return await self.overload.wait_capacity(max_wait)
+
     async def submit(self, raw: bytes, source=None) -> None:
         """Queue one raw gossip message for verification.  The submit
         span is the message's enqueue point: the correlation carrier
@@ -175,22 +237,74 @@ class GossipIngest:
             kind = wire.msg_type(raw)
             if not self._precheck(kind, parsed, raw, source):
                 return
+            # overload admission, deliberately BEFORE the ratelimiter:
+            # a shed message must not spend a ratelimit token, or an
+            # unthrottled replay of the non-shed subset would see a
+            # different token state and accept a different set — the
+            # bit-identical-replay contract tools/loadgen.py asserts
+            prio = self._priority(kind, parsed)
             n_sigs = 4 if kind == wire.MSG_CHANNEL_ANNOUNCEMENT else 1
+            if not self.overload.admit(prio, n_sigs):
+                self.stats.drop(R_SHED)
+                self.overload.shed(prio, "queue_full",
+                                   **_shed_key(kind, parsed))
+                return
+            if kind == wire.MSG_CHANNEL_UPDATE and not self._ratelimit_ok(
+                    (parsed.short_channel_id, parsed.direction)):
+                self.stats.drop(R_RATELIMIT)
+                return
             self._queue.append(_QItem(kind, parsed, raw, source, n_sigs,
                                       corr=trace.new_corr()))
             self._queued_sigs += n_sigs
-        _M_QUEUE.set(self._queued_sigs)
+        self._note_backlog()
         if self._flush_due is None:
-            self._flush_due = self.now() + self.flush_ms / 1000.0
+            # adaptive flush window: the latency budget stretches as
+            # pressure rises (throughput over latency under load)
+            self._flush_due = self.now() + self.overload.window_s(
+                self.flush_ms)
             # the loop may be parked on an indefinite wait — rearm it so
             # it recomputes its timeout against the new deadline
             self._wakeup.set()
-        if self._queued_sigs >= self.flush_size:
+        if self._queued_sigs >= self._flush_threshold():
             self._wakeup.set()
+
+    def _flush_threshold(self) -> int:
+        """Adaptive size trigger: flush_size when calm, widening toward
+        flush_size * LIGHTNING_TPU_FLUSH_WIDEN as the backlog climbs —
+        bigger batches amortize dispatch overhead exactly when the
+        storm makes overhead matter (doc/overload.md)."""
+        return self.overload.flush_target(self.flush_size)
+
+    def _note_backlog(self) -> None:
+        _M_QUEUE.set(self._queued_sigs)
+        _M_BACKLOG.set(self._queued_sigs + self._inflight_sigs)
+        self.overload.update(self._queued_sigs, self._inflight_sigs)
+
+    def _priority(self, kind: int, parsed) -> int:
+        """Shed-priority classes (doc/overload.md): own-node/own-channel
+        traffic sheds last, fresh third-party channel data next, node
+        announcements first."""
+        own = self.own_node_id
+        if kind == wire.MSG_CHANNEL_ANNOUNCEMENT:
+            if own is not None and own in (parsed.node_id_1,
+                                           parsed.node_id_2):
+                return _overload.PRIO_OWN
+            return _overload.PRIO_FRESH
+        if kind == wire.MSG_CHANNEL_UPDATE:
+            if own is not None and own in self.channels.get(
+                    parsed.short_channel_id, ()):
+                return _overload.PRIO_OWN
+            return _overload.PRIO_FRESH
+        # node_announcement
+        if own is not None and parsed.node_id == own:
+            return _overload.PRIO_OWN
+        return _overload.PRIO_BULK
 
     def _precheck(self, kind: int, parsed, raw: bytes, source) -> bool:
         """Cheap host-side dedup BEFORE paying for signature checks
-        (gossmap_manage.c does the same ordering)."""
+        (gossmap_manage.c does the same ordering).  Stateful gates
+        (ratelimit, overload admission) live in submit(), after this
+        purely content-keyed screen."""
         if kind == wire.MSG_CHANNEL_ANNOUNCEMENT:
             if parsed.short_channel_id in self.channels:
                 self.stats.drop(R_DUP)
@@ -204,17 +318,31 @@ class GossipIngest:
                 # can't verify yet — the signer is node[direction] of a
                 # channel we don't know.  Hold latest per direction
                 # (gossmap_manage's pending_cupdates), re-submitted when
-                # the channel_announcement lands.
-                held = self.pending_updates.setdefault(
-                    parsed.short_channel_id, {})
-                prev = held.get(parsed.direction)
-                if prev is None or prev.parsed.timestamp < parsed.timestamp:
+                # the channel_announcement lands.  The pending maps are
+                # bounded too: past the cap, NEW keys shed (metered)
+                # instead of growing without limit.
+                held = self.pending_updates.get(parsed.short_channel_id)
+                prev = held.get(parsed.direction) if held else None
+                if prev is None:
+                    if self._pending_held >= self.pending_cap:
+                        # classify honestly for the shed record.  (An
+                        # own-channel update is indistinguishable here —
+                        # the channel's endpoints are exactly what we
+                        # don't know yet — so it classifies "fresh";
+                        # the shed ring still makes it re-requestable.)
+                        self.stats.drop(R_SHED)
+                        self.overload.shed(self._priority(kind, parsed),
+                                           "pending_cap",
+                                           **_shed_key(kind, parsed))
+                        return False
+                    self.pending_updates.setdefault(
+                        parsed.short_channel_id, {})[parsed.direction] = \
+                        _QItem(kind, parsed, raw, source, 1)
+                    self._pending_held += 1
+                elif prev.parsed.timestamp < parsed.timestamp:
                     held[parsed.direction] = _QItem(
                         kind, parsed, raw, source, 1)
                 self.stats.drop(R_NO_CHANNEL)
-                return False
-            if not self._ratelimit_ok(key):
-                self.stats.drop(R_RATELIMIT)
                 return False
         elif kind == wire.MSG_NODE_ANNOUNCEMENT:
             if self.nodes.get(parsed.node_id, -1) >= parsed.timestamp:
@@ -278,7 +406,7 @@ class GossipIngest:
             self._wakeup.clear()
             return
         timeout = self._flush_due - self.now()
-        if timeout > 0 and self._queued_sigs < self.flush_size:
+        if timeout > 0 and self._queued_sigs < self._flush_threshold():
             try:
                 await asyncio.wait_for(self._wakeup.wait(), timeout)
             except asyncio.TimeoutError:
@@ -298,10 +426,13 @@ class GossipIngest:
         """Verify everything queued in one batched device dispatch, then
         apply accepted messages in arrival order."""
         batch, self._queue = self._queue, []
+        n_sigs = self._queued_sigs
         self._queued_sigs = 0
         self._flush_due = None
-        _M_QUEUE.set(0)
+        self._inflight_sigs = n_sigs
+        self._note_backlog()
         if not batch:
+            self._inflight_sigs = 0
             return
         self._flushing = True
         t0 = time.perf_counter()
@@ -316,7 +447,14 @@ class GossipIngest:
             raise
         finally:
             self._flushing = False
-            _M_FLUSH_SECONDS.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            _M_FLUSH_SECONDS.observe(dt)
+            self._inflight_sigs = 0
+            # drain-rate feedback for the overload retry hints, then
+            # publish the post-flush backlog (wakes backpressure
+            # waiters if we fell below the low watermark)
+            self.overload.note_drain(n_sigs, dt)
+            self._note_backlog()
 
     async def _flush_batch(self, batch: list[_QItem]) -> None:
         corrs = [it.corr for it in batch if it.corr is not None]
@@ -385,11 +523,14 @@ class GossipIngest:
             self._channeled_nodes.update((p.node_id_1, p.node_id_2))
             self._accept(it)
             # drain pendings now satisfiable
-            for q in self.pending_updates.pop(scid, {}).values():
+            drained = self.pending_updates.pop(scid, {})
+            self._pending_held -= len(drained)
+            for q in drained.values():
                 await self.submit(q.raw, q.source)
             for nid in (p.node_id_1, p.node_id_2):
                 q = self.pending_nodes.pop(nid, None)
                 if q is not None:
+                    self._pending_held -= 1
                     await self.submit(q.raw, q.source)
         elif kind == wire.MSG_CHANNEL_UPDATE:
             scid, d = p.short_channel_id, p.direction
@@ -402,7 +543,23 @@ class GossipIngest:
             nid = p.node_id
             if nid not in self._channeled_nodes:
                 prev = self.pending_nodes.get(nid)
-                if prev is None or prev.parsed.timestamp < p.timestamp:
+                if prev is None:
+                    # held-map bound, same contract as pending_updates
+                    # (this one post-verify: the signature was real, but
+                    # an orphan-NA flood must still not grow memory).
+                    # OWN node announcements are exempt: they are
+                    # intrinsically bounded (one node) and the
+                    # own-sheds-last contract must hold here too.
+                    prio = self._priority(kind, p)
+                    if prio != _overload.PRIO_OWN and \
+                            self._pending_held >= self.pending_cap:
+                        self.stats.drop(R_SHED)
+                        self.overload.shed(prio, "pending_cap",
+                                           **_shed_key(kind, p))
+                        return
+                    self.pending_nodes[nid] = it
+                    self._pending_held += 1
+                elif prev.parsed.timestamp < p.timestamp:
                     self.pending_nodes[nid] = it
                 self.stats.drop(R_NO_CHANNEL)
                 return

@@ -96,6 +96,36 @@ INGEST_FLUSH_ERRORS = REGISTRY.counter(
     "GossipIngest flush-loop iterations that raised (the loop restarts "
     "with backoff instead of dying silently)")
 
+# -- resilience/overload.py: overload control (doc/overload.md) ------------
+SHED = REGISTRY.counter(
+    "clntpu_shed_total",
+    "Messages/queries shed by the overload controller, by family, "
+    "priority class, and reason (every shed is also recorded in the "
+    "shed ring — never silently dropped)",
+    labelnames=("family", "priority", "reason"))
+OVERLOAD_STATE = REGISTRY.gauge(
+    "clntpu_overload_state",
+    "Degradation-ladder state per dispatch family "
+    "(0 = normal, 1 = elevated, 2 = saturated)",
+    labelnames=("family",))
+OVERLOAD_TRANSITIONS = REGISTRY.counter(
+    "clntpu_overload_transitions_total",
+    "Degradation-ladder transitions, by family and target state",
+    labelnames=("family", "to"))
+BACKPRESSURE_WAITS = REGISTRY.counter(
+    "clntpu_backpressure_waits_total",
+    "Transport read pauses taken because the family was saturated "
+    "(one per paused message, bounded per wait)",
+    labelnames=("family",))
+BACKPRESSURE_WAIT_SECONDS = REGISTRY.histogram(
+    "clntpu_backpressure_wait_seconds",
+    "Seconds a saturated family paused one transport read",
+    labelnames=("family",), buckets=DURATION_BUCKETS)
+INGEST_BACKLOG = REGISTRY.gauge(
+    "clntpu_ingest_backlog_sigs",
+    "Total unverified ingest backlog: queued signatures plus the "
+    "in-flight flush batch (the queue gauge counts only queued)")
+
 # -- obs/flight.py: the dispatch flight recorder (doc/tracing.md) ----------
 DISPATCHES = REGISTRY.counter(
     "clntpu_dispatches_total",

@@ -15,6 +15,11 @@ package is the common machinery:
                      event instead of a silent stall;
 * ``quarantine``   — host-side bisection of a poisoned batch: isolate
                      the offending rows, complete the remainder;
+* ``overload``     — watermarked backlog control shared by the flush
+                     queues: degradation ladder, priority-aware load
+                     shedding, adaptive flush widening, transport
+                     backpressure, TRY_AGAIN admission control
+                     (doc/overload.md);
 * ``faultinject``  — deterministic fault injectors at named seams
                      (``LIGHTNING_TPU_FAULT=dispatch:verify:raise:0.1``)
                      driving the scripted fault matrix in
@@ -26,7 +31,8 @@ families without paying the crypto-stack import.
 """
 from __future__ import annotations
 
-from . import breaker, deadline, faultinject, quarantine  # noqa: F401
+from . import (breaker, deadline, faultinject,  # noqa: F401
+               overload, quarantine)
 
 # the canonical dispatch families every daemon has (a breaker exists
 # for each even before its first dispatch, so getmetrics' resilience
@@ -46,3 +52,4 @@ def resilience_snapshot() -> dict:
 def reset_for_tests() -> None:
     breaker.reset_for_tests()
     faultinject.reset_for_tests()
+    overload.reset_for_tests()

@@ -1,0 +1,362 @@
+"""Unified overload control for the batched-dispatch consumers.
+
+Every queue in front of a batched device dispatch — GossipIngest's
+signature queue, RouteService's query queue — used to be unbounded: a
+sustained gossip storm or an RPC flood grew memory without limit and
+destroyed tail latency instead of degrading gracefully.  This module is
+the shared answer (doc/overload.md):
+
+* **Watermarks + degradation ladder.**  Each consumer registers an
+  ``OverloadController`` with a low and a high watermark over its
+  backlog (queued + in-flight work units).  The ladder state — NORMAL
+  below the low watermark, ELEVATED between the two, SATURATED at or
+  above the high one — is a first-class observable:
+  ``clntpu_overload_state{family}``, transition counters, an
+  ``overload_state`` events topic, and a ``getmetrics`` overload
+  section.
+
+* **Adaptive flush window.**  As pressure rises the controller widens
+  the consumer's flush trigger (size threshold and latency budget)
+  from its base toward ``base * LIGHTNING_TPU_FLUSH_WIDEN``: batches
+  grow exactly when dispatch overhead matters most, amortizing the
+  fixed per-dispatch cost against the storm.
+
+* **Priority-aware load shedding.**  At the high watermark, admission
+  becomes priority-ordered: own-node/own-channel updates (PRIO_OWN)
+  outrank fresh third-party channel updates and announcements
+  (PRIO_FRESH), which outrank node announcements and other redundant
+  traffic (PRIO_BULK).  Lower priorities shed first; each higher class
+  keeps one more ``high_wm // 4`` band of headroom, so the queue is
+  hard-bounded at ``high_wm + 2 * (high_wm // 4)``.  Every shed is
+  metered (``clntpu_shed_total{family,priority,reason}``) AND recorded
+  in a bounded shed ring carrying the message identity — shed traffic
+  is re-requestable (a peer can be re-queried for the scids), never
+  silently dropped.
+
+* **Backpressure propagation.**  ``wait_capacity()`` gives transports a
+  bounded, fair pause point: while the family is SATURATED a caller
+  (the per-peer gossip read path) waits — at most
+  ``LIGHTNING_TPU_BACKPRESSURE_MAX_S`` per message, every waiter woken
+  together when the backlog drains below the low watermark — so socket
+  reads stop and TCP pushes back on the remote instead of us buffering
+  its storm.
+
+* **Admission control.**  ``Overloaded`` is the retryable rejection:
+  RouteService raises it once its queue crosses the high watermark and
+  the JSON-RPC layer maps it to a ``TRY_AGAIN`` error carrying a
+  ``retry_after_s`` hint derived from the observed drain rate (doubled
+  while the family's circuit breaker is open — the host fallback
+  drains slower).
+
+Deliberately jax-free (like the rest of ``resilience``): hot paths
+import it at module scope and exposition-only consumers reach the
+snapshot without the crypto stack.
+
+Determinism contract: admission compares the backlog snapshot (queued
++ in-flight) against per-priority limits — a pure function of observed
+state at submit time.  A scripted storm that submits without yielding
+to the event loop keeps in-flight at zero, so its shed set is a pure
+function of the storm content: the property
+tests/test_zz_overload.py pins bare and under the fault matrix.  Live
+sheds additionally depend on flush timing, but every shed is metered
+AND ring-recorded, and the replay-parity invariant (the accepted set
+equals an unthrottled replay of the non-shed subset) is
+timing-independent — tools/loadgen.py asserts it on every soak.
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import os
+import time
+
+from ..obs import families as _f
+from ..utils import events
+
+# -- knobs (doc/overload.md; registry-sync keeps doc/knobs.md honest) ------
+# max widening factor for flush size/window under full pressure
+FLUSH_WIDEN = int(os.environ.get("LIGHTNING_TPU_FLUSH_WIDEN", "8"))
+# bounded per-message transport pause while saturated
+BACKPRESSURE_MAX_S = float(
+    os.environ.get("LIGHTNING_TPU_BACKPRESSURE_MAX_S", "0.25"))
+# shed ring capacity (loadgen/selfcheck raise this to capture every shed)
+SHED_RING = int(os.environ.get("LIGHTNING_TPU_SHED_RING", "1024"))
+
+# -- ladder states ---------------------------------------------------------
+NORMAL, ELEVATED, SATURATED = 0, 1, 2
+STATE_NAMES = ("normal", "elevated", "saturated")
+
+# -- priorities (lower value = more important, sheds last) -----------------
+PRIO_OWN, PRIO_FRESH, PRIO_BULK, PRIO_QUERY = 0, 1, 2, 3
+PRIO_NAMES = ("own", "fresh", "bulk", "query")
+
+_M_SHED = _f.SHED
+_M_STATE = _f.OVERLOAD_STATE
+_M_TRANSITIONS = _f.OVERLOAD_TRANSITIONS
+_M_BP_WAITS = _f.BACKPRESSURE_WAITS
+_M_BP_SECONDS = _f.BACKPRESSURE_WAIT_SECONDS
+
+
+class Overloaded(RuntimeError):
+    """Retryable admission rejection: the consumer's backlog is past its
+    high watermark.  The JSON-RPC layer maps this to TRY_AGAIN with the
+    ``retry_after_s`` hint in the error data."""
+
+    def __init__(self, family: str, retry_after_s: float, backlog: int):
+        super().__init__(
+            f"{family} overloaded (backlog {backlog}); "
+            f"retry in {retry_after_s:.2f}s")
+        self.family = family
+        self.retry_after_s = retry_after_s
+        self.backlog = backlog
+
+
+class _ShedRecord(dict):
+    """One shed message (a plain dict; class only for isinstance tests)."""
+
+
+class OverloadController:
+    """Watermarked backlog supervision for one dispatch family."""
+
+    def __init__(self, family: str, high_wm: int, low_wm: int = 0, *,
+                 breaker_family: str | None = None,
+                 now=time.monotonic):
+        if high_wm <= 0:
+            raise ValueError("high watermark must be positive")
+        self.family = family
+        self.high_wm = int(high_wm)
+        self.low_wm = int(low_wm) or max(1, self.high_wm // 2)
+        if self.low_wm > self.high_wm:
+            raise ValueError("low watermark above high watermark")
+        # the breaker whose open state slows this family's drain (the
+        # ladder is wired into the breaker machinery through the
+        # retry-after hint and the snapshot)
+        self.breaker_family = breaker_family or family
+        self.now = now
+        self._headroom = max(1, self.high_wm // 4)
+        self.hard_cap = self.high_wm + 2 * self._headroom
+        self.pending = 0         # queued units (admission input)
+        self.inflight = 0        # units inside a running flush
+        self.peak_backlog = 0
+        self.state = NORMAL
+        self.shed_counts: dict[tuple[str, str], int] = {}
+        # drain-rate EWMA (units/second) feeding the retry-after hint
+        self._drain_rate = 0.0
+        self._drained = asyncio.Event()
+        self._drained.set()
+        _M_STATE.labels(family).set(NORMAL)
+
+    # -- backlog + ladder --------------------------------------------------
+
+    def update(self, pending: int, inflight: int = 0) -> None:
+        """Report the consumer's current queue occupancy.  Transitions
+        the ladder, wakes backpressure waiters on drain."""
+        self.pending = pending
+        self.inflight = inflight
+        total = pending + inflight
+        if total > self.peak_backlog:
+            self.peak_backlog = total
+        if total >= self.high_wm:
+            state = SATURATED
+        elif total >= self.low_wm:
+            # hysteresis: once saturated, stay saturated until the
+            # backlog falls below the LOW watermark (no flapping)
+            state = SATURATED if self.state == SATURATED else ELEVATED
+        else:
+            state = NORMAL
+        if state != self.state:
+            self.state = state
+            _M_STATE.labels(self.family).set(state)
+            _M_TRANSITIONS.labels(self.family, STATE_NAMES[state]).inc()
+            events.emit("overload_state",
+                        {"family": self.family,
+                         "state": STATE_NAMES[state],
+                         "backlog": total})
+        if total < self.low_wm:
+            self._drained.set()
+        elif state == SATURATED:
+            self._drained.clear()
+
+    # -- admission / shedding ---------------------------------------------
+
+    def _limit(self, priority: int) -> int:
+        """Queue depth past which `priority` sheds: each class above
+        BULK keeps one more headroom band; nothing queues past the
+        hard cap."""
+        if priority <= PRIO_OWN:
+            return self.hard_cap
+        if priority == PRIO_FRESH:
+            return self.high_wm + self._headroom
+        return self.high_wm
+
+    def admit(self, priority: int, n: int = 1) -> bool:
+        """Admission against the full backlog snapshot (queued +
+        in-flight): work inside a running flush still occupies memory
+        and drain capacity, so it counts — the queue cannot quietly
+        refill to the watermark while a long flush is out.  ``n`` is
+        the candidate's unit weight (a channel_announcement is 4
+        signatures): the post-admission backlog must stay within the
+        limit, so the hard cap is a true bound, not cap + weight - 1.
+        See the module docstring's determinism contract."""
+        return self.pending + self.inflight + n <= self._limit(priority)
+
+    def shed(self, priority: int, reason: str, **key) -> None:
+        """Meter + flight-record one shed message.  ``key`` carries the
+        message identity (kind/scid/node_id/timestamp...) so shed
+        traffic is re-requestable and a replay harness can reconstruct
+        the non-shed subset exactly."""
+        pname = PRIO_NAMES[priority]
+        _M_SHED.labels(self.family, pname, reason).inc()
+        k = (pname, reason)
+        self.shed_counts[k] = self.shed_counts.get(k, 0) + 1
+        rec = _ShedRecord(family=self.family, priority=pname,
+                          reason=reason)
+        rec.update(key)
+        _shed_ring.append(rec)
+
+    # -- adaptive flush widening ------------------------------------------
+
+    def _pressure(self) -> float:
+        """0.0 at/below the low watermark, 1.0 at/above the high one."""
+        total = self.pending + self.inflight
+        if total <= self.low_wm:
+            return 0.0
+        span = max(1, self.high_wm - self.low_wm)
+        return min(1.0, (total - self.low_wm) / span)
+
+    def widen_factor(self) -> float:
+        """1.0 when calm, up to FLUSH_WIDEN under full pressure."""
+        return 1.0 + self._pressure() * (max(1, FLUSH_WIDEN) - 1)
+
+    def flush_target(self, base: int) -> int:
+        """The consumer's adaptive size trigger: batches widen from
+        ``base`` toward ``base * FLUSH_WIDEN`` as pressure rises,
+        amortizing per-dispatch overhead exactly when it matters."""
+        return max(1, int(base * self.widen_factor()))
+
+    def window_s(self, base_ms: float) -> float:
+        """The adaptive latency budget (seconds) for the flush window —
+        stretched under pressure for the same reason as flush_target."""
+        return base_ms * self.widen_factor() / 1000.0
+
+    # -- backpressure ------------------------------------------------------
+
+    async def wait_capacity(self, max_wait: float | None = None) -> float:
+        """Pause the caller while this family is SATURATED: a bounded,
+        fair transport-side backpressure point.  Returns the seconds
+        actually waited.  Every waiter is released together when the
+        backlog drains below the low watermark; the per-call bound
+        (default LIGHTNING_TPU_BACKPRESSURE_MAX_S) keeps a saturated
+        steady state from starving any peer forever."""
+        if self.state != SATURATED:
+            return 0.0
+        bound = BACKPRESSURE_MAX_S if max_wait is None else max_wait
+        _M_BP_WAITS.labels(self.family).inc()
+        t0 = self.now()
+        try:
+            await asyncio.wait_for(self._drained.wait(), bound)
+        except asyncio.TimeoutError:
+            pass
+        waited = max(0.0, self.now() - t0)
+        _M_BP_SECONDS.labels(self.family).observe(waited)
+        return waited
+
+    # -- drain-rate / retry hint ------------------------------------------
+
+    def note_drain(self, units: int, seconds: float) -> None:
+        """Feed one completed flush into the drain-rate EWMA."""
+        if units <= 0 or seconds <= 0:
+            return
+        rate = units / seconds
+        self._drain_rate = (rate if self._drain_rate == 0.0
+                            else 0.7 * self._drain_rate + 0.3 * rate)
+
+    def retry_after_s(self) -> float:
+        """How long a rejected caller should wait before retrying:
+        backlog over the observed drain rate, clamped to [0.05, 5]s,
+        doubled while this family's circuit breaker is open (the host
+        fallback drains slower than the device path)."""
+        total = self.pending + self.inflight
+        if self._drain_rate > 0:
+            hint = total / self._drain_rate
+        else:
+            hint = 0.1
+        hint = min(5.0, max(0.05, hint))
+        from . import breaker as _breaker
+
+        if _breaker.get(self.breaker_family).state == "open":
+            hint = min(10.0, hint * 2)
+        return hint
+
+    def overloaded(self) -> Overloaded:
+        """The admission rejection for this family, hint included."""
+        return Overloaded(self.family, self.retry_after_s(),
+                          self.pending + self.inflight)
+
+    # -- exposition --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        from . import breaker as _breaker
+
+        return {
+            "state": STATE_NAMES[self.state],
+            "backlog": self.pending + self.inflight,
+            "pending": self.pending,
+            "inflight": self.inflight,
+            "peak_backlog": self.peak_backlog,
+            "low_wm": self.low_wm,
+            "high_wm": self.high_wm,
+            "hard_cap": self.hard_cap,
+            "widen_factor": round(self.widen_factor(), 3),
+            "drain_rate_per_s": round(self._drain_rate, 3),
+            "retry_after_s": round(self.retry_after_s(), 3),
+            "breaker": _breaker.get(self.breaker_family).state,
+            "shed": {f"{p}:{r}": n
+                     for (p, r), n in sorted(self.shed_counts.items())},
+        }
+
+
+# -- module registry -------------------------------------------------------
+
+_controllers: dict[str, OverloadController] = {}
+_shed_ring: collections.deque = collections.deque(maxlen=SHED_RING)
+
+
+def controller(family: str, high_wm: int, low_wm: int = 0, *,
+               breaker_family: str | None = None,
+               now=time.monotonic) -> OverloadController:
+    """Create + register the controller for `family` (the registry
+    feeds the getmetrics overload section; last construction wins,
+    which is what tests constructing many consumers want)."""
+    ctl = OverloadController(family, high_wm, low_wm,
+                             breaker_family=breaker_family, now=now)
+    _controllers[family] = ctl
+    return ctl
+
+
+def get(family: str) -> OverloadController | None:
+    return _controllers.get(family)
+
+
+def recent_sheds(limit: int | None = None) -> list[dict]:
+    """The shed flight ring, oldest first (bounded by
+    LIGHTNING_TPU_SHED_RING) — the re-request source of truth."""
+    out = [dict(r) for r in _shed_ring]
+    if limit is not None:
+        out = out[-limit:]
+    return out
+
+
+def snapshot() -> dict:
+    """The `overload` section of getmetrics (doc/overload.md)."""
+    return {
+        "families": {f: c.snapshot()
+                     for f, c in sorted(_controllers.items())},
+        "sheds_recorded": len(_shed_ring),
+        "recent_sheds": recent_sheds(64),
+    }
+
+
+def reset_for_tests() -> None:
+    _controllers.clear()
+    _shed_ring.clear()

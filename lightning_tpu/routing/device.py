@@ -51,6 +51,8 @@ queries the planes can't express (custom max_hops, oversized amounts)
   LIGHTNING_TPU_ROUTE_MAX_AMOUNT_MSAT  device amount cap (default 2^48)
   LIGHTNING_TPU_ROUTE_MAX_RISKFACTOR   device riskfactor cap (10^6)
   LIGHTNING_TPU_ROUTE_DEVICE       0 → host-only service (default 1)
+  LIGHTNING_TPU_ROUTE_HIGH_WM      TRY_AGAIN admission watermark (256)
+  LIGHTNING_TPU_ROUTE_LOW_WM       backlog-drained watermark (high/2)
 """
 from __future__ import annotations
 
@@ -72,6 +74,7 @@ from ..obs import flight as _flight
 from ..resilience import breaker as _breaker
 from ..resilience import deadline as _deadline
 from ..resilience import faultinject as _fault
+from ..resilience import overload as _overload
 from ..utils import events, trace
 from . import dijkstra as DJ
 from .dijkstra import BLOCKS_PER_YEAR, NoRoute, RouteHop
@@ -92,6 +95,13 @@ ROUTE_FLUSH_MS = float(_os.environ.get("LIGHTNING_TPU_ROUTE_FLUSH_MS", "2.0"))
 HOST_ROUTE_MAX = int(_os.environ.get("LIGHTNING_TPU_ROUTE_HOST_MAX", "4"))
 ROUTE_MAX_AMOUNT_MSAT = int(_os.environ.get(
     "LIGHTNING_TPU_ROUTE_MAX_AMOUNT_MSAT", str(1 << 48)))
+# admission-control watermarks, in queued QUERIES (doc/overload.md):
+# past the high watermark getroute/pay reject with a retryable
+# TRY_AGAIN + retry-after hint instead of queueing unboundedly.
+# LOW_WM=0 means "half of high".
+ROUTE_HIGH_WM = int(_os.environ.get("LIGHTNING_TPU_ROUTE_HIGH_WM", "256"))
+ROUTE_LOW_WM = (int(_os.environ.get("LIGHTNING_TPU_ROUTE_LOW_WM", "0"))
+                or ROUTE_HIGH_WM // 2)
 # riskfactor joins cd (≤ 2^16) in an int64 product INSIDE the overflow
 # guard itself — an RPC-supplied rf ≥ ~2^45 would wrap cd·rf negative
 # and disarm the guard entirely, so oversized values go to the host's
@@ -198,12 +208,30 @@ _PLANE_ORDER = ("edge_src", "edge_dst", "edge_base", "edge_ppm",
                 "edge_cltv", "edge_hmin", "edge_hmax")
 
 
+# parameter planes a channel_update can change (patchable in place on
+# device); src/dst are topology and only ever full-upload
+_PARAM_PLANES = ("edge_base", "edge_ppm", "edge_cltv", "edge_hmin",
+                 "edge_hmax")
+
+
 def _device_plane_args(planes: RoutePlanes) -> tuple:
     """Upload (once per planes revision) and return the shared operands.
     A param-refresh revision arrives with the topology uploads carried
-    over, so only the missing planes stage.  int64 planes must cross
-    jnp.asarray inside the x64 scope or they silently truncate to
-    int32."""
+    over, so only the missing planes stage; an incremental revision
+    (planes.patch_idx set by with_patched_params) scatters JUST the
+    touched lanes into the carried device planes — a channel_update
+    burst costs O(changed) device traffic, not a full re-upload.
+    int64 planes must cross jnp.asarray inside the x64 scope or they
+    silently truncate to int32."""
+    patch = planes.patch_idx
+    if patch is not None and len(patch):
+        with enable_x64():
+            ji = jnp.asarray(patch)
+            for name in _PARAM_PLANES:
+                if name in planes.dev:
+                    vals = jnp.asarray(getattr(planes, name)[patch])
+                    planes.dev[name] = planes.dev[name].at[ji].set(vals)
+    planes.patch_idx = None
     missing = [n for n in _PLANE_ORDER if n not in planes.dev]
     if missing:
         with enable_x64():
@@ -434,11 +462,18 @@ class RouteService:
 
     def __init__(self, get_map, *, flush_ms: float | None = None,
                  batch: int | None = None, host_max: int | None = None,
-                 device: bool | None = None, now=time.monotonic):
+                 device: bool | None = None, now=time.monotonic,
+                 high_wm: int | None = None, low_wm: int | None = None):
         self.get_map = get_map          # () -> Gossmap | None
         self.flush_ms = ROUTE_FLUSH_MS if flush_ms is None else flush_ms
         self.batch = batch or ROUTE_BATCH
         self.host_max = HOST_ROUTE_MAX if host_max is None else host_max
+        # admission control + adaptive flush widening (doc/overload.md)
+        self.overload = _overload.controller(
+            "route",
+            high_wm if high_wm is not None else ROUTE_HIGH_WM,
+            low_wm if low_wm is not None else ROUTE_LOW_WM,
+            breaker_family="route", now=now)
         # device=False pins the service host-only regardless of env
         # (a --cpu daemon: batched CPU-jax routing is slower than the
         # host dijkstra it would displace, and its warmup is skipped)
@@ -446,6 +481,7 @@ class RouteService:
         self.now = now
         self._planes: RoutePlanes | None = None
         self._queue: list[RouteQuery] = []
+        self._inflight = 0               # queries inside a running flush
         self._flush_due: float | None = None
         self._wakeup = asyncio.Event()
         self._task: asyncio.Task | None = None
@@ -505,17 +541,38 @@ class RouteService:
                 self._resolve(q, "host", res)
                 route, src_info = await q.future
                 return (route, src_info) if with_source else route
+            # admission control (doc/overload.md): past the high
+            # watermark this query is REJECTED retryably — metered as a
+            # shed, surfaced to RPC callers as TRY_AGAIN with the
+            # retry-after hint — instead of joining an unbounded queue
+            # and wrecking every caller's tail latency
+            if not self.overload.admit(_overload.PRIO_QUERY):
+                self.overload.shed(_overload.PRIO_QUERY, "admission")
+                raise self.overload.overloaded()
             self._queue.append(q)
-            _M_QUEUE.set(len(self._queue))
+            self._note_backlog()
             if self._flush_due is None:
-                self._flush_due = self.now() + self.flush_ms / 1000.0
+                # adaptive flush window: latency budget stretches as
+                # pressure rises (throughput over latency under load)
+                self._flush_due = self.now() + self.overload.window_s(
+                    self.flush_ms)
                 self._wakeup.set()
-            if len(self._queue) >= self.batch:
+            if len(self._queue) >= self._flush_threshold():
                 self._wakeup.set()
         route, src_info = await q.future
         if with_source:
             return route, src_info
         return route
+
+    def _flush_threshold(self) -> int:
+        """Adaptive size trigger: `batch` when calm, widening toward
+        batch * LIGHTNING_TPU_FLUSH_WIDEN under pressure so one flush
+        (and its thread hop + planes refresh) serves more queries."""
+        return self.overload.flush_target(self.batch)
+
+    def _note_backlog(self) -> None:
+        _M_QUEUE.set(len(self._queue))
+        self.overload.update(len(self._queue), self._inflight)
 
     # -- the flush loop ---------------------------------------------------
 
@@ -560,7 +617,7 @@ class RouteService:
             self._wakeup.clear()
             return
         timeout = self._flush_due - self.now()
-        if timeout > 0 and len(self._queue) < self.batch:
+        if timeout > 0 and len(self._queue) < self._flush_threshold():
             try:
                 await asyncio.wait_for(self._wakeup.wait(), timeout)
             except asyncio.TimeoutError:
@@ -573,8 +630,10 @@ class RouteService:
     async def flush(self) -> None:
         batch, self._queue = self._queue, []
         self._flush_due = None
-        _M_QUEUE.set(0)
+        self._inflight = len(batch)
+        self._note_backlog()
         if not batch:
+            self._inflight = 0
             return
         t0 = time.perf_counter()
         try:
@@ -590,7 +649,11 @@ class RouteService:
                     q.future.set_exception(
                         RuntimeError(f"route flush failed: {e}"))
         finally:
-            _M_FLUSH_SECONDS.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            _M_FLUSH_SECONDS.observe(dt)
+            self._inflight = 0
+            self.overload.note_drain(len(batch), dt)
+            self._note_backlog()
 
     async def _flush_batch(self, batch: list[RouteQuery]) -> None:
         # every route flush is one flight-recorded dispatch: the record

@@ -72,6 +72,13 @@ class RoutePlanes:
     # channel→edge lookup (exclusion masks): edge indices sorted by chan
     _chan_order: np.ndarray = None
     _chan_sorted: np.ndarray = None
+    # incremental-maintenance state: cursor into the gossmap's
+    # (channel, direction) change log, and the edge lanes whose device
+    # copies in `dev` are stale relative to the (already patched) host
+    # planes — routing.device scatters just those lanes before the
+    # next dispatch instead of re-uploading whole parameter planes
+    params_log_pos: int = 0
+    patch_idx: np.ndarray | None = None
 
     # -- construction -----------------------------------------------------
 
@@ -112,6 +119,7 @@ class RoutePlanes:
                 np.minimum(g.htlc_max_msat[d, c], _I64_CLAMP), np.int64),
             edge_enabled=_padded(g.enabled[d, c], bool, False),
             edge_cap_sat=_padded(g.capacity_sat[c], np.float32),
+            params_log_pos=getattr(g, "param_log_pos", 0),
         )
         planes._chan_order = np.argsort(
             planes.edge_chan[:e_real], kind="stable").astype(np.int64)
@@ -139,6 +147,8 @@ class RoutePlanes:
         return dataclasses.replace(
             self,
             params_version=getattr(g, "params_version", 0),
+            params_log_pos=getattr(g, "param_log_pos", 0),
+            patch_idx=None,
             edge_base=_padded(g.fee_base_msat[d, c], np.int64),
             edge_ppm=_padded(g.fee_ppm[d, c], np.int64),
             edge_cltv=_padded(g.cltv_delta[d, c], np.int64),
@@ -154,17 +164,88 @@ class RoutePlanes:
                  if k in ("edge_src", "edge_dst")},
         )
 
+    # touched-lane patching threshold: bursts touching more than this
+    # share of the real edges re-derive everything (one vectorized
+    # gather beats per-channel loops at that scale)
+    _PATCH_MAX_FRACTION = 8   # e_real // 8
+
+    def with_patched_params(self, entries) -> "RoutePlanes":
+        """The incremental path for a channel_update burst: patch ONLY
+        the edge lanes named by the gossmap's change-log `entries`
+        ((channel_index, direction) pairs) instead of re-deriving every
+        parameter plane.  Returns a NEW planes object (in-flight solves
+        keep their consistent snapshot) that SHARES the topology arrays
+        and the already-uploaded device planes; the stale device lanes
+        are recorded in `patch_idx` and scattered in place on device by
+        routing.device._device_plane_args before the next dispatch —
+        a params version bump without a CSR rebuild or a full
+        re-upload."""
+        import dataclasses
+
+        g = self.g
+        idxs: set[int] = set()
+        for c, d in set(entries):
+            for e in self.edges_of_channel(int(c)):
+                if int(self.edge_dir[e]) == int(d):
+                    idxs.add(int(e))
+        idx = np.array(sorted(idxs), np.int64)
+        if self.patch_idx is not None:
+            # an unapplied patch (no dispatch ran between two bursts)
+            # folds into this one: host arrays are canonical, so the
+            # union of stale lanes re-reads the right values at apply
+            idx = np.union1d(idx, self.patch_idx)
+        c_arr = self.edge_chan[idx]
+        d_arr = self.edge_dir[idx].astype(np.int64)
+
+        def _patched(cur: np.ndarray, vals) -> np.ndarray:
+            out = cur.copy()
+            out[idx] = vals
+            return out
+
+        return dataclasses.replace(
+            self,
+            params_version=getattr(g, "params_version", 0),
+            params_log_pos=getattr(g, "param_log_pos", 0),
+            patch_idx=idx,
+            edge_base=_patched(self.edge_base,
+                               g.fee_base_msat[d_arr, c_arr]),
+            edge_ppm=_patched(self.edge_ppm, g.fee_ppm[d_arr, c_arr]),
+            edge_cltv=_patched(self.edge_cltv,
+                               g.cltv_delta[d_arr, c_arr]),
+            edge_hmin=_patched(self.edge_hmin, np.minimum(
+                g.htlc_min_msat[d_arr, c_arr], _I64_CLAMP)),
+            edge_hmax=_patched(self.edge_hmax, np.minimum(
+                g.htlc_max_msat[d_arr, c_arr], _I64_CLAMP)),
+            edge_enabled=_patched(self.edge_enabled,
+                                  g.enabled[d_arr, c_arr]),
+            # device planes carry over WHOLE (patch_idx marks the
+            # stale lanes); shallow-copy so patch application on this
+            # revision never mutates the predecessor's dict
+            dev=dict(self.dev),
+        )
+
     @classmethod
     def current(cls, g: Gossmap,
                 cached: "RoutePlanes | None") -> "RoutePlanes":
         """The freshness gate: reuse `cached` when it matches `g`'s
-        version counters, derive fresh param planes (shared topology)
-        on a param-only bump, rebuild on topology change or a different
+        version counters; on a param-only bump patch just the touched
+        edge lanes (the gossmap change log names them) or re-derive
+        every param plane when the burst is too large / the log was
+        trimmed; full rebuild only on topology change or a different
         map object.  Never mutates `cached`."""
         if (cached is None or cached.g is not g
                 or cached.topo_version != getattr(g, "topology_version", 0)):
             return cls.build(g)
         if cached.params_version != getattr(g, "params_version", 0):
+            entries = None
+            if hasattr(g, "param_entries_since"):
+                entries = g.param_entries_since(cached.params_log_pos)
+            # DISTINCT (channel, direction) pairs decide patch-vs-
+            # rederive: a hot-channel burst logs many entries but
+            # touches few lanes — exactly the case patching amortizes
+            if entries is not None and len(set(entries)) <= max(
+                    64, cached.e_real // cls._PATCH_MAX_FRACTION):
+                return cached.with_patched_params(entries)
             return cached.with_fresh_params()
         return cached
 

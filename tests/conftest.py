@@ -41,3 +41,11 @@ assert jax.default_backend() == "cpu", "tests must run on the CPU mesh"
 assert jax.device_count() >= 8, "expected virtual 8-device CPU mesh"
 
 setup_cache()
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; register the marker so full-scale
+    # soak/bench tests (test_zz_overload.py's loadgen storm) don't warn
+    config.addinivalue_line(
+        "markers",
+        "slow: full-scale soak/bench runs excluded from tier-1")

@@ -197,6 +197,31 @@ def test_utxo_check_gate(store_path):
     run_ingest(main())
 
 
+def test_unbounded_burst_bounded_by_watermark(store_path):
+    """ISSUE-7 regression: the submit queue is watermark-bounded — an
+    unbounded burst sheds (metered, ring-recorded) instead of growing
+    memory, and the backlog gauge sees queued + in-flight."""
+    from lightning_tpu.resilience import overload as ovl
+
+    async def main():
+        ovl.reset_for_tests()
+        ing = gi.GossipIngest(store_path, flush_ms=1e9,
+                              flush_size=1 << 30, bucket=64,
+                              high_wm=16, low_wm=8)
+        # no flush loop started: nothing drains, pure bound check
+        for i in range(120):
+            await ing.submit(make_na(70000 + i, ts=10))
+        assert ing._queued_sigs <= ing.overload.hard_cap
+        assert ing.overload.state == ovl.SATURATED
+        shed = ing.stats.dropped.get(gi.R_SHED, 0)
+        assert shed == 120 - ing._queued_sigs > 0
+        assert len(ovl.recent_sheds()) == shed
+        await ing.close()
+        ovl.reset_for_tests()
+
+    run_ingest(main())
+
+
 def test_batching_observable(store_path):
     async def main():
         ing = gi.GossipIngest(store_path, flush_size=4096, flush_ms=50.0,

@@ -1,0 +1,510 @@
+"""Overload-control layer (doc/overload.md): bounded queues,
+deterministic priority shedding (bare AND under the fault matrix),
+adaptive flush widening, transport backpressure, TRY_AGAIN admission
+control, incremental RoutePlanes patching, and streamed synth.
+
+Determinism contract under test (ISSUE 7 satellite): same storm + same
+seed ⇒ identical shed set and identical post-storm ingest/store state —
+and shedding composes with breakers/quarantine (identical outcome with
+verify faults armed) instead of masking them.
+"""
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from lightning_tpu.crypto import ref_python as ref
+from lightning_tpu.gossip import gossmap as GM
+from lightning_tpu.gossip import ingest as gi
+from lightning_tpu.gossip import store as gstore
+from lightning_tpu.gossip import synth, wire
+from lightning_tpu.resilience import faultinject
+from lightning_tpu.resilience import overload as ovl
+
+K1, K2, K3 = 11111, 22222, 33333
+SCID = (600000 << 40) | (1 << 16) | 0
+
+
+def pub(k: int) -> bytes:
+    return ref.pubkey_serialize(ref.pubkey_create(k))
+
+
+def _ordered(ka, kb):
+    return (ka, kb) if pub(ka) < pub(kb) else (kb, ka)
+
+
+def make_ca(ka: int, kb: int, scid: int) -> bytes:
+    ka, kb = _ordered(ka, kb)
+    ca = wire.ChannelAnnouncement(
+        short_channel_id=scid,
+        node_id_1=pub(ka), node_id_2=pub(kb),
+        bitcoin_key_1=pub(ka), bitcoin_key_2=pub(kb))
+    m = bytearray(ca.serialize())
+    h = ref.sha256d(bytes(m[wire.CA_SIGNED_OFFSET:]))
+    for off, k in zip(wire.CA_SIG_OFFSETS, (ka, kb, ka, kb)):
+        r, s = ref.ecdsa_sign(h, k)
+        m[off:off + 64] = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    return bytes(m)
+
+
+def make_cu(ka: int, kb: int, scid: int, direction: int, ts: int) -> bytes:
+    ka, kb = _ordered(ka, kb)
+    cu = wire.ChannelUpdate(
+        short_channel_id=scid, timestamp=ts, channel_flags=direction,
+        htlc_maximum_msat=10 ** 9, fee_base_msat=1000,
+        fee_proportional_millionths=10)
+    m = bytearray(cu.serialize())
+    h = ref.sha256d(bytes(m[wire.CU_SIGNED_OFFSET:]))
+    k = ka if direction == 0 else kb
+    r, s = ref.ecdsa_sign(h, k)
+    m[wire.CU_SIG_OFFSET:wire.CU_SIG_OFFSET + 64] = (
+        r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+    return bytes(m)
+
+
+def make_na(k: int, ts: int) -> bytes:
+    na = wire.NodeAnnouncement(
+        timestamp=ts, node_id=pub(k),
+        alias=b"overload-test".ljust(32, b"\0"))
+    m = bytearray(na.serialize())
+    h = ref.sha256d(bytes(m[wire.NA_SIGNED_OFFSET:]))
+    r, s = ref.ecdsa_sign(h, k)
+    m[wire.NA_SIG_OFFSET:wire.NA_SIG_OFFSET + 64] = (
+        r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+    return bytes(m)
+
+
+# ---------------------------------------------------------------------------
+# controller unit behavior
+
+
+def test_ladder_widening_and_priority_limits():
+    ctl = ovl.OverloadController("ingest", 100, 50)
+    assert ctl.state == ovl.NORMAL
+    assert ctl.flush_target(8) == 8
+    ctl.update(60, 0)
+    assert ctl.state == ovl.ELEVATED
+    assert 8 < ctl.flush_target(8) < 8 * ovl.FLUSH_WIDEN
+    ctl.update(120, 0)
+    assert ctl.state == ovl.SATURATED
+    assert ctl.flush_target(8) == 8 * ovl.FLUSH_WIDEN
+    assert ctl.window_s(2.0) == pytest.approx(
+        2.0 * ovl.FLUSH_WIDEN / 1000.0)
+    # hysteresis: between the watermarks a saturated ladder HOLDS
+    ctl.update(60, 0)
+    assert ctl.state == ovl.SATURATED
+    ctl.update(40, 0)
+    assert ctl.state == ovl.NORMAL
+    # priority limits: bulk sheds at high, fresh gets one headroom
+    # band, own two (the hard cap)
+    ctl.update(100, 0)
+    assert not ctl.admit(ovl.PRIO_BULK)
+    assert ctl.admit(ovl.PRIO_FRESH)
+    assert ctl.admit(ovl.PRIO_OWN)
+    ctl.update(125, 0)
+    assert not ctl.admit(ovl.PRIO_FRESH)
+    assert ctl.admit(ovl.PRIO_OWN)
+    ctl.update(150, 0)
+    assert not ctl.admit(ovl.PRIO_OWN)
+    assert ctl.hard_cap == 150
+    # in-flight work counts toward admission (the queue cannot refill
+    # to the watermark while a long flush is out)
+    ctl.update(10, 120)
+    assert not ctl.admit(ovl.PRIO_BULK)
+    snap = ctl.snapshot()
+    assert snap["peak_backlog"] >= 150
+    assert snap["state"] == "saturated"
+    assert snap["breaker"] in ("closed", "open", "half_open")
+
+
+def test_shed_ring_records_identity():
+    ovl.reset_for_tests()
+    ctl = ovl.controller("ingest", 10)
+    ctl.shed(ovl.PRIO_BULK, "queue_full", kind="node_announcement",
+             node_id="ab" * 33, timestamp=7)
+    recs = ovl.recent_sheds()
+    assert len(recs) == 1
+    assert recs[0]["priority"] == "bulk"
+    assert recs[0]["reason"] == "queue_full"
+    assert recs[0]["timestamp"] == 7
+    snap = ovl.snapshot()
+    assert snap["families"]["ingest"]["shed"] == {"bulk:queue_full": 1}
+    assert snap["sheds_recorded"] == 1
+    ovl.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# deterministic priority shedding (bare + fault matrix)
+
+
+SCID2 = (600000 << 40) | (2 << 16) | 0   # K2<->K3: NOT own-channel
+
+
+def _storm_msgs():
+    """A scripted storm: a burst mixing a few own-channel updates
+    (own = K1's node, channel SCID), many fresh third-party updates
+    (channel SCID2 between K2 and K3), and bulk NAs for unknown
+    nodes.  Sized so that against a high watermark of 12 sigs the
+    bulk AND fresh classes must shed while own never does."""
+    msgs = []
+    for i in range(40):
+        if i % 10 == 0:
+            msgs.append(("own", make_cu(K1, K2, SCID, i % 2,
+                                        ts=1000 + i)))
+        elif i % 4 == 3:
+            msgs.append(("na", make_na(K3 + 100 + i, ts=1000 + i)))
+        else:
+            msgs.append(("cu", make_cu(K2, K3, SCID2, i % 2,
+                                       ts=1000 + i)))
+    return msgs
+
+
+async def _run_storm(store_path: str, faults: str | None = None):
+    """Submit the scripted storm WITHOUT yielding to the event loop
+    (in-flight stays 0 → the shed set is a pure function of the storm),
+    then drain, then return (shed_keys, state, store_bytes)."""
+    ovl.reset_for_tests()
+    ing = gi.GossipIngest(store_path, flush_ms=1.0, flush_size=8,
+                          bucket=64, own_node_id=pub(K1),
+                          high_wm=12, low_wm=6)
+    ing.start()
+    await ing.submit(make_ca(K1, K2, SCID))
+    await ing.submit(make_ca(K2, K3, SCID2))
+    await ing.drain()
+    assert ing.stats.accepted == 2
+    ctx = faultinject.arm(faults) if faults else None
+    if ctx:
+        ctx.__enter__()
+    try:
+        for _kind, raw in _storm_msgs():
+            await ing.submit(raw)   # no internal awaits: atomic burst
+        peak_queue = ing._queued_sigs
+        await ing.drain()
+    finally:
+        if ctx:
+            ctx.__exit__(None, None, None)
+        await ing.close()
+    sheds = [tuple(sorted(r.items())) for r in ovl.recent_sheds()]
+    state = (ing.stats.accepted, dict(ing.stats.dropped),
+             dict(ing.updates), dict(ing.nodes))
+    with open(store_path, "rb") as f:
+        blob = f.read()
+    return sheds, state, blob, peak_queue, ing
+
+
+def test_shed_determinism_and_priority(tmp_path):
+    s1, st1, b1, peak1, ing1 = asyncio.run(
+        _run_storm(str(tmp_path / "a.gs")))
+    s2, st2, b2, peak2, _ = asyncio.run(
+        _run_storm(str(tmp_path / "b.gs")))
+    # identical shed set, state, and store bytes on a re-run
+    assert s1 == s2
+    assert st1 == st2
+    assert b1 == b2
+    assert s1, "storm must actually shed (watermark 12 vs 40-msg burst)"
+    # queue stayed bounded by the hard cap at all times
+    assert peak1 <= ing1.overload.hard_cap
+    # priority: own-channel updates (K1's channel includes own node)
+    # are hard-capped only — none shed here; bulk NAs shed first
+    prios = [dict(s).get("priority") for s in s1]
+    assert "own" not in prios
+    assert "bulk" in prios
+    ovl.reset_for_tests()
+
+
+def test_shed_determinism_composes_with_fault_matrix(tmp_path):
+    """Same storm under armed verify faults: the breaker/quarantine
+    machinery recovers the flushes bit-identically, so the shed set
+    AND the final state match the bare run (shedding neither masks
+    faults nor is perturbed by them)."""
+    from lightning_tpu.resilience import reset_for_tests as _reset
+
+    s1, st1, b1, _, _ = asyncio.run(_run_storm(str(tmp_path / "a.gs")))
+    _reset()
+    try:
+        s2, st2, b2, _, _ = asyncio.run(_run_storm(
+            str(tmp_path / "b.gs"),
+            faults="dispatch:verify:raise:0.25"))
+    finally:
+        _reset()
+    assert s1 == s2
+    assert st1 == st2
+    assert b1 == b2
+
+
+def test_pending_maps_bounded(tmp_path):
+    """Orphan channel_updates (channel unknown) are HELD, but the held
+    maps are bounded: past the cap new keys shed with pending_cap."""
+    async def main():
+        ovl.reset_for_tests()
+        ing = gi.GossipIngest(str(tmp_path / "d.gs"), flush_ms=1e9,
+                              flush_size=1 << 30, bucket=64,
+                              pending_cap=5)
+        for i in range(12):
+            scid = SCID + (i << 16)
+            await ing.submit(make_cu(K1, K2, scid, 0, ts=100))
+        assert ing._pending_held == 5
+        sheds = [r for r in ovl.recent_sheds()
+                 if r["reason"] == "pending_cap"]
+        assert len(sheds) == 7
+        await ing.close()
+        ovl.reset_for_tests()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# backpressure
+
+
+def test_backpressure_bounded_wait_and_release(tmp_path):
+    async def main():
+        ovl.reset_for_tests()
+        ing = gi.GossipIngest(str(tmp_path / "e.gs"), flush_ms=1e9,
+                              flush_size=1 << 30, bucket=64,
+                              high_wm=8, low_wm=4)
+        for i in range(10):
+            await ing.submit(make_na(60000 + i, ts=10))
+        assert ing.overload.state == ovl.SATURATED
+        # saturated: the wait is BOUNDED (no drain is coming)
+        waited = await ing.wait_capacity(max_wait=0.05)
+        assert 0.01 < waited < 1.0
+        # simulate the drain below the low watermark: release is quick
+        ing.overload.update(0, 0)
+        assert await ing.wait_capacity(max_wait=5.0) == 0.0
+        await ing.close()
+        ovl.reset_for_tests()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# route admission control → TRY_AGAIN
+
+
+def _tiny_graph(tmp_path):
+    p = str(tmp_path / "graph.gs")
+    synth.make_network_store(p, 24, 8, sign=False)
+    return GM.from_store(gstore.load_store(p))
+
+
+def test_route_admission_overloaded(tmp_path):
+    from lightning_tpu.routing.device import RouteService
+
+    g = _tiny_graph(tmp_path)
+    ids = [bytes(g.node_ids[i]) for i in range(g.n_nodes)]
+
+    async def main():
+        ovl.reset_for_tests()
+        svc = RouteService(lambda: g, device=False, batch=4,
+                           host_max=0, flush_ms=10_000.0,
+                           high_wm=4, low_wm=2)
+        svc.start()
+        await asyncio.sleep(0)
+        tasks = [asyncio.create_task(
+            svc.getroute(ids[0], ids[1 + i % 4], 1000))
+            for i in range(4)]
+        for _ in range(4):       # let each task reach its enqueue
+            await asyncio.sleep(0)
+        assert len(svc._queue) == 4
+        with pytest.raises(ovl.Overloaded) as ei:
+            await svc.getroute(ids[0], ids[5], 1000)
+        assert ei.value.retry_after_s > 0
+        assert ei.value.family == "route"
+        # metered as a query-class admission shed
+        assert any(r["reason"] == "admission"
+                   for r in ovl.recent_sheds())
+        # the queued callers still resolve once a flush runs
+        await svc.flush()
+        done = await asyncio.gather(*tasks, return_exceptions=True)
+        assert all(not isinstance(r, ovl.Overloaded) for r in done)
+        await svc.close()
+        ovl.reset_for_tests()
+
+    asyncio.run(main())
+
+
+def test_jsonrpc_maps_overloaded_to_try_again(tmp_path):
+    from lightning_tpu.daemon.jsonrpc import TRY_AGAIN, JsonRpcServer
+
+    sock = str(tmp_path / "rpc.sock")
+
+    async def main():
+        rpc = JsonRpcServer(sock)
+
+        async def saturated():
+            raise ovl.Overloaded("route", 0.42, 99)
+
+        rpc.register("saturated", saturated)
+        await rpc.start()
+        reader, writer = await asyncio.open_unix_connection(sock)
+        writer.write(json.dumps({"jsonrpc": "2.0", "id": 1,
+                                 "method": "saturated",
+                                 "params": {}}).encode())
+        await writer.drain()
+        buf = b""
+        while b"\n\n" not in buf:
+            buf += await reader.read(1 << 16)
+        resp = json.loads(buf.split(b"\n\n")[0])
+        writer.close()
+        await rpc.close()
+        assert resp["error"]["code"] == TRY_AGAIN == 429
+        assert resp["error"]["data"]["retry_after_s"] == 0.42
+        assert "retry" in resp["error"]["message"]
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# incremental RoutePlanes maintenance
+
+
+def _apply_random_updates(g, rng, n):
+    """Fold n random accepted channel_updates into live directions."""
+    live = np.argwhere(g.timestamps > 0)   # (k, 2): [dir, chan]
+    applied = 0
+    while applied < n:
+        d, c = live[int(rng.integers(0, len(live)))]
+        ok = g.apply_channel_update(
+            int(g.scids[c]), int(d),
+            timestamp=int(g.timestamps[d, c]) + 1 + applied,
+            disabled=bool(rng.integers(0, 5) == 0),
+            cltv_delta=int(rng.integers(6, 80)),
+            htlc_min_msat=int(rng.integers(0, 1000)),
+            htlc_max_msat=int(rng.integers(10 ** 6, 10 ** 9)),
+            fee_base_msat=int(rng.integers(0, 5000)),
+            fee_ppm=int(rng.integers(0, 10000)))
+        assert ok
+        applied += 1
+
+
+def test_planes_patch_parity_randomized_burst(tmp_path):
+    from lightning_tpu.routing.planes import RoutePlanes
+
+    g = _tiny_graph(tmp_path)
+    planes0 = RoutePlanes.build(g)
+    rng = np.random.default_rng(11)
+    _apply_random_updates(g, rng, 12)
+    patched = RoutePlanes.current(g, planes0)
+    # the burst was small: the incremental path must have been taken
+    assert patched is not planes0
+    assert patched.patch_idx is not None and len(patched.patch_idx)
+    assert patched.edge_src is planes0.edge_src     # topology shared
+    rebuilt = RoutePlanes.build(g)
+    for name in ("edge_base", "edge_ppm", "edge_cltv", "edge_hmin",
+                 "edge_hmax", "edge_enabled"):
+        assert np.array_equal(getattr(patched, name),
+                              getattr(rebuilt, name)), name
+    # a second burst folds the unapplied patch forward (union)
+    _apply_random_updates(g, rng, 5)
+    patched2 = RoutePlanes.current(g, patched)
+    assert len(patched2.patch_idx) >= len(patched.patch_idx)
+    rebuilt2 = RoutePlanes.build(g)
+    for name in ("edge_base", "edge_ppm", "edge_hmax"):
+        assert np.array_equal(getattr(patched2, name),
+                              getattr(rebuilt2, name)), name
+    # a hot-channel burst (many entries, FEW distinct pairs) must stay
+    # on the incremental path: the threshold counts distinct lanes
+    live = np.argwhere(g.timestamps > 0)
+    d0, c0 = live[0]
+    for k in range(200):
+        assert g.apply_channel_update(
+            int(g.scids[c0]), int(d0),
+            timestamp=int(g.timestamps[d0, c0]) + 1,
+            disabled=False, cltv_delta=9, htlc_min_msat=0,
+            htlc_max_msat=10 ** 9, fee_base_msat=k, fee_ppm=k)
+    hot = RoutePlanes.current(g, patched2)
+    assert hot.patch_idx is not None and len(hot.patch_idx)
+    assert np.array_equal(hot.edge_base, RoutePlanes.build(g).edge_base)
+    # a burst that overflows the bounded change log trims it; a cursor
+    # older than the trimmed base falls back to full re-derivation
+    from lightning_tpu.gossip.gossmap import _PARAM_LOG_CAP
+    _apply_random_updates(g, rng, _PARAM_LOG_CAP + 50)
+    fresh = RoutePlanes.current(g, hot)
+    assert fresh.patch_idx is None
+    assert np.array_equal(fresh.edge_base,
+                          RoutePlanes.build(g).edge_base)
+
+
+def test_planes_patch_device_solve_parity(tmp_path):
+    """The patched chain must solve identically to freshly built
+    planes THROUGH the device path — including the dev-plane scatter
+    in _device_plane_args (carried uploads + patch_idx)."""
+    from lightning_tpu.routing import device as RD
+    from lightning_tpu.routing.planes import RoutePlanes
+
+    g = _tiny_graph(tmp_path)
+    ids = [bytes(g.node_ids[i]) for i in range(g.n_nodes)]
+    queries = [RD.RouteQuery(ids[i % 4], ids[4 + i % 4], 1000 + i)
+               for i in range(8)]
+    planes0 = RoutePlanes.build(g)
+    RD.solve_batch(planes0, queries, batch=8)   # uploads dev planes
+    rng = np.random.default_rng(13)
+    _apply_random_updates(g, rng, 10)
+    patched = RoutePlanes.current(g, planes0)
+    assert patched.patch_idx is not None
+    res_patched = RD.solve_batch(patched, queries, batch=8)
+    res_rebuilt = RD.solve_batch(RoutePlanes.build(g), queries, batch=8)
+
+    def norm(res):
+        out = []
+        for r in res:
+            if r[0] == "ok":
+                out.append(("ok", [(h.scid, h.direction, h.amount_msat,
+                                    h.delay) for h in r[1]], r[2]))
+            else:
+                out.append((r[0], str(r[1])))
+        return out
+
+    assert norm(res_patched) == norm(res_rebuilt)
+
+
+# ---------------------------------------------------------------------------
+# streamed synth (mainnet-scale generation path)
+
+
+def test_synth_streaming_chunked_byte_parity(tmp_path):
+    p1, p2 = str(tmp_path / "s1.gs"), str(tmp_path / "s2.gs")
+    i1 = synth.make_network_store(p1, 300, 64, sign=False, chunk=77)
+    i2 = synth.make_network_store(p2, 300, 64, sign=False,
+                                  chunk=1 << 30)
+    assert i1["channels"] == 300 and i1["channel_updates"] == 600
+    assert i1["node_announcements"] == 64
+    with open(p1, "rb") as f1, open(p2, "rb") as f2:
+        assert f1.read() == f2.read()
+    g = GM.from_store(gstore.load_store(p1))
+    assert (g.n_channels, g.n_nodes) == (300, 64)
+
+
+def test_synth_mainnet_preset_smoke_slice(tmp_path):
+    p = str(tmp_path / "slice.gs")
+    rc = synth.main([p, "--mainnet", "--scale", "0.002", "--no-sign",
+                     "--chunk", "256"])
+    assert rc == 0
+    g = GM.from_store(gstore.load_store(p))
+    assert g.n_channels == int(synth.MAINNET_CHANNELS * 0.002)
+    assert g.n_nodes == int(synth.MAINNET_NODES * 0.002)
+
+
+# ---------------------------------------------------------------------------
+# the full soak (slow: run_suite's soak-lite runs tools/loadgen.py
+# --selfcheck directly; this is the larger storm)
+
+
+@pytest.mark.slow
+def test_loadgen_full_soak():
+    import subprocess
+    import sys as _sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               LIGHTNING_TPU_JAX_CACHE_MODE="ro")
+    r = subprocess.run(
+        [_sys.executable, os.path.join(root, "tools", "loadgen.py"),
+         "--selfcheck", "--channels", "256", "--storm-msgs", "2400",
+         "--storm-seconds", "45"],
+        env=env, capture_output=True, text=True, timeout=1800)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "loadgen: PASS" in r.stdout

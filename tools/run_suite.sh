@@ -106,4 +106,17 @@ LIGHTNING_TPU_DEADLINE_ROUTE_S=120 \
 LIGHTNING_TPU_DEADLINE_INGEST_S=240 \
   timeout 1800 python -m pytest tests/test_zz_resilience.py -x -q \
   || { echo "fault-matrix pass failed"; exit 1; }
-echo "suite green (2 slices + graftlint + fault matrix)"
+
+# Overload soak-lite pass (doc/overload.md): a bounded (~20 s storm)
+# gossip storm + concurrent getroute/sign load against a live daemon
+# surface on the CPU stub, asserting the overload SLOs — bounded
+# queues, zero unmetered drops, priority shedding with no own-class
+# shed, TRY_AGAIN admission control actually firing, getroute p99,
+# and byte-identical unthrottled replay of the non-shed subset.  The
+# full-scale storm is tests/test_zz_overload.py's slow-marked soak.
+# loadgen pins the suite's jax config (8-device CPU, cache read-only)
+# so the warmed verify/sign/route programs are reused, not recompiled.
+echo "overload soak-lite pass (tools/loadgen.py --selfcheck)"
+timeout 1200 python tools/loadgen.py --selfcheck \
+  || { echo "loadgen selfcheck failed"; exit 1; }
+echo "suite green (2 slices + graftlint + fault matrix + soak-lite)"
