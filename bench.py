@@ -69,6 +69,14 @@ ROUTE_METRIC = "getroute_batched_throughput"
 ROUTE_UNIT = "routes_per_sec"
 LAST_TPU_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_last_tpu.json")
+# Every emitted record also appends to this JSONL trajectory (schema-
+# gated by check_history_line); tools/perf_report.py --compare gates
+# regressions against it (doc/perf.md).  BENCH_HISTORY overrides.
+HISTORY_PATH = os.environ.get(
+    "BENCH_HISTORY",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_HISTORY.jsonl"))
+HISTORY_VERSION = 1
 
 
 def _load_last_tpu() -> dict | None:
@@ -95,7 +103,140 @@ def emit(value: float, vs_baseline: float, **extra):
     if last is not None:
         line["last_measured_tpu"] = last
     line.update(extra)
+    append_history(line)
     print(json.dumps(line), flush=True)
+
+
+# -- BENCH_HISTORY.jsonl: the bench trajectory -------------------------------
+#
+# One JSON object per line: {"v": 1, "appended_at": ..., "source": ...,
+# "record": <the emitted bench line>}.  Records seeded from pre-history
+# driver artifacts carry "legacy": true (they predate the measurement/
+# engine/bucket contract and are exempt from it — but never from the
+# metric/value/unit core).  perf_report.py --compare consumes this file
+# as the regression baseline (doc/perf.md).
+
+
+def check_history_line(entry: dict) -> list[str]:
+    """Schema violations in one BENCH_HISTORY.jsonl entry (empty = ok)."""
+    problems = []
+    if entry.get("v") != HISTORY_VERSION:
+        problems.append(f"v must be {HISTORY_VERSION}")
+    for key in ("appended_at", "source"):
+        if not isinstance(entry.get(key), str) or not entry.get(key):
+            problems.append(f"missing/empty key: {key}")
+    rec = entry.get("record")
+    if not isinstance(rec, dict):
+        return problems + ["record must be an object"]
+    if entry.get("legacy"):
+        # pre-contract artifact: only the core is enforced
+        for k in ("metric", "unit"):
+            if not rec.get(k):
+                problems.append(f"legacy record missing key: {k}")
+        if "error" not in rec \
+                and not isinstance(rec.get("value"), (int, float)):
+            problems.append("legacy record value must be numeric")
+    else:
+        problems += [f"record: {p}" for p in check_bench_line(rec)]
+    return problems
+
+
+def append_history(line: dict, source: str = "bench.py",
+                   legacy: bool = False, path: str | None = None) -> bool:
+    """Append one emitted record to the history, gated on the schema:
+    an entry that fails check_history_line is NOT written (the gate's
+    whole point — a malformed record would poison every later
+    --compare) and the violation goes to stderr."""
+    entry = {"v": HISTORY_VERSION,
+             "appended_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+             "source": source, "record": line}
+    if legacy:
+        entry["legacy"] = True
+    probs = check_history_line(entry)
+    if probs:
+        print(f"bench: NOT appending to history (schema): "
+              f"{'; '.join(probs)}", file=sys.stderr, flush=True)
+        return False
+    try:
+        with open(path or HISTORY_PATH, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+        return True
+    except OSError as e:
+        print(f"bench: history append failed: {e}", file=sys.stderr,
+              flush=True)
+        return False
+
+
+def load_history(path: str | None = None) -> list[dict]:
+    """Parse + validate the history; raises ValueError naming the bad
+    line on any schema violation (the file is a gated artifact — a
+    corrupt line is a bug, not data)."""
+    entries = []
+    with open(path or HISTORY_PATH) as f:
+        for i, raw in enumerate(f, 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                entry = json.loads(raw)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"history line {i}: invalid JSON: {e}")
+            probs = check_history_line(entry)
+            if probs:
+                raise ValueError(
+                    f"history line {i}: {'; '.join(probs)}")
+            entries.append(entry)
+    return entries
+
+
+def seed_history(paths: list[str] | None = None) -> int:
+    """`bench.py --seed-history [BENCH_rNN.json ...]` — bootstrap
+    BENCH_HISTORY.jsonl from the existing driver artifacts (default:
+    every BENCH_r*.json beside this file) plus the persisted real-
+    hardware measurement in bench_last_tpu.json, so perf_report.py
+    --compare has both a cpu-fallback trajectory and a hardware
+    baseline from day one.  Artifacts whose `parsed` is null (the
+    round-1 backend-init failure) are skipped with a note — there is
+    no measurement in them to gate against."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    if not paths:
+        paths = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    rc = 0
+    for p in paths:
+        name = os.path.basename(p)
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+        except Exception as e:
+            print(f"{name}: unreadable ({e}) — skipped")
+            rc = 1
+            continue
+        if "metric" not in rec and "parsed" in rec:
+            rec = rec["parsed"]
+        if rec is None:
+            print(f"{name}: parsed is null (errored round) — skipped")
+            continue
+        ok = append_history(rec, source=f"seed:{name}", legacy=True)
+        print(f"{name}: {'seeded (legacy)' if ok else 'REJECTED'}")
+        rc |= not ok
+    last = _load_last_tpu()
+    hw = (last or {}).get("end_to_end_sig_verifies_per_sec")
+    if hw:
+        line = {"metric": METRIC, "unit": UNIT, "value": float(hw),
+                "vs_baseline": round(float(hw) / BASELINE_CPU_OPS, 3),
+                "platform": last.get("platform", "tpu"),
+                "engine": last.get("impl"),
+                "bucket": last.get("bucket"), "measurement": "live",
+                "measured_at": last.get("e2e_date"),
+                "n_sigs": last.get("n_sigs"),
+                "kernel_only": last.get("kernel_only")}
+        ok = append_history(line, source="seed:bench_last_tpu.json")
+        print("bench_last_tpu.json: "
+              + ("seeded (hardware baseline)" if ok else "REJECTED"))
+        rc |= not ok
+    return rc
 
 
 _AUTO_LAST = object()  # sentinel: "read bench_last_tpu.json yourself"
@@ -190,10 +331,13 @@ def check_bench_line(line: dict) -> list[str]:
 
 
 def run_selfcheck(paths: list[str]) -> int:
-    """`bench.py --selfcheck [BENCH_rNN.json ...]` — validate driver
-    artifacts against the schema contract.  With no paths, validates
-    the line this bench WOULD emit on a cpu-fallback round (catching a
-    headline-burial regression before any artifact is written)."""
+    """`bench.py --selfcheck [BENCH_rNN.json | *.jsonl ...]` — validate
+    driver artifacts against the schema contract; .jsonl paths validate
+    as BENCH_HISTORY trajectories (every line through
+    check_history_line).  With no paths, validates the line this bench
+    WOULD emit on a cpu-fallback round (catching a headline-burial
+    regression before any artifact is written) AND the history entry
+    it would append — plus BENCH_HISTORY.jsonl itself when present."""
     rc = 0
     if not paths:
         line = compose_line(39.6, "cpu-fallback", engine="glv", bucket=64)
@@ -201,7 +345,24 @@ def run_selfcheck(paths: list[str]) -> int:
         tag = "hypothetical cpu-fallback line"
         print(f"{tag}: " + ("ok" if not probs else "; ".join(probs)))
         rc |= bool(probs)
+        entry = {"v": HISTORY_VERSION,
+                 "appended_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                 "source": "bench.py", "record": line}
+        probs = check_history_line(entry)
+        print("hypothetical history entry: "
+              + ("ok" if not probs else "; ".join(probs)))
+        rc |= bool(probs)
+        if os.path.exists(HISTORY_PATH):
+            paths = [HISTORY_PATH]
     for p in paths:
+        if p.endswith(".jsonl"):
+            try:
+                entries = load_history(p)
+                print(f"{p}: ok ({len(entries)} entries)")
+            except (ValueError, OSError) as e:
+                print(f"{p}: {e}")
+                rc = 1
+            continue
         try:
             with open(p) as f:
                 rec = json.load(f)
@@ -620,6 +781,9 @@ def main():
     if "--selfcheck" in sys.argv:
         sys.exit(run_selfcheck(
             [a for a in sys.argv[1:] if not a.startswith("-")]))
+    if "--seed-history" in sys.argv:
+        sys.exit(seed_history(
+            [a for a in sys.argv[1:] if not a.startswith("-")]))
 
     # A hang is not an Exception: if the tunnel drops after the probe, the
     # try/except below never fires.  The watchdog emits the JSON line and
@@ -654,13 +818,15 @@ def main():
         if "route" in sys.argv[1:]:
             r = run_route_bench(platform)
             guard.cancel()
-            print(json.dumps(compose_route_line(
+            rline = compose_route_line(
                 r["qps"], platform, batch=r["batch"],
                 n_channels=r["n_channels"], host_rps=r["host_rps"],
                 extra={"n_nodes": r["n_nodes"], "queries": r["queries"],
                        "fallbacks": r["fallbacks"],
                        "seconds": round(r["seconds"], 3),
-                       "planes": r["planes"]})), flush=True)
+                       "planes": r["planes"]})
+            append_history(rline)
+            print(json.dumps(rline), flush=True)
             return
         # --metrics: bracket the run with obs snapshots and embed the
         # diff, so an offline bench round reports through the SAME
@@ -688,6 +854,7 @@ def main():
             extra={"n_sigs": r["n_sigs"],
                    "seconds": round(r["seconds"], 3),
                    "kernel_only": r.get("kernel_only"), **extra})
+        append_history(line)
         print(json.dumps(line), flush=True)
     except Exception as e:
         guard.cancel()

@@ -97,6 +97,11 @@ def _sign_dispatch(op: str, msg_hashes: np.ndarray, seckeys: list[int],
         return S.host_sign_batch(msg_hashes, seckeys)
     try:
         _fault.fire("sign", "sign")
+        # operand-staging accounting (doc/perf.md): B 32-byte message
+        # hashes + B 32-byte scalar keys up, B compact signatures back
+        rec["h2d_bytes"] = int(msg_hashes.nbytes) + 32 * B
+        _families.TRANSFER_BYTES.labels("sign",
+                                        "h2d").inc(rec["h2d_bytes"])
         out = S.ecdsa_sign_batch(msg_hashes, seckeys)
     except Exception as e:
         brk.record_failure()
@@ -111,6 +116,8 @@ def _sign_dispatch(op: str, msg_hashes: np.ndarray, seckeys: list[int],
         return S.host_sign_batch(msg_hashes, seckeys)
     brk.record_success()
     rec["outcome"] = "ok"
+    rec["d2h_bytes"] = 64 * B
+    _families.TRANSFER_BYTES.labels("sign", "d2h").inc(64 * B)
     _note_sign(op, B, "device")
     return out
 

@@ -874,16 +874,20 @@ def attach_admin_commands(rpc: JsonRpcServer, cfg, ring) -> None:
         every dispatch family and any armed fault-injection specs,
         doc/resilience.md), a `dispatches` section (per-family
         flight-ring occupancy + the latest DispatchRecord,
-        doc/tracing.md), and an `overload` section (degradation-ladder
+        doc/tracing.md), an `overload` section (degradation-ladder
         states, watermarks, shed counts and the recent shed ring,
-        doc/overload.md)."""
-        from ..obs import flight
+        doc/overload.md), and a `perf` section (the stage-attribution
+        report: per-family breakdown, bottleneck, retrace state and
+        device memory, doc/perf.md — the full report is `getperf`)."""
+        from ..obs import attribution, flight
         from ..resilience import overload, resilience_snapshot
 
         snap = obs.snapshot()
         snap["resilience"] = resilience_snapshot()
         snap["dispatches"] = flight.summary()
         snap["overload"] = overload.snapshot()
+        snap["perf"] = attribution.report_local(
+            metrics=snap["metrics"], flight_summary=snap["dispatches"])
         return snap
 
     async def listdispatches(family: str | None = None,
@@ -929,9 +933,45 @@ def attach_admin_commands(rpc: JsonRpcServer, cfg, ring) -> None:
         return traceexport.chrome_trace(
             _trace.records(), flight.recent(limit=dispatches))
 
+    async def getperf(family: str | None = None,
+                      kernel_rate=None) -> dict:
+        """The perf-observatory report (doc/perf.md): per dispatch
+        family, the queue-wait/prep/stall/dispatch/readback stage
+        attribution off the flight rings + clntpu_replay_* counters,
+        overlap efficiency, the named bottleneck with a
+        speedup-if-removed projection, transfer-byte rates, post-warmup
+        retrace state, and live device memory where the backend exposes
+        it.  `kernel_rate` (items/s of the kernel alone, e.g. from a
+        bench sweep) adds the roofline comparison; `family` filters to
+        verify|route|sign|mesh."""
+        from ..obs import attribution
+
+        if family is not None and family not in ("verify", "route",
+                                                 "sign", "mesh"):
+            raise RpcError(INVALID_PARAMS,
+                           f"unknown dispatch family {family!r}")
+        if kernel_rate is not None:
+            import math
+
+            try:
+                kernel_rate = float(kernel_rate)
+            except (TypeError, ValueError):
+                raise RpcError(INVALID_PARAMS,
+                               "kernel_rate must be a number")
+            # NaN slides past a <= 0 test and then poisons the
+            # roofline math AND the JSON response (json.dumps emits
+            # the non-RFC NaN token strict clients reject)
+            if not math.isfinite(kernel_rate) or kernel_rate <= 0:
+                raise RpcError(INVALID_PARAMS,
+                               "kernel_rate must be positive")
+        return attribution.report_local(
+            kernel_rate=kernel_rate,
+            families=[family] if family is not None else None)
+
     rpc.register("listconfigs", listconfigs)
     rpc.register("setconfig", setconfig)
     rpc.register("getlog", getlog)
     rpc.register("getmetrics", getmetrics)
     rpc.register("listdispatches", listdispatches)
     rpc.register("gettrace", gettrace)
+    rpc.register("getperf", getperf)

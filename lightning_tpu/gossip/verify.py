@@ -39,6 +39,8 @@ from .. import obs
 from ..crypto import field as F
 from ..crypto import secp256k1 as S
 from ..crypto import sha256 as H
+from ..obs import attribution as _attr
+from ..obs import families as _families
 from ..obs import flight as _flight
 from ..resilience import breaker as _breaker
 from ..resilience import deadline as _deadline
@@ -93,33 +95,17 @@ _M_COMPILE = obs.counter(
 # in serial mode stall == prep by definition), "dispatch" is upload +
 # program enqueue, "readback" is the single end-of-replay block on the
 # device booleans.  overlap_ratio = 1 - stall/prep: the fraction of host
-# prep wall time hidden behind device compute.
-_M_R_PREP = obs.counter(
-    "clntpu_replay_prep_seconds_total",
-    "Host bucket-prep busy time (slice + pack + pad), all buckets")
-_M_R_STALL = obs.counter(
-    "clntpu_replay_prep_stall_seconds_total",
-    "Prep time visible on the dispatch critical path (queue-empty waits; "
-    "== prep time when the pipeline is serial/depth 0)")
-_M_R_DISPATCH = obs.counter(
-    "clntpu_replay_dispatch_seconds_total",
-    "Dispatch-thread time spent uploading + enqueueing bucket programs")
-_M_R_READBACK = obs.counter(
-    "clntpu_replay_readback_seconds_total",
-    "Time blocked on the single end-of-replay device readback")
-_M_R_OVERLAP = obs.histogram(
-    "clntpu_replay_overlap_ratio",
-    "Per-replay fraction of host prep hidden behind device compute "
-    "(1 - stall/prep; serial pipelines observe 0)",
-    buckets=obs.RATIO_BUCKETS)
-_M_R_QDEPTH = obs.histogram(
-    "clntpu_replay_queue_depth",
-    "Prepared-bucket queue depth sampled at each dispatch",
-    buckets=obs.log2_buckets(1.0, 16.0))
-_M_R_BUCKETS = obs.counter(
-    "clntpu_replay_buckets_total",
-    "Fused bucket dispatches, by device path",
-    labelnames=("path",))
+# prep wall time hidden behind device compute.  Families are DECLARED
+# in obs/families.py (jax-free) so the attribution model and capture
+# tools see them without this module's crypto-stack import.
+_M_R_PREP = _families.REPLAY_PREP
+_M_R_STALL = _families.REPLAY_STALL
+_M_R_DISPATCH = _families.REPLAY_DISPATCH
+_M_R_READBACK = _families.REPLAY_READBACK
+_M_R_OVERLAP = _families.REPLAY_OVERLAP
+_M_R_QDEPTH = _families.REPLAY_QDEPTH
+_M_R_BUCKETS = _families.REPLAY_BUCKETS
+_M_TRANSFER = _families.TRANSFER_BYTES
 
 # every (program, shape) jax compiles exactly once per process; tracking
 # first-sights here turns "did the live path hit a compile stall?" into
@@ -132,6 +118,10 @@ def _note_shape(program: str, key: tuple) -> None:
     if (program, key) not in _seen_shapes:
         _seen_shapes.add((program, key))
         _M_COMPILE.labels(program).inc()
+        # the retrace detector (obs/attribution.py): once warmup() has
+        # completed, a first-sight here means a LIVE flush paid a
+        # compile — clntpu_retrace_total fires + the `retrace` topic
+        _attr.note_program(program, key)
 
 
 def gossip_hash_kernel(blocks, n_blocks):
@@ -207,7 +197,17 @@ def warmup(bucket: int = DEFAULT_BUCKET) -> None:
     (the bucket planner guarantees those are the only live shapes).
     The unfused 3-program chain is warmed only when the fallback is
     selected (LIGHTNING_TPU_REPLAY_FUSED=0) — eagerly tracing programs
-    the process will never dispatch costs seconds per warmup call."""
+    the process will never dispatch costs seconds per warmup call.
+
+    Runs inside attribution.warmup_scope(): the shapes compiled here
+    are EXPECTED first-sights, and the scope's exit arms the retrace
+    detector — any program-shape first-sight after this call is a live
+    compile stall and fires clntpu_retrace_total (doc/perf.md)."""
+    with _attr.warmup_scope():
+        _warmup_inner(bucket)
+
+
+def _warmup_inner(bucket: int) -> None:
     nb = jnp.ones((bucket,), jnp.int32)
     idx = jnp.zeros((bucket,), jnp.int32)
     fused_on = _os.environ.get("LIGHTNING_TPU_REPLAY_FUSED", "1") != "0"
@@ -782,6 +782,11 @@ def _wrap_resilient(device_fn, items: VerifyItems, roi: np.ndarray,
             return ok
         try:
             _fault.fire("dispatch", "verify")
+            # operand upload happens inside device_fn (jnp.asarray on
+            # the packed planes): account the staged bytes against THIS
+            # dispatch only when a device dispatch is actually attempted
+            rec["h2d_bytes"] = pb.staged_bytes
+            _M_TRANSFER.labels("verify", "h2d").inc(pb.staged_bytes)
             ok = device_fn(pb)
         except Exception as e:
             brk.record_failure()
@@ -993,7 +998,14 @@ def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
                 t0b = time.perf_counter()
                 try:
                     _fault.fire("readback", "verify")
-                    out[idx] = np.asarray(ok)[:n_real]
+                    ok_host = np.asarray(ok)
+                    out[idx] = ok_host[:n_real]
+                    if rec["outcome"] in ("ok", "bisect"):
+                        # the replay's only device→host transfer: the
+                        # boolean plane this bucket read back
+                        rec["d2h_bytes"] = ok_host.nbytes
+                        _M_TRANSFER.labels("verify",
+                                           "d2h").inc(ok_host.nbytes)
                 except Exception as e:
                     brk.record_failure()
                     _quarantine.note("verify", "readback", n_real)

@@ -126,6 +126,61 @@ INGEST_BACKLOG = REGISTRY.gauge(
     "Total unverified ingest backlog: queued signatures plus the "
     "in-flight flush batch (the queue gauge counts only queued)")
 
+# -- gossip/verify.py: streaming-replay pipeline stages --------------------
+# (doc/replay_pipeline.md owns the timing vocabulary; declared here so
+# jax-free consumers — tools/obs_snapshot.py capture, the attribution
+# model in obs/attribution.py, perf_report --selfcheck — see the series
+# present-at-zero and can drive them synthetically without the crypto
+# stack.)  "prep" is host bucket build (slice + pack + pad), "stall" is
+# the slice of prep VISIBLE on the dispatch thread's critical path,
+# "dispatch" is upload + program enqueue, "readback" is the single
+# end-of-replay block on the device booleans.
+REPLAY_PREP = REGISTRY.counter(
+    "clntpu_replay_prep_seconds_total",
+    "Host bucket-prep busy time (slice + pack + pad), all buckets")
+REPLAY_STALL = REGISTRY.counter(
+    "clntpu_replay_prep_stall_seconds_total",
+    "Prep time visible on the dispatch critical path (queue-empty waits; "
+    "== prep time when the pipeline is serial/depth 0)")
+REPLAY_DISPATCH = REGISTRY.counter(
+    "clntpu_replay_dispatch_seconds_total",
+    "Dispatch-thread time spent uploading + enqueueing bucket programs")
+REPLAY_READBACK = REGISTRY.counter(
+    "clntpu_replay_readback_seconds_total",
+    "Time blocked on the single end-of-replay device readback")
+REPLAY_OVERLAP = REGISTRY.histogram(
+    "clntpu_replay_overlap_ratio",
+    "Per-replay fraction of host prep hidden behind device compute "
+    "(1 - stall/prep; serial pipelines observe 0)",
+    buckets=RATIO_BUCKETS)
+REPLAY_QDEPTH = REGISTRY.histogram(
+    "clntpu_replay_queue_depth",
+    "Prepared-bucket queue depth sampled at each dispatch",
+    buckets=_r.log2_buckets(1.0, 16.0))
+REPLAY_BUCKETS = REGISTRY.counter(
+    "clntpu_replay_buckets_total",
+    "Fused bucket dispatches, by device path",
+    labelnames=("path",))
+
+# -- obs/attribution.py: the perf observatory (doc/perf.md) ----------------
+TRANSFER_BYTES = REGISTRY.counter(
+    "clntpu_transfer_bytes_total",
+    "Host<->device bytes staged for batched dispatches, by family and "
+    "direction (h2d = operand upload, d2h = result readback; "
+    "operand-size accounting, not a PCIe counter)",
+    labelnames=("family", "direction"))
+RETRACE = REGISTRY.counter(
+    "clntpu_retrace_total",
+    "Program-shape compile first-sights AFTER warmup() completed — "
+    "every increment is an anomaly (a live dispatch paid a compile the "
+    "warmup contract promises it never does), by program",
+    labelnames=("program",))
+DEVICE_MEMORY = REGISTRY.gauge(
+    "clntpu_device_memory_bytes",
+    "Live device-memory statistics where the backend exposes "
+    "memory_stats() (TPU does; CPU reports nothing), by device and stat",
+    labelnames=("device", "stat"))
+
 # -- obs/flight.py: the dispatch flight recorder (doc/tracing.md) ----------
 DISPATCHES = REGISTRY.counter(
     "clntpu_dispatches_total",

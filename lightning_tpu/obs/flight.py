@@ -135,6 +135,11 @@ def begin(family: str, *, corr_ids=(), shape=None, n_real: int = 0,
         "dispatch_ms": None,
         "readback_ms": None,
         "breaker_state": breaker_state,
+        # host<->device operand bytes staged for THIS dispatch (the
+        # perf-attribution model's transfer accounting, doc/perf.md);
+        # dispatch sites fill them in when a device path actually runs
+        "h2d_bytes": 0,
+        "d2h_bytes": 0,
         "faults": [],
         "quarantined": 0,
         "outcome": None,

@@ -69,6 +69,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import enable_x64
 
+from ..obs import attribution as _attr
 from ..obs import families as _families
 from ..obs import flight as _flight
 from ..resilience import breaker as _breaker
@@ -215,7 +216,10 @@ _PARAM_PLANES = ("edge_base", "edge_ppm", "edge_cltv", "edge_hmin",
 
 
 def _device_plane_args(planes: RoutePlanes) -> tuple:
-    """Upload (once per planes revision) and return the shared operands.
+    """Upload (once per planes revision) and return (operands,
+    staged_bytes) — the shared device planes plus how many host bytes
+    this call actually staged (zero when every plane was carried over;
+    the perf-attribution transfer accounting, doc/perf.md).
     A param-refresh revision arrives with the topology uploads carried
     over, so only the missing planes stage; an incremental revision
     (planes.patch_idx set by with_patched_params) scatters JUST the
@@ -223,21 +227,28 @@ def _device_plane_args(planes: RoutePlanes) -> tuple:
     burst costs O(changed) device traffic, not a full re-upload.
     int64 planes must cross jnp.asarray inside the x64 scope or they
     silently truncate to int32."""
+    staged = 0
     patch = planes.patch_idx
     if patch is not None and len(patch):
         with enable_x64():
             ji = jnp.asarray(patch)
+            staged += patch.nbytes if hasattr(patch, "nbytes") \
+                else len(patch) * 8
             for name in _PARAM_PLANES:
                 if name in planes.dev:
-                    vals = jnp.asarray(getattr(planes, name)[patch])
+                    host_vals = getattr(planes, name)[patch]
+                    staged += host_vals.nbytes
+                    vals = jnp.asarray(host_vals)
                     planes.dev[name] = planes.dev[name].at[ji].set(vals)
     planes.patch_idx = None
     missing = [n for n in _PLANE_ORDER if n not in planes.dev]
     if missing:
         with enable_x64():
             for name in missing:
-                planes.dev[name] = jnp.asarray(getattr(planes, name))
-    return tuple(planes.dev[n] for n in _PLANE_ORDER)
+                host_plane = getattr(planes, name)
+                staged += host_plane.nbytes
+                planes.dev[name] = jnp.asarray(host_plane)
+    return tuple(planes.dev[n] for n in _PLANE_ORDER), staged
 
 
 # ---------------------------------------------------------------------------
@@ -320,13 +331,20 @@ def _reconstruct(planes: RoutePlanes, via: np.ndarray, src: int, dst: int,
 
 def solve_batch(planes: RoutePlanes, queries: list[RouteQuery],
                 batch: int = ROUTE_BATCH,
-                max_hops: int = DEFAULT_MAX_HOPS) -> list[tuple]:
+                max_hops: int = DEFAULT_MAX_HOPS,
+                io_acct: dict | None = None) -> list[tuple]:
     """Solve every query on the device in ⌈Q/batch⌉ vmapped dispatches.
 
     Returns one tuple per query:
       ("ok", route, (src_amount, src_delay))  — reachable, exact
       ("noroute", message)                    — provably unreachable
       ("fallback", reason)                    — solve on the host instead
+
+    ``io_acct`` (when given) accumulates the host<->device operand
+    bytes this call staged under keys ``h2d_bytes``/``d2h_bytes`` —
+    RouteService folds them into the flush's flight record; the
+    clntpu_transfer_bytes_total{family="route"} counters are metered
+    here either way (doc/perf.md).
     """
     g = planes.g
     out: list[tuple] = [None] * len(queries)
@@ -338,7 +356,16 @@ def solve_batch(planes: RoutePlanes, queries: list[RouteQuery],
             i = idx_cache[nid] = g.node_index(nid)
         return i
 
-    plane_args = _device_plane_args(planes)
+    plane_args, h2d = _device_plane_args(planes)
+    d2h = 0
+    # retrace detector: the traced program is keyed by EVERY static
+    # operand shape — node pad, edge pad (e_pad grows independently of
+    # n_pad on channel bursts and re-traces under the same lru_cache'd
+    # jit callable), the query batch width, and the sweep budget.  A
+    # first-sight of this full key after warmup means this flush is
+    # paying a compile (doc/perf.md)
+    _attr.note_program("route",
+                       (planes.n_pad, planes.e_pad, batch, max_hops))
     kern = _jit_route(planes.n_pad, max_hops)
     for start in range(0, len(queries), batch):
         chunk = queries[start:start + batch]
@@ -380,6 +407,8 @@ def solve_batch(planes: RoutePlanes, queries: list[RouteQuery],
             cltv[i] = q.final_cltv
             rf[i] = q.riskfactor
             ok_mat[i] = planes.edge_ok_mask(q.excluded_scids)
+        h2d += (ok_mat.nbytes + src.nbytes + dst.nbytes
+                + amount.nbytes + cltv.nbytes + rf.nbytes)
         with enable_x64():
             dist_src, via, ovf = kern(
                 *plane_args, jnp.asarray(ok_mat), jnp.asarray(src),
@@ -388,6 +417,7 @@ def solve_batch(planes: RoutePlanes, queries: list[RouteQuery],
             dist_src = np.asarray(dist_src)
             via = np.asarray(via)
             ovf = np.asarray(ovf)
+        d2h += dist_src.nbytes + via.nbytes + ovf.nbytes
         for i, q in enumerate(chunk):
             if out[start + i] is not None:
                 continue       # resolved as an error above
@@ -408,6 +438,11 @@ def solve_batch(planes: RoutePlanes, queries: list[RouteQuery],
                     log.warning("route reconstruction diverged (%s); "
                                 "host re-solves", e)
                     out[start + i] = ("fallback", R_RECONSTRUCT)
+    _families.TRANSFER_BYTES.labels("route", "h2d").inc(h2d)
+    _families.TRANSFER_BYTES.labels("route", "d2h").inc(d2h)
+    if io_acct is not None:
+        io_acct["h2d_bytes"] = io_acct.get("h2d_bytes", 0) + h2d
+        io_acct["d2h_bytes"] = io_acct.get("d2h_bytes", 0) + d2h
     return out
 
 
@@ -435,8 +470,13 @@ def warmup(batch: int = ROUTE_BATCH, n_pad: int = 64, e_pad: int = 256,
     """Compile (or load from the persistent cache) the route program at
     the given quantized shape, off the live path — same contract as
     gossip.verify.warmup.  Daemons call RouteService.warmup() instead,
-    which passes the live planes' actual padded shape."""
-    with enable_x64():
+    which passes the live planes' actual padded shape.
+
+    Wrapped in attribution.warmup_scope(): this first-sight is the
+    expected one; a LATER first-sight of a different (n_pad, max_hops)
+    fires clntpu_retrace_total{program="route"} (doc/perf.md)."""
+    with _attr.warmup_scope(), enable_x64():
+        _attr.note_program("route", (n_pad, e_pad, batch, max_hops))
         zeros_i64 = jnp.zeros((e_pad,), jnp.int64)
         np.asarray(_jit_route(n_pad, max_hops)(
             jnp.zeros((e_pad,), jnp.int32), jnp.zeros((e_pad,), jnp.int32),
@@ -722,6 +762,7 @@ class RouteService:
             rec["n_real"] = len(device)
             rec["lanes"] = lanes
             rec["occupancy"] = round(len(device) / lanes, 4)
+            io_acct: dict = {}
             try:
                 _fault.fire("dispatch", "route")
                 self._planes = RoutePlanes.current(g, self._planes)
@@ -731,11 +772,14 @@ class RouteService:
                 with trace.annotation("route/dispatch"):
                     results = await _deadline.guard(
                         asyncio.to_thread(solve_batch, self._planes,
-                                          device, self.batch),
+                                          device, self.batch,
+                                          io_acct=io_acct),
                         family="route", seam="dispatch")
                 _M_OCCUPANCY.observe(len(device) / lanes)
                 brk.record_success()
                 rec["outcome"] = "ok"
+                rec["h2d_bytes"] = io_acct.get("h2d_bytes", 0)
+                rec["d2h_bytes"] = io_acct.get("d2h_bytes", 0)
             except _deadline.DeadlineExceeded:
                 brk.record_failure()
                 rec["outcome"] = "deadline"

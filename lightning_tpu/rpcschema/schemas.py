@@ -300,6 +300,14 @@ COMMANDS: dict[str, dict] = {
         "params": {},
         "result": {"metrics": "dict", "resilience": "dict",
                    "dispatches": "dict"},
+        # overload + perf sections ride in `.extra` (result fields are
+        # documentation; unschema'd keys cross both transports intact)
+    },
+    "getperf": {
+        "params": {"family": "str?", "kernel_rate": "any?"},
+        "result": {"generated_at": "any", "epsilon": "any",
+                   "kernel_rate": "any", "families": "dict",
+                   "retraces": "dict", "device_memory": "dict"},
     },
     "listdispatches": {
         "params": {"family": "str?", "limit": "int?"},

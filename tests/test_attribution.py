@@ -1,0 +1,487 @@
+"""Perf-observatory model tests (doc/perf.md): the synthetic
+flight-ring attribution corpus — hand-built rings with KNOWN stage
+splits must yield the exact expected breakdown, bottleneck name, and
+speedup-if-removed projection — plus the post-warmup retrace detector
+and the BENCH_HISTORY.jsonl schema + regression gate.
+
+Deliberately jax-free end to end (obs/attribution.py is an obs-package
+module; bench.py's top-level imports are stdlib): the whole file runs
+in milliseconds and sorts early in tier-1 without displacing dots.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))) + "/tools")
+
+import bench  # noqa: E402
+import perf_report  # noqa: E402
+from lightning_tpu.obs import attribution, families, flight  # noqa: E402
+from lightning_tpu.utils import events  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    attribution.reset_for_tests()
+    flight.reset_for_tests()
+    events.reset()
+    yield
+    attribution.reset_for_tests()
+    flight.reset_for_tests()
+    events.reset()
+
+
+def _rec(family="verify", qw=2.0, prep=5.0, disp=4.0, rb=1.0,
+         n=64, ts_ns=None, **extra):
+    r = {"dispatch_id": 1, "family": family, "ts": 0.0,
+         "ts_ns": ts_ns if ts_ns is not None else 0,
+         "queue_wait_ms": qw, "prep_ms": prep, "dispatch_ms": disp,
+         "readback_ms": rb, "n_real": n, "lanes": n, "outcome": "ok",
+         "h2d_bytes": 0, "d2h_bytes": 0, "quarantined": 0}
+    r.update(extra)
+    return r
+
+
+# ---------------------------------------------------------------------------
+# the attribution corpus: known splits → exact expected output
+
+
+def test_overlapped_breakdown_exact():
+    """verify-family shape: counters are authoritative, the stall is
+    the only visible prep, critical = stall + dispatch + readback."""
+    n = 10
+    records = [_rec(qw=3.0, prep=8.0, disp=6.0, rb=1.0)
+               for _ in range(n)]
+    totals = {"prep": n * 8.0 / 1e3, "stall": n * 3.0 / 1e3,
+              "dispatch": n * 6.0 / 1e3, "readback": n * 1.0 / 1e3}
+    sec = attribution.attribute_family("verify", records,
+                                       stage_totals_s=totals,
+                                       kernel_rate=1000.0)
+    st = sec["stages"]
+    assert st["prep_s"] == pytest.approx(0.08)
+    assert st["stall_s"] == pytest.approx(0.03)
+    assert st["dispatch_s"] == pytest.approx(0.06)
+    assert st["readback_s"] == pytest.approx(0.01)
+    assert sec["critical_path_s"] == pytest.approx(0.10)
+    assert sorted(sec["critical_path"]) == ["dispatch", "readback",
+                                            "stall"]
+    assert sec["bottleneck"] == "dispatch"
+    # Amdahl by hand: crit 10ms/dispatch, dispatch 6ms → 10/4 = 2.5x
+    assert sec["speedup_if_removed"]["dispatch"] == pytest.approx(2.5)
+    assert sec["speedup_if_removed"]["stall"] == pytest.approx(
+        10 / 7, abs=1e-4)
+    assert sec["speedup_if_removed"]["readback"] == pytest.approx(
+        10 / 9, abs=1e-4)
+    assert sec["overlap_ratio"] == pytest.approx(1 - 3 / 8)
+    assert sec["hidden_prep_s"] == pytest.approx(0.05)
+    # throughput = items / critical seconds; roofline vs 1000/s kernel
+    assert sec["throughput_per_s"] == pytest.approx(640 / 0.10)
+    assert sec["roofline"]["gap_x"] == pytest.approx(
+        1000.0 / 6400.0, abs=0.01)
+    # ring agrees with counters exactly here → reconciliation clean
+    recon = sec["reconciliation"]
+    assert recon["checked"] and recon["ok"]
+    assert recon["max_rel_err"] == 0.0
+
+
+def test_serial_breakdown_exact():
+    """route/sign-family shape: no stage counters, every stage is on
+    the critical path and prep is fully visible."""
+    records = [_rec(family="route", qw=2.0, prep=1.0, disp=7.0, rb=0.0,
+                    n=8) for _ in range(5)]
+    sec = attribution.attribute_family("route", records)
+    assert sec["pipeline"] == "serial"
+    assert sec["critical_path_s"] == pytest.approx(5 * 10.0 / 1e3)
+    assert sec["bottleneck"] == "dispatch"
+    assert sorted(sec["critical_path"]) == ["dispatch", "prep",
+                                            "queue_wait", "readback"]
+    assert sec["speedup_if_removed"]["dispatch"] == pytest.approx(
+        10 / 3, abs=1e-4)
+    assert "reconciliation" not in sec
+
+
+def test_each_stage_wins_when_inflated():
+    """The bottleneck follows the inflated stage — the selfcheck
+    contract, swept across every critical stage."""
+    for inflate, expect in (("qw", "stall"), ("disp", "dispatch"),
+                            ("rb", "readback")):
+        base = {"qw": 2.0, "disp": 3.0, "rb": 1.0}
+        base[inflate] *= 20
+        n = 4
+        records = [_rec(qw=base["qw"], prep=base["qw"] + 1.0,
+                        disp=base["disp"], rb=base["rb"])
+                   for _ in range(n)]
+        totals = {"prep": n * (base["qw"] + 1.0) / 1e3,
+                  "stall": n * base["qw"] / 1e3,
+                  "dispatch": n * base["disp"] / 1e3,
+                  "readback": n * base["rb"] / 1e3}
+        sec = attribution.attribute_family("verify", records,
+                                           stage_totals_s=totals)
+        assert sec["bottleneck"] == expect, (inflate, sec["bottleneck"])
+
+
+def test_reconciliation_flags_unattributed_time():
+    """Counters that disagree with the ring beyond epsilon must be
+    reported as a reconciliation failure, not silently averaged."""
+    n = 8
+    records = [_rec(qw=2.0, prep=4.0, disp=3.0, rb=1.0)
+               for _ in range(n)]
+    totals = {"prep": n * 4.0 / 1e3, "stall": n * 2.0 / 1e3,
+              "dispatch": 2 * n * 3.0 / 1e3,  # 2x what the ring saw
+              "readback": n * 1.0 / 1e3}
+    sec = attribution.attribute_family("verify", records,
+                                       stage_totals_s=totals)
+    recon = sec["reconciliation"]
+    assert recon["checked"] and not recon["ok"]
+    assert recon["rel_err"]["dispatch"] == pytest.approx(0.5)
+
+
+def test_incomplete_ring_skips_reconciliation():
+    sec = attribution.attribute_family(
+        "verify", [_rec()], stage_totals_s={"prep": 1.0, "stall": 0.5,
+                                            "dispatch": 0.2,
+                                            "readback": 0.1},
+        ring_complete=False)
+    assert sec["reconciliation"]["checked"] is False
+
+
+def test_transfer_and_wall_span():
+    records = [
+        _rec(ts_ns=0, h2d_bytes=1000, d2h_bytes=10),
+        _rec(ts_ns=50_000_000, h2d_bytes=2000, d2h_bytes=20),
+    ]
+    sec = attribution.attribute_family("sign", records)
+    assert sec["transfer"]["h2d_bytes"] == 3000
+    assert sec["transfer"]["d2h_bytes"] == 30
+    # span = 50ms between starts + the last record's own
+    # qw+prep+dispatch+readback (2+5+4+1 = 12ms) — prep included so a
+    # serial family's span is never smaller than its critical path
+    assert sec["wall_span_s"] == pytest.approx(0.062)
+    assert sec["wall_span_s"] >= sec["critical_path_s"] / 2  # 2 recs
+
+
+def test_report_local_uses_live_ring_and_counters():
+    for _ in range(3):
+        rec = flight.begin("verify", n_real=8, lanes=8,
+                           queue_wait_ms=2.0, prep_ms=4.0)
+        rec["readback_ms"] = 1.0
+        flight.finish(rec, "ok", dispatch_ms=3.0)
+    families.REPLAY_PREP.inc(0.012)
+    families.REPLAY_STALL.inc(0.006)
+    families.REPLAY_DISPATCH.inc(0.009)
+    families.REPLAY_READBACK.inc(0.003)
+    rep = attribution.report_local(kernel_rate=100.0)
+    fam = rep["families"]["verify"]
+    assert fam["pipeline"] == "overlapped"
+    assert fam["reconciliation"]["ok"]
+    assert fam["bottleneck"] == "dispatch"
+    c = attribution.compact(rep)
+    assert c["families"]["verify"]["bottleneck"] == "dispatch"
+
+
+def test_report_from_snapshot_offline():
+    snap = {
+        "metrics": {},
+        "dispatch_log": [_rec(family="route", n=8) for _ in range(4)],
+        "dispatches": {"families": {"route": {"total": 4}}},
+    }
+    rep = attribution.report_from_snapshot(snap)
+    assert rep["families"]["route"]["dispatches"] == 4
+    assert rep["families"]["route"]["pipeline"] == "serial"
+
+
+# ---------------------------------------------------------------------------
+# the retrace detector
+
+
+def test_retrace_fires_only_after_warmup():
+    got = []
+    events.subscribe("retrace", got.append)
+    # before any warmup: first-sights are silent (cold test processes
+    # must not spam anomalies)
+    assert not attribution.note_program("fused", (8, 4))
+    with attribution.warmup_scope():
+        assert not attribution.note_program("fused", (8, 8))
+    # armed now: a seen shape stays quiet, a NEW one is the anomaly
+    assert not attribution.note_program("fused", (8, 8))
+    assert attribution.note_program("fused", (16, 8))
+    assert len(got) == 1
+    assert got[0]["program"] == "fused" and got[0]["key"] == [16, 8]
+    st = attribution.retrace_state()
+    assert st["armed"] and st["total"] == 1
+    assert st["recent"][0]["program"] == "fused"
+
+
+def test_retrace_counter_increments():
+    from lightning_tpu.obs import REGISTRY
+
+    def count():
+        fam = REGISTRY.snapshot()["metrics"].get("clntpu_retrace_total",
+                                                 {"samples": []})
+        return sum(s["value"] for s in fam["samples"]
+                   if s["labels"].get("program") == "prog_x")
+
+    before = count()
+    with attribution.warmup_scope():
+        attribution.note_program("prog_x", (1,))
+    attribution.note_program("prog_x", (2,))
+    assert count() == before + 1
+
+
+def test_rates_use_ring_window_when_ring_wrapped():
+    """Counters are process-lifetime, the ring is bounded: once the
+    ring wraps, throughput/transfer rates must divide ring items by
+    RING-window seconds, not by the (much larger) lifetime totals."""
+    n = 4
+    records = [_rec(qw=2.0, prep=5.0, disp=3.0, rb=1.0, n=64,
+                    h2d_bytes=1000) for _ in range(n)]
+    # lifetime counters: 100x the window (the ring kept 4 of ~400)
+    totals = {"prep": 0.5, "stall": 0.2, "dispatch": 0.3,
+              "readback": 0.1}
+    sec = attribution.attribute_family("verify", records,
+                                       stage_totals_s=totals,
+                                       ring_complete=False,
+                                       kernel_rate=10_000.0)
+    window = n * (2.0 + 3.0 + 1.0) / 1e3     # ring qw+disp+rb
+    assert sec["window_s"] == pytest.approx(window)
+    assert sec["throughput_per_s"] == pytest.approx(
+        n * 64 / window, rel=1e-3)
+    assert sec["transfer"]["h2d_bytes_per_s"] == pytest.approx(
+        n * 1000 / window, rel=1e-3)
+    assert sec["roofline"]["achieved_items_per_s"] == pytest.approx(
+        n * 64 / window, rel=1e-3)
+    # the stage breakdown itself stays lifetime (the authoritative
+    # totals the bottleneck/speedup are computed from)
+    assert sec["critical_path_s"] == pytest.approx(0.6)
+
+
+def test_retrace_total_is_monotonic_beyond_ring():
+    with attribution.warmup_scope():
+        pass
+    for i in range(70):
+        assert attribution.note_program("p", (i,))
+    st = attribution.retrace_state()
+    assert st["total"] == 70
+    assert len(st["recent"]) == 64   # the ring stays bounded
+
+
+def test_nested_warmup_scopes_suppress():
+    with attribution.warmup_scope():
+        with attribution.warmup_scope():
+            assert not attribution.note_program("p", (1,))
+        # still inside the outer scope: expected, not an anomaly
+        assert not attribution.note_program("p", (2,))
+    assert attribution.note_program("p", (3,))
+
+
+def test_sample_device_memory_never_imports_jax(monkeypatch):
+    # the sampler peeks sys.modules instead of importing: in a process
+    # without jax it must return {} rather than trigger the (possibly
+    # hanging) accelerator probe.  Simulated here because the pytest
+    # session itself may already have jax loaded via other files.
+    monkeypatch.setitem(sys.modules, "jax", None)
+    assert attribution.sample_device_memory() == {}
+
+
+# ---------------------------------------------------------------------------
+# BENCH_HISTORY.jsonl schema + seeding
+
+
+def _entry(rec, legacy=False, **over):
+    e = {"v": bench.HISTORY_VERSION, "appended_at": "2026-08-04T00:00:00",
+         "source": "test", "record": rec}
+    if legacy:
+        e["legacy"] = True
+    e.update(over)
+    return e
+
+
+def _hw_line(value=100_000.0, **over):
+    line = {"metric": bench.METRIC, "unit": bench.UNIT,
+            "value": value,
+            "vs_baseline": round(value / bench.BASELINE_CPU_OPS, 3),
+            "platform": "tpu", "engine": "pallas_fbj+pp",
+            "bucket": 16384, "measurement": "live",
+            "measured_at": "2026-08-01",
+            "kernel_only": {"throughput": 200_000.0,
+                            "ms_per_call": 81.55}}
+    line.update(over)
+    return line
+
+
+def test_history_line_schema():
+    assert bench.check_history_line(_entry(_hw_line())) == []
+    assert bench.check_history_line(_entry({"metric": bench.METRIC,
+                                            "unit": bench.UNIT,
+                                            "value": 3.2},
+                                           legacy=True)) == []
+    # wrapper violations
+    assert bench.check_history_line(_entry(_hw_line(), v=2))
+    assert bench.check_history_line(_entry(_hw_line(), appended_at=""))
+    assert bench.check_history_line(_entry("not a dict"))
+    # a non-legacy record is held to the full bench-line contract
+    bad = _hw_line()
+    del bad["measurement"]
+    assert any("measurement" in p
+               for p in bench.check_history_line(_entry(bad)))
+    # legacy is exempt from the contract but never from the core
+    assert bench.check_history_line(_entry({"metric": bench.METRIC},
+                                           legacy=True))
+
+
+def test_append_history_gates_on_schema(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    assert bench.append_history(_hw_line(), path=path)
+    # schema-violating record must NOT be written
+    assert not bench.append_history({"metric": bench.METRIC},
+                                    path=path)
+    entries = bench.load_history(path)
+    assert len(entries) == 1
+    assert entries[0]["record"]["value"] == 100_000.0
+
+
+def test_load_history_raises_on_corrupt_line(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(_entry(_hw_line())) + "\n")
+        f.write("{broken\n")
+    with pytest.raises(ValueError):
+        bench.load_history(path)
+
+
+def test_committed_history_is_schema_clean():
+    """The seeded BENCH_HISTORY.jsonl artifact must validate — the
+    regression gate runs against it from day one."""
+    path = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+    entries = bench.load_history(path)
+    assert entries, "history must be seeded"
+    # the satellite contract: a REAL-hardware baseline is present
+    hw = [e for e in entries
+          if e["record"].get("platform") not in ("cpu", "cpu-fallback")
+          and isinstance(e["record"].get("value"), (int, float))]
+    assert hw, "history must carry a hardware baseline"
+    assert any(e["source"].startswith("seed:BENCH_r")
+               for e in entries), "BENCH_rNN artifacts must be seeded"
+
+
+# ---------------------------------------------------------------------------
+# the regression gate
+
+
+def test_compare_records_flags_throughput_and_latency():
+    base = _hw_line()
+    regressed = _hw_line(value=50_000.0,
+                         kernel_only={"throughput": 120_000.0,
+                                      "ms_per_call": 120.0})
+    regs = perf_report.compare_records(base, regressed, 0.10)
+    assert any("throughput" in r for r in regs)
+    assert any("ms/call" in r for r in regs)
+    assert perf_report.compare_records(base, _hw_line(value=95_000.0),
+                                       0.10) == []
+
+
+def test_compare_gate_exits_nonzero_on_seeded_regression(tmp_path):
+    """The acceptance criterion: a seeded synthetic regression in the
+    history makes `perf_report.py --compare` exit non-zero."""
+    path = str(tmp_path / "hist.jsonl")
+
+    def add(value, day):
+        line = _hw_line(value=value, measured_at=f"2026-08-{day:02d}")
+        line["vs_baseline"] = round(value / bench.BASELINE_CPU_OPS, 3)
+        assert bench.append_history(line, source="t", path=path)
+
+    add(100_000.0, 1)
+    add(40_000.0, 2)
+    assert perf_report.run_compare(path, 0.10) == 1
+    # the regressed record is in the history but must NOT become the
+    # baseline (no ratchet-down): a still-regressed follow-up keeps
+    # failing against the best of the recent window
+    add(41_000.0, 3)
+    assert perf_report.run_compare(path, 0.10) == 1
+    # a recovered run within tolerance of the best passes again
+    add(97_000.0, 4)
+    assert perf_report.run_compare(path, 0.10) == 0
+
+
+def test_compare_ignores_platformless_legacy_baselines(tmp_path):
+    """A pre-contract legacy seed without a platform key must never
+    serve as the hardware baseline."""
+    path = str(tmp_path / "hist.jsonl")
+    entry = {"v": bench.HISTORY_VERSION,
+             "appended_at": "2026-08-01T00:00:00", "source": "seed:x",
+             "legacy": True,
+             "record": {"metric": bench.METRIC, "unit": bench.UNIT,
+                        "value": 3.2}}
+    assert bench.check_history_line(entry) == []
+    with open(path, "w") as f:
+        f.write(json.dumps(entry) + "\n")
+    assert bench.append_history(_hw_line(), source="t", path=path)
+    # 100k hardware vs the 3.2 platform-less record: no hardware
+    # baseline exists → nothing to gate, not a 31000x "improvement"
+    # against a cpu-era number
+    assert perf_report.run_compare(path, 0.10) == 0
+
+
+def test_compare_skips_replayed_candidates(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    assert bench.append_history(_hw_line(), source="t", path=path)
+    replay = _hw_line(measurement="replayed:bench_last_tpu.json")
+    replay["fallback_run"] = {"value": 39.6, "platform": "cpu-fallback"}
+    assert bench.append_history(replay, source="t", path=path)
+    # the replayed record carries no new measurement: candidate stays
+    # the live one, nothing to gate, rc 0
+    assert perf_report.run_compare(path, 0.10) == 0
+
+
+def test_compare_hardware_never_gates_against_cpu(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    cpu = {"metric": bench.METRIC, "unit": bench.UNIT, "value": 39.6,
+           "vs_baseline": 0.001, "platform": "cpu-fallback",
+           "measurement": "live", "engine": "glv", "bucket": 64}
+    assert bench.append_history(cpu, source="t", path=path)
+    hw = _hw_line()
+    assert bench.append_history(hw, source="t", path=path)
+    # 100k vs a 39.6 cpu record is not a comparison; no hardware
+    # baseline exists yet → gate passes with a note
+    assert perf_report.run_compare(path, 0.10) == 0
+
+
+def test_compare_rejects_corrupt_history(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    with open(path, "w") as f:
+        f.write('{"v": 99}\n')
+    assert perf_report.run_compare(path, 0.10) == 2
+
+
+# ---------------------------------------------------------------------------
+# the perf-smoke CLI (the run_suite.sh pass, end to end)
+
+
+def test_perf_report_selfcheck_cli():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_report.py"),
+         "--selfcheck"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "bottleneck named" in r.stdout
+    assert "perf selfcheck ok" in r.stdout
+
+
+def test_bench_selfcheck_validates_history_files(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    assert bench.append_history(_hw_line(), path=path)
+    assert bench.run_selfcheck([path]) == 0
+    with open(path, "a") as f:
+        f.write('{"v": 99}\n')
+    assert bench.run_selfcheck([path]) == 1

@@ -130,3 +130,26 @@ def test_selfcheck_cli(tmp_path, capsys):
     assert bench.run_selfcheck([str(pb)]) == 1
     out = capsys.readouterr().out
     assert "ok" in out and "buried" in out
+
+
+def test_history_roundtrip_of_emitted_line(tmp_path):
+    """Every line compose_line emits must survive the history gate
+    verbatim (append → load → identical record) — the contract that
+    lets bench append unconditionally (doc/perf.md)."""
+    path = str(tmp_path / "hist.jsonl")
+    for line in (
+        bench.compose_line(50.0, "cpu-fallback", engine="glv",
+                           bucket=64, last=None),
+        bench.compose_line(91234.5, "axon-tpu", engine="pallas_fbj+pp",
+                           bucket=16384, last=_HW),
+        bench.compose_route_line(500.0, "cpu", batch=64,
+                                 n_channels=2_000, host_rps=250.0),
+        {"metric": bench.METRIC, "value": 0.0, "unit": bench.UNIT,
+         "vs_baseline": 0.0, "error": "watchdog: exceeded deadline"},
+    ):
+        assert bench.append_history(line, path=path), line
+    entries = bench.load_history(path)
+    assert [e["record"] for e in entries][0]["value"] == 50.0
+    assert len(entries) == 4
+    # the .jsonl form of --selfcheck validates it too
+    assert bench.run_selfcheck([path]) == 0

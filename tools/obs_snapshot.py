@@ -30,6 +30,12 @@ The diff output is the "what did this flush/bench actually do" view:
 two snapshots bracket a workload and the delta is attributable to it.
 `bench.py --metrics` embeds the same diff in its emitted JSON line so
 offline bench rounds and live scrapes finally share one vocabulary.
+
+Captures carry the getmetrics `perf` section (the stage-attribution
+report, doc/perf.md) — capture --local computes it in-process, and
+diffs/--watch ticks fold in its compact per-family view (bottleneck +
+critical-path seconds), so a watch tick NAMES the bottleneck as the
+stage counters move.
 """
 from __future__ import annotations
 
@@ -101,10 +107,15 @@ def capture_local(dispatches: int | None = None) -> dict:
     # well-known families owned by heavyweight modules (routing.device,
     # daemon.hsmd) are declared in this jax-free module so they appear
     # present-at-zero in a fresh capture process — a diff against a
-    # later in-daemon snapshot then attributes deltas correctly
-    from lightning_tpu.obs import families, flight  # noqa: F401
+    # later in-daemon snapshot then attributes deltas correctly.  The
+    # attribution import does the same for the perf-observatory
+    # families (clntpu_retrace_total, clntpu_transfer_bytes_total,
+    # clntpu_device_memory_bytes) and adds the `perf` section the
+    # getmetrics RPC carries (doc/perf.md).
+    from lightning_tpu.obs import attribution, families, flight  # noqa: F401
 
     snap = obs.snapshot()
+    snap["perf"] = attribution.report_local(metrics=snap["metrics"])
     if dispatches:
         snap["dispatch_log"] = flight.recent(limit=dispatches)
     return snap
@@ -143,6 +154,17 @@ def diff_snapshots(a: dict, b: dict) -> dict:
                 rows.append({"labels": labels, "value": s["value"]})
         if rows:
             out[name] = {"kind": fam["kind"], "samples": rows}
+    # the perf section (getmetrics "perf" / capture_local) is a
+    # point-in-time analysis like a gauge: the diff carries `b`'s
+    # compact view (bottleneck + critical path per family) so a
+    # --watch tick names the bottleneck as the counters move
+    if "perf" in b:
+        try:
+            from lightning_tpu.obs import attribution
+
+            out["perf"] = attribution.compact(b["perf"])
+        except Exception:
+            out["perf"] = b["perf"]
     # flight records captured with --dispatches: the diff keeps only
     # the dispatches NEW since `a`, so a --watch tick shows WHICH
     # dispatch blew up a counter delta, not just that one did

@@ -91,6 +91,19 @@ echo "graftlint static-analysis pass"
 timeout 300 python tools/graftlint.py \
   || { echo "graftlint failed"; exit 1; }
 
+# Perf-smoke pass (doc/perf.md): the attribution model is driven with
+# a synthetic workload whose dispatch stage is deliberately inflated
+# and must name exactly that stage as the bottleneck, reproduce the
+# hand-computed speedup-if-removed projection, and reconcile the
+# flight-ring sums against the clntpu_replay_* counters within the
+# stated epsilon; then the bench-regression gate validates
+# BENCH_HISTORY.jsonl end to end.  Jax-free, seconds of budget.
+echo "perf-smoke pass (tools/perf_report.py --selfcheck)"
+timeout 300 python tools/perf_report.py --selfcheck \
+  || { echo "perf selfcheck failed"; exit 1; }
+timeout 300 python tools/perf_report.py --compare \
+  || { echo "perf compare gate failed"; exit 1; }
+
 # Fault-matrix pass (doc/resilience.md): re-run the resilience suite
 # with deterministic faults armed at every named device seam — dispatch
 # raises for verify/route, the mesh reshard and the sign kernel fail
@@ -119,4 +132,4 @@ LIGHTNING_TPU_DEADLINE_INGEST_S=240 \
 echo "overload soak-lite pass (tools/loadgen.py --selfcheck)"
 timeout 1200 python tools/loadgen.py --selfcheck \
   || { echo "loadgen selfcheck failed"; exit 1; }
-echo "suite green (2 slices + graftlint + fault matrix + soak-lite)"
+echo "suite green (2 slices + graftlint + perf smoke + fault matrix + soak-lite)"
