@@ -76,6 +76,16 @@ async def amain(args) -> int:
     logging.getLogger("lightning_tpu.lightningd").info(
         "server started, node_id %s", node.node_id.hex())
 
+    # always-on health engine (doc/health.md): periodic sampler over
+    # the metrics registry + breaker/overload taps, continuous SLO
+    # evaluation, and the state the gethealth RPC / REST GET /health
+    # serve.  Jax-free and off the hot path (one registry walk per
+    # LIGHTNING_TPU_HEALTH_INTERVAL_S tick).
+    from ..obs import health as _health
+
+    health_engine = _health.ensure_engine()
+    health_engine.start()
+
     if args.proxy:
         host, _, p_ = args.proxy.rpartition(":")
         node.tor_proxy = (host, int(p_))
@@ -526,6 +536,7 @@ async def amain(args) -> int:
     from ..utils import events as _EV
 
     _EV.emit("shutdown", {})
+    health_engine.stop()
     if node.plugin_host is not None:
         await node.plugin_host.close()
     if rpc is not None:

@@ -33,6 +33,9 @@ _M_RPC_SECONDS = obs.histogram(
     "clntpu_rpc_latency_seconds",
     "JSON-RPC handler latency, by method",
     labelnames=("method",), max_label_sets=256)
+# answered-getroute latency (declared jax-free in obs/families.py; the
+# health engine's route_p99 SLO reads it — doc/health.md)
+from ..obs.families import ROUTE_ANSWER_SECONDS as _M_ROUTE_ANSWER  # noqa: E402
 
 # JSON-RPC error codes (common/jsonrpc_errors.h)
 PARSE_ERROR = -32700
@@ -385,11 +388,12 @@ def attach_core_commands(rpc: JsonRpcServer, node, gossmap_ref: dict,
 
     async def getroute(id: str, amount_msat: int, riskfactor: int = 10,
                        cltv: int = 18, fromid: str | None = None) -> dict:
-        from ..routing import dijkstra as DJ
-
         g = _need_map()
         src = _hex(fromid, "fromid") if fromid else node.node_id
         if fromid is None:
+            # instant precheck rejection — NOT an answered query, so it
+            # stays out of the answered-latency histogram (a retry loop
+            # of these would dilute the tail just like TRY_AGAIN would)
             try:
                 g.node_index(src)
             except KeyError:
@@ -398,6 +402,25 @@ def attach_core_commands(rpc: JsonRpcServer, node, gossmap_ref: dict,
                     "this node is not in the gossip graph yet; "
                     "pass fromid to route between known nodes",
                 )
+        # answered-query latency (ok AND solver no-route — an answer
+        # either way); TRY_AGAIN escapes as Overloaded before the
+        # observe, so fast admission rejections never dilute the tail
+        # the health engine's route_p99 SLO watches (doc/health.md)
+        t0 = time.perf_counter()
+        try:
+            result = await _getroute(g, src, id, amount_msat,
+                                     riskfactor, cltv)
+        except RpcError as e:
+            if e.code == ROUTE_NOT_FOUND:
+                _M_ROUTE_ANSWER.observe(time.perf_counter() - t0)
+            raise
+        _M_ROUTE_ANSWER.observe(time.perf_counter() - t0)
+        return result
+
+    async def _getroute(g, src: bytes, id: str, amount_msat: int,
+                        riskfactor: int, cltv: int) -> dict:
+        from ..routing import dijkstra as DJ
+
         try:
             if router is not None:
                 hops = await router.getroute(
@@ -975,3 +998,42 @@ def attach_admin_commands(rpc: JsonRpcServer, cfg, ring) -> None:
     rpc.register("listdispatches", listdispatches)
     rpc.register("gettrace", gettrace)
     rpc.register("getperf", getperf)
+    rpc.register("gethealth", make_gethealth())
+
+
+def make_gethealth(engine=None):
+    """The gethealth handler (doc/health.md): bound to `engine`, or to
+    the process singleton at call time when None — shared by
+    attach_admin_commands and the harness daemons (tools/loadgen.py,
+    tools/health_smoke.py) so every surface validates params the same
+    way."""
+
+    async def gethealth(series=None, points=None) -> dict:
+        """The health engine's full report (doc/health.md): rolled-up
+        state (healthy/degraded/unhealthy), per-SLO ok/warn/breach with
+        error-budget burn rates over the short+long windows, headline
+        window rates, breaker/overload taps — and, with `series` (a
+        list of metric family names), extracts of the per-series
+        time-series rings (`points` caps their length).  Terse
+        liveness/readiness lives at REST `GET /health`."""
+        from ..obs import health as _health
+
+        if series is not None:
+            if not isinstance(series, (list, tuple)) or not all(
+                    isinstance(s, str) for s in series):
+                raise RpcError(INVALID_PARAMS,
+                               "series must be a list of family names")
+        if points is not None:
+            try:
+                points = int(points)
+            except (TypeError, ValueError):
+                raise RpcError(INVALID_PARAMS,
+                               "points must be an integer")
+            if points <= 0:
+                raise RpcError(INVALID_PARAMS, "points must be > 0")
+        eng = engine if engine is not None else _health.current()
+        if eng is None:
+            return _health.empty_report()
+        return eng.report(series=series, points=points)
+
+    return gethealth

@@ -96,8 +96,34 @@ class RestServer:
             return 400, {"error": "too many headers"}
 
         custom = self.custom_paths.get("/" + target.strip("/"))
-        if custom is None \
-                and target.split("?", 1)[0].rstrip("/") == "/metrics":
+        path_only = target.split("?", 1)[0].rstrip("/")
+        if custom is None and path_only == "/health":
+            # liveness/readiness probe (GET; doc/health.md).  The terse
+            # body is deliberately auth-less — orchestrator probes must
+            # not need a rune — but the full report (?detail=1) is
+            # gated exactly like /metrics: the rune must permit the
+            # equivalent `gethealth` command.
+            if method_verb != "GET":
+                return 400, {"error": "use GET for /health"}
+            from ..obs import health as _health
+
+            eng = _health.current()
+            state = eng.state_name() if eng is not None else "unknown"
+            from urllib.parse import parse_qs
+
+            query = (target.split("?", 1) + [""])[1]
+            detail = parse_qs(query).get("detail", ["0"])[-1] == "1"
+            if not detail:
+                return 200, {"status": state, "live": True,
+                             "ready": state != "unhealthy"}
+            if self.commando is not None:
+                why = self.commando.check_rune(
+                    headers.get("rune") or "", "gethealth", {}, b"")
+                if why is not None:
+                    return 401, {"error": f"rune rejected: {why}"}
+            return 200, (eng.report() if eng is not None
+                         else _health.empty_report())
+        if custom is None and path_only == "/metrics":
             # Prometheus text exposition (GET; scrape-friendly; a
             # clnrest-register-path mapping of /metrics takes
             # precedence).  Under rune auth the scraper must send a

@@ -43,6 +43,17 @@ ROUTE_FALLBACK = REGISTRY.counter(
 ROUTE_QUEUE = REGISTRY.gauge(
     "clntpu_route_queue_queries",
     "Route queries currently queued awaiting a flush")
+# owner: daemon/jsonrpc.py's getroute command.  ANSWERED queries only
+# (ok or no-route) — TRY_AGAIN admission rejections are excluded, so
+# this is the same population tools/loadgen.py's post-hoc p99 and the
+# health engine's route_p99 SLO judge (clntpu_rpc_latency_seconds
+# counts every call, and under storm the fast 429s would drag the
+# tail estimate down exactly when it matters).
+ROUTE_ANSWER_SECONDS = REGISTRY.histogram(
+    "clntpu_route_answer_seconds",
+    "getroute RPC latency for answered queries (ok or no-route; "
+    "TRY_AGAIN rejections excluded)",
+    buckets=DURATION_BUCKETS)
 
 # -- daemon/hsmd.py: the batched-sign paths --------------------------------
 SIGN_BATCH_SIGS = REGISTRY.histogram(
@@ -180,6 +191,17 @@ DEVICE_MEMORY = REGISTRY.gauge(
     "Live device-memory statistics where the backend exposes "
     "memory_stats() (TPU does; CPU reports nothing), by device and stat",
     labelnames=("device", "stat"))
+
+# -- obs/health.py: the always-on health engine (doc/health.md) ------------
+HEALTH_STATE = REGISTRY.gauge(
+    "clntpu_health_state",
+    "Rolled-up daemon health from the continuous SLO evaluator "
+    "(0 = healthy, 1 = degraded, 2 = unhealthy)")
+SLO_BREACH = REGISTRY.counter(
+    "clntpu_slo_breach_total",
+    "SLO breach ENTRIES recorded by the health engine (one increment "
+    "per transition into breach, not per breached tick), by SLO name",
+    labelnames=("slo",))
 
 # -- obs/flight.py: the dispatch flight recorder (doc/tracing.md) ----------
 DISPATCHES = REGISTRY.counter(

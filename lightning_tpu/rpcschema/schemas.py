@@ -309,6 +309,14 @@ COMMANDS: dict[str, dict] = {
                    "kernel_rate": "any", "families": "dict",
                    "retraces": "dict", "device_memory": "dict"},
     },
+    "gethealth": {
+        "params": {"series": "list?", "points": "int?"},
+        "result": {"running": "bool", "state": "str",
+                   "state_code": "int", "ticks": "int",
+                   "breached": "list", "slos": "dict", "rates": "dict"},
+        # burn rates, breaker/overload taps, and requested time-series
+        # ring extracts ride in `.extra` (doc/health.md)
+    },
     "listdispatches": {
         "params": {"family": "str?", "limit": "int?"},
         "result": {"dispatches": "list", "ring_size": "int"},

@@ -1,0 +1,554 @@
+"""Health engine (lightning_tpu/obs/health.py, doc/health.md):
+log2-histogram percentile estimation against hand-computed corpora,
+time-series ring wrap / fixed-step resampling semantics, SLO
+evaluation + burn rates, the hysteresis state machine, and the
+gethealth / REST GET /health surfaces.  Jax-free by design (the obs
+package rule) — everything here drives the engine with an injected
+clock and a private registry."""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightning_tpu.obs import health as H  # noqa: E402
+from lightning_tpu.obs.registry import Registry, log2_buckets  # noqa: E402
+from lightning_tpu.utils import events  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# percentile estimation (satellite: exact corpus, hand-computed bounds)
+
+
+def test_quantile_hand_computed():
+    # buckets (le): 1, 2, 4, 8; corpus: 3 obs in (1,2], 1 obs in (2,4]
+    bounds = [1.0, 2.0, 4.0, 8.0]
+    counts = [0, 3, 1, 0]
+    # p50: rank ceil(0.5*4)=2 -> bucket (1,2], frac 2/3 -> 1*2^(2/3)
+    assert H.estimate_quantile(bounds, counts, 0, 0.5) == \
+        pytest.approx(2 ** (2 / 3))
+    # p99: rank ceil(0.99*4)=4 -> bucket (2,4], frac 1/1 -> 2*2^1 = 4.0
+    assert H.estimate_quantile(bounds, counts, 0, 0.99) == \
+        pytest.approx(4.0)
+    # p25: rank 1 -> first obs bucket, frac 1/3 -> 2^(1/3)
+    assert H.estimate_quantile(bounds, counts, 0, 0.25) == \
+        pytest.approx(2 ** (1 / 3))
+
+
+def test_quantile_bucket_bounds_hold():
+    """The estimate always lands inside (lo, hi] of the bucket holding
+    the true rank — the contract the SLO thresholds (set at bucket
+    bounds) rely on."""
+    bounds = list(log2_buckets(1e-3, 16.0))
+    # 100 obs: 90 in (0.25, 0.5], 9 in (1, 2], 1 in (8, 16]
+    counts = [0] * len(bounds)
+    counts[bounds.index(0.5)] = 90
+    counts[bounds.index(2.0)] = 9
+    counts[bounds.index(16.0)] = 1
+    p50 = H.estimate_quantile(bounds, counts, 0, 0.5)
+    assert 0.25 < p50 <= 0.5
+    p99 = H.estimate_quantile(bounds, counts, 0, 0.99)
+    assert 1.0 < p99 <= 2.0          # rank 99 is the last (1,2] obs
+    p999 = H.estimate_quantile(bounds, counts, 0, 0.999)
+    assert 8.0 < p999 <= 16.0
+
+
+def test_quantile_edges():
+    bounds = [1.0, 2.0, 4.0]
+    assert H.estimate_quantile(bounds, [0, 0, 0], 0, 0.99) is None
+    # all mass in the overflow bucket clamps to the top finite bound
+    assert H.estimate_quantile(bounds, [0, 0, 0], 5, 0.5) == 4.0
+    # first bucket extends the log ladder downward: lo = 1/2
+    est = H.estimate_quantile(bounds, [2, 0, 0], 0, 0.5)
+    assert 0.5 < est <= 1.0
+    # q=0 still resolves to the first observation's bucket
+    est0 = H.estimate_quantile(bounds, [0, 4, 0], 0, 0.0)
+    assert 1.0 < est0 <= 2.0
+
+
+def test_window_buckets_delta_and_overflow():
+    prev = {"buckets": [(1.0, 2), (2.0, 5)], "count": 6}   # 1 overflow
+    cur = {"buckets": [(1.0, 3), (2.0, 9)], "count": 12}   # 3 overflow
+    counts, overflow = H.window_buckets(prev, cur)
+    assert counts == [1, 3]       # non-cumulative per-bucket deltas
+    assert overflow == 2
+
+
+# ---------------------------------------------------------------------------
+# ring / fixed-step resampling semantics
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_engine(reg, slos=(), clock=None, **kw):
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("ring", 8)
+    kw.setdefault("short_ticks", 3)
+    kw.setdefault("long_ticks", 6)
+    kw.setdefault("recover_ticks", 2)
+    return H.HealthEngine(registry=reg, slos=list(slos),
+                          now=clock or Clock(), **kw)
+
+
+def test_ring_wrap_and_counter_rates():
+    reg = Registry()
+    c = reg.counter("clntpu_t_total", "t")
+    clock = Clock()
+    eng = make_engine(reg, clock=clock, ring=8)   # 8 is the floor
+    for _ in range(12):
+        c.inc(10)
+        clock.t += 1.0
+        eng.tick()
+    rep = eng.report(series=["clntpu_t_total"])
+    pts = rep["rings"]["clntpu_t_total"]["points"]
+    assert len(pts) == 8                  # ring wrapped: last 8 kept
+    assert pts[-1] == pytest.approx(10.0)  # 10/s at 1 s steps
+    assert rep["ticks"] == 12
+
+
+def test_fixed_step_rate_normalization():
+    """A late tick must not inflate the rate: deltas divide by the
+    ACTUAL elapsed time, not the nominal interval."""
+    reg = Registry()
+    c = reg.counter("clntpu_t_total", "t")
+    clock = Clock()
+    eng = make_engine(reg, clock=clock)
+    eng.tick()
+    c.inc(10)
+    clock.t += 2.0                        # sampler ran 2x late
+    eng.tick()
+    rep = eng.report(series=["clntpu_t_total"])
+    assert rep["rings"]["clntpu_t_total"]["points"][-1] == \
+        pytest.approx(5.0)
+
+
+def test_gauge_and_histogram_points():
+    reg = Registry()
+    g = reg.gauge("clntpu_g", "g")
+    h = reg.histogram("clntpu_h_seconds", "h",
+                      buckets=log2_buckets(1e-3, 8.0))
+    clock = Clock()
+    eng = make_engine(reg, clock=clock)
+    g.set(7)
+    eng.tick()
+    for _ in range(4):
+        h.observe(1.5)                    # lands in (1, 2]
+    g.set(3)
+    clock.t += 2.0
+    eng.tick()
+    rep = eng.report(series=["clntpu_g", "clntpu_h_seconds"])
+    assert rep["rings"]["clntpu_g"]["points"] == [7.0, 3.0]
+    rate, p50, p99 = rep["rings"]["clntpu_h_seconds"]["points"][-1]
+    assert rate == pytest.approx(2.0)     # 4 obs / 2 s
+    assert 1.0 < p50 <= 2.0
+    assert 1.0 < p99 <= 2.0
+
+
+def test_counter_reset_clamps():
+    reg = Registry()
+    c = reg.counter("clntpu_t_total", "t")
+    clock = Clock()
+    eng = make_engine(reg, clock=clock)
+    c.inc(5)
+    eng.tick()
+    clock.t += 1.0
+    reg.reset()                            # test-style registry reset
+    c2 = reg.counter("clntpu_t_total", "t")
+    c2.inc(1)
+    eng.tick()
+    rep = eng.report(series=["clntpu_t_total"])
+    assert rep["rings"]["clntpu_t_total"]["points"][-1] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluation + burn rates
+
+
+def _drive(eng, clock, n, step=1.0, mutate=None):
+    for i in range(n):
+        if mutate:
+            mutate(i)
+        clock.t += step
+        eng.tick()
+
+
+def test_rate_min_gated_on_activity():
+    reg = Registry()
+    sigs = reg.histogram("clntpu_gossip_flush_sigs", "s",
+                         buckets=log2_buckets(1.0, 1024.0))
+    acc = reg.counter("clntpu_gossip_accepted_total", "a")
+    reg.counter("clntpu_gossip_dropped_total", "d", labelnames=("reason",))
+    spec = H.SloSpec("ingest_accept", "rate_min",
+                     {"family": "clntpu_gossip_flush_sigs", "min": 20.0,
+                      "active": ["clntpu_gossip_accepted_total",
+                                 "clntpu_gossip_flush_sigs"]})
+    clock = Clock()
+    eng = make_engine(reg, [spec], clock)
+    # idle: no traffic -> inactive -> ok, never violated
+    _drive(eng, clock, 4)
+    st = eng.report()["slos"]["ingest_accept"]
+    assert st["status"] == "ok" and st["breaches_total"] == 0
+    # active but slow: 2 sigs/s < 20 floor -> breach
+    def slow(i):
+        acc.inc(2)
+        sigs.observe(2)
+    _drive(eng, clock, 3, mutate=slow)
+    st = eng.report()["slos"]["ingest_accept"]
+    assert st["status"] == "breach"
+    assert st["breaches_total"] == 1      # one ENTRY, not one per tick
+    # fast again: 100 sigs/s -> ok
+    def fast(i):
+        acc.inc(100)
+        sigs.observe(100)
+    _drive(eng, clock, 6, mutate=fast)
+    st = eng.report()["slos"]["ingest_accept"]
+    assert st["status"] == "ok"
+
+
+def test_quantile_max_and_burn_rates():
+    reg = Registry()
+    lat = reg.histogram("clntpu_rpc_latency_seconds", "l",
+                        labelnames=("method",),
+                        buckets=log2_buckets(1e-3, 32.0))
+    spec = H.SloSpec("route_p99", "quantile_max",
+                     {"family": "clntpu_rpc_latency_seconds",
+                      "labels": {"method": "getroute"}, "q": 0.99,
+                      "max": 2.0}, objective=0.9)
+    clock = Clock()
+    eng = make_engine(reg, [spec], clock, short_ticks=3, long_ticks=6)
+    def good(i):
+        for _ in range(10):
+            lat.labels("getroute").observe(0.1)
+    _drive(eng, clock, 3, mutate=good)
+    assert eng.report()["slos"]["route_p99"]["status"] == "ok"
+    # now every observation is slow: windowed p99 > 2 s -> breach
+    def bad(i):
+        for _ in range(10):
+            lat.labels("getroute").observe(3.0)
+    _drive(eng, clock, 2, mutate=bad)
+    st = eng.report()["slos"]["route_p99"]
+    assert st["status"] == "breach"
+    assert st["observed"] > 2.0
+    # burn: 2 violated of last 3 short ticks / 0.1 budget = 6.67
+    assert st["burn_short"] == pytest.approx((2 / 3) / 0.1, rel=1e-3)
+    # 2 of the 4 evaluated ticks in the long ring / 0.1 budget = 5.0
+    assert st["burn_long"] == pytest.approx((2 / 4) / 0.1, rel=1e-3)
+    # recovery: once the short window's quantile no longer covers the
+    # slow observations the breach clears, but the window still burns
+    # budget -> warn, not ok
+    _drive(eng, clock, 3, mutate=good)
+    st = eng.report()["slos"]["route_p99"]
+    assert st["status"] == "warn"
+    assert st["burn_short"] > 1.0
+
+
+def test_increase_max_and_saturated():
+    reg = Registry()
+    dl = reg.counter("clntpu_deadline_exceeded_total", "d",
+                     labelnames=("family", "seam"))
+    ovl = reg.gauge("clntpu_overload_state", "o", labelnames=("family",))
+    specs = [
+        H.SloSpec("deadline_rate", "increase_max",
+                  {"family": "clntpu_deadline_exceeded_total",
+                   "max": 0.0}),
+        H.SloSpec("overload_saturated", "saturated",
+                  {"family": "clntpu_overload_state", "level": 2.0}),
+    ]
+    clock = Clock()
+    eng = make_engine(reg, specs, clock)
+    ovl.labels("ingest").set(1.0)          # elevated: not saturated
+    _drive(eng, clock, 2)
+    rep = eng.report()
+    assert rep["slos"]["deadline_rate"]["status"] == "ok"
+    assert rep["slos"]["overload_saturated"]["status"] == "ok"
+    dl.labels("verify", "flush").inc()
+    ovl.labels("ingest").set(2.0)
+    _drive(eng, clock, 1)
+    rep = eng.report()
+    assert rep["slos"]["deadline_rate"]["status"] == "breach"
+    assert rep["slos"]["overload_saturated"]["status"] == "breach"
+    assert rep["breached"] == sorted(["deadline_rate",
+                                      "overload_saturated"])
+
+
+def test_breaker_open_slo():
+    from lightning_tpu.resilience import breaker as B
+
+    B.reset_for_tests()
+    try:
+        spec = H.SloSpec("breaker_open", "breaker_open",
+                         {"max_open_s": 5.0})
+        clock = Clock()
+        eng = make_engine(Registry(), [spec], clock)
+        _drive(eng, clock, 2)
+        assert eng.report()["slos"]["breaker_open"]["status"] == "ok"
+        B.get("verify").force_open()
+        _drive(eng, clock, 3)              # open ~3 s < 5 s grace
+        assert eng.report()["slos"]["breaker_open"]["status"] == "ok"
+        _drive(eng, clock, 4)              # open ~7 s > grace -> breach
+        st = eng.report()["slos"]["breaker_open"]
+        assert st["status"] == "breach" and st["observed"] > 5.0
+        B.get("verify").reset()
+        _drive(eng, clock, 1)
+        assert eng.report()["slos"]["breaker_open"]["observed"] == 0.0
+    finally:
+        B.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# the hysteresis state machine
+
+
+def _toggle_spec(reg):
+    g = reg.gauge("clntpu_overload_state", "o", labelnames=("family",))
+    spec = H.SloSpec("overload_saturated", "saturated",
+                     {"family": "clntpu_overload_state", "level": 2.0})
+    return g, spec
+
+
+def test_state_machine_hysteresis_and_events():
+    reg = Registry()
+    g, spec = _toggle_spec(reg)
+    clock = Clock()
+    eng = make_engine(reg, [spec], clock, recover_ticks=3)
+    seen = []
+
+    def on_state(payload):
+        seen.append((payload["state"], tuple(payload["breached"])))
+
+    events.subscribe("health_state", on_state)
+    try:
+        _drive(eng, clock, 2)
+        assert eng.report()["state"] == "healthy"
+        # escalation is IMMEDIATE on the first breached tick
+        g.labels("ingest").set(2.0)
+        _drive(eng, clock, 1)
+        assert eng.report()["state"] == "degraded"
+        assert seen[-1] == ("degraded", ("overload_saturated",))
+        # de-escalation needs recover_ticks consecutive clean ticks
+        g.labels("ingest").set(0.0)
+        _drive(eng, clock, 2)
+        assert eng.report()["state"] == "degraded"   # 2 < 3 clean
+        _drive(eng, clock, 1)
+        assert eng.report()["state"] == "healthy"
+        assert seen[-1][0] == "healthy"
+        # a breach inside the recovery run resets the countdown
+        g.labels("ingest").set(2.0)
+        _drive(eng, clock, 1)
+        g.labels("ingest").set(0.0)
+        _drive(eng, clock, 2)
+        g.labels("ingest").set(2.0)
+        _drive(eng, clock, 1)
+        g.labels("ingest").set(0.0)
+        _drive(eng, clock, 2)
+        assert eng.report()["state"] == "degraded"
+        _drive(eng, clock, 1)
+        assert eng.report()["state"] == "healthy"
+    finally:
+        events.unsubscribe("health_state", on_state)
+
+
+def test_major_burn_escalates_to_unhealthy():
+    reg = Registry()
+    dl = reg.counter("clntpu_deadline_exceeded_total", "d")
+    spec = H.SloSpec("deadline_rate", "increase_max",
+                     {"family": "clntpu_deadline_exceeded_total",
+                      "max": 0.0}, severity="major", objective=0.9)
+    clock = Clock()
+    eng = make_engine(reg, [spec], clock, long_ticks=6)
+    # sustained major violation: every tick breaches -> long burn >> 1
+    _drive(eng, clock, 4, mutate=lambda i: dl.inc())
+    rep = eng.report()
+    assert rep["state"] == "unhealthy"
+    assert rep["slos"]["deadline_rate"]["burn_long"] > 1.0
+
+
+def test_breach_counter_meters_entries():
+    from lightning_tpu import obs
+
+    reg = Registry()
+    g, spec = _toggle_spec(reg)
+    clock = Clock()
+    eng = make_engine(reg, [spec], clock, recover_ticks=1)
+
+    def counter_value():
+        fam = obs.REGISTRY.snapshot()["metrics"].get(
+            "clntpu_slo_breach_total", {})
+        return sum(s["value"] for s in fam.get("samples", ())
+                   if s["labels"].get("slo") == "overload_saturated")
+
+    before = counter_value()
+    _drive(eng, clock, 2)
+    g.labels("ingest").set(2.0)
+    _drive(eng, clock, 3)                  # one entry, three bad ticks
+    g.labels("ingest").set(0.0)
+    _drive(eng, clock, 2)
+    g.labels("ingest").set(2.0)
+    _drive(eng, clock, 1)                  # second entry
+    assert counter_value() - before == 2.0
+    assert eng.report()["slos"]["overload_saturated"][
+        "breaches_total"] == 2
+
+
+# ---------------------------------------------------------------------------
+# exposition surfaces
+
+
+def test_report_shape_and_ring_extracts():
+    reg = Registry()
+    c = reg.counter("clntpu_t_total", "t", labelnames=("k",))
+    clock = Clock()
+    eng = make_engine(reg, clock=clock)
+    c.labels("a").inc(3)
+    c.labels("b").inc(5)
+    _drive(eng, clock, 5)
+    rep = eng.report()
+    assert "rings" not in rep              # extracts are opt-in
+    assert set(rep) >= {"state", "slos", "rates", "breakers",
+                        "overload", "ticks", "breached"}
+    rep = eng.report(series=["clntpu_t_total"], points=2)
+    keys = sorted(rep["rings"])
+    assert keys == ["clntpu_t_total{k=a}", "clntpu_t_total{k=b}"]
+    assert all(len(r["points"]) == 2 for r in rep["rings"].values())
+    comp = H.compact(rep)
+    assert comp["state"] == rep["state"]
+    assert set(comp["slos"]) == set(rep["slos"])
+    json.dumps(rep)                        # the RPC result serializes
+
+
+def test_singleton_install_and_empty_report():
+    H.reset_for_tests()
+    assert H.current() is None
+    eng = H.ensure_engine(interval_s=1.0)
+    assert H.current() is eng
+    assert H.ensure_engine() is eng
+    H.install(None)
+    assert H.current() is None
+    assert H.empty_report()["state"] == "unknown"
+    H.reset_for_tests()
+
+
+def test_sampler_thread_start_stop():
+    reg = Registry()
+    c = reg.counter("clntpu_t_total", "t")
+    eng = H.HealthEngine(interval_s=0.02, ring=16, registry=reg,
+                         short_ticks=2, long_ticks=4, recover_ticks=1)
+    eng.start()
+    try:
+        c.inc(5)
+        deadline = 100
+        while eng.report()["ticks"] < 3 and deadline:
+            deadline -= 1
+            import time as _t
+            _t.sleep(0.02)
+        assert eng.report()["ticks"] >= 3
+        assert eng.report()["running"]
+    finally:
+        eng.stop()
+    assert not eng.report()["running"]
+
+
+def _rest_stack(tmp_path, engine, commando=None):
+    from lightning_tpu.daemon.jsonrpc import JsonRpcServer
+    from lightning_tpu.daemon.rest import RestServer
+
+    rpc = JsonRpcServer(str(tmp_path / "r.sock"))
+    return RestServer(rpc, commando=commando)
+
+
+async def _get(port: int, path: str, rune: str | None = None):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    hdrs = f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+    if rune:
+        hdrs += f"Rune: {rune}\r\n"
+    writer.write(hdrs.encode() + b"\r\n")
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split(b" ")[1]), json.loads(body)
+
+
+def test_rest_health_endpoint(tmp_path):
+    class FakeCommando:
+        def check_rune(self, rune, method, params, _):
+            assert method == "gethealth"
+            return None if rune == "good" else "bad rune"
+
+    async def body():
+        H.reset_for_tests()
+        reg = Registry()
+        g, spec = _toggle_spec(reg)
+        clock = Clock()
+        eng = make_engine(reg, [spec], clock)
+        H.install(eng)
+        try:
+            rest = _rest_stack(tmp_path, eng,
+                               commando=FakeCommando())
+            port = await rest.start()
+            # before the first tick the state is unknown (but live)
+            status, b = await _get(port, "/health")
+            assert status == 200 and b["status"] == "unknown"
+            _drive(eng, clock, 2)
+            status, b = await _get(port, "/health")
+            assert (status, b) == (200, {"status": "healthy",
+                                         "live": True, "ready": True})
+            g.labels("ingest").set(2.0)
+            _drive(eng, clock, 1)
+            status, b = await _get(port, "/health")
+            assert b["status"] == "degraded" and b["ready"]
+            # only an exact detail=1 query parameter asks for detail —
+            # a probe with an unlucky query string must stay terse
+            # (and therefore auth-less), not bounce off the rune gate
+            for q in ("?nodetail=1", "?detail=12", "?detail=0"):
+                status, b = await _get(port, "/health" + q)
+                assert status == 200 and b["status"] == "degraded"
+            # detail is rune-gated like /metrics
+            status, b = await _get(port, "/health?detail=1")
+            assert status == 401
+            status, b = await _get(port, "/health?detail=1",
+                                   rune="good")
+            assert status == 200
+            assert b["slos"]["overload_saturated"]["status"] == "breach"
+            await rest.close()
+        finally:
+            H.reset_for_tests()
+
+    asyncio.run(asyncio.wait_for(body(), 30))
+
+
+def test_gethealth_handler_validation(tmp_path):
+    from lightning_tpu.daemon.jsonrpc import RpcError, make_gethealth
+
+    async def body():
+        reg = Registry()
+        clock = Clock()
+        eng = make_engine(reg, clock=clock)
+        _drive(eng, clock, 2)
+        handler = make_gethealth(eng)
+        rep = await handler()
+        assert rep["state"] == "healthy"
+        with pytest.raises(RpcError):
+            await handler(series="clntpu_t_total")   # not a list
+        with pytest.raises(RpcError):
+            await handler(points="zero")
+        with pytest.raises(RpcError):
+            await handler(points=0)
+        # unbound handler falls back to the singleton / empty report
+        H.reset_for_tests()
+        rep = await make_gethealth()()
+        assert rep["state"] == "unknown" and rep["running"] is False
+
+    asyncio.run(asyncio.wait_for(body(), 30))

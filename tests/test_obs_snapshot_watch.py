@@ -139,6 +139,44 @@ def test_watch_empty_delta_prints_empty_object():
     assert t1["clntpu_breaker_state"]["samples"][0]["value"] == 0
 
 
+def test_watch_folds_health_report():
+    """Captures carrying a gethealth report (an RPC capture against a
+    daemon running the health engine) print its compact view on every
+    tick: rolled-up state, per-SLO statuses, and the window rates read
+    from the engine's rings — the dashboard's numbers (doc/health.md)."""
+    def snap(n, state):
+        s = _snap(n, 0, 0)
+        s["health"] = {
+            "state": state, "breached": (["shed_ratio"]
+                                         if state != "healthy" else []),
+            "slos": {"shed_ratio": {"status": "breach"
+                                    if state != "healthy" else "ok"}},
+            "rates": {"gossip_accepted_per_s": 12.5},
+        }
+        return s
+
+    snaps = [snap(0, "healthy"), snap(3, "degraded"),
+             snap(3, "healthy")]
+    it = iter(snaps)
+    out = io.StringIO()
+    obs_snapshot.watch(lambda: next(it), 5.0, out=out, ticks=2,
+                       sleep=lambda s: None)
+    t1, t2 = _ticks_of(out.getvalue())
+    assert t1["health"]["state"] == "degraded"
+    assert t1["health"]["breached"] == ["shed_ratio"]
+    assert t1["health"]["slos"]["shed_ratio"] == "breach"
+    assert t1["health"]["rates"]["gossip_accepted_per_s"] == 12.5
+    assert t2["health"]["state"] == "healthy"
+    # a daemon WITHOUT the engine: no health key, plain local diffing
+    snaps2 = [_snap(0, 0, 0), _snap(1, 0, 0)]
+    it2 = iter(snaps2)
+    out2 = io.StringIO()
+    obs_snapshot.watch(lambda: next(it2), 5.0, out=out2, ticks=1,
+                       sleep=lambda s: None)
+    (u1,) = _ticks_of(out2.getvalue())
+    assert "health" not in u1
+
+
 def test_cli_watch_local_with_ticks(capsys, monkeypatch):
     """End-to-end through main(): --local --watch --ticks captures this
     process's registry (the resilience families are present-at-zero via

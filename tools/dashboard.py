@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Live terminal dashboard over the health engine + metrics registry
+(doc/health.md).
+
+Renders, from `gethealth` + `getmetrics` on a running daemon's unix
+JSON-RPC socket:
+
+  * the rolled-up health state (healthy/degraded/unhealthy) with the
+    breached SLO names;
+  * the SLO panel — per SLO: status, observed value vs threshold,
+    short/long error-budget burn rates, lifetime breach entries;
+  * per-family rate sparklines read from the engine's time-series
+    rings (the SAME rings `obs_snapshot capture --watch` folds into
+    its ticks, so the two surfaces always agree);
+  * the breaker / overload / shed panel (circuit-breaker states,
+    degradation-ladder states, shed counts by priority:reason).
+
+Stdlib only (ANSI escapes, no curses dependency), jax-free.  Live mode
+redraws every ``--interval`` seconds until Ctrl-C; ``--once`` prints a
+single plain-text frame — the CI-friendly mode tools/health_smoke.py
+asserts against.
+
+Usage:
+  python tools/dashboard.py --rpc <lightning-rpc> [--interval 2]
+  python tools/dashboard.py --rpc <lightning-rpc> --once
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightning_tpu.obs.health import HEADLINE_RATES  # noqa: E402
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _TOOLS)
+from obs_snapshot import rpc_call  # noqa: E402
+
+SPARK = "▁▂▃▄▅▆▇█"
+_STATE_COLOR = {"healthy": "32", "degraded": "33", "unhealthy": "31",
+                "unknown": "90"}
+_STATUS_MARK = {"ok": "·", "warn": "!", "breach": "✗"}
+
+
+def sparkline(points, width: int = 32) -> str:
+    """Unicode sparkline over the last `width` numeric points (None =
+    no data for that tick, rendered as a space)."""
+    pts = list(points)[-width:]
+    vals = [p for p in pts if isinstance(p, (int, float))]
+    if not vals:
+        return " " * min(width, len(pts))
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    out = []
+    for p in pts:
+        if not isinstance(p, (int, float)):
+            out.append(" ")
+        else:
+            idx = int((p - lo) / span * (len(SPARK) - 1))
+            out.append(SPARK[idx])
+    return "".join(out)
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _color(text: str, code: str, enable: bool) -> str:
+    return f"\x1b[{code}m{text}\x1b[0m" if enable else text
+
+
+def merge_family_points(rings: dict, family: str) -> list:
+    """Sum a family's per-child ring points elementwise (tail-aligned:
+    every series ticks together, so index -1 is the same tick in each).
+    Histogram points are (rate, p50, p99) tuples — the rate leads."""
+    children = []
+    for key, ser in sorted(rings.items()):
+        if key != family and not key.startswith(family + "{"):
+            continue
+        pts = [p[0] if isinstance(p, (list, tuple)) else p
+               for p in (ser.get("points") or [])]
+        children.append([p if isinstance(p, (int, float)) else None
+                         for p in pts])
+    if not children:
+        return []
+    width = max(len(c) for c in children)
+    merged: list = [None] * width
+    for pts in children:
+        off = width - len(pts)
+        for i, p in enumerate(pts):
+            if p is not None:
+                j = off + i
+                merged[j] = (merged[j] or 0.0) + p
+    return merged
+
+
+def fetch(rpc_path: str, points: int = 40) -> tuple[dict, dict]:
+    """One (gethealth, getmetrics) pair; the ring extract asks for the
+    headline families the sparkline panel draws."""
+    health = rpc_call(rpc_path, "gethealth",
+                      {"series": sorted(set(HEADLINE_RATES.values())),
+                       "points": points})
+    metrics = rpc_call(rpc_path, "getmetrics")
+    return health, metrics
+
+
+def render(health: dict, metrics: dict, color: bool = False,
+           width: int = 40) -> str:
+    """One text frame (shared by --once and the live loop)."""
+    lines: list[str] = []
+    state = health.get("state", "unknown")
+    breached = health.get("breached") or []
+    head = (f"lightning-tpu health  state={state.upper()}"
+            + (f"  breached={','.join(breached)}" if breached else ""))
+    lines.append(_color(head, _STATE_COLOR.get(state, "0"), color))
+    lines.append(
+        f"  ticks={health.get('ticks', 0)}"
+        f"  interval={_fmt(health.get('interval_s'))}s"
+        f"  windows={health.get('short_ticks', '-')}"
+        f"/{health.get('long_ticks', '-')} ticks"
+        f"  transitions={health.get('transitions', 0)}"
+        f"  running={health.get('running', False)}")
+
+    lines.append("")
+    lines.append("SLOs                status   observed    threshold  "
+                 "burn_s  burn_l  breaches")
+    for name, s in sorted((health.get("slos") or {}).items()):
+        mark = _STATUS_MARK.get(s.get("status"), "?")
+        row = (f"  {mark} {name:<17} {s.get('status', '?'):<8} "
+               f"{_fmt(s.get('observed')):>9}   {_fmt(s.get('threshold')):>9}"
+               f"  {_fmt(s.get('burn_short')):>6}  {_fmt(s.get('burn_long')):>6}"
+               f"  {s.get('breaches_total', 0):>8}")
+        code = {"breach": "31", "warn": "33"}.get(s.get("status"))
+        lines.append(_color(row, code, color and code is not None))
+
+    lines.append("")
+    lines.append("rates (short window, from the health rings)")
+    rings = health.get("rings") or {}
+    rates = health.get("rates") or {}
+    for label, fam in sorted(HEADLINE_RATES.items()):
+        lines.append(
+            f"  {label:<24} {_fmt(rates.get(label)):>10}/s "
+            f"|{sparkline(merge_family_points(rings, fam), width)}|")
+
+    lines.append("")
+    lines.append("breakers / overload / shed")
+    for fam, b in sorted((health.get("breakers") or {}).items()):
+        extra = (f" open_s={_fmt(b.get('open_s'))}"
+                 if b.get("state") != "closed" else "")
+        lines.append(f"  breaker {fam:<8} {b.get('state', '?')}"
+                     f" trips={b.get('trips', 0)}{extra}")
+    ovl = (metrics.get("overload") or {}).get("families", {})
+    for fam, o in sorted(ovl.items()):
+        lines.append(
+            f"  overload {fam:<7} {o.get('state', '?'):<9} "
+            f"backlog={o.get('backlog', 0)}/{o.get('high_wm', '-')} "
+            f"peak={o.get('peak_backlog', 0)} "
+            f"widen={_fmt(o.get('widen_factor'))}")
+        for key, n in sorted((o.get("shed") or {}).items()):
+            lines.append(f"    shed {key}: {n}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/dashboard.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--rpc", required=True,
+                    help="daemon unix socket (lightning-rpc)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="redraw period in live mode (seconds)")
+    ap.add_argument("--points", type=int, default=40,
+                    help="sparkline width (ring points requested)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one plain-text frame and exit (CI mode)")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: dump the raw gethealth report "
+                         "instead of the rendered frame")
+    args = ap.parse_args(argv)
+    if args.interval <= 0:
+        ap.error("--interval must be positive")
+    if args.points <= 0:
+        ap.error("--points must be positive")
+
+    if args.once:
+        health, metrics = fetch(args.rpc, points=args.points)
+        if args.json:
+            print(json.dumps(health, indent=1, default=str))
+        else:
+            print(render(health, metrics, color=False,
+                         width=args.points))
+        return 0
+
+    color = sys.stdout.isatty()
+    try:
+        while True:
+            health, metrics = fetch(args.rpc, points=args.points)
+            frame = render(health, metrics, color=color,
+                           width=args.points)
+            # ANSI full redraw: clear + home (stdlib-portable; no
+            # curses dependency so --once and CI pipes behave)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Health-engine smoke: a live daemon surface must flip gethealth to
+degraded under an injected device fault and recover after disarm
+(doc/health.md; the run_suite.sh health-smoke pass).
+
+The drive (in one process, like tools/loadgen.py's harness):
+
+  1. a REAL Gossipd/GossipIngest with batched verify flushes behind a
+     JSON-RPC unix socket (gethealth + getmetrics) and a REST gateway,
+     plus a fast-tick HealthEngine;
+  2. baseline gossip traffic -> gethealth reports healthy, REST
+     GET /health agrees;
+  3. `dispatch:verify:raise:1` armed via the PR-4 fault grammar: every
+     verify dispatch fails, quarantine bisects to the host oracle
+     (correctness preserved), the verify breaker trips and STAYS open
+     past the SLO's grace period -> gethealth flips to
+     degraded/unhealthy with `breaker_open` named,
+     clntpu_slo_breach_total{slo="breaker_open"} increments, REST and
+     `tools/dashboard.py --once` render the same state;
+  4. fault disarmed, traffic resumes -> the half-open probe closes the
+     breaker, the breach clears, and after the hysteresis ticks
+     gethealth recovers to healthy.
+
+Pins the suite's jax config (8-device CPU, read-only compile cache) so
+the warmed verify programs are reused — same reasoning as loadgen's
+selfcheck.
+"""
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import importlib.util
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+
+# suite config BEFORE any heavy import (see tools/loadgen.py main())
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LIGHTNING_TPU_JAX_CACHE_MODE", "ro")
+os.environ.setdefault("LIGHTNING_TPU_MESH_VERIFY", "off")
+# a tight breaker so the fault trips fast and the open window is
+# bounded: 3 consecutive failures to open, ~1.5 s to half-open
+os.environ.setdefault("LIGHTNING_TPU_BREAKER_THRESHOLD", "3")
+os.environ.setdefault("LIGHTNING_TPU_BREAKER_BACKOFF_S", "1.5")
+os.environ.setdefault("LIGHTNING_TPU_BREAKER_MAX_BACKOFF_S", "1.5")
+
+
+def _load_tool(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_TOOLS, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+async def _rest_get(port: int, path: str) -> tuple[int, dict]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split(b" ")[1]), json.loads(body)
+
+
+async def run_smoke() -> dict:
+    from lightning_tpu.crypto import ref_python as ref
+    from lightning_tpu.daemon.jsonrpc import JsonRpcServer, make_gethealth
+    from lightning_tpu.daemon.rest import RestServer
+    from lightning_tpu.gossip import store as gstore
+    from lightning_tpu.gossip import gossmap as GM
+    from lightning_tpu.gossip import synth
+    from lightning_tpu.gossip.gossipd import Gossipd
+    from lightning_tpu.obs import health as _health
+    from lightning_tpu.resilience import breaker as _breaker
+    from lightning_tpu.resilience import faultinject
+
+    loadgen = _load_tool("loadgen")
+    dashboard = _load_tool("dashboard")
+
+    failures: list[str] = []
+    report: dict = {}
+    tmp = tempfile.mkdtemp(prefix="health_smoke_")
+    base_path = os.path.join(tmp, "base.gs")
+    print("health-smoke: generating base network (96 ch, signed)...",
+          flush=True)
+    info = synth.make_network_store(base_path, 96, 48, sign=True,
+                                    sign_bucket=256, seed=11)
+    seckeys = info["seckeys"]
+    pubs = [ref.pubkey_serialize(ref.pubkey_create(k)) for k in seckeys]
+    pub2sec = dict(zip(pubs, seckeys))
+    own_pub = pubs[0]
+
+    idx = gstore.load_store(base_path)
+    g = GM.from_store(idx)
+    node = loadgen._StubNode(own_pub)
+    gossipd = Gossipd(node, os.path.join(tmp, "live.gs"),
+                      gossmap_ref={"map": g}, flush_size=64,
+                      flush_ms=2.0, bucket=64)
+    gossipd.load_existing(base_path, idx=idx)
+    ing = gossipd.ingest
+
+    rpc_path = os.path.join(tmp, "rpc.sock")
+    rpc = JsonRpcServer(rpc_path)
+
+    async def getmetrics() -> dict:
+        from lightning_tpu import obs
+        from lightning_tpu.resilience import (overload as _overload,
+                                              resilience_snapshot)
+
+        snap = obs.snapshot()
+        snap["resilience"] = resilience_snapshot()
+        snap["overload"] = _overload.snapshot()
+        return snap
+
+    rpc.register("getmetrics", getmetrics)
+
+    # fast ticks: the whole degrade->recover cycle fits in seconds.
+    # breaker_open grace 0.4 s << the 1.5 s open window the env pins.
+    specs = _health.default_slo_specs()
+    for s in specs:
+        if s.name == "breaker_open":
+            s.params["max_open_s"] = 0.4
+    heng = _health.install(_health.HealthEngine(
+        interval_s=0.2, short_ticks=5, long_ticks=50, recover_ticks=3,
+        slos=specs))
+    rpc.register("gethealth", make_gethealth(heng))
+    await rpc.start()
+    rest = RestServer(rpc)
+    rest_port = await rest.start()
+    gossipd.start()
+    print("health-smoke: warming verify programs...", flush=True)
+    await ing.warmup()
+    heng.start()
+
+    storm = loadgen._build_storm(ing, pub2sec, own_pub, 768, 11)
+    peer = loadgen._StubPeer(b"\x03" + b"\x22" * 32)
+    cursor = [0]
+
+    async def feed(n: int) -> None:
+        lo = cursor[0]
+        cursor[0] = min(len(storm), lo + n)
+        for _key, raw, _own in storm[lo:cursor[0]]:
+            await gossipd._on_gossip(peer, raw)
+        await ing.drain()
+
+    cli = await loadgen._RpcClient(rpc_path).connect()
+
+    async def wait_health(pred, timeout: float, what: str):
+        deadline = time.monotonic() + timeout
+        rep = None
+        while time.monotonic() < deadline:
+            rep = (await cli.call("gethealth")).get("result") or {}
+            if pred(rep):
+                return rep
+            await asyncio.sleep(0.2)
+        failures.append(f"timed out waiting for {what} "
+                        f"(state={rep.get('state') if rep else None}, "
+                        f"breached={rep.get('breached') if rep else None})")
+        return rep or {}
+
+    def _slo_breach_count(snap: dict, slo: str) -> float:
+        fam = snap.get("metrics", {}).get("clntpu_slo_breach_total", {})
+        return sum(s.get("value", 0.0) for s in fam.get("samples", ())
+                   if s.get("labels", {}).get("slo") == slo)
+
+    # -- phase A: healthy baseline ----------------------------------------
+    print("health-smoke: phase A (baseline)...", flush=True)
+    await feed(48)
+    rep = await wait_health(
+        lambda r: r.get("state") == "healthy" and r.get("ticks", 0) > 3,
+        15.0, "healthy baseline")
+    status, body = await _rest_get(rest_port, "/health")
+    report["baseline"] = {"state": rep.get("state"), "rest": body}
+    if status != 200 or body.get("status") != "healthy" \
+            or not body.get("ready"):
+        failures.append(f"REST /health baseline disagrees: {status} {body}")
+    breaches_before = _slo_breach_count(
+        (await cli.call("getmetrics"))["result"], "breaker_open")
+
+    # -- phase B: fault armed -> degraded ---------------------------------
+    print("health-smoke: phase B (dispatch:verify:raise:1 armed)...",
+          flush=True)
+    with faultinject.arm("dispatch:verify:raise:1"):
+        await feed(96)
+        if _breaker.get("verify").state == "closed":
+            # keep feeding until the consecutive-failure threshold trips
+            for _ in range(4):
+                await feed(16)
+                if _breaker.get("verify").state != "closed":
+                    break
+        rep = await wait_health(
+            lambda r: r.get("state") in ("degraded", "unhealthy")
+            and "breaker_open" in (r.get("breached") or ()),
+            12.0, "degraded with breaker_open breached")
+        degraded_state = rep.get("state")
+        status, body = await _rest_get(rest_port, "/health")
+        if body.get("status") != degraded_state:
+            failures.append(
+                f"REST /health disagrees while degraded: {body} "
+                f"vs {degraded_state}")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            await asyncio.to_thread(
+                dashboard.main, ["--rpc", rpc_path, "--once"])
+        frame = buf.getvalue()
+        if degraded_state and degraded_state.upper() not in frame:
+            failures.append("dashboard --once does not render the "
+                            f"degraded state ({degraded_state})")
+        if "breaker_open" not in frame:
+            failures.append("dashboard --once does not list the "
+                            "breaker_open SLO")
+        snap = (await cli.call("getmetrics"))["result"]
+        breaches_after = _slo_breach_count(snap, "breaker_open")
+        if not breaches_after > breaches_before:
+            failures.append(
+                "clntpu_slo_breach_total{slo=breaker_open} did not "
+                f"increment ({breaches_before} -> {breaches_after})")
+        report["degraded"] = {"state": degraded_state,
+                              "breached": rep.get("breached"),
+                              "breach_counter": breaches_after,
+                              "rest": body}
+
+    # -- phase C: disarm -> recover ---------------------------------------
+    print("health-smoke: phase C (disarmed, recovering)...", flush=True)
+    deadline = time.monotonic() + 20.0
+    while _breaker.get("verify").state != "closed" \
+            and time.monotonic() < deadline \
+            and cursor[0] < len(storm):
+        # traffic gives the half-open probe something to dispatch
+        await feed(8)
+        await asyncio.sleep(0.3)
+    if _breaker.get("verify").state != "closed":
+        failures.append("verify breaker never re-closed after disarm")
+    rep = await wait_health(lambda r: r.get("state") == "healthy",
+                            20.0, "recovery to healthy")
+    status, body = await _rest_get(rest_port, "/health")
+    if body.get("status") != "healthy" or not body.get("ready"):
+        failures.append(f"REST /health did not recover: {body}")
+    report["recovered"] = {"state": rep.get("state"), "rest": body}
+
+    await cli.close()
+    await gossipd.close()
+    await rest.close()
+    await rpc.close()
+    heng.stop()
+    _health.install(None)
+    report["failures"] = failures
+    report["ok"] = not failures
+    return report
+
+
+def main() -> int:
+    from lightning_tpu.utils.jaxcfg import force_cpu, setup_cache
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        force_cpu(n_devices=8)
+    setup_cache()
+    report = asyncio.run(run_smoke())
+    for f in report["failures"]:
+        print(f"health-smoke: FAIL: {f}", file=sys.stderr)
+    print("health-smoke:", json.dumps(
+        {k: v for k, v in report.items() if k != "failures"},
+        default=str))
+    print("health-smoke: PASS" if report["ok"] else "health-smoke: FAIL")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

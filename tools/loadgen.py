@@ -52,16 +52,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-DEFAULT_SLO = {
-    # p99 latency of ANSWERED getroute RPCs (ok or noroute; TRY_AGAIN
-    # retries excluded — they are the mechanism that protects this)
-    "route_p99_s": 2.0,
-    # verified-signature throughput floor while storming (CPU stub is
-    # the selfcheck target; TPU deployments declare their own)
-    "min_accept_sigs_per_s": 20.0,
-    # at least this many getroute answers must land during the storm
-    "min_route_answers": 20,
-}
+# the SLO table lives with the live evaluator now (the health engine,
+# doc/health.md) so the daemon's continuous SLO evaluation and this
+# harness's post-hoc assertions share one source of truth; the run
+# FAILS if the two evaluators disagree (jax-free import, safe before
+# the env setup in main()).
+from lightning_tpu.obs.health import DEFAULT_SLO  # noqa: E402
 
 
 def parse_args(argv=None):
@@ -312,6 +308,20 @@ async def run_load(args, slo: dict) -> dict:
         return snap
 
     rpc.register("getmetrics", getmetrics)
+
+    # live health engine (doc/health.md): fast ticks so the ~20 s
+    # selfcheck storm spans many evaluation windows; SLO thresholds
+    # seeded from the SAME table this harness asserts post-hoc, and a
+    # long window wide enough that the final route_p99 verdict covers
+    # the whole storm
+    from lightning_tpu.daemon.jsonrpc import make_gethealth
+    from lightning_tpu.obs import health as _health
+
+    heng = _health.install(_health.HealthEngine(
+        interval_s=0.5, short_ticks=6, long_ticks=120, recover_ticks=3,
+        slos=_health.default_slo_specs(slo)))
+    rpc.register("gethealth", make_gethealth(heng))
+    heng.start()
     await rpc.start()
     gossipd.start()
     router.start()
@@ -393,6 +403,25 @@ async def run_load(args, slo: dict) -> dict:
         finally:
             await cli.close()
 
+    health_seen = {"states": set(), "breached": set(), "observed": set()}
+
+    async def health_watch():
+        # poll the LIVE evaluator while the storm runs: the engine must
+        # leave healthy (the overload SLOs breach while the watermarks
+        # are exceeded) and name the breached SLOs
+        cli = await _RpcClient(rpc_path).connect()
+        try:
+            while not storm_done.is_set():
+                rep = (await cli.call("gethealth")).get("result") or {}
+                health_seen["states"].add(rep.get("state"))
+                health_seen["breached"].update(rep.get("breached") or ())
+                for n, s in (rep.get("slos") or {}).items():
+                    if s.get("observed") is not None:
+                        health_seen["observed"].add(n)
+                await asyncio.sleep(0.5)
+        finally:
+            await cli.close()
+
     async def sign_task():
         rng = np.random.default_rng(args.seed + 2)
         keys = seckeys[:8]
@@ -407,12 +436,20 @@ async def run_load(args, slo: dict) -> dict:
     await asyncio.gather(storm_task(),
                          *(route_client(i)
                            for i in range(args.route_conc)),
-                         sign_task())
+                         sign_task(), health_watch())
     await ing.drain()
 
     # -- post-storm: metrics surface still live ---------------------------
     cli = await _RpcClient(rpc_path).connect()
     metrics = (await cli.call("getmetrics"))["result"]
+    # the live engine must RECOVER once the storm drains (hysteresis:
+    # recover_ticks clean ticks after the last breach window rolls out)
+    health_final = (await cli.call("gethealth"))["result"]
+    recover_deadline = time.monotonic() + 30.0
+    while health_final.get("state") != "healthy" and \
+            time.monotonic() < recover_deadline:
+        await asyncio.sleep(0.5)
+        health_final = (await cli.call("gethealth"))["result"]
     await cli.close()
     ovl = metrics.get("overload", {})
     if "ingest" not in ovl.get("families", {}) or \
@@ -426,6 +463,8 @@ async def run_load(args, slo: dict) -> dict:
     await gossipd.close()
     await router.close()
     await rpc.close()
+    heng.stop()
+    _health.install(None)
 
     # -- SLO evaluation ----------------------------------------------------
     storm_wall = max(report.get("storm_wall_s", 0.001), 0.001)
@@ -454,6 +493,13 @@ async def run_load(args, slo: dict) -> dict:
         "route_p99_s": round(p99, 4),
         "sign_batches": sign_stats["batches"],
         "ingest_state_after": bp.get("state"),
+        "health": {
+            "states_seen": sorted(s for s in health_seen["states"] if s),
+            "breached_seen": sorted(health_seen["breached"]),
+            "final_state": health_final.get("state"),
+            "final_slos": {n: s.get("status") for n, s in
+                           (health_final.get("slos") or {}).items()},
+        },
     })
 
     # bounded queues (a true bound: admission is unit-weighted)
@@ -504,6 +550,53 @@ async def run_load(args, slo: dict) -> dict:
         # TRY_AGAIN path is a regression, not a quiet success
         failures.append("route admission control never fired "
                         "(expected TRY_AGAIN under selfcheck load)")
+
+    # -- live health engine vs. this harness (doc/health.md) --------------
+    # While the storm exceeds the watermarks the engine must leave
+    # healthy with the overload SLOs named, and must recover once the
+    # backlog drains.
+    if not (health_seen["states"] & {"degraded", "unhealthy"}):
+        failures.append("health engine never left healthy under storm")
+    if not (health_seen["breached"] & {"shed_ratio",
+                                       "overload_saturated"}):
+        failures.append(
+            "storm breached none of the overload SLOs (saw: "
+            f"{sorted(health_seen['breached'])})")
+    if health_final.get("state") != "healthy":
+        failures.append(
+            f"health engine did not recover after drain (state "
+            f"{health_final.get('state')}, breached "
+            f"{health_final.get('breached')})")
+    # agreement between the two evaluators on the shared SLOs — the
+    # drift check this harness exists to catch.  The live engine is
+    # windowed (strictly more sensitive than one whole-storm number),
+    # so: a harness breach MUST have been seen live, a harness pass
+    # must leave the live SLO un-violated at the end, and both SLOs
+    # must actually have observed data during the storm (an evaluator
+    # wired to a renamed metric silently observes nothing forever).
+    live_slos = health_final.get("slos") or {}
+    harness_verdicts = {
+        "route_p99": p99 > slo["route_p99_s"],
+        "ingest_accept": accept_rate < slo["min_accept_sigs_per_s"],
+    }
+    for name, harness_breach in harness_verdicts.items():
+        live = live_slos.get(name)
+        if live is None:
+            failures.append(f"gethealth report lacks SLO {name!r}")
+            continue
+        if name not in health_seen["observed"]:
+            failures.append(
+                f"health SLO {name} never observed data during the "
+                "storm (evaluator wired to a dead metric?)")
+        if harness_breach and live.get("breaches_total", 0) == 0 \
+                and not live.get("violated"):
+            failures.append(
+                f"evaluator drift on {name}: harness post-hoc verdict "
+                "is BREACH but the live engine never recorded one")
+        if not harness_breach and live.get("violated"):
+            failures.append(
+                f"evaluator drift on {name}: live engine still in "
+                "breach but the harness post-hoc verdict is PASS")
 
     # -- determinism: unthrottled replay of the non-shed subset -----------
     print("loadgen: replaying non-shed subset unthrottled...",
@@ -584,6 +677,10 @@ def main(argv=None) -> int:
               f"p99={r['route_p99_s']}s "
               f"sign_batches={r['sign_batches']} "
               f"replay_identical={r['replay_identical']}")
+        h = r.get("health", {})
+        print(f"loadgen: health states={h.get('states_seen')} "
+              f"breached={h.get('breached_seen')} "
+              f"final={h.get('final_state')}")
     for f in report["failures"]:
         print(f"loadgen: SLO FAIL: {f}", file=sys.stderr)
     print("loadgen: PASS" if report["ok"] else "loadgen: FAIL")

@@ -36,6 +36,13 @@ report, doc/perf.md) — capture --local computes it in-process, and
 diffs/--watch ticks fold in its compact per-family view (bottleneck +
 critical-path seconds), so a watch tick NAMES the bottleneck as the
 stage counters move.
+
+RPC/REST captures also carry the health engine's `gethealth` report
+when the daemon runs one (doc/health.md); --watch ticks then print the
+rolled-up state, per-SLO statuses, and window rates read from the
+engine's time-series rings — the same numbers tools/dashboard.py
+renders — falling back to plain local diffing on daemons without the
+engine.
 """
 from __future__ import annotations
 
@@ -70,12 +77,25 @@ def rpc_call(rpc_path: str, method: str, params: dict | None = None) -> dict:
 def capture_rpc(rpc_path: str, dispatches: int | None = None) -> dict:
     """getmetrics over the daemon's unix JSON-RPC socket;
     --dispatches N folds the last N flight records in (listdispatches,
-    doc/tracing.md)."""
+    doc/tracing.md).  When the daemon runs the health engine the
+    gethealth report rides along too, so --watch ticks read their
+    window rates from the SAME rings the dashboard renders
+    (doc/health.md); a daemon without the engine falls back to plain
+    local diffing."""
     snap = rpc_call(rpc_path, "getmetrics")
     if dispatches:
         snap["dispatch_log"] = rpc_call(
             rpc_path, "listdispatches",
             {"limit": dispatches})["dispatches"]
+    try:
+        health = rpc_call(rpc_path, "gethealth")
+        # a daemon that registers gethealth but never installed/ran an
+        # engine answers with an empty zero-tick report — that is the
+        # "no engine" case too, not a health signal worth folding
+        if health.get("ticks"):
+            snap["health"] = health
+    except (SystemExit, OSError, ValueError, KeyError):
+        pass
     return snap
 
 
@@ -99,6 +119,12 @@ def capture_url(url: str, rune: str | None = None,
     if dispatches:
         snap["dispatch_log"] = post(
             "listdispatches", {"limit": dispatches})["dispatches"]
+    try:
+        health = post("gethealth", {})
+        if health.get("ticks"):      # zero ticks = no engine running
+            snap["health"] = health
+    except Exception:
+        pass  # no health engine behind this gateway: local diffing only
     return snap
 
 
@@ -165,6 +191,18 @@ def diff_snapshots(a: dict, b: dict) -> dict:
             out["perf"] = attribution.compact(b["perf"])
         except Exception:
             out["perf"] = b["perf"]
+    # the health engine's report (gethealth) is point-in-time like the
+    # perf section: a --watch tick carries the compact view — rolled-up
+    # state, per-SLO statuses, and the short-window rates read from the
+    # engine's own rings, so watch output and tools/dashboard.py agree
+    # on the same numbers (doc/health.md)
+    if "health" in b:
+        try:
+            from lightning_tpu.obs import health as _health
+
+            out["health"] = _health.compact(b["health"])
+        except Exception:
+            out["health"] = b["health"]
     # flight records captured with --dispatches: the diff keeps only
     # the dispatches NEW since `a`, so a --watch tick shows WHICH
     # dispatch blew up a counter delta, not just that one did

@@ -120,6 +120,17 @@ LIGHTNING_TPU_DEADLINE_INGEST_S=240 \
   timeout 1800 python -m pytest tests/test_zz_resilience.py -x -q \
   || { echo "fault-matrix pass failed"; exit 1; }
 
+# Health-smoke pass (doc/health.md): a live daemon surface with the
+# fast-tick health engine — a dispatch:verify fault armed via the PR-4
+# grammar must trip the verify breaker, flip gethealth (and REST
+# GET /health and tools/dashboard.py --once) to degraded with
+# breaker_open named and clntpu_slo_breach_total incremented, then
+# recover to healthy after disarm.  Pins the same jax config as the
+# soak-lite pass so the warmed verify programs are reused.
+echo "health-smoke pass (tools/health_smoke.py)"
+timeout 1200 python tools/health_smoke.py \
+  || { echo "health-smoke failed"; exit 1; }
+
 # Overload soak-lite pass (doc/overload.md): a bounded (~20 s storm)
 # gossip storm + concurrent getroute/sign load against a live daemon
 # surface on the CPU stub, asserting the overload SLOs — bounded
@@ -132,4 +143,4 @@ LIGHTNING_TPU_DEADLINE_INGEST_S=240 \
 echo "overload soak-lite pass (tools/loadgen.py --selfcheck)"
 timeout 1200 python tools/loadgen.py --selfcheck \
   || { echo "loadgen selfcheck failed"; exit 1; }
-echo "suite green (2 slices + graftlint + perf smoke + fault matrix + soak-lite)"
+echo "suite green (2 slices + graftlint + perf smoke + fault matrix + health smoke + soak-lite)"
