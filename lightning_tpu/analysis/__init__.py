@@ -22,11 +22,19 @@ DEFAULT_BASELINE = "tools/graftlint_baseline.json"
 
 __all__ = ["Config", "Engine", "Finding", "AnalysisResult",
            "ALL_PASSES", "PASSES_BY_NAME", "DEFAULT_BASELINE",
-           "REPO_ROOT", "run_repo"]
+           "REPO_ROOT", "run_repo", "pass_versions"]
+
+
+def pass_versions(names) -> dict:
+    """{pass name: current version} — what the baseline stamps and
+    checks entries against (a pass rewrite bumps its version and
+    orphans its grandfathers)."""
+    return {n: PASSES_BY_NAME[n].version for n in names}
 
 
 def run_repo(pass_names=None, config: Config | None = None,
-             baseline_path: str | None = None) -> AnalysisResult:
+             baseline_path: str | None = None,
+             check_stale: bool = True) -> AnalysisResult:
     """Run graftlint and apply the baseline.  ``pass_names`` None →
     every pass.  Returns the AnalysisResult with baselined findings
     marked and stale/unjustified entries collected."""
@@ -40,5 +48,6 @@ def run_repo(pass_names=None, config: Config | None = None,
     bpath = baseline_path or cfg.baseline_path or os.path.join(
         cfg.root, DEFAULT_BASELINE)
     data = _baseline.load(bpath)
-    _baseline.apply(result, data, names)
+    _baseline.apply(result, data, pass_versions(names),
+                    check_stale=check_stale)
     return result

@@ -43,47 +43,79 @@ def save(path: str, data: dict) -> None:
         f.write("\n")
 
 
-def apply(result: AnalysisResult, data: dict,
-          passes_run: tuple) -> None:
+def _version_map(passes_run) -> dict:
+    """Accept a {name: version} dict or a bare name tuple (the lint
+    shims' legacy spelling — no version enforcement)."""
+    if isinstance(passes_run, dict):
+        return passes_run
+    return {name: None for name in passes_run}
+
+
+def apply(result: AnalysisResult, data: dict, passes_run,
+          check_stale: bool = True) -> None:
     """Mark baselined findings and collect stale/unjustified entries.
 
     Staleness only considers entries belonging to the passes that
     actually ran: `tools/lint_asserts.py` (asserts pass only) must not
-    report every other pass's entries as stale."""
+    report every other pass's entries as stale.  ``check_stale=False``
+    skips the whole-tree staleness sweep — the ``--changed`` mode lints
+    a file subset, where an entry for an untouched file matching
+    nothing is expected, not stale.
+
+    Entries carry the pass version they were grandfathered under
+    (``pass_version``); an entry from an older (or unstamped) pass
+    revision no longer suppresses — the pass was rewritten, its
+    grandfathers must be re-justified against the new semantics.  The
+    mismatched entry reports as stale AND the finding as new."""
+    versions = _version_map(passes_run)
     entries = data.get("entries", {})
     seen: set[str] = set()
     for f in result.findings:
         entry = entries.get(f.fingerprint)
-        if entry is not None:
-            seen.add(f.fingerprint)
-            just = (entry.get("justification") or "").strip()
-            f.baselined = True      # suppressed from new_findings
-            f.justification = just  # "" when unjustified
-            if not just:
-                # reported ONCE, as an unjustified entry (not again as
-                # a new finding) — the fix is to annotate the entry
-                result.unjustified.append(
-                    {"fingerprint": f.fingerprint, **entry})
+        if entry is None:
+            continue
+        want = versions.get(f.pass_name)
+        if want is not None and entry.get("pass_version") != want:
+            continue    # version mismatch: entry dead, finding live
+        seen.add(f.fingerprint)
+        just = (entry.get("justification") or "").strip()
+        f.baselined = True      # suppressed from new_findings
+        f.justification = just  # "" when unjustified
+        if not just:
+            # reported ONCE, as an unjustified entry (not again as
+            # a new finding) — the fix is to annotate the entry
+            result.unjustified.append(
+                {"fingerprint": f.fingerprint, **entry})
+    if not check_stale:
+        return
     for fp, entry in entries.items():
         if fp in seen:
             continue
-        if entry.get("pass") not in passes_run:
+        if entry.get("pass") not in versions:
             continue
         result.stale_baseline.append({"fingerprint": fp, **entry})
 
 
-def update(data: dict, result: AnalysisResult,
-           justification: str) -> tuple[int, int]:
-    """--baseline-update: drop stale entries for the passes that ran,
-    add entries for new findings (requires a justification), refresh
-    context fields on survivors.  Returns (added, removed)."""
+def update(data: dict, result: AnalysisResult, justification: str,
+           passes_run=()) -> dict:
+    """--baseline-update: drop stale entries for the passes that ran
+    (incl. pass-version orphans), add entries for new findings
+    (requires a justification), refresh context fields — and the pass
+    version stamp — on survivors.  Returns per-pass counts
+    ``{pass: {"added": n, "removed": n, "kept": n}}`` so one run
+    reports its hygiene across all passes."""
+    versions = _version_map(passes_run)
     entries = data.setdefault("entries", {})
-    removed = 0
+    per_pass: dict = {}
+
+    def bump(name: str, key: str) -> None:
+        per_pass.setdefault(
+            name, {"added": 0, "removed": 0, "kept": 0})[key] += 1
+
     for stale in result.stale_baseline:
         if stale["fingerprint"] in entries:
             del entries[stale["fingerprint"]]
-            removed += 1
-    added = 0
+            bump(stale.get("pass", "?"), "removed")
     for f in result.findings:
         prev = entries.get(f.fingerprint)
         just = (prev or {}).get("justification", "").strip() \
@@ -92,8 +124,7 @@ def update(data: dict, result: AnalysisResult,
             raise ValueError(
                 f"new finding {f.fingerprint} ({f.location()} "
                 f"[{f.pass_name}/{f.code}]) needs --justification")
-        if prev is None:
-            added += 1
+        bump(f.pass_name, "added" if prev is None else "kept")
         entries[f.fingerprint] = {
             "pass": f.pass_name,
             "code": f.code,
@@ -101,13 +132,18 @@ def update(data: dict, result: AnalysisResult,
             "scope": f.scope,
             "detail": f.detail,
             "justification": just,
+            **({"pass_version": versions[f.pass_name]}
+               if versions.get(f.pass_name) is not None else {}),
         }
-    return added, removed
+    return per_pass
 
 
-def entry_for(f: Finding, justification: str) -> dict:
+def entry_for(f: Finding, justification: str,
+              pass_version: int | None = None) -> dict:
     return {
         "pass": f.pass_name, "code": f.code, "file": f.path,
         "scope": f.scope, "detail": f.detail,
         "justification": justification,
+        **({"pass_version": pass_version}
+           if pass_version is not None else {}),
     }

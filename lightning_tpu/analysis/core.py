@@ -131,6 +131,56 @@ class FileContext:
     _defs: list = field(default_factory=list)        # (node, chain ids)
     _wrapped_names: set = field(default_factory=set)
     _kernel_ids: set | None = None
+    _imports: dict | None = None
+
+    def module_name(self) -> str:
+        """Dotted module name for this file ('pkg/mod.py' → 'pkg.mod')."""
+        rel = self.relpath[:-3] if self.relpath.endswith(".py") \
+            else self.relpath
+        parts = rel.split(os.sep)
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def import_aliases(self) -> dict[str, str]:
+        """Local name → dotted module it references, for every module
+        import in the file (any nesting — verify.py imports the mesh
+        inside a builder function).  Relative imports resolve against
+        this file's package; `from x import name` binds ``name`` to
+        ``x.name`` (which is only a module path when ``name`` IS a
+        module — consumers check against the scanned set).  Resolved
+        lazily once per file, shared by the cross-file passes."""
+        if self._imports is not None:
+            return self._imports
+        # for a package __init__.py the module IS the package, so a
+        # level-1 relative import resolves against module_name() itself
+        # (not its parent — that is one package too high)
+        parts = self.module_name().split(".")
+        if os.path.basename(self.relpath) == "__init__.py":
+            pkg_parts = parts
+        else:
+            pkg_parts = parts[:-1]
+        out: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    out[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else
+                        alias.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                else:
+                    base = []
+                mod = ".".join(base + ([node.module]
+                                       if node.module else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    target = f"{mod}.{alias.name}" if mod else alias.name
+                    out[alias.asname or alias.name] = target
+        self._imports = out
+        return out
 
     def scope(self) -> str:
         parts = [c.name for c in self.class_stack] + [
@@ -196,6 +246,11 @@ class Pass:
     description = ""
     default_scope: tuple = ("",)
     node_types: tuple = ()
+    # bumped on a semantic rewrite of the pass: baseline entries carry
+    # the version they were grandfathered under, and a mismatch makes
+    # them stale — a rewritten pass cannot inherit the old pass's
+    # grandfathers (doc/static_analysis.md §baseline)
+    version = 1
 
     def __init__(self):
         self.findings: list[Finding] = []

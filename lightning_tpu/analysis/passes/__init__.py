@@ -11,6 +11,10 @@ from .jit_hygiene import JitHygienePass
 from .host_sync import HostSyncPass
 from .lock_discipline import LockDisciplinePass
 from .registry_sync import RegistrySyncPass
+from .lock_order import LockOrderPass
+from .async_blocking import AsyncBlockingPass
+from .supervision import SupervisionCoveragePass
+from .x64_discipline import X64DisciplinePass
 
 ALL_PASSES = (
     InputContractAssertPass,
@@ -18,6 +22,10 @@ ALL_PASSES = (
     JitHygienePass,
     HostSyncPass,
     LockDisciplinePass,
+    LockOrderPass,
+    AsyncBlockingPass,
+    SupervisionCoveragePass,
+    X64DisciplinePass,
     RegistrySyncPass,
 )
 

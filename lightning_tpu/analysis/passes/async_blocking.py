@@ -1,0 +1,303 @@
+"""async-blocking pass: the event loop never runs blocking primitives.
+
+The daemon is ~15 asyncio modules sharing ONE event loop; a single
+``time.sleep``/unbounded ``queue.get``/synchronous file read inside a
+coroutine stalls every peer, every RPC, every flush loop at once — the
+exact failure the PR-7 backpressure work bounds *per message* and a
+blocking call un-bounds globally.  Nothing checked this: the PR-4
+close-race class (an ``async def close()`` joining a dispatch thread
+with no timeout wedges shutdown exactly when a dispatch is in flight)
+was caught by a targeted test, not by analysis.
+
+Flagged inside ``async def`` bodies AND inside sync functions reachable
+*only* from the event loop (every intra-file reference is a call from
+async code — a helper that is also passed to ``asyncio.to_thread``/
+``run_in_executor``/``threading.Thread`` escapes to a worker and is
+exempt):
+
+* ``time.sleep``                        → ``blocking-sleep``
+* queue-ish ``.get()`` with no timeout  → ``blocking-queue-get``
+* thread-ish ``.join()`` with no timeout→ ``blocking-join``
+* executor-future ``.result()`` with no timeout (receiver assigned
+  from ``*.submit(...)``; asyncio futures' non-blocking ``result()``
+  is NOT flagged)                       → ``blocking-result``
+* ``subprocess.*`` / ``os.system``      → ``blocking-subprocess``
+* ``socket.*`` / ``urlopen`` / ``requests.*`` / builtin ``open``
+                                        → ``blocking-io``
+* ``block_until_ready`` / ``device_get``→ ``blocking-device``
+
+Accepted idioms: anything lexically inside a ``to_thread``/
+``run_in_executor`` argument list (it runs on a worker), and bounded
+waits (an explicit ``timeout=``/positional timeout).  A deliberate
+exception is a baseline entry with a justification.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, Pass
+
+_QUEUEISH = re.compile(r"(^|[._])(q|queue|inbox|mailbox|jobs|work)s?$",
+                       re.I)
+_THREADISH = re.compile(
+    r"(^|[._])(thread|worker|producer|consumer|proc|process|t)s?$", re.I)
+_EXECUTOR_WRAPS = {"to_thread", "run_in_executor"}
+_THREAD_ESCAPES = {"to_thread", "run_in_executor", "Thread", "Timer",
+                   "call_soon_threadsafe", "submit", "partial"}
+_LOOP_NOTE = ("this function's only callers are coroutines — it runs "
+              "ON the event loop")
+
+
+def _has_timeout(node: ast.Call, pos: int = 0,
+                 block_pos: int | None = None) -> bool:
+    """True when the call is a bounded wait.  ``pos`` is the positional
+    index of the timeout parameter — queue ``get(block, timeout)`` puts
+    it SECOND (``get(True)`` is the block flag, still unbounded), while
+    ``join``/``result`` take it first.  A literal ``None``/``True``
+    timeout is not a bound (``join(None)`` is the explicit-unbounded
+    spelling of the PR-4 close race); ``get(block=False)`` never blocks
+    at all."""
+    def bound(v: ast.AST) -> bool:
+        return not (isinstance(v, ast.Constant)
+                    and (v.value is None or v.value is True))
+
+    def nonblocking(v: ast.AST) -> bool:
+        return isinstance(v, ast.Constant) and v.value is False
+
+    for kw in node.keywords:
+        if kw.arg == "timeout" and bound(kw.value):
+            return True
+        if block_pos is not None and kw.arg == "block" \
+                and nonblocking(kw.value):
+            return True
+    if len(node.args) > pos and bound(node.args[pos]):
+        return True
+    if block_pos is not None and len(node.args) > block_pos \
+            and nonblocking(node.args[block_pos]):
+        return True
+    return False
+
+
+class AsyncBlockingPass(Pass):
+    name = "async-blocking"
+    description = ("no blocking primitives (sleep/unbounded get/join/"
+                   "result/subprocess/sync IO) on the event loop")
+    default_scope = ("lightning_tpu",)
+    node_types = (ast.Call, ast.Await)
+    version = 1
+
+    def __init__(self):
+        super().__init__()
+        self._reset_file()
+
+    def _reset_file(self):
+        # candidate blocking calls: (node, code, msg, fn id, scope)
+        self._candidates: list = []
+        # dataflow-lite: (fn id, var) -> source call head ('x.submit')
+        self._assign_src: dict = {}
+        # call sites of local defs: def id -> [caller fn node or None]
+        self._call_sites: dict = {}
+        # def names referenced NOT as a direct call (escapes as value)
+        self._escapes: set = set()
+        self._exempt_subtrees: set = set()   # ids of to_thread arg calls
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._reset_file()
+
+    # -- classification -----------------------------------------------------
+
+    def _head(self, fn: ast.AST) -> str:
+        try:
+            return ast.unparse(fn)
+        except Exception:
+            return ""
+
+    def _classify(self, node: ast.Call, ctx: FileContext):
+        fn = node.func
+        head = self._head(fn)
+        if head == "time.sleep" or (
+                head == "sleep"
+                and ctx.import_aliases().get("sleep") == "time.sleep"):
+            return ("blocking-sleep",
+                    "time.sleep stalls the whole event loop — use "
+                    "`await asyncio.sleep` (or to_thread the worker)")
+        if isinstance(fn, ast.Attribute):
+            recv = self._head(fn.value)
+            if fn.attr == "get" \
+                    and not _has_timeout(node, pos=1, block_pos=0) \
+                    and _QUEUEISH.search(recv):
+                return ("blocking-queue-get",
+                        f"`{recv}.get()` with no timeout parks the "
+                        "loop until a producer shows up — every peer "
+                        "and RPC stalls with it")
+            if fn.attr == "join" and not _has_timeout(node) \
+                    and _THREADISH.search(recv):
+                return ("blocking-join",
+                        f"`{recv}.join()` with no timeout wedges the "
+                        "loop on a worker that may never exit (the "
+                        "PR-4 close-vs-inflight-dispatch class)")
+            if fn.attr == "result" and not _has_timeout(node):
+                # provisional: kept only when the same function also
+                # calls `.submit(...)` (an executor future blocks; an
+                # asyncio Task's result() does not) — see end_file
+                return ("blocking-result",
+                        f"`{recv}.result()` blocks on an executor "
+                        "future with no timeout — await "
+                        "`asyncio.wrap_future` instead")
+            if fn.attr == "block_until_ready" or head.endswith(
+                    "jax.block_until_ready"):
+                return ("blocking-device",
+                        "block_until_ready pins the loop to a device "
+                        "round-trip — dispatch via to_thread and await")
+            if head.startswith(("subprocess.", "os.system", "os.popen")):
+                return ("blocking-subprocess",
+                        f"`{head}` runs a child process synchronously "
+                        "— use asyncio.create_subprocess_* or "
+                        "to_thread")
+            if head.startswith(("socket.", "urllib.request.urlopen",
+                                "requests.")):
+                return ("blocking-io",
+                        f"`{head}` does synchronous network I/O on "
+                        "the loop")
+            if head.endswith(".device_get") or head == "device_get":
+                return ("blocking-device",
+                        "device_get blocks on a device→host transfer")
+        elif isinstance(fn, ast.Name):
+            if fn.id == "open":
+                return ("blocking-io",
+                        "builtin open() is synchronous file I/O on "
+                        "the event loop — wrap the read/write in "
+                        "asyncio.to_thread")
+            if fn.id == "urlopen":
+                return ("blocking-io",
+                        "urlopen does synchronous network I/O on "
+                        "the loop")
+        return None
+
+    # -- collection ---------------------------------------------------------
+
+    def _nearest_fn(self, ctx: FileContext):
+        for f in reversed(ctx.func_stack):
+            if not isinstance(f, ast.Lambda):
+                return f
+        return None
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, ast.Await):
+            # an awaited call is a coroutine by construction (an
+            # asyncio.Queue's get(), not a stdlib queue's); same for
+            # everything under a coroutine wrapper's argument list
+            if isinstance(node.value, ast.Call):
+                self._exempt_subtrees.add(id(node.value))
+                tail = self._head(node.value.func).rsplit(".", 1)[-1]
+                if tail in ("wait_for", "wait", "gather", "shield"):
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Call):
+                            self._exempt_subtrees.add(id(sub))
+            return
+        fn_node = self._nearest_fn(ctx)
+        head = self._head(node.func)
+        tail = head.rsplit(".", 1)[-1]
+        # escape + exemption bookkeeping -----------------------------------
+        if tail in _THREAD_ESCAPES:
+            for sub in ast.walk(node):
+                if sub is node:
+                    continue
+                if isinstance(sub, ast.Name):
+                    self._escapes.add(sub.id)
+                elif isinstance(sub, ast.Attribute):
+                    self._escapes.add(sub.attr)
+                if tail in _EXECUTOR_WRAPS and isinstance(sub, ast.Call):
+                    self._exempt_subtrees.add(id(sub))
+        # dataflow-lite for .result(): record assigns in this function
+        # (visit order guarantees the Assign's Call arrives here too)
+        # -- handled via parent Assign detection below is not available,
+        # so track "x = y.submit(...)" by peeking at the call's own
+        # shape when it appears as an assignment RHS is done in
+        # end-of-walk; instead record every `.submit(` call head keyed
+        # by enclosing fn for the receiver match.
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "submit":
+            # conservative: any name later calling .result() in this
+            # function with a submit in scope counts as executor-born
+            self._assign_src[(id(fn_node) if fn_node else None,
+                              "*submit*")] = head
+        # direct call of a local def: record the call site
+        callee = None
+        if isinstance(node.func, ast.Name):
+            callee = node.func.id
+        elif (isinstance(node.func, ast.Attribute)
+              and isinstance(node.func.value, ast.Name)
+              and node.func.value.id in ("self", "cls")):
+            callee = node.func.attr
+        if callee is not None:
+            self._call_sites.setdefault(callee, []).append(fn_node)
+        got = self._classify(node, ctx)
+        if got is not None and id(node) not in self._exempt_subtrees:
+            code, msg = got
+            self._candidates.append(
+                (node, code, msg, fn_node, ctx.scope()))
+
+    # -- resolution ---------------------------------------------------------
+
+    def end_file(self, ctx: FileContext) -> None:
+        # escape analysis: a def referenced MORE times than it is
+        # directly called is passed somewhere as a value (event-bus
+        # subscription, Thread target, RPC table) — we cannot prove it
+        # only runs on the loop
+        def_names = {getattr(d, "name", None) for d, _c in ctx._defs}
+        def_names.discard(None)
+        refs: dict = {}
+        for sub in ast.walk(ctx.tree):
+            if isinstance(sub, ast.Name) and sub.id in def_names \
+                    and isinstance(sub.ctx, ast.Load):
+                refs[sub.id] = refs.get(sub.id, 0) + 1
+            elif isinstance(sub, ast.Attribute) \
+                    and sub.attr in def_names \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id in ("self", "cls"):
+                refs[sub.attr] = refs.get(sub.attr, 0) + 1
+        for name, n in refs.items():
+            if n > len(self._call_sites.get(name, [])):
+                self._escapes.add(name)
+
+        # which sync defs are reachable ONLY from coroutines?
+        async_only: dict = {}
+
+        def loop_only(d, stack=()):
+            if isinstance(d, ast.AsyncFunctionDef):
+                return True
+            if d in stack:
+                return False
+            got = async_only.get(id(d))
+            if got is not None:
+                return got
+            name = getattr(d, "name", "")
+            if name in self._escapes or not name:
+                async_only[id(d)] = False
+                return False
+            sites = self._call_sites.get(name, [])
+            ok = bool(sites) and all(
+                s is not None and loop_only(s, stack + (d,))
+                for s in sites)
+            async_only[id(d)] = ok
+            return ok
+
+        for node, code, msg, fn_node, scope in self._candidates:
+            if fn_node is None:
+                continue
+            if isinstance(fn_node, ast.AsyncFunctionDef):
+                note = ""
+            elif loop_only(fn_node):
+                note = f" ({_LOOP_NOTE})"
+            else:
+                continue
+            if code == "blocking-result":
+                # require a .submit in the same function (executor
+                # future, not an asyncio one)
+                if (id(fn_node), "*submit*") not in self._assign_src:
+                    continue
+            self.emit(ctx, node.lineno, code, msg + note,
+                      ast.unparse(node)[:80], scope=scope)
+        self._reset_file()

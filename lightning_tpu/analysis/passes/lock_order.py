@@ -1,0 +1,336 @@
+"""lock-order pass: deadlock topology + callback-under-lock.
+
+The shipped bug (PR 9): the health engine's sampler evaluated SLOs and
+then emitted the ``health_state`` events topic while still holding the
+sampler lock — the events bus runs subscriber callbacks synchronously,
+so any subscriber calling back into ``report()``/``state_name()`` (or
+just being slow) deadlocked the sampler AND every gethealth caller.
+The fix moved the emit outside the lock; nothing then stopped the next
+lock from repeating the shape.  This pass checks two things:
+
+**Acquisition graph / cycles** (``lock-cycle``): every ``with <lock>``
+whose context expression looks like a lock (name heuristic, plus every
+lock named by a ``# guarded-by:`` annotation) is a node; acquiring B
+while A is held — lexically nested ``with``, or a call chain inside the
+file that reaches a ``with B`` — adds edge A→B.  A cycle means two
+threads can interleave the acquisitions and deadlock.
+
+**callback-under-lock** (``callback-under-lock``): while a lock is
+held (lexically, or because every path to this function runs under a
+caller's lock), calling out to code that can re-enter or block is the
+PR-9 class.  Flagged callees:
+
+* the events bus (``events.emit`` — synchronous subscriber fan-out);
+* logging (handlers are pluggable — logring, trace taps — and the
+  logging module takes its own handler locks: a lock-order edge into
+  code we don't control);
+* callback-shaped values (``cb``/``callback``/``hook``/``sink``/
+  ``tap``/``subscriber``/``listener``/``waiter``-named calls, and
+  ``Future.set_result``/``set_exception`` — concurrent.futures runs
+  done-callbacks synchronously in the calling thread);
+* public functions of other ``lightning_tpu`` modules (an imported
+  module alias's public attr) — crossing a module boundary under a
+  lock hands our lock to code that may take its own.
+
+Accepted idiom, deliberately NOT flagged: terminal metric-instrument
+calls (``*.labels(...).inc()/.set()/.observe()``) — obs/registry
+children never call back out and hold their family lock O(1).
+
+Deliberate exceptions (e.g. the trace-ring sink, which must run under
+the module lock so a ``set_sink`` rotation cannot close the file
+mid-write) are baseline entries with a justification.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, Pass
+
+# with-item expressions that acquire a lock, by naming convention;
+# guarded-by annotations extend this per file with their lock names
+_LOCK_NAME = re.compile(
+    r"(^|[._])(lock|locked|mutex|mtx|sem|cv|cond(ition)?)s?$")
+_GUARDED_BY = re.compile(r"guarded-by:\s*([A-Za-z_][\w.]*)")
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error",
+                "exception", "critical", "log"}
+_LOG_BASES = {"log", "logger", "logging"}
+_CALLBACK_NAME = re.compile(
+    r"(^|_)(cb|callback|hook|sink|tap|subscriber|listener|waiter)s?$")
+_FUTURE_METHODS = {"set_result", "set_exception"}
+# terminal metric-instrument methods: registry children are leaf calls
+_METRIC_METHODS = {"inc", "dec", "set", "observe", "labels"}
+
+
+def _expr_root(node: ast.AST) -> str | None:
+    """Leftmost Name of a dotted expression (``a.b.c`` → 'a')."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class LockOrderPass(Pass):
+    name = "lock-order"
+    description = ("lock acquisition cycles + events/logging/callbacks/"
+                   "foreign public calls while a lock is held")
+    default_scope = ("lightning_tpu",)
+    node_types = (ast.With, ast.AsyncWith, ast.Call)
+    version = 1
+
+    def __init__(self):
+        super().__init__()
+        # global across files: edges lockA -> {lockB: (path, lineno)}
+        self._edges: dict = {}
+        self._reset_file()
+
+    def _reset_file(self):
+        self._annot_locks: set[str] = set()
+        # fn key -> {"risky": [(lineno, kind, callee, scope, held)],
+        #            "acquires": [(lock_id, lineno)],
+        #            "callers": [(caller key, locks at site)]}
+        self._fns: dict = {}
+        self._pending_edges: list = []   # (callee name, caller, held)
+        self._ctx = None
+
+    # -- lock identity ------------------------------------------------------
+
+    def _is_lock_expr(self, raw: str) -> bool:
+        base = raw.split("(")[0].strip()
+        return bool(_LOCK_NAME.search(base)) or base in self._annot_locks
+
+    def _lock_id(self, raw: str, ctx: FileContext) -> str:
+        """Module/class-qualified lock identity: ``self._lock`` in two
+        classes are distinct graph nodes, and a module-global lock is
+        the SAME node whether acquired in its home module (``with
+        _lock:``) or through an import alias from another file (``with
+        trace._lock:``) — without that, a cross-file AB/BA cycle splits
+        into four nodes and can never close."""
+        base = raw.split("(")[0].strip()
+        if base.startswith(("self.", "cls.")):
+            cls = ctx.class_stack[-1].name if ctx.class_stack else "?"
+            attr = base.split(".", 1)[1]
+            return f"{ctx.module_name()}:{cls}.{attr}"
+        root, _, rest = base.partition(".")
+        if rest:
+            target = ctx.import_aliases().get(root, "")
+            if target.startswith("lightning_tpu"):
+                return f"{target}:{rest}"
+        return f"{ctx.module_name()}:{base}"
+
+    def _held(self, ctx: FileContext) -> list[str]:
+        return [self._lock_id(e, ctx)
+                for frame in ctx.with_stack for e in frame
+                if self._is_lock_expr(e)]
+
+    def _fn_key(self, ctx: FileContext):
+        return id(ctx.func_stack[-1]) if ctx.func_stack else None
+
+    def _fn_rec(self, key):
+        return self._fns.setdefault(
+            key, {"risky": [], "acquires": [], "callers": []})
+
+    # -- per-file collection ------------------------------------------------
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._reset_file()
+        self._ctx = ctx
+        for c in ctx.comments.values():
+            m = _GUARDED_BY.search(c)
+            if m:
+                name = m.group(1)
+                self._annot_locks.add(name)
+                if name.startswith("self."):
+                    self._annot_locks.add(name[5:])
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            # `with a, b:` acquires left-to-right — earlier items are
+            # held while later ones acquire, same as nested withs
+            held = list(self._held(ctx))
+            for item in node.items:
+                raw = ast.unparse(item.context_expr)
+                if not self._is_lock_expr(raw):
+                    continue
+                lock = self._lock_id(raw, ctx)
+                for h in held:
+                    if h != lock:
+                        self._edges.setdefault(h, {}).setdefault(
+                            lock, (ctx.relpath, node.lineno))
+                held.append(lock)
+                self._fn_rec(self._fn_key(ctx))["acquires"].append(
+                    (lock, node.lineno))
+            return
+        if not isinstance(node, ast.Call):
+            return
+        held = self._held(ctx)
+        key = self._fn_key(ctx)
+        risk = self._classify(node, ctx)
+        if risk is not None:
+            self._fn_rec(key)["risky"].append(
+                (node.lineno, *risk, ctx.scope(), held))
+        # intra-file call edges for lock-context propagation: by NAME
+        # here, resolved against the (then-complete) def set in
+        # end_file — the callee's def may not have been walked yet
+        name = self._callee_name(node)
+        if name is not None:
+            self._pending_edges.append((name, key, held))
+
+    @staticmethod
+    def _callee_name(node: ast.Call) -> str | None:
+        """Simple callee name for bare-name and ``self.``/``cls.``
+        method calls (anything else is unresolvable by name)."""
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            return fn.id
+        if (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in ("self", "cls")):
+            return fn.attr
+        return None
+
+    def _classify(self, node: ast.Call, ctx: FileContext):
+        """(kind, callee-detail) when the callee can re-enter/block."""
+        fn = node.func
+        aliases = ctx.import_aliases()
+        if isinstance(fn, ast.Attribute):
+            root = _expr_root(fn)
+            target = aliases.get(root or "", "")
+            # events bus: synchronous subscriber fan-out
+            if fn.attr == "emit" and (
+                    target.endswith("utils.events") or target == "events"
+                    or root == "events"):
+                return ("events-bus", f"{ast.unparse(fn)}()")
+            # logging: log.warning(...) / logging.getLogger(...).error
+            if fn.attr in _LOG_METHODS:
+                base = fn.value
+                base_root = _expr_root(base)
+                is_logger = (
+                    (isinstance(base, ast.Name)
+                     and base.id in _LOG_BASES)
+                    or (isinstance(base, ast.Call)
+                        and isinstance(base.func, ast.Attribute)
+                        and base.func.attr == "getLogger")
+                    or (base_root in _LOG_BASES))
+                if is_logger:
+                    return ("logging", f"{ast.unparse(fn)}()"[:60])
+            if fn.attr in _FUTURE_METHODS:
+                return ("future-callback", f"{ast.unparse(fn)}()"[:60])
+            # callback-shaped attrs — but a self/cls method merely
+            # NAMED like one (``self._sample_taps``) is an intra-class
+            # call: propagation covers its body, naming does not
+            if _CALLBACK_NAME.search(fn.attr) and not (
+                    isinstance(fn.value, ast.Name)
+                    and fn.value.id in ("self", "cls")):
+                return ("callback", f"{ast.unparse(fn)}()"[:60])
+            # public call into another lightning_tpu module
+            if (root and root in aliases
+                    and aliases[root].startswith("lightning_tpu")
+                    and not fn.attr.startswith("_")
+                    and fn.attr not in _METRIC_METHODS):
+                # walk the attr chain: exempt instrument chains like
+                # _f.FAMILY.labels(...).inc() — every hop terminal
+                mid = fn.value
+                metricish = False
+                while isinstance(mid, (ast.Attribute, ast.Call)):
+                    if isinstance(mid, ast.Call):
+                        mid = mid.func
+                        continue
+                    if mid.attr in _METRIC_METHODS or mid.attr.isupper():
+                        metricish = True
+                    mid = mid.value
+                if not metricish:
+                    return ("foreign-public",
+                            f"{ast.unparse(fn)}()"[:60])
+        elif isinstance(fn, ast.Name):
+            if _CALLBACK_NAME.search(fn.id):
+                return ("callback", f"{fn.id}()")
+        return None
+
+    # -- per-file resolution ------------------------------------------------
+
+    def end_file(self, ctx: FileContext) -> None:
+        # resolve the by-name call edges against the complete def set
+        by_name: dict = {}
+        for d, _chain in ctx._defs:
+            name = getattr(d, "name", None)
+            if name:
+                by_name.setdefault(name, []).append(d)
+        for name, caller, held in self._pending_edges:
+            for target in by_name.get(name, ()):
+                self._fn_rec(id(target))["callers"].append(
+                    (caller, held))
+        # propagate lock context through intra-file calls: a function
+        # whose every known call site runs under lock L inherits L
+        # (union over sites would over-flag a helper that ALSO runs
+        # lock-free; intersection proves "always under L")
+        inherited: dict = {}
+
+        def entry_locks(key, stack=()):
+            if key in stack:
+                return set()          # recursion: no extra locks proven
+            if key in inherited:
+                return inherited[key]
+            rec = self._fns.get(key)
+            locks: set = set()
+            if rec and rec["callers"]:
+                per_site = [set(held) | entry_locks(ck, stack + (key,))
+                            for ck, held in rec["callers"]]
+                locks = set.intersection(*per_site) if per_site else set()
+            inherited[key] = locks
+            return locks
+
+        for key, rec in list(self._fns.items()):
+            ext = entry_locks(key)
+            # acquisition edges from inherited context
+            for lock, lineno in rec["acquires"]:
+                for h in ext:
+                    if h != lock:
+                        self._edges.setdefault(h, {}).setdefault(
+                            lock, (ctx.relpath, lineno))
+            for lineno, kind, callee, scope, held in rec["risky"]:
+                locks = sorted(set(held) | ext)
+                if not locks:
+                    continue
+                shown = ", ".join(l.split(":", 1)[1] for l in locks)
+                via = "" if held else " (every caller holds it)"
+                self.emit(
+                    ctx, lineno, "callback-under-lock",
+                    f"{kind} call while `{shown}` is held{via} — "
+                    "subscribers/handlers can block or re-enter and "
+                    "deadlock (the PR-9 health-engine class); move the "
+                    "call outside the lock",
+                    f"{kind} {callee} [{shown}]", scope=scope)
+        self._ctx = None
+
+    # -- cross-file cycle detection -----------------------------------------
+
+    def finish(self, config) -> None:
+        # DFS over the acquisition graph; each distinct cycle reported
+        # once, anchored at its lexically-smallest lock
+        seen_cycles: set = set()
+        for start in sorted(self._edges):
+            stack = [(start, [start])]
+            visited: set = set()
+            while stack:
+                node, path = stack.pop()
+                for nxt, (relpath, lineno) in sorted(
+                        self._edges.get(node, {}).items()):
+                    if nxt == start:
+                        cyc = tuple(sorted(path))
+                        if cyc in seen_cycles:
+                            continue
+                        seen_cycles.add(cyc)
+                        shown = " -> ".join(
+                            l.split(":", 1)[1] for l in path + [start])
+                        self.emit(
+                            relpath, lineno, "lock-cycle",
+                            f"lock acquisition cycle {shown}: two "
+                            "threads interleaving these acquisitions "
+                            "deadlock; impose a single order",
+                            f"cycle {shown}", scope="")
+                    elif nxt not in visited and nxt not in path:
+                        visited.add(nxt)
+                        stack.append((nxt, path + [nxt]))

@@ -1,0 +1,262 @@
+"""supervision-coverage pass: no device dispatch escapes the net.
+
+PR 4 built the supervision net — every batched device dispatch family
+(verify / route / sign / mesh) runs behind a circuit-breaker
+``allow()`` seam with an exact host fallback, and PR 5 made every
+dispatch a flight record.  PR 4 itself shipped the hole this pass
+exists for: the RouteService close()-vs-inflight-dispatch race lived
+precisely where a dispatch could run outside the supervised seam.  The
+net only works if it has NO holes, and nothing checked that a *future*
+dispatch family remembers the seam.
+
+The proof obligation: every jit-program invocation in the dispatch
+scopes (``gossip/``, ``routing/``, ``crypto/``, ``parallel/``,
+``daemon/hsmd.py``) must be lexically reachable ONLY through functions
+that pass a supervision seam — a breaker ``allow()`` call or a flight
+record (``with _flight.dispatch(...)`` / ``_flight.begin(...)``).
+
+Mechanics (cross-file, like registry-sync): per file we collect each
+function's program-invocation sites (``_jit_*()(...)`` builder-invoke,
+names bound from ``jax.jit(...)``/``shard_map(...)``/``_jit_*`` /
+``sharded_verify_fn`` calls), seam evidence, and resolved call edges
+(bare names, ``self.``/``cls.`` methods, imported-module attrs within
+the scanned set).  ``finish`` walks the call graph upward from each
+invocation: if an *entry* function (one with no known callers) reaches
+it without crossing a seam, that chain is an unsupervised dispatch
+path — code ``unsupervised-dispatch``, one finding per (site, entry)
+so a NEW unsupervised caller of a supervised helper is a NEW
+fingerprint and fails the run.
+
+Accepted idioms: warmup functions (``warmup*`` names or bodies under
+``attribution.warmup_scope()``) — they dispatch dummy shapes off the
+live path by design — and anything reached only through them.  A
+deliberately-unsupervised family (e.g. the offline synth generator)
+is a baseline entry with a justification, not a silent pass.
+
+How a new dispatch family learns the seam: give the dispatching
+function a breaker (``_breaker.get("<family>").allow()``) or wrap the
+invocation in ``_flight.dispatch("<family>", ...)`` — either makes
+every path through it supervised; the pass needs no configuration.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, Pass, is_jit_wrapper
+
+_JIT_BUILDER = re.compile(r"^_jit_\w+$")
+# cross-module builders that RETURN a compiled program (not a
+# supervised dispatcher): invoking their result is a dispatch
+_PROGRAM_BUILDERS = {"sharded_verify_fn"}
+_SEAM_WITH = re.compile(r"flight\.(dispatch|begin)\s*\(")
+_WARMUP_WITH = re.compile(r"warmup_scope\s*\(")
+
+
+def _terminal_attr(fn: ast.AST) -> str | None:
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+class SupervisionCoveragePass(Pass):
+    name = "supervision-coverage"
+    description = ("every jit-program invocation reachable only "
+                   "through a breaker allow()/flight-record seam")
+    default_scope = ("lightning_tpu/gossip", "lightning_tpu/routing",
+                     "lightning_tpu/crypto", "lightning_tpu/parallel",
+                     "lightning_tpu/daemon/hsmd.py")
+    node_types = (ast.Call, ast.Assign, ast.With, ast.AsyncWith,
+                  ast.FunctionDef, ast.AsyncFunctionDef)
+    version = 1
+
+    def __init__(self):
+        super().__init__()
+        # qual -> {"sites": [(lineno, detail)], "seam": bool,
+        #          "warmup": bool, "callers": set[qual],
+        #          "relpath": str}
+        self._fns: dict = {}
+        self._ctx = None
+        self._module = ""
+
+    # -- naming -------------------------------------------------------------
+
+    def _qual(self, ctx: FileContext) -> str:
+        scope = ctx.scope()
+        return f"{ctx.module_name()}:{scope or '<module>'}"
+
+    def _rec(self, qual: str, relpath: str):
+        return self._fns.setdefault(
+            qual, {"sites": [], "seam": False, "warmup": False,
+                   "callers": set(), "relpath": relpath})
+
+    # -- program-variable tracking ------------------------------------------
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._ctx = ctx
+        self._module = ctx.module_name()
+        # (enclosing fn id or None, var name) -> True when bound from a
+        # program-returning expression
+        self._program_vars: dict = {}
+        # local def simple name -> set of def qualnames in this module
+        self._local_defs: dict = {}
+        # by-name local call edges, resolved in end_file once every
+        # def has been seen (a call can precede its callee's def)
+        self._pending_local: list = []   # (callee name, caller qual)
+
+    def _fn_id(self, ctx: FileContext):
+        return id(ctx.func_stack[-1]) if ctx.func_stack else None
+
+    def _is_program_expr(self, node: ast.AST) -> bool:
+        """RHS expressions whose value is a compiled program."""
+        if not isinstance(node, ast.Call):
+            return False
+        if is_jit_wrapper(node.func):
+            return True
+        tail = _terminal_attr(node.func)
+        if tail and (_JIT_BUILDER.match(tail)
+                     or tail in _PROGRAM_BUILDERS):
+            return True
+        return False
+
+    def _is_program_invocation(self, node: ast.Call,
+                               ctx: FileContext) -> bool:
+        fn = node.func
+        # builder-invoke: _jit_hash()(...) / S._jit_sign()(...)
+        if isinstance(fn, ast.Call):
+            return self._is_program_expr(fn)
+        # invocation of a tracked program variable: kern(...), vfn(...)
+        if isinstance(fn, ast.Name):
+            for frame in [self._fn_id(ctx), *[
+                    id(f) for f in ctx.func_stack[:-1]], None]:
+                if self._program_vars.get((frame, fn.id)):
+                    return True
+        return False
+
+    # -- collection ---------------------------------------------------------
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # ctx.scope() does not yet include this def (dispatch
+            # precedes the push) — qualify by hand
+            scope = ctx.scope()
+            qual = f"{self._module}:" + (f"{scope}.{node.name}"
+                                         if scope else node.name)
+            rec = self._rec(qual, ctx.relpath)
+            if node.name.startswith(("warmup", "_warm")):
+                rec["warmup"] = True
+            self._local_defs.setdefault(node.name, set()).add(qual)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            raws = [ast.unparse(i.context_expr) for i in node.items]
+            rec = self._rec(self._qual(ctx), ctx.relpath)
+            if any(_SEAM_WITH.search(r) for r in raws):
+                rec["seam"] = True
+            if any(_WARMUP_WITH.search(r) for r in raws):
+                rec["warmup"] = True
+            return
+        if isinstance(node, ast.Assign):
+            if self._is_program_expr(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._program_vars[
+                            (self._fn_id(ctx), tgt.id)] = True
+            return
+        if not isinstance(node, ast.Call):
+            return
+        qual = self._qual(ctx)
+        rec = self._rec(qual, ctx.relpath)
+        tail = _terminal_attr(node.func)
+        if tail == "allow" and not node.args:
+            rec["seam"] = True
+        if tail in ("begin", "dispatch") and isinstance(
+                node.func, ast.Attribute) and "flight" in (
+                ast.unparse(node.func.value)):
+            rec["seam"] = True
+        if self._is_program_invocation(node, ctx):
+            rec["sites"].append(
+                (node.lineno, ast.unparse(node)[:60], ctx.scope()))
+        self._record_call_edge(node, qual, ctx)
+
+    def _record_call_edge(self, node: ast.Call, caller: str,
+                          ctx: FileContext) -> None:
+        fn = node.func
+        # a worker-thread hop is still a call edge: the flush loops
+        # dispatch via `asyncio.to_thread(solve_batch, ...)` and their
+        # seam supervises the threaded callee
+        tail = _terminal_attr(fn)
+        if tail in ("to_thread", "run_in_executor"):
+            for arg in node.args[:2]:
+                name = None
+                if isinstance(arg, ast.Name):
+                    name = arg.id
+                elif (isinstance(arg, ast.Attribute)
+                      and isinstance(arg.value, ast.Name)
+                      and arg.value.id in ("self", "cls")):
+                    name = arg.attr
+                if name:
+                    self._pending_local.append((name, caller))
+            return
+        if isinstance(fn, ast.Name):
+            self._pending_local.append((fn.id, caller))
+        elif isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Name):
+                if base.id in ("self", "cls"):
+                    self._pending_local.append((fn.attr, caller))
+                else:
+                    mod = ctx.import_aliases().get(base.id)
+                    if mod:
+                        # resolved against the scanned set in finish()
+                        self._rec(f"{mod}:{fn.attr}",
+                                  ctx.relpath)["callers"].add(caller)
+
+    def end_file(self, ctx: FileContext) -> None:
+        # resolve by-name edges now that every def has been seen
+        for name, caller in self._pending_local:
+            for qual in self._local_defs.get(name, ()):
+                self._rec(qual, ctx.relpath)["callers"].add(caller)
+        self._pending_local = []
+        self._ctx = None
+
+    # -- the proof ----------------------------------------------------------
+
+    def finish(self, config) -> None:
+        def unsupervised_roots(qual, stack=()):
+            """Entry functions that reach ``qual`` without crossing a
+            seam (empty → every path is supervised)."""
+            rec = self._fns.get(qual)
+            if rec is None or qual in stack:
+                return set()
+            if rec["seam"] or rec["warmup"]:
+                return set()
+            callers = {c for c in rec["callers"] if c in self._fns}
+            if not callers:
+                return {qual}
+            roots = set()
+            for c in callers:
+                roots |= unsupervised_roots(c, stack + (qual,))
+            return roots
+
+        for qual in sorted(self._fns):
+            rec = self._fns[qual]
+            if not rec["sites"]:
+                continue
+            if rec["seam"] or rec["warmup"]:
+                continue
+            roots = unsupervised_roots(qual)
+            for lineno, detail, scope in rec["sites"]:
+                for root in sorted(roots):
+                    root_name = root.split(":", 1)[1]
+                    self.emit(
+                        rec["relpath"], lineno, "unsupervised-dispatch",
+                        f"jit program invoked with no breaker allow()/"
+                        f"flight-record seam on the path from "
+                        f"`{root_name}` — a failing device wedges this "
+                        "path instead of degrading to the host "
+                        "fallback (doc/resilience.md); wrap the "
+                        "dispatch in its family's seam",
+                        f"{detail} via {root_name}", scope=scope)
+        self._fns = {}

@@ -1,0 +1,174 @@
+"""x64-discipline pass: msat math never silently truncates to int32.
+
+jax defaults to 32-bit; this repo's money amounts are 64-bit
+millisatoshis with explicit 2^61 overflow guards in the device solver
+(routing/device.py).  The discipline that keeps them exact is a
+*scope*: int64 planes and operands must cross ``jnp.asarray`` (the
+host→device staging boundary — where dtype is decided) inside a
+``with enable_x64():`` block, or they truncate to int32 with nothing
+but a warning — fees silently wrap, the overflow guards see garbage,
+and the parity tests only catch it on amounts past 2^31.  PR 3 got
+this right by review; nothing checks the next kernel builder.
+
+This is the static twin of the runtime overflow guards: a *dataflow*
+rule over the staging code, not the kernels.
+
+Rules (outside kernel builders — a kernel body traces under its
+call-site's x64 scope, which the supervision/doc idiom pins to the
+staging block; host ``np.*`` is always 64-bit and exempt):
+
+* ``unscoped-int64`` — a ``jnp`` constructor/cast that names an
+  ``int64``/``uint64`` dtype lexically outside ``enable_x64``;
+* ``unscoped-msat-stage`` — ``jnp.asarray``/``jnp.array`` staging an
+  expression whose identifiers carry money semantics (msat / amount /
+  fee / ppm / htlc_min / htlc_max / capacity / risk naming) outside
+  ``enable_x64``;
+* ``msat-static-arg`` — an msat-named parameter in ``static_argnums``
+  / ``static_argnames`` of a jit wrap: every distinct amount is a
+  fresh trace (a compile stall per payment) and the value is baked as
+  a Python constant, dodging both the x64 scope and the overflow
+  guards.
+
+Donation boundaries need no separate rule: donating a buffer reuses
+its (already staged) dtype, so the truncation point is always the
+staging call the first two rules cover.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, Pass, is_jit_wrapper
+
+_MONEY = re.compile(
+    r"(^|_)(msat|amount|amt|fee|ppm|base|capacity|risk|"
+    r"htlc_min|htlc_max|hmin|hmax)s?($|_)", re.I)
+_I64 = re.compile(r"(^|[^\w])u?int64([^\w]|$)")
+_JNP_BASES = {"jnp", "jax"}
+_STAGE_FNS = {"asarray", "array"}
+_CTOR_FNS = {"asarray", "array", "zeros", "ones", "full", "arange",
+             "astype"}
+
+
+def _mentions_money(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and _MONEY.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and _MONEY.search(sub.attr):
+            return True
+    return False
+
+
+class X64DisciplinePass(Pass):
+    name = "x64-discipline"
+    description = ("int64/msat staging into jnp only inside "
+                   "enable_x64; no msat static_argnums")
+    default_scope = ("lightning_tpu/routing", "lightning_tpu/gossip",
+                     "lightning_tpu/crypto", "lightning_tpu/parallel",
+                     "lightning_tpu/pay")
+    node_types = (ast.Call,)
+    version = 1
+
+    def __init__(self):
+        super().__init__()
+        self._candidates: list = []
+        self._static_sites: list = []
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._candidates = []
+        self._static_sites = []
+
+    def _in_x64(self, ctx: FileContext) -> bool:
+        return any("enable_x64" in e
+                   for frame in ctx.with_stack for e in frame)
+
+    def _jnp_call(self, node: ast.Call) -> str | None:
+        """'asarray'/'zeros'/... when this is a jnp namespace call."""
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and isinstance(
+                fn.value, ast.Name) and fn.value.id in _JNP_BASES:
+            return fn.attr
+        return None
+
+    def visit(self, node: ast.Call, ctx: FileContext) -> None:
+        # static_argnums/argnames on jit wraps: checked everywhere,
+        # x64 scope does not excuse a per-amount retrace.  Collected
+        # here, resolved in end_file — the wrap may lexically precede
+        # the wrapped def, and ctx._defs is complete only after the
+        # walk (same rule as every other by-name edge in this repo)
+        if is_jit_wrapper(node.func):
+            self._static_sites.append((node, ctx.scope()))
+        if not ctx.in_function() or self._in_x64(ctx):
+            return
+        name = self._jnp_call(node)
+        arg_src = " ".join(ast.unparse(a) for a in node.args) + " " + \
+            " ".join(ast.unparse(kw.value) for kw in node.keywords)
+        # kernel-builder membership resolves in end_file — the
+        # engine's wrap-site facts are complete only after the walk
+        stack = tuple(ctx.func_stack)
+        if name in _CTOR_FNS and _I64.search(arg_src):
+            self._candidates.append((node, "unscoped-int64",
+                                     f"jnp.{name} names an int64 dtype "
+                                     "outside `with enable_x64()` — "
+                                     "jax truncates it to int32 with "
+                                     "only a warning; wrap the staging "
+                                     "in the x64 scope "
+                                     "(routing/device.py idiom)",
+                                     ctx.scope(), stack))
+        elif name in _STAGE_FNS and node.args \
+                and _mentions_money(node.args[0]):
+            self._candidates.append((node, "unscoped-msat-stage",
+                                     f"jnp.{name} stages msat/fee-"
+                                     "named values outside `with "
+                                     "enable_x64()` — 64-bit amounts "
+                                     "silently wrap to int32 and the "
+                                     "2^61 overflow guards see "
+                                     "garbage",
+                                     ctx.scope(), stack))
+
+    def _check_static_args(self, node: ast.Call, scope: str,
+                           ctx: FileContext) -> None:
+        names: list[str] = []
+        params: list[str] = []
+        # wrapped function's positional params, when resolvable
+        target = node.args[0] if node.args else None
+        if isinstance(target, ast.Name):
+            for d, _chain in ctx._defs:
+                if getattr(d, "name", None) == target.id:
+                    a = d.args
+                    params = [p.arg for p in
+                              (*a.posonlyargs, *a.args)]
+                    break
+        for kw in node.keywords:
+            if kw.arg == "static_argnames":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, str):
+                        names.append(sub.value)
+            elif kw.arg == "static_argnums" and params:
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, int) and sub.value < len(params):
+                        names.append(params[sub.value])
+        for pname in names:
+            if _MONEY.search(pname):
+                self.emit(
+                    ctx, node.lineno, "msat-static-arg",
+                    f"`{pname}` is msat-named and static in this jit "
+                    "wrap — every distinct amount re-traces the "
+                    "program (a compile stall per payment) and bakes "
+                    "the value as a host constant outside the x64 "
+                    "scope and the overflow guards",
+                    f"static {pname}", scope=scope)
+
+    def end_file(self, ctx: FileContext) -> None:
+        for node, scope in self._static_sites:
+            self._check_static_args(node, scope, ctx)
+        kernels = ctx.kernel_builder_ids()
+        for node, code, msg, scope, stack in self._candidates:
+            if any(id(f) in kernels for f in stack):
+                continue    # traces under the caller's x64 scope
+            self.emit(ctx, node.lineno, code, msg,
+                      ast.unparse(node)[:80], scope=scope)
+        self._candidates = []
+        self._static_sites = []

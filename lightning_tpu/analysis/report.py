@@ -45,6 +45,89 @@ def text_report(result: AnalysisResult, verbose: bool = False) -> str:
     return "\n".join(lines)
 
 
+def sarif_report(result: AnalysisResult, passes=()) -> str:
+    """SARIF 2.1.0 — the schema CI annotation surfaces (GitHub code
+    scanning et al.) ingest to pin findings onto PR diff lines.  New
+    findings are level=error results; baselined ones are included but
+    carry a suppression (reviewers see them greyed, not re-raised).
+    Stale/unjustified baseline entries become tool-level notifications
+    so a failing run explains itself in the same artifact.  ``passes``
+    (the instantiated pass list) seeds the rules array so every pass
+    that ran is visible in the artifact even with zero findings."""
+    rules: dict[str, dict] = {}
+    for p in passes:
+        rules[p.name] = {
+            "id": p.name,
+            "shortDescription": {"text": p.description[:120]},
+        }
+    results = []
+
+    def rule_id(f) -> str:
+        rid = f"{f.pass_name}/{f.code}"
+        rules.setdefault(rid, {
+            "id": rid,
+            "shortDescription": {"text": f.message.split(" — ")[0]
+                                 [:120]},
+        })
+        return rid
+
+    for f in result.findings:
+        entry = {
+            "ruleId": rule_id(f),
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.lineno)},
+                },
+            }],
+            "partialFingerprints": {"graftlint/v1": f.fingerprint},
+        }
+        if f.baselined:
+            entry["level"] = "note"
+            entry["suppressions"] = [{
+                "kind": "external",
+                "justification": f.justification,
+            }]
+        results.append(entry)
+    notifications = []
+    for stale in result.stale_baseline:
+        notifications.append({
+            "level": "error",
+            "message": {"text": "stale baseline entry "
+                        f"{stale['fingerprint']} "
+                        f"[{stale.get('pass')}/{stale.get('code')}] "
+                        f"{stale.get('file')} — delete it"},
+        })
+    for uj in result.unjustified:
+        notifications.append({
+            "level": "error",
+            "message": {"text": "unjustified baseline entry "
+                        f"{uj['fingerprint']} [{uj.get('pass')}/"
+                        f"{uj.get('code')}] {uj.get('file')}"},
+        })
+    run = {
+        "tool": {"driver": {
+            "name": "graftlint",
+            "informationUri":
+                "doc/static_analysis.md",
+            "rules": [rules[k] for k in sorted(rules)],
+        }},
+        "results": results,
+    }
+    if notifications:
+        run["invocations"] = [{
+            "executionSuccessful": False,
+            "toolExecutionNotifications": notifications,
+        }]
+    return json.dumps({
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [run],
+    }, indent=2)
+
+
 def json_report(result: AnalysisResult) -> str:
     return json.dumps({
         "version": 1,

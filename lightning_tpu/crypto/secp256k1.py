@@ -520,6 +520,15 @@ HOST_VERIFY_MAX = int(_os.environ.get("LIGHTNING_TPU_HOST_VERIFY_MAX",
                                       "2"))
 
 
+def host_verify_batch(msg_hashes: np.ndarray, sigs64: np.ndarray,
+                      pubkeys33: np.ndarray) -> np.ndarray:
+    """The host verification oracle (exact-int ECDSA, kernel-parity
+    semantics incl. the high-S reject): the micro-batch branch of
+    ecdsa_verify_batch and hsmd's check-sig breaker fallback both
+    route here, so device and fallback verdicts can never diverge."""
+    return _host_verify(msg_hashes, sigs64, pubkeys33)
+
+
 def _host_verify(msg_hashes: np.ndarray, sigs64: np.ndarray,
                  pubkeys33: np.ndarray) -> np.ndarray:
     out = np.zeros(msg_hashes.shape[0], bool)

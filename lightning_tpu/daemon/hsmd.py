@@ -121,6 +121,58 @@ def _sign_dispatch(op: str, msg_hashes: np.ndarray, seckeys: list[int],
     _note_sign(op, B, "device")
     return out
 
+def _check_sigs_resilient(msg_hashes: np.ndarray, sigs64: np.ndarray,
+                          pubkeys33: np.ndarray) -> np.ndarray:
+    """Batched sig-check under the shared "verify" circuit breaker —
+    the same EC verify program family as the gossip replay, so a
+    flapping device that opened the replay's breaker also diverts
+    commitment self-checks to the exact host oracle instead of wedging
+    the commitment dance.  This seam was the one hole graftlint's
+    supervision-coverage pass found on its first full-tree run: every
+    other dispatch family got breakers in PR 4 and flight records in
+    PR 5; check_sigs_batch predated both and got neither."""
+    B = msg_hashes.shape[0]
+    # the BREAKER is the shared "verify" one (same EC program family,
+    # same device health signal as the replay); the FLIGHT family is
+    # its own "check" lane — folding these records into "verify" would
+    # skew the replay pipeline's ring↔counter reconciliation
+    # (doc/perf.md), whose stage timings these records don't carry
+    brk = _breaker.get("verify")
+    corr = trace.new_corr()
+    with _flight.dispatch("check", n_real=B, lanes=B, shape=(B, 32),
+                          corr_ids=(corr.corr_id,),
+                          breaker_state=brk.state) as rec:
+        with trace.span("check/dispatch", corr=corr,
+                        dispatch_id=rec["dispatch_id"]):
+            if B <= S.HOST_VERIFY_MAX:
+                # micro-batches verify host-side inside
+                # ecdsa_verify_batch already
+                rec["outcome"] = "host"
+                return S.ecdsa_verify_batch(msg_hashes, sigs64,
+                                            pubkeys33)
+            if not brk.allow():
+                rec["outcome"] = "host_breaker"
+                return S.host_verify_batch(msg_hashes, sigs64,
+                                           pubkeys33)
+            try:
+                _fault.fire("dispatch", "verify")
+                out = S.ecdsa_verify_batch(msg_hashes, sigs64,
+                                           pubkeys33)
+            except Exception as e:
+                brk.record_failure()
+                _quarantine.note("check", type(e).__name__, B)
+                rec["outcome"] = "host"
+                rec["error"] = type(e).__name__
+                log.warning("device sig-check dispatch failed (%s); "
+                            "re-checking %d sigs on the host oracle",
+                            e, B)
+                return S.host_verify_batch(msg_hashes, sigs64,
+                                           pubkeys33)
+            brk.record_success()
+            rec["outcome"] = "ok"
+            return out
+
+
 # Capability bits (shape mirrors hsmd/permissions.h)
 CAP_ECDH = 1
 CAP_SIGN_GOSSIP = 2
@@ -301,7 +353,7 @@ class Hsm:
                          pubkeys: np.ndarray) -> np.ndarray:
         """Batched verify (the self-check the reference does per-HTLC with
         check_tx_sig, channeld/channeld.c:1068 — here one call)."""
-        return S.ecdsa_verify_batch(msg_hashes, sigs, pubkeys)
+        return _check_sigs_resilient(msg_hashes, sigs, pubkeys)
 
     # -- on-chain wallet (hsmd_sign_withdrawal equivalents) ---------------
 

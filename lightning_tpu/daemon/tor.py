@@ -148,8 +148,10 @@ class TorController:
                 body = s[4:]
                 if body.startswith("AUTH ") and "COOKIEFILE=" in body:
                     path = body.split('COOKIEFILE="', 1)[1].split('"')[0]
-                    with open(path, "rb") as f:
-                        cookie = f.read()
+                    # the cookie can live on slow media (NFS homedirs);
+                    # never read it on the event loop
+                    cookie = await asyncio.to_thread(
+                        lambda p: open(p, "rb").read(), path)
         except (TorError, OSError):
             cookie = None
         if cookie is not None:

@@ -58,6 +58,7 @@ import math
 import os
 import threading
 import time
+import traceback
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -389,7 +390,11 @@ class HealthEngine:
         except Exception:
             pass
         snap = self._registry.snapshot()["metrics"]
+        # tap the breaker/overload/flight subsystems BEFORE taking the
+        # engine lock — each tap takes its subsystem's own lock
+        taps = self._collect_taps()
         now = self._now()
+        eval_errors = ()
         with self._lock:
             self._ticks += 1
             elapsed = (now - self._last_mono
@@ -397,15 +402,18 @@ class HealthEngine:
             self._last_mono = now
             self._last_wall = time.time()
             self._fold(snap, now, elapsed)
-            self._sample_taps(now)
+            self._sample_taps(now, taps)
             transition = None
             if elapsed is not None:
-                self._evaluate(now)
+                eval_errors = self._evaluate(now)
                 transition = self._roll_up()
         # the events bus runs arbitrary subscriber callbacks
         # synchronously — emitting OUTSIDE the lock keeps a subscriber
         # that calls back into report()/state_name() (or is just slow)
-        # from deadlocking the sampler and every gethealth caller
+        # from deadlocking the sampler and every gethealth caller;
+        # same for logging, whose handlers are pluggable
+        for name, tb in eval_errors:
+            log.error("SLO %s evaluation failed:\n%s", name, tb)
         if transition is not None:
             state, breached = transition
             log.log(logging.WARNING if state != HEALTHY else logging.INFO,
@@ -476,19 +484,46 @@ class HealthEngine:
                     ser["raw"].append((now, v))
                     ser["points"].append(v)
 
-    def _sample_taps(self, now: float) -> None:
-        """Breaker / overload / flight state (jax-free imports; lazy so
-        obs.health never forces the resilience package on importers
-        that only want the quantile math)."""
+    def _collect_taps(self):
+        """Breaker / overload / flight state, gathered OUTSIDE the
+        engine lock (graftlint lock-order): every call here takes the
+        tapped subsystem's own lock — breaker.get/snapshot, overload
+        snapshot, the flight-ring summary — and holding ours across
+        theirs builds acquisition edges into code we don't control.
+        (Jax-free imports; lazy so obs.health never forces the
+        resilience package on importers that only want the quantile
+        math.)  Returns (breakers, overload_view, flight_view) or
+        None."""
         try:
             from ..resilience import FAMILIES, breaker as _breaker
             from ..resilience import overload as _overload
         except Exception:
-            return
-        view = {}
+            return None
+        breakers = {}
         for fam in FAMILIES:
             brk = _breaker.get(fam)
-            state = brk.state
+            breakers[fam] = (brk.state, brk.trips)
+        overload_view = {
+            f: c.snapshot()["state"]
+            for f, c in sorted(getattr(_overload, "_controllers",
+                                       {}).items())}
+        try:
+            summ = _flight.summary()["families"]
+            flight_view = {f: {"total": v["total"],
+                               "ring": v["ring"]}
+                           for f, v in summ.items()}
+        except Exception:
+            flight_view = {}
+        return breakers, overload_view, flight_view
+
+    def _sample_taps(self, now: float, taps) -> None:
+        """Fold pre-collected tap state into the engine's views (lock
+        held; pure bookkeeping, no calls out)."""
+        if taps is None:
+            return
+        breakers, self._overload_view, self._flight_view = taps
+        view = {}
+        for fam, (state, trips) in breakers.items():
             if state == "open":
                 self._open_since.setdefault(fam, now)
                 open_s = now - self._open_since[fam]
@@ -496,19 +531,8 @@ class HealthEngine:
                 self._open_since.pop(fam, None)
                 open_s = 0.0
             view[fam] = {"state": state, "open_s": round(open_s, 3),
-                         "trips": brk.trips}
+                         "trips": trips}
         self._breaker_view = view
-        self._overload_view = {
-            f: c.snapshot()["state"]
-            for f, c in sorted(getattr(_overload, "_controllers",
-                                       {}).items())}
-        try:
-            summ = _flight.summary()["families"]
-            self._flight_view = {f: {"total": v["total"],
-                                     "ring": v["ring"]}
-                                 for f, v in summ.items()}
-        except Exception:
-            self._flight_view = {}
 
     # -- windowed reads (lock held) ----------------------------------------
 
@@ -657,13 +681,16 @@ class HealthEngine:
             return inc > p.get("max", 0.0), inc
         raise ValueError(f"unknown SLO kind {spec.kind!r}")
 
-    def _evaluate(self, now: float) -> None:
+    def _evaluate(self, now: float) -> list:
+        errors: list = []
         for spec in self.slos:
             st = self._slo_state[spec.name]
             try:
                 violated, observed = self._evaluate_spec(spec)
             except Exception:
-                log.exception("SLO %s evaluation failed", spec.name)
+                # runs under the engine lock: collect, let tick() log
+                # after release (handlers are pluggable — lock-order)
+                errors.append((spec.name, traceback.format_exc()))
                 violated, observed = None, None
             st["violated"].append(1 if violated else 0)
             st["observed"].append(observed)
@@ -685,6 +712,7 @@ class HealthEngine:
             else:
                 st["status"] = OK
             st["was_violated"] = bool(violated)
+        return errors
 
     # -- roll-up state machine (lock held) ---------------------------------
 

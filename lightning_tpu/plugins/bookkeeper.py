@@ -17,6 +17,7 @@ onchain_fee (close/open fees).
 """
 from __future__ import annotations
 
+import asyncio
 import time
 
 from ..utils import events
@@ -275,8 +276,13 @@ def attach_bookkeeper_commands(rpc, bk: Bookkeeper) -> None:
                                  csv_file: str | None = None) -> dict:
         text = bk.income_csv(csv_format)
         if csv_file:
-            with open(csv_file, "w") as f:
-                f.write(text)
+            # a full income history can be megabytes — write it off
+            # the event loop
+            def _dump(path: str, body: str) -> None:
+                with open(path, "w") as f:
+                    f.write(body)
+
+            await asyncio.to_thread(_dump, csv_file, text)
         return {"csv_format": csv_format,
                 "csv_file": csv_file or "", "csv": text}
 

@@ -78,6 +78,7 @@ class CircuitBreaker:
         self.state = CLOSED
         self.consecutive_failures = 0
         self.trips = 0
+        self._seq = 0
         self._retry_at = 0.0
         self._open_backoff = 0.0
         _f.BREAKER_STATE.labels(family).set(_STATE_CODE[CLOSED])
@@ -90,36 +91,43 @@ class CircuitBreaker:
         half-opens and grants exactly one probe."""
         if self.disabled:
             return True
-        with self._lock:
-            if self.state == CLOSED:
-                return True
-            if self.state == OPEN and self._clock() >= self._retry_at:
-                self._transition(HALF_OPEN)
-                return True
-            # open-and-waiting, or a half-open probe already in flight
-            _f.BREAKER_SHORT_CIRCUITS.labels(self.family).inc()
-            return False
+        evt = None
+        try:
+            with self._lock:
+                if self.state == CLOSED:
+                    return True
+                if self.state == OPEN and self._clock() >= self._retry_at:
+                    evt = self._transition(HALF_OPEN)
+                    return True
+                # open-and-waiting, or a half-open probe in flight
+                _f.BREAKER_SHORT_CIRCUITS.labels(self.family).inc()
+                return False
+        finally:
+            self._emit(evt)
 
     def record_success(self) -> None:
+        evt = None
         with self._lock:
             self.consecutive_failures = 0
             if self.state != CLOSED:
-                self._transition(CLOSED)
+                evt = self._transition(CLOSED)
+        self._emit(evt)
 
     def record_failure(self) -> None:
         _f.BREAKER_FAILURES.labels(self.family).inc()
+        evt = None
         with self._lock:
             self.consecutive_failures += 1
-            if self.disabled:
-                return
-            if self.state == HALF_OPEN or (
-                    self.state == CLOSED
-                    and self.consecutive_failures >= self.threshold):
-                self._trip()
+            if not self.disabled and (
+                    self.state == HALF_OPEN or (
+                        self.state == CLOSED
+                        and self.consecutive_failures >= self.threshold)):
+                evt = self._trip()
+        self._emit(evt)
 
-    # -- internals (lock held) --------------------------------------------
+    # -- internals (lock held; transitions return the event payload) ------
 
-    def _trip(self) -> None:
+    def _trip(self) -> dict:
         self.trips += 1
         backoff = min(self.max_backoff,
                       self.base_backoff
@@ -127,18 +135,36 @@ class CircuitBreaker:
         backoff *= 1.0 + 0.1 * self._rng.random()
         self._open_backoff = backoff
         self._retry_at = self._clock() + backoff
-        self._transition(OPEN)
+        return self._transition(OPEN)
 
-    def _transition(self, to: str) -> None:
+    def _transition(self, to: str) -> dict:
+        """State change + metering under the lock; the events-bus
+        emission is the CALLER's job once the lock is released — the
+        bus runs subscriber callbacks synchronously, and a subscriber
+        calling back into snapshot()/allow() (the health engine's
+        breaker tap does exactly that shape) would deadlock against a
+        non-reentrant Lock.  The PR-9 health-engine class, caught here
+        by graftlint's lock-order pass.
+
+        Emitting after release means two threads' events can reach the
+        bus out of transition order; ``seq`` (monotonic, assigned under
+        the lock) lets a subscriber mirroring state drop the stale one
+        instead of latching a wrong terminal state."""
         self.state = to
+        self._seq += 1
         _f.BREAKER_STATE.labels(self.family).set(_STATE_CODE[to])
         _f.BREAKER_TRANSITIONS.labels(self.family, to).inc()
-        events.emit("breaker_transition", {
-            "family": self.family, "to": to,
+        return {
+            "family": self.family, "to": to, "seq": self._seq,
             "consecutive_failures": self.consecutive_failures,
             "backoff_s": round(self._open_backoff, 3) if to == OPEN
             else 0.0,
-        })
+        }
+
+    @staticmethod
+    def _emit(evt: dict | None) -> None:
+        if evt is not None:
+            events.emit("breaker_transition", evt)
 
     # -- introspection ------------------------------------------------------
 
@@ -158,14 +184,17 @@ class CircuitBreaker:
     def force_open(self) -> None:
         """Test/ops helper: trip immediately regardless of history."""
         with self._lock:
-            self._trip()
+            evt = self._trip()
+        self._emit(evt)
 
     def reset(self) -> None:
+        evt = None
         with self._lock:
             self.consecutive_failures = 0
             self.trips = 0
             if self.state != CLOSED:
-                self._transition(CLOSED)
+                evt = self._transition(CLOSED)
+        self._emit(evt)
 
 
 _breakers: dict[str, CircuitBreaker] = {}

@@ -121,17 +121,17 @@ def _env_specs() -> list[_Spec]:
     cached_env, specs = _env_cache
     if env == cached_env:
         return specs
+    # parse (and warn) OUTSIDE the lock: parse is pure, so a racing
+    # second parse of the same env is benign — but log handlers are
+    # pluggable and may block or re-enter (graftlint lock-order)
+    try:
+        specs = parse(env)
+    except ValueError as e:
+        log.warning("ignoring bad LIGHTNING_TPU_FAULT: %s", e)
+        specs = []
     with _env_lock:
-        cached_env, specs = _env_cache
-        if env == cached_env:
-            return specs
-        try:
-            specs = parse(env)
-        except ValueError as e:
-            log.warning("ignoring bad LIGHTNING_TPU_FAULT: %s", e)
-            specs = []
         _env_cache = (env, specs)
-        return specs
+    return specs
 
 
 def fire(seam: str, family: str) -> None:

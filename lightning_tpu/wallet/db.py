@@ -240,15 +240,19 @@ class Db:
             # veto: the transaction (incl. the vars row) rolls back, so
             # the counter must give this number back — the next commit
             # reuses it, keeping the replica's lock-step monotone.
+            raced = False
             with self._version_lock:
                 if self._data_version == version:
                     self._data_version = version - 1
                 else:   # pragma: no cover — needs interleaved writers
-                    import logging
+                    raced = True
+            if raced:   # pragma: no cover — log OUTSIDE the version
+                # lock: handlers are pluggable (graftlint lock-order)
+                import logging
 
-                    logging.getLogger("lightning_tpu.db").warning(
-                        "db_write veto raced a concurrent commit; "
-                        "replication stream may skip version %d", version)
+                logging.getLogger("lightning_tpu.db").warning(
+                    "db_write veto raced a concurrent commit; "
+                    "replication stream may skip version %d", version)
             raise
 
     def _migrate(self) -> None:

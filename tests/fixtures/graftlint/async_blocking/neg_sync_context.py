@@ -1,0 +1,28 @@
+"""NEGATIVE [async-blocking]: blocking calls in plain sync functions
+with sync callers (worker threads), and bounded join/get."""
+import queue
+import threading
+import time
+
+
+class Producer:
+    def __init__(self):
+        self.queue = queue.Queue()
+        self.thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        # thread entry: blocking is this function's whole job
+        while True:
+            item = self.queue.get()
+            time.sleep(0.01)
+            if item is None:
+                return
+
+    def close(self):
+        self.queue.put(None)
+        self.thread.join(timeout=5.0)
+
+    async def aclose(self):
+        # bounded waits are the accepted idiom
+        self.queue.get(timeout=2.0)
+        self.thread.join(2.0)

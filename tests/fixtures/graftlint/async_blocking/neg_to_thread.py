@@ -1,0 +1,29 @@
+"""NEGATIVE [async-blocking]: the accepted idioms — to_thread-wrapped
+work, awaited asyncio-queue gets, bounded waits."""
+import asyncio
+import time
+
+
+class Daemon:
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+        self._queue = None
+
+    async def poll(self, timeout):
+        # awaited .get() is a coroutine (asyncio.Queue), not stdlib
+        return await asyncio.wait_for(self.inbox.get(), timeout)
+
+    async def sleep_right(self):
+        await asyncio.sleep(0.5)
+
+    async def offload(self):
+        return await asyncio.to_thread(self._read_all)
+
+    def _read_all(self):
+        # escapes into to_thread: runs on a worker, open() is fine
+        with open("/tmp/state", "rb") as f:
+            return f.read()
+
+
+async def nap_off_loop():
+    await asyncio.to_thread(time.sleep, 0.1)

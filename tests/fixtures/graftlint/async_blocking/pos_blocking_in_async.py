@@ -1,0 +1,19 @@
+"""POSITIVE [async-blocking]: blocking primitives directly inside
+coroutine bodies."""
+import queue
+import subprocess
+import time
+
+
+class Daemon:
+    def __init__(self):
+        self.inbox = queue.Queue()
+
+    async def poll(self):
+        time.sleep(0.5)                      # HIT: blocking-sleep
+        return self.inbox.get()              # HIT: blocking-queue-get
+
+    async def spawn(self):
+        out = subprocess.check_output(["ls"])   # HIT: blocking-subprocess
+        with open("/tmp/out", "wb") as f:       # HIT: blocking-io
+            f.write(out)

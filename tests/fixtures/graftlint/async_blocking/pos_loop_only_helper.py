@@ -1,0 +1,25 @@
+"""POSITIVE [async-blocking]: a sync helper whose ONLY callers are
+coroutines runs on the event loop — its blocking calls stall it, plus
+an executor-future result() with no timeout."""
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+_pool = ThreadPoolExecutor(2)
+
+
+def _settle(batch):
+    time.sleep(1.0)              # HIT: loop-only helper blocks
+    return batch
+
+
+async def flush(batch):
+    return _settle(batch)
+
+
+async def flush_all(batches):
+    return [_settle(b) for b in batches]
+
+
+async def offload(work):
+    fut = _pool.submit(work)
+    return fut.result()          # HIT: executor future, no timeout

@@ -1,0 +1,57 @@
+"""Historical regression [lock-order]: the PR-9 health-engine
+deadlock, verbatim shape.  obs/health.py's sampler originally emitted
+the ``health_state`` events topic INSIDE ``with self._lock:`` at the
+end of tick() — the events bus runs subscriber callbacks
+synchronously, so a subscriber calling back into report()/state_name()
+(both take the same non-reentrant lock) deadlocked the sampler thread
+AND every gethealth caller behind it.  The PR-9 post-review fix moved
+the emit after the lock release; this fixture is the PRE-fix shape and
+proves lock-order would have caught it at review time."""
+import logging
+import threading
+import time
+
+from lightning_tpu.utils import events
+
+log = logging.getLogger("fixture.health")
+
+HEALTHY, DEGRADED = 0, 1
+STATE_NAMES = {0: "healthy", 1: "degraded"}
+
+
+class HealthEngine:
+    def __init__(self, registry):
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._ticks = 0
+        self._state = HEALTHY
+        self._state_since = time.time()
+
+    def tick(self) -> None:
+        snap = self._registry.snapshot()["metrics"]
+        with self._lock:
+            self._ticks += 1
+            self._fold(snap)
+            transition = self._roll_up()
+            if transition is not None:
+                state, breached = transition
+                # HIT: subscribers run synchronously UNDER self._lock;
+                # one calling report() deadlocks the sampler
+                events.emit("health_state",
+                            {"state": STATE_NAMES[state],
+                             "breached": breached})
+
+    def _fold(self, snap) -> None:
+        pass
+
+    def _roll_up(self):
+        return (DEGRADED, ["route_p99"])
+
+    def report(self) -> dict:
+        with self._lock:
+            return {"state": STATE_NAMES[self._state],
+                    "ticks": self._ticks}
+
+    def state_name(self) -> str:
+        with self._lock:
+            return STATE_NAMES[self._state] if self._ticks else "unknown"

@@ -1,0 +1,44 @@
+"""Historical-class seed [async-blocking]: a ``time.sleep`` throttle
+inside daemon/rest.py's request handler — the acceptance-criteria
+re-injection.  The REST server is asyncio streams on the daemon's ONE
+event loop; a synchronous sleep (say, a naive retry backoff) in
+_handle stalls every peer connection, every RPC, and every flush loop
+for its full duration.  Copy of the real RestServer shape with the
+seeded bug."""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+log = logging.getLogger("fixture.rest")
+
+MAX_BODY = 4 * 1024 * 1024
+
+
+class RestServer:
+    def __init__(self, rpc, host: str = "127.0.0.1", port: int = 0):
+        self.rpc = rpc
+        self.host, self.port = host, port
+        self._server = None
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        status, body = await self._handle(reader)
+        writer.write(json.dumps(body).encode())
+        await writer.drain()
+        writer.close()
+
+    async def _handle(self, reader) -> tuple[int, dict]:
+        line = await reader.readline()
+        if not line:
+            # HIT: a "cheap" retry backoff that stalls the WHOLE loop
+            time.sleep(0.25)
+            return 400, {"error": "empty request"}
+        return 200, {"result": line.decode().strip()}

@@ -1,0 +1,49 @@
+"""Historical regression [async-blocking]: the PR-4 RouteService
+close()-vs-inflight-dispatch race, in its static spelling.  The
+pre-fix close() assumed no dispatch was in flight: it waited for the
+dispatch worker with an UNBOUNDED join and drained the queue with an
+unbounded get — on the event loop.  With a dispatch in flight (the
+race PR-4's test pins with a slow solver), the join parks the loop on
+a thread that is itself waiting for the loop to resolve futures:
+shutdown wedges and every pending getroute future hangs instead of
+resolving.  The PR-4 fix awaited the flush task and resolved every
+pending future with hard-timeout joins in the TESTS; this fixture is
+the pre-fix shape, caught as blocking-join/blocking-queue-get inside
+``async def close``.  Copy of the real RouteService lifecycle shape."""
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time
+
+
+class RouteService:
+    """Coalesce concurrent getroute queries into batched dispatches
+    (trimmed copy: lifecycle only)."""
+
+    def __init__(self, get_map, flush_ms: float = 4.0):
+        self.get_map = get_map
+        self.flush_ms = flush_ms
+        self._queue = queue.Queue()
+        self._dispatch_thread = threading.Thread(target=self._run)
+        self._closed = False
+
+    def start(self) -> None:
+        self._dispatch_thread.start()
+
+    def _run(self) -> None:
+        while not self._closed:
+            batch = self._queue.get()
+            if batch is None:
+                return
+            time.sleep(self.flush_ms / 1000.0)   # the dispatch
+
+    async def close(self) -> None:
+        self._closed = True
+        # HIT: unbounded drain on the loop — with a dispatch in
+        # flight this parks the event loop the worker needs
+        pending = self._queue.get()
+        del pending
+        # HIT: unbounded join — the exact close-vs-inflight wedge
+        self._dispatch_thread.join()

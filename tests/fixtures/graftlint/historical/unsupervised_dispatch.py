@@ -1,0 +1,46 @@
+"""Historical regression [supervision-coverage]: the pre-fix
+hsmd.check_sigs_batch — the one supervision hole this pass found on
+its first full-tree run (fixed in the same PR).  Every other dispatch
+family got circuit breakers in PR 4 and flight records in PR 5;
+check_sigs_batch predated both and invoked the EC verify program bare:
+a flapping device failed the commitment dance's self-check instead of
+degrading to the exact host oracle.  Trimmed copy of the real
+hsmd/secp256k1 shape, pre-fix."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+HOST_VERIFY_MAX = 8
+
+
+def ecdsa_verify_kernel(z, r, s, qx, parity):
+    return z
+
+
+@functools.lru_cache(maxsize=2)
+def _jit_verify():
+    return jax.jit(ecdsa_verify_kernel)
+
+
+def _host_verify(msg_hashes, sigs64, pubkeys33):
+    return np.zeros(msg_hashes.shape[0], bool)
+
+
+def ecdsa_verify_batch(msg_hashes, sigs64, pubkeys33, bucket=64):
+    B = msg_hashes.shape[0]
+    if B <= HOST_VERIFY_MAX:
+        return _host_verify(msg_hashes, sigs64, pubkeys33)
+    kern = _jit_verify()
+    # HIT: reachable from check_sigs_batch with no seam anywhere
+    ok = kern(jnp.asarray(msg_hashes), jnp.asarray(sigs64[:, :32]),
+              jnp.asarray(sigs64[:, 32:]), jnp.asarray(pubkeys33[:, 1:]),
+              jnp.asarray(pubkeys33[:, 0] & 1))
+    return np.asarray(ok)
+
+
+class Hsm:
+    def check_sigs_batch(self, msg_hashes, sigs, pubkeys):
+        """Batched verify (pre-fix: no breaker, no flight record)."""
+        return ecdsa_verify_batch(msg_hashes, sigs, pubkeys)

@@ -1,0 +1,27 @@
+"""Historical-class seed [x64-discipline]: routing/device.py's
+solve_batch with the ``enable_x64`` scope dropped — the
+acceptance-criteria re-injection.  The real module stages amount/fee
+planes through jnp.asarray INSIDE ``with enable_x64():`` (an explicit
+idiom comment warns that int64 planes "silently truncate to int32"
+otherwise); this copy stages them bare, so every amount past 2^31
+wraps before the solver's 2^61 overflow guards can see it.  Trimmed
+copy of the real staging shape, scope removed."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def solve_batch(planes, queries, batch):
+    n = len(queries)
+    amount = np.zeros(batch, np.int64)
+    cltv = np.zeros(batch, np.int32)
+    fee_base = planes.edge_base
+    for i, q in enumerate(queries[:n]):
+        amount[i] = q.amount_msat
+        cltv[i] = q.final_cltv
+    # HIT: msat staging with no enable_x64 — int64 wraps to int32
+    dev_amount = jnp.asarray(amount)
+    # HIT: int64 ctor outside the scope
+    risk = jnp.zeros((batch,), jnp.int64)
+    # HIT: fee plane staged bare
+    dev_fees = jnp.asarray(fee_base)
+    return dev_amount, risk, dev_fees, cltv

@@ -1,0 +1,36 @@
+"""NEGATIVE [lock-order]: the fixed idiom — collect under the lock,
+emit after releasing (obs/health.py tick / resilience/breaker.py)."""
+import logging
+import threading
+
+from lightning_tpu.utils import events
+
+log = logging.getLogger("fixture")
+
+_lock = threading.Lock()
+_state = "closed"
+
+
+def trip():
+    with _lock:
+        transition = _compute("open")
+    if transition is not None:
+        events.emit("state_change", transition)
+        log.warning("tripped: %s", transition)
+
+
+def _compute(to):
+    return {"to": to}
+
+
+class Sampler:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def tick(self):
+        with self._lock:
+            evt = self._fold()
+        events.emit("sampler_state", evt)
+
+    def _fold(self):
+        return {}

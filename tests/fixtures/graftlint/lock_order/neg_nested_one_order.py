@@ -1,0 +1,30 @@
+"""NEGATIVE [lock-order]: nested acquisition in ONE global order (and
+metric-instrument calls under a lock — the accepted terminal idiom)."""
+import threading
+
+from lightning_tpu.obs import families as _f
+
+_outer_lock = threading.Lock()
+_inner_lock = threading.Lock()
+
+
+def update(rec):
+    with _outer_lock:
+        with _inner_lock:         # only ever outer → inner: no cycle
+            _apply(rec)
+
+
+def refresh():
+    with _outer_lock:
+        with _inner_lock:
+            _apply(None)
+
+
+def meter(family):
+    with _inner_lock:
+        # registry children are terminal: never re-enter, O(1) hold
+        _f.BREAKER_STATE.labels(family).set(1.0)
+
+
+def _apply(rec):
+    pass

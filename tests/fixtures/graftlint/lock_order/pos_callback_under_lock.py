@@ -1,0 +1,42 @@
+"""POSITIVE [lock-order]: events-bus, logging, and callback-shaped
+calls while a lock is held — incl. the interprocedural shape where the
+emitting helper is only ever CALLED under the lock."""
+import logging
+import threading
+
+from lightning_tpu.utils import events
+
+log = logging.getLogger("fixture")
+
+_lock = threading.Lock()
+_state = "closed"
+
+
+def trip():
+    with _lock:
+        events.emit("state_change", {"to": "open"})   # HIT: events bus
+        log.warning("tripped")                        # HIT: logging
+
+
+def set_result_under_lock(fut):
+    with _lock:
+        fut.set_result(True)      # HIT: done-callbacks run HERE
+
+
+def notify(on_change):
+    with _lock:
+        on_change()               # no hit: plain name, not cb-shaped
+
+
+class Sampler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sink = None
+
+    def tick(self):
+        with self._lock:
+            self._transition("open")
+
+    def _transition(self, to):
+        # HIT via propagation: every caller holds self._lock
+        events.emit("sampler_state", {"to": to})

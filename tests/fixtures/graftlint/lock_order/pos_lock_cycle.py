@@ -1,0 +1,26 @@
+"""POSITIVE [lock-order]: an A→B / B→A acquisition cycle — two threads
+interleaving these deadlock."""
+import threading
+
+_ring_lock = threading.Lock()
+_sink_lock = threading.Lock()
+
+
+def append(rec):
+    with _ring_lock:
+        with _sink_lock:          # edge ring → sink
+            _write(rec)
+
+
+def rotate(path):
+    with _sink_lock:
+        with _ring_lock:          # edge sink → ring: CYCLE
+            _drain(path)
+
+
+def _write(rec):
+    pass
+
+
+def _drain(path):
+    pass

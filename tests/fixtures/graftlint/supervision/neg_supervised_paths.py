@@ -1,0 +1,37 @@
+"""NEGATIVE [supervision-coverage]: every path to the program crosses
+a seam — breaker allow(), a flight-record with, or the to_thread hop
+from a supervised flush loop."""
+import functools
+
+import asyncio
+
+import jax
+
+from lightning_tpu.obs import flight as _flight
+from lightning_tpu.resilience import breaker as _breaker
+
+
+def route_kernel(planes):
+    return planes
+
+
+@functools.lru_cache(maxsize=1)
+def _jit_route():
+    return jax.jit(route_kernel)
+
+
+def solve_batch(planes):
+    return _jit_route()(planes)    # covered: both callers supervised
+
+
+async def flush(planes):
+    brk = _breaker.get("route")
+    if not brk.allow():
+        return planes
+    return await asyncio.to_thread(solve_batch, planes)
+
+
+def flush_sync(planes):
+    with _flight.dispatch("route", n_real=1) as rec:
+        rec["outcome"] = "ok"
+        return solve_batch(planes)

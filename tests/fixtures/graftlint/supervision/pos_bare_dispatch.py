@@ -1,0 +1,24 @@
+"""POSITIVE [supervision-coverage]: jit programs invoked with no
+breaker/flight seam anywhere on the path — the builder-invoke shape
+and the program-variable shape."""
+import functools
+
+import jax
+
+
+def fee_kernel(amounts, rates):
+    return amounts * rates
+
+
+@functools.lru_cache(maxsize=1)
+def _jit_fees():
+    return jax.jit(fee_kernel)
+
+
+def apply_fees(amounts, rates):
+    return _jit_fees()(amounts, rates)       # HIT: no seam on any path
+
+
+def serve(batch):
+    kern = jax.jit(fee_kernel)
+    return kern(batch, batch)                # HIT: program variable

@@ -1,0 +1,34 @@
+"""POSITIVE [supervision-coverage]: the dispatch helper IS called from
+a supervised flush — but a second, unsupervised entry reaches it too.
+One finding per leaky root: supervising the main path does not excuse
+the side door."""
+import functools
+
+import jax
+
+from lightning_tpu.resilience import breaker as _breaker
+
+
+def verify_kernel(rows):
+    return rows
+
+
+@functools.lru_cache(maxsize=1)
+def _jit_verify():
+    return jax.jit(verify_kernel)
+
+
+def _dispatch(rows):
+    return _jit_verify()(rows)     # HIT via debug_peek only
+
+
+def flush(rows):
+    brk = _breaker.get("verify")
+    if not brk.allow():
+        return rows                # host fallback
+    return _dispatch(rows)
+
+
+def debug_peek(rows):
+    # the side door: no breaker consulted
+    return _dispatch(rows)

@@ -1,0 +1,15 @@
+"""POSITIVE [x64-discipline]: msat-named parameters in static
+positions — one trace per distinct amount, value baked as a host
+constant outside the x64 scope and the overflow guards."""
+import jax
+
+
+def route_kernel(planes, amount_msat, riskfactor):
+    return planes
+
+
+def build(planes):
+    solver = jax.jit(route_kernel,
+                     static_argnames=("amount_msat",))    # HIT
+    solver2 = jax.jit(route_kernel, static_argnums=(1,))  # HIT
+    return solver, solver2

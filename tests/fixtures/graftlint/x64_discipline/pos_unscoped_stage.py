@@ -1,0 +1,11 @@
+"""POSITIVE [x64-discipline]: msat/int64 staging outside the
+enable_x64 scope — the amounts silently truncate to int32."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def stage_query(amount_msat, fee_base, n):
+    a = jnp.asarray(amount_msat)              # HIT: msat outside scope
+    b = jnp.asarray(np.asarray(fee_base))     # HIT: fee outside scope
+    z = jnp.zeros((n,), jnp.int64)            # HIT: int64 ctor outside
+    return a, b, z

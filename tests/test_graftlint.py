@@ -1,12 +1,17 @@
-"""graftlint (ISSUE 6): the unified static-analysis framework.
+"""graftlint (ISSUE 6 + ISSUE 12): the unified static-analysis
+framework.
 
 Tier-1 contract: the repo itself is CLEAN — zero unbaselined findings,
 every baseline entry justified, no stale entries.  Plus: each of the
-six passes fails on its positive fixtures and passes on its negative
-fixtures (tests/fixtures/graftlint/), the three historical bugs
-(PR-3 jit re-wrap, PR-5 unlocked ring mutation, PR-4 unwired knob) are
-caught by their passes, fingerprints are line-number independent, and
-the baseline workflow (stale entry → fail; --baseline-update) works.
+ten passes fails on its positive fixtures and passes on its negative
+fixtures (tests/fixtures/graftlint/), the historical bugs (PR-3 jit
+re-wrap, PR-5 unlocked ring mutation, PR-4 unwired knob, PR-9
+callback-under-lock deadlock, PR-4 close-vs-inflight race, the
+check_sigs supervision hole, the seeded rest.py sleep, the un-scoped
+int64 fee staging) are caught by their passes, fingerprints are
+line-number independent, and the baseline workflow (stale entry →
+fail; pass-version invalidation; --baseline-update with per-pass
+counts) works, as do --changed and --format sarif.
 
 Everything here is pure-AST stdlib analysis — no jax import, runs in
 milliseconds.
@@ -53,7 +58,7 @@ def test_repo_zero_unbaselined_findings():
     assert result.stale_baseline == []
     assert result.unjustified == []
     assert result.files_scanned > 100
-    assert len(result.passes_run) == 6
+    assert len(result.passes_run) == 10
 
 
 def test_every_baseline_entry_is_justified():
@@ -228,6 +233,119 @@ def test_duplicate_violations_get_distinct_fingerprints(tmp_path):
     assert len(set(fps)) == 2, fps  # one entry cannot cover both
 
 
+# -- the four ISSUE-12 passes: fixtures ---------------------------------------
+
+
+def test_lock_order_fixtures():
+    d = os.path.join(FIX, "lock_order")
+    p = run_pass("lock-order", d, ("pos_callback_under_lock.py",))
+    assert codes(p) == ["callback-under-lock"] * 4, codes(p)
+    kinds = {f.detail.split(" ")[0] for f in p.findings}
+    assert kinds == {"events-bus", "logging", "future-callback"}
+    # the interprocedural case: _transition only ever called under
+    # Sampler._lock — the emit inside it is flagged
+    assert any("Sampler._lock" in f.detail and f.scope.endswith(
+        "_transition") for f in p.findings), \
+        [(f.scope, f.detail) for f in p.findings]
+    p = run_pass("lock-order", d, ("pos_lock_cycle.py",))
+    assert codes(p) == ["lock-cycle"], codes(p)
+    assert "_ring_lock" in p.findings[0].detail
+    assert "_sink_lock" in p.findings[0].detail
+    for fname in _fixture_files("lock_order", "neg_"):
+        p = run_pass("lock-order", d, (fname,))
+        assert p.findings == [], (fname, [f.detail for f in p.findings])
+
+
+def test_async_blocking_fixtures():
+    d = os.path.join(FIX, "async_blocking")
+    p = run_pass("async-blocking", d, ("pos_blocking_in_async.py",))
+    assert sorted(codes(p)) == ["blocking-io", "blocking-queue-get",
+                                "blocking-sleep",
+                                "blocking-subprocess"], codes(p)
+    p = run_pass("async-blocking", d, ("pos_loop_only_helper.py",))
+    assert sorted(codes(p)) == ["blocking-result", "blocking-sleep"], \
+        codes(p)
+    # the flow-sensitive part: the sleep lives in a SYNC helper whose
+    # only callers are coroutines
+    sleep = [f for f in p.findings if f.code == "blocking-sleep"][0]
+    assert sleep.scope == "_settle"
+    assert "only callers are coroutines" in sleep.message
+    for fname in _fixture_files("async_blocking", "neg_"):
+        p = run_pass("async-blocking", d, (fname,))
+        assert p.findings == [], (fname, [f.detail for f in p.findings])
+
+
+def test_supervision_fixtures():
+    d = os.path.join(FIX, "supervision")
+    p = run_pass("supervision-coverage", d, ("pos_bare_dispatch.py",))
+    assert codes(p) == ["unsupervised-dispatch"] * 2, codes(p)
+    p = run_pass("supervision-coverage", d, ("pos_one_leaky_caller.py",))
+    # exactly ONE finding: the supervised flush path is fine, the
+    # debug_peek side door is the hole
+    assert len(p.findings) == 1, [f.detail for f in p.findings]
+    assert "via debug_peek" in p.findings[0].detail
+    for fname in _fixture_files("supervision", "neg_"):
+        p = run_pass("supervision-coverage", d, (fname,))
+        assert p.findings == [], (fname, [f.detail for f in p.findings])
+
+
+def test_x64_fixtures():
+    d = os.path.join(FIX, "x64_discipline")
+    p = run_pass("x64-discipline", d, ("pos_unscoped_stage.py",))
+    assert sorted(codes(p)) == ["unscoped-int64", "unscoped-msat-stage",
+                                "unscoped-msat-stage"], codes(p)
+    p = run_pass("x64-discipline", d, ("pos_static_msat.py",))
+    assert codes(p) == ["msat-static-arg"] * 2, codes(p)
+    assert all("amount_msat" in f.detail for f in p.findings)
+    for fname in _fixture_files("x64_discipline", "neg_"):
+        p = run_pass("x64-discipline", d, (fname,))
+        assert p.findings == [], (fname, [f.detail for f in p.findings])
+
+
+# -- the historical bugs ------------------------------------------------------
+
+
+def test_catches_pr9_health_deadlock():
+    p = run_pass("lock-order", os.path.join(FIX, "historical"),
+                 ("health_deadlock.py",))
+    assert codes(p) == ["callback-under-lock"], codes(p)
+    f = p.findings[0]
+    assert f.detail.startswith("events-bus events.emit")
+    assert "HealthEngine._lock" in f.detail
+    assert f.scope == "HealthEngine.tick"
+
+
+def test_catches_pr4_close_race():
+    p = run_pass("async-blocking", os.path.join(FIX, "historical"),
+                 ("route_close_race.py",))
+    assert sorted(codes(p)) == ["blocking-join", "blocking-queue-get"], \
+        codes(p)
+    assert all(f.scope == "RouteService.close" for f in p.findings)
+
+
+def test_catches_seeded_rest_sleep():
+    p = run_pass("async-blocking", os.path.join(FIX, "historical"),
+                 ("rest_sleep.py",))
+    assert codes(p) == ["blocking-sleep"], codes(p)
+    assert p.findings[0].scope == "RestServer._handle"
+
+
+def test_catches_unsupervised_check_sigs():
+    p = run_pass("supervision-coverage", os.path.join(FIX, "historical"),
+                 ("unsupervised_dispatch.py",))
+    assert codes(p) == ["unsupervised-dispatch"], codes(p)
+    assert "via Hsm.check_sigs_batch" in p.findings[0].detail
+    assert p.findings[0].scope == "ecdsa_verify_batch"
+
+
+def test_catches_unscoped_x64_fee_staging():
+    p = run_pass("x64-discipline", os.path.join(FIX, "historical"),
+                 ("x64_fee_unscoped.py",))
+    assert sorted(codes(p)) == ["unscoped-int64", "unscoped-msat-stage",
+                                "unscoped-msat-stage"], codes(p)
+    assert all(f.scope == "solve_batch" for f in p.findings)
+
+
 # -- the three historical bugs -----------------------------------------------
 
 
@@ -338,7 +456,8 @@ def test_unjustified_baseline_entry_fails(tmp_path):
     bl.write_text(json.dumps({"version": 1, "entries": {fp: {
         "pass": "jit-hygiene", "code": "call-wrap",
         "file": "jit_rewrap.py", "scope": "ecdsa_sign_batch",
-        "detail": p.findings[0].detail, "justification": "   "}}}))
+        "detail": p.findings[0].detail, "justification": "   ",
+        "pass_version": PASSES_BY_NAME["jit-hygiene"].version}}}))
     cli = [sys.executable, os.path.join(ROOT, "tools", "graftlint.py"),
            "--root", str(tmp_path), "--scan-roots", "jit_rewrap.py",
            "--passes", "jit-hygiene", "--baseline", str(bl)]
@@ -347,6 +466,176 @@ def test_unjustified_baseline_entry_fails(tmp_path):
     assert "unjustified" in r.stdout
     # reported ONCE (as an unjustified entry), not also as new
     assert "finding(s)" not in r.stdout
+
+
+def test_pass_version_invalidates_grandfathers(tmp_path):
+    """A baseline entry stamped with an older pass version no longer
+    suppresses: the finding comes back AND the entry reports stale —
+    a pass rewrite cannot inherit the old pass's grandfathers."""
+    shutil.copy(os.path.join(FIX, "historical", "jit_rewrap.py"),
+                tmp_path / "jit_rewrap.py")
+    bl = tmp_path / "baseline.json"
+    cli = [sys.executable, os.path.join(ROOT, "tools", "graftlint.py"),
+           "--root", str(tmp_path), "--scan-roots", "jit_rewrap.py",
+           "--passes", "jit-hygiene", "--baseline", str(bl)]
+    p = subprocess.run(cli + ["--baseline-update", "--justification",
+                              "fixture: version workflow"],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stdout + p.stderr
+    data = json.loads(bl.read_text())
+    (fp, entry), = data["entries"].items()
+    assert entry["pass_version"] == PASSES_BY_NAME["jit-hygiene"].version
+    # clean while the stamp matches
+    p = subprocess.run(cli, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stdout + p.stderr
+    # "rewrite" the pass: fake an older stamp
+    entry["pass_version"] = 0
+    bl.write_text(json.dumps(data))
+    p = subprocess.run(cli, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "call-wrap" in p.stdout      # the finding is live again
+    assert "stale" in p.stdout          # and the orphan entry reported
+    # --baseline-update re-stamps (fresh justification required: the
+    # old entry was judged against the OLD pass semantics)
+    p = subprocess.run(cli + ["--baseline-update", "--justification",
+                              "re-judged against v-next"],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stdout + p.stderr
+    data = json.loads(bl.read_text())
+    (fp2, entry2), = data["entries"].items()
+    assert fp2 == fp
+    assert entry2["pass_version"] == \
+        PASSES_BY_NAME["jit-hygiene"].version
+    assert entry2["justification"] == "re-judged against v-next"
+
+
+def test_baseline_update_reports_per_pass_counts(tmp_path):
+    shutil.copy(os.path.join(FIX, "historical", "jit_rewrap.py"),
+                tmp_path / "jit_rewrap.py")
+    shutil.copy(os.path.join(FIX, "historical", "rest_sleep.py"),
+                tmp_path / "rest_sleep.py")
+    bl = tmp_path / "baseline.json"
+    cli = [sys.executable, os.path.join(ROOT, "tools", "graftlint.py"),
+           "--root", str(tmp_path),
+           "--scan-roots", "jit_rewrap.py,rest_sleep.py",
+           "--passes", "jit-hygiene,async-blocking",
+           "--baseline", str(bl)]
+    p = subprocess.run(cli + ["--baseline-update", "--justification",
+                              "fixture: per-pass counts"],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "jit-hygiene" in p.stdout and "+1" in p.stdout
+    assert "async-blocking" in p.stdout
+    # fix one family → its entries prune, the other's are kept — one
+    # run reports both movements
+    (tmp_path / "rest_sleep.py").write_text("async def ok():\n    pass\n")
+    p = subprocess.run(cli + ["--baseline-update"],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "async-blocking" in p.stdout and "−1" in p.stdout, p.stdout
+    assert "=1 kept" in p.stdout, p.stdout
+
+
+# -- --changed and --format sarif --------------------------------------------
+
+
+def _git(cwd, *args):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   capture_output=True, text=True, timeout=60)
+
+
+def test_changed_mode_lints_only_touched_files(tmp_path):
+    repo = tmp_path / "repo"
+    os.makedirs(repo)
+    _git(repo, "init", "-q")
+    _git(repo, "config", "user.email", "t@t")
+    _git(repo, "config", "user.name", "t")
+    clean = "def fine():\n    return 1\n"
+    (repo / "a.py").write_text(clean)
+    # b.py carries a committed violation — untouched, so --changed
+    # must NOT report it
+    shutil.copy(os.path.join(FIX, "historical", "jit_rewrap.py"),
+                repo / "b.py")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    cli = [sys.executable, os.path.join(ROOT, "tools", "graftlint.py"),
+           "--root", str(repo), "--scan-roots", "a.py,b.py",
+           "--baseline", str(repo / "bl.json"), "--changed"]
+    p = subprocess.run(cli, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "no touched python files" in p.stdout
+    # touch a.py with a violation → --changed reports it, still not b's
+    (repo / "a.py").write_text(
+        "import time\n\nasync def poll():\n    time.sleep(1)\n")
+    p = subprocess.run(cli, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "blocking-sleep" in p.stdout
+    assert "b.py" not in p.stdout
+    # an entry for the UNTOUCHED b.py must not report stale in
+    # --changed mode (the subset can't see it)
+    full = [sys.executable, os.path.join(ROOT, "tools", "graftlint.py"),
+            "--root", str(repo), "--scan-roots", "a.py,b.py",
+            "--baseline", str(repo / "bl.json")]
+    p = subprocess.run(full + ["--baseline-update", "--justification",
+                               "fixture: changed-mode"],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stdout + p.stderr
+    p = subprocess.run(cli, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "stale" not in p.stdout
+
+
+def test_sarif_output(tmp_path):
+    shutil.copy(os.path.join(FIX, "historical", "health_deadlock.py"),
+                tmp_path / "health_deadlock.py")
+    cli = [sys.executable, os.path.join(ROOT, "tools", "graftlint.py"),
+           "--root", str(tmp_path),
+           "--scan-roots", "health_deadlock.py",
+           "--passes", "lock-order",
+           "--baseline", str(tmp_path / "bl.json"),
+           "--format", "sarif"]
+    p = subprocess.run(cli, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 1, p.stdout + p.stderr
+    doc = json.loads(p.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    res, = run["results"]
+    assert res["ruleId"] == "lock-order/callback-under-lock"
+    assert res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "health_deadlock.py"
+    assert loc["region"]["startLine"] > 1
+    assert res["partialFingerprints"]["graftlint/v1"]
+    # baselined → suppressed note, exit 0
+    p2 = subprocess.run(
+        cli[:-2] + ["--baseline-update", "--justification",
+                    "fixture: sarif suppression"],
+        capture_output=True, text=True, timeout=60)
+    assert p2.returncode == 0, p2.stdout + p2.stderr
+    p3 = subprocess.run(cli, capture_output=True, text=True, timeout=60)
+    assert p3.returncode == 0, p3.stdout + p3.stderr
+    doc = json.loads(p3.stdout)
+    res, = doc["runs"][0]["results"]
+    assert res["level"] == "note"
+    assert res["suppressions"][0]["kind"] == "external"
+
+
+def test_repo_changed_and_sarif_are_clean():
+    """The run_suite wiring: --changed and --format sarif both succeed
+    against the repo itself (sarif exit 0 = every finding baselined)."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "graftlint.py"),
+         "--changed"], capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "graftlint.py"),
+         "--format", "sarif"], capture_output=True, text=True,
+        timeout=180)
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout)
+    assert all(r["level"] == "note"
+               for r in doc["runs"][0]["results"])
 
 
 # -- knobs.md stays in sync with the tree ------------------------------------

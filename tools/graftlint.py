@@ -3,9 +3,17 @@
 
     python tools/graftlint.py                 # all passes, text report
     python tools/graftlint.py --json          # machine-readable
+    python tools/graftlint.py --format sarif  # CI diff annotations
+    python tools/graftlint.py --changed       # git-diff-touched files
     python tools/graftlint.py --passes jit-hygiene,host-sync
     python tools/graftlint.py --baseline-update --justification "..."
     python tools/graftlint.py --write-knobs   # regenerate doc/knobs.md
+
+``--changed`` lints only the files `git` reports as touched (working
+tree vs HEAD, plus untracked) — the pre-push loop, <1 s.  Cross-file
+passes (registry-sync, supervision-coverage) need the whole tree and
+are skipped there unless named explicitly; baseline staleness is not
+checked (entries for untouched files would all look stale).
 
 Exit status: 0 clean (every finding baselined WITH a justification, no
 stale entries), 1 findings / stale or unjustified baseline entries,
@@ -16,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -23,11 +32,57 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from lightning_tpu.analysis import (  # noqa: E402
     ALL_PASSES, DEFAULT_BASELINE, PASSES_BY_NAME, Config, Engine,
-    baseline as B, REPO_ROOT)
+    baseline as B, REPO_ROOT, pass_versions)
 from lightning_tpu.analysis.passes.registry_sync import (  # noqa: E402
     RegistrySyncPass)
 from lightning_tpu.analysis.report import (  # noqa: E402
-    json_report, text_report)
+    json_report, sarif_report, text_report)
+
+# whole-tree passes: meaningless on a file subset
+CROSS_FILE_PASSES = ("registry-sync", "supervision-coverage")
+
+
+def _changed_files(root: str) -> list[str] | None:
+    """Root-relative .py files touched vs HEAD (staged, unstaged, and
+    untracked).  None when git is unusable (not a repo, no HEAD).
+    Porcelain paths are relative to the git TOPLEVEL, not to ``root``
+    — when root is a subdirectory of a larger checkout, joining them
+    onto root would silently match nothing and report a falsely clean
+    tree, so resolve against the toplevel and re-relativize."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        p = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=all"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if p.returncode != 0 or top.returncode != 0:
+        return None
+    toplevel = top.stdout.strip()
+    root_abs = os.path.realpath(root)
+    out = []
+    for line in p.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].split(" -> ")[-1].strip()
+        if path.startswith('"'):
+            # porcelain C-quotes non-ASCII/space paths; decode rather
+            # than silently dropping the file from the lint set
+            try:
+                path = path[1:-1].encode().decode("unicode_escape")
+            except UnicodeDecodeError:
+                continue
+        if not path.endswith(".py"):
+            continue
+        abspath = os.path.realpath(os.path.join(toplevel, path))
+        if not os.path.exists(abspath):
+            continue
+        rel = os.path.relpath(abspath, root_abs)
+        if not rel.startswith(".."):
+            out.append(rel)
+    return sorted(set(out))
 
 
 def main(argv=None) -> int:
@@ -35,15 +90,25 @@ def main(argv=None) -> int:
         prog="graftlint", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--json", action="store_true",
-                    help="emit machine-readable findings")
+                    help="emit machine-readable findings "
+                         "(= --format json)")
+    ap.add_argument("--format", dest="fmt", default=None,
+                    choices=("text", "json", "sarif"),
+                    help="report format (default text)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only git-touched files (fast pre-push "
+                         "loop; skips cross-file passes and the "
+                         "staleness sweep)")
     ap.add_argument("--passes", default=None,
                     help="comma-separated pass names (default: all)")
     ap.add_argument("--list-passes", action="store_true")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline store (default {DEFAULT_BASELINE})")
     ap.add_argument("--baseline-update", action="store_true",
-                    help="refresh fingerprints: drop stale entries, add "
-                         "new findings (requires --justification)")
+                    help="refresh fingerprints: drop stale entries "
+                         "across every pass run, add new findings "
+                         "(requires --justification), report per-pass "
+                         "counts")
     ap.add_argument("--justification", default="",
                     help="justification recorded for entries added by "
                          "--baseline-update")
@@ -57,10 +122,11 @@ def main(argv=None) -> int:
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also list baselined findings")
     args = ap.parse_args(argv)
+    fmt = args.fmt or ("json" if args.json else "text")
 
     if args.list_passes:
         for cls in ALL_PASSES:
-            print(f"{cls.name:16s} {cls.description}")
+            print(f"{cls.name:22s} v{cls.version}  {cls.description}")
         return 0
 
     names = tuple(n.strip() for n in args.passes.split(",")
@@ -79,6 +145,30 @@ def main(argv=None) -> int:
         # explicit roots mean "lint these wherever they are": widen
         # every pass's scope to the whole scanned set
         cfg.scopes = {n: ("",) for n in PASSES_BY_NAME}
+
+    if args.changed:
+        if args.baseline_update or args.write_knobs:
+            print("--changed is a read-only subset lint; run the full "
+                  "tree for --baseline-update/--write-knobs",
+                  file=sys.stderr)
+            return 2
+        files = _changed_files(cfg.root)
+        if files is None:
+            print("graftlint --changed: git unavailable; falling back "
+                  "to the full tree", file=sys.stderr)
+        else:
+            scan = [f for f in files
+                    if any(f == r or f.startswith(r.rstrip("/") + "/")
+                           for r in cfg.scan_roots)]
+            if not scan:
+                print("graftlint --changed: no touched python files "
+                      "under " + ",".join(cfg.scan_roots))
+                return 0
+            cfg.scan_roots = tuple(scan)
+            if args.passes is None:
+                names = tuple(n for n in names
+                              if n not in CROSS_FILE_PASSES)
+
     bpath = args.baseline or os.path.join(cfg.root, DEFAULT_BASELINE)
 
     if args.write_knobs:
@@ -96,21 +186,35 @@ def main(argv=None) -> int:
     passes = [PASSES_BY_NAME[n]() for n in names]
     result = Engine(passes, cfg).run()
     data = B.load(bpath)
-    B.apply(result, data, names)
+    versions = pass_versions(names)
+    B.apply(result, data, versions, check_stale=not args.changed)
 
     if args.baseline_update:
         try:
-            added, removed = B.update(data, result, args.justification)
+            per_pass = B.update(data, result, args.justification,
+                                versions)
         except ValueError as e:
             print(str(e), file=sys.stderr)
             return 2
         B.save(bpath, data)
+        added = sum(c["added"] for c in per_pass.values())
+        removed = sum(c["removed"] for c in per_pass.values())
         print(f"baseline updated: +{added} −{removed} "
               f"({os.path.relpath(bpath, cfg.root)})")
+        for name in names:
+            c = per_pass.get(name)
+            if c is None or not any(c.values()):
+                continue
+            print(f"  {name:22s} +{c['added']} −{c['removed']} "
+                  f"={c['kept']} kept")
         return 0
 
-    print(json_report(result) if args.json
-          else text_report(result, verbose=args.verbose))
+    if fmt == "sarif":
+        print(sarif_report(result, passes))
+    elif fmt == "json":
+        print(json_report(result))
+    else:
+        print(text_report(result, verbose=args.verbose))
     return 0 if result.clean else 1
 
 

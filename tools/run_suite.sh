@@ -80,16 +80,32 @@ echo "trace-export schema pass"
 timeout 300 python tools/trace_export.py --selfcheck \
   || { echo "trace-export selfcheck failed"; exit 1; }
 
-# Static-analysis pass (doc/static_analysis.md): graftlint runs all six
-# passes — input-contract asserts, span/label cardinality, jit hygiene,
-# host-sync leaks in kernel builders, guarded-by lock discipline, and
-# the env-knob/metric registry cross-check — and fails on any finding
-# not baselined with a justification.  Subsumes the old standalone
-# lint_asserts/lint_spans scripts (still available as shims).  Stdlib
-# only, no jax import: the 300 s budget is pure headroom.
+# Static-analysis pass (doc/static_analysis.md): graftlint runs all
+# ten passes — input-contract asserts, span/label cardinality, jit
+# hygiene, host-sync leaks in kernel builders, guarded-by lock
+# discipline, lock-order deadlock topology + callback-under-lock,
+# async-blocking on the event loop, supervision-coverage of every jit
+# dispatch, x64/msat staging discipline, and the env-knob/metric
+# registry cross-check — and fails on any finding not baselined with a
+# justification.  Subsumes the old standalone lint_asserts/lint_spans
+# scripts (still available as shims).  Stdlib only, no jax import: the
+# 300 s budget is pure headroom.  The FULL run is the gate; --changed
+# (the <1 s pre-push subset) and --format sarif (the CI diff-annotation
+# artifact) are exercised after it so their plumbing can't rot.
 echo "graftlint static-analysis pass"
 timeout 300 python tools/graftlint.py \
   || { echo "graftlint failed"; exit 1; }
+timeout 120 python tools/graftlint.py --changed \
+  || { echo "graftlint --changed failed"; exit 1; }
+SARIF_OUT=$(mktemp -t graftlint.XXXXXX.sarif)
+timeout 300 python tools/graftlint.py --format sarif > "$SARIF_OUT" \
+  || { echo "graftlint sarif failed"; exit 1; }
+SARIF_OUT="$SARIF_OUT" python - <<'PYEOF' || { echo "graftlint sarif schema check failed"; exit 1; }
+import json, os
+doc = json.load(open(os.environ["SARIF_OUT"]))
+assert doc["version"] == "2.1.0" and doc["runs"][0]["tool"]["driver"]["name"] == "graftlint"
+PYEOF
+rm -f "$SARIF_OUT"
 
 # Perf-smoke pass (doc/perf.md): the attribution model is driven with
 # a synthetic workload whose dispatch stage is deliberately inflated
