@@ -67,6 +67,10 @@ UNIT = "sig_verifies_per_sec"
 # single-query host dijkstra over the same synth gossmap
 ROUTE_METRIC = "getroute_batched_throughput"
 ROUTE_UNIT = "routes_per_sec"
+# `bench.py mcf` workload: batched device min-cost-flow MPP solves vs
+# the serial host mcf.getroutes oracle (doc/routing.md §MCF/MPP)
+MCF_METRIC = "mcf_batched_throughput"
+MCF_UNIT = "solves_per_sec"
 LAST_TPU_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_last_tpu.json")
 # Every emitted record also appends to this JSONL trajectory (schema-
@@ -294,12 +298,12 @@ def check_bench_line(line: dict) -> list[str]:
     """Return the list of schema violations in one emitted bench record
     (empty = ok).  Error/watchdog lines (an `error` key) only promise
     metric/value/unit and are exempt from the measurement contract.
-    `route` workload records carry their own key set: the baseline is
-    the measured single-query host rate, not BASELINE_CPU_OPS."""
+    `route`/`mcf` workload records carry their own key set: the
+    baseline is the measured serial host rate, not BASELINE_CPU_OPS."""
     if "error" in line:
         return [f"error line missing key: {k}" for k in
                 ("metric", "value", "unit") if k not in line]
-    if line.get("metric") == ROUTE_METRIC:
+    if line.get("metric") in (ROUTE_METRIC, MCF_METRIC):
         problems = [f"missing/empty key: {k}" for k in ROUTE_REQUIRED_KEYS
                     if line.get(k) in (None, "")]
         v, hb, sp = (line.get("value"), line.get("host_baseline_rps"),
@@ -344,6 +348,12 @@ def run_selfcheck(paths: list[str]) -> int:
         probs = check_bench_line(line)
         tag = "hypothetical cpu-fallback line"
         print(f"{tag}: " + ("ok" if not probs else "; ".join(probs)))
+        rc |= bool(probs)
+        mline = compose_mcf_line(12.5, "cpu", batch=8, n_channels=2000,
+                                 host_rps=20.0)
+        probs = check_bench_line(mline)
+        print("hypothetical mcf line: "
+              + ("ok" if not probs else "; ".join(probs)))
         rc |= bool(probs)
         entry = {"v": HISTORY_VERSION,
                  "appended_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -739,6 +749,108 @@ def run_route_bench(platform: str) -> dict:
     return out
 
 
+def compose_mcf_line(sps: float, platform: str, *, batch: int,
+                     n_channels: int, host_rps: float,
+                     extra: dict | None = None) -> dict:
+    """Emitted record for the `mcf` workload — the route-record key
+    contract (check_bench_line validates both against the same set):
+    always a LIVE measurement, host baseline = serial mcf.getroutes."""
+    label = platform if platform not in ("cpu",) else "cpu-fallback"
+    line = {"metric": MCF_METRIC, "unit": MCF_UNIT,
+            "value": round(sps, 1), "platform": label,
+            "measurement": "live",
+            "measured_at": time.strftime("%Y-%m-%d"),
+            "batch": batch, "n_channels": n_channels,
+            "host_baseline_rps": round(host_rps, 2),
+            "speedup_vs_host": round(sps / host_rps, 3) if host_rps
+            else 0.0}
+    line.update(extra or {})
+    return line
+
+
+def run_mcf_bench(platform: str) -> dict:
+    """`bench.py mcf`: batched device min-cost-flow (MPP getroutes)
+    throughput over a synth gossmap vs the serial host solver baseline.
+
+    Env knobs: BENCH_MCF_CHANNELS (default 2000), BENCH_MCF_BATCH
+    (device query bucket, default 8), BENCH_MCF_BATCHES (timed device
+    dispatches, default 2), BENCH_MCF_HOST_QUERIES (baseline sample,
+    default 8)."""
+    import numpy as np
+
+    from lightning_tpu.gossip import gossmap as GM
+    from lightning_tpu.gossip import store as gstore
+    from lightning_tpu.gossip import synth
+    from lightning_tpu.routing import mcf as MCF
+    from lightning_tpu.routing import mcf_device as MD
+
+    n_channels = int(os.environ.get("BENCH_MCF_CHANNELS", "2000"))
+    batch = int(os.environ.get("BENCH_MCF_BATCH", "8"))
+    n_batches = int(os.environ.get("BENCH_MCF_BATCHES", "2"))
+    n_host = int(os.environ.get("BENCH_MCF_HOST_QUERIES", "8"))
+
+    path = os.path.join(tempfile.gettempdir(),
+                        f"bench_mcf_{n_channels}.gs")
+    if not os.path.exists(path):
+        tmp = path + f".tmp.{os.getpid()}"
+        synth.make_network_store(
+            tmp, n_channels=n_channels, n_nodes=max(2, n_channels // 8),
+            updates_per_channel=2, sign=False)
+        os.replace(tmp, path)
+    g = GM.from_store(gstore.load_store(path))
+
+    rng = np.random.default_rng(13)
+    # amounts big enough that some queries genuinely split (MPP), small
+    # enough that most are routable — the realistic xpay mix
+    queries = []
+    for _ in range(batch * (n_batches + 1)):
+        a, b = rng.integers(0, g.n_nodes, 2)
+        if a == b:
+            b = (b + 1) % g.n_nodes
+        queries.append(MD.McfQuery(
+            bytes(g.node_ids[a]), bytes(g.node_ids[b]),
+            int(rng.integers(100_000, 50_000_000)), max_parts=8))
+
+    # host baseline: the serial per-payment solver this engine batches
+    t0 = time.perf_counter()
+    host_done = 0
+    for q in queries[:n_host]:
+        try:
+            MCF.getroutes(g, q.source, q.destination, q.amount_msat,
+                          max_parts=q.max_parts)
+        except MCF.McfError:
+            pass
+        host_done += 1
+    host_rps = host_done / (time.perf_counter() - t0)
+
+    planes = MD.McfPlanes.build(g)
+    MD.solve_mcf_batch(planes, queries[:batch], batch=batch)  # warm
+    t0 = time.perf_counter()
+    solved = fellback = 0
+    for i in range(1, n_batches + 1):
+        res = MD.solve_mcf_batch(planes,
+                                 queries[i * batch:(i + 1) * batch],
+                                 batch=batch)
+        # honest headline: only lanes the device ANSWERED (routes or
+        # provably unroutable); fallback lanes need a host re-solve
+        solved += sum(1 for r in res if r[0] in ("ok", "mcferr"))
+        fellback += sum(1 for r in res if r[0] not in ("ok", "mcferr"))
+    dt = time.perf_counter() - t0
+    sps = solved / dt
+    out = {"sps": sps, "host_rps": host_rps, "batch": batch,
+           "n_channels": n_channels, "n_nodes": g.n_nodes,
+           "queries": solved, "fallbacks": fellback, "seconds": dt,
+           "planes": {"n_pad": planes.n_pad,
+                      "a_fwd_pad": planes.a_fwd_pad}}
+    if platform not in ("cpu",):
+        record_tpu_measurement({"mcf": {
+            "solves_per_sec": round(sps, 1),
+            "host_baseline_rps": round(host_rps, 2),
+            "batch": batch, "n_channels": n_channels,
+            "date": time.strftime("%Y-%m-%d")}})
+    return out
+
+
 def run_sweep(platform: str) -> None:
     """Manual mode (`bench.py --sweep`): kernel-only throughput for each
     dual-mul implementation × bucket, printed as a table.  Used to pick
@@ -793,6 +905,8 @@ def main():
     if "route" in sys.argv[1:]:
         # scope error/watchdog lines to the workload being measured
         _ACTIVE.update(metric=ROUTE_METRIC, unit=ROUTE_UNIT)
+    elif "mcf" in sys.argv[1:]:
+        _ACTIVE.update(metric=MCF_METRIC, unit=MCF_UNIT)
 
     t_start = time.monotonic()
     deadline = float(os.environ.get("BENCH_DEADLINE", "2400"))
@@ -827,6 +941,19 @@ def main():
                        "planes": r["planes"]})
             append_history(rline)
             print(json.dumps(rline), flush=True)
+            return
+        if "mcf" in sys.argv[1:]:
+            r = run_mcf_bench(platform)
+            guard.cancel()
+            mline = compose_mcf_line(
+                r["sps"], platform, batch=r["batch"],
+                n_channels=r["n_channels"], host_rps=r["host_rps"],
+                extra={"n_nodes": r["n_nodes"], "queries": r["queries"],
+                       "fallbacks": r["fallbacks"],
+                       "seconds": round(r["seconds"], 3),
+                       "planes": r["planes"]})
+            append_history(mline)
+            print(json.dumps(mline), flush=True)
             return
         # --metrics: bracket the run with obs snapshots and embed the
         # diff, so an offline bench round reports through the SAME
@@ -875,7 +1002,8 @@ def main():
                 try:
                     child = subprocess.run(
                         [sys.executable, os.path.abspath(__file__)]
-                        + (["route"] if "route" in sys.argv[1:] else []),
+                        + (["route"] if "route" in sys.argv[1:] else
+                           ["mcf"] if "mcf" in sys.argv[1:] else []),
                         env=dict(os.environ, BENCH_FORCE_CPU="1",
                                  BENCH_DEADLINE=str(int(remaining))),
                         capture_output=True, text=True, timeout=remaining,

@@ -155,6 +155,29 @@ async def amain(args) -> int:
 
         router._warmup_task.add_done_callback(_route_warmup_done)
 
+    # batching min-cost-flow payment engine: concurrent getroutes/xpay
+    # MPP queries coalesce into vmapped device dispatches
+    # (routing/mcf_device.py); the host solver in routing/mcf.py stays
+    # the bit-identical fallback for anything the planes can't express
+    from ..routing.mcf_device import McfService
+
+    mcf_service = McfService(lambda: gossmap_ref.get("map"),
+                             device=False if args.cpu else None)
+    mcf_service.start()
+    if gossmap_ref["map"] is not None and not args.cpu:
+        # same off-the-live-path pre-compile contract as the route
+        # warmup above; anchored so GC cannot drop the task mid-await
+        mcf_service._warmup_task = asyncio.get_running_loop().create_task(
+            mcf_service.warmup())
+
+        def _mcf_warmup_done(t):
+            if not t.cancelled() and t.exception() is not None:
+                print(f"mcf warmup failed: {t.exception()!r} (first "
+                      "batched getroutes will pay the cold compile)",
+                      file=sys.stderr, flush=True)
+
+        mcf_service._warmup_task.add_done_callback(_mcf_warmup_done)
+
     # live gossipd: ingest from peers, serve BOLT#7 queries, stream out
     # (gossip_init, lightningd.c:1375 — previously only tests wired this)
     gossipd = None
@@ -257,7 +280,8 @@ async def amain(args) -> int:
             chain_backend=chain_backend, topology=topology,
             invoices=invoices, relay=relay_svc,
             htlc_sets=HtlcSets(invoices), gossmap_ref=gossmap_ref,
-            funder_policy=funder_policy, gossipd=gossipd, router=router)
+            funder_policy=funder_policy, gossipd=gossipd, router=router,
+            mcf=mcf_service)
         restored = await manager.restore_all()
         if restored:
             print(f"restored {restored} live channel(s)", flush=True)
@@ -298,7 +322,7 @@ async def amain(args) -> int:
 
         from ..routing.mcf import attach_routing_commands
 
-        attach_routing_commands(rpc, gossmap_ref)
+        attach_routing_commands(rpc, gossmap_ref, service=mcf_service)
 
         from ..plugins.bookkeeper import (Bookkeeper,
                                           attach_bookkeeper_commands)
@@ -550,6 +574,7 @@ async def amain(args) -> int:
     if gossipd is not None:
         await gossipd.close()
     await router.close()
+    await mcf_service.close()
     if topology is not None:
         await topology.stop()
     await node.close()

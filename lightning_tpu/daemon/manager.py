@@ -57,7 +57,8 @@ class ChannelManager:
     def __init__(self, node, hsm, wallet=None, onchain=None,
                  chain_backend=None, topology=None, invoices=None,
                  relay=None, htlc_sets=None, gossmap_ref=None,
-                 funder_policy=None, gossipd=None, router=None):
+                 funder_policy=None, gossipd=None, router=None,
+                 mcf=None):
         self.node = node
         self.hsm = hsm
         self.wallet = wallet
@@ -71,6 +72,11 @@ class ChannelManager:
         self.funder_policy = funder_policy
         self.gossipd = gossipd   # own-channel gossip origination
         self.router = router     # batching RouteService (routing.device)
+        self.mcf = mcf           # batching McfService (routing.mcf_device)
+        # GC anchors for xpay engine runs that outlived their RPC's
+        # retry_for (shielded: cancelling mid-commitment-dance would
+        # desync the channel; each task settles its own wallet row)
+        self._xpay_tasks: set = set()
         # channel_id -> (Channeld, loop task)
         self.channels: dict[bytes, tuple] = {}
         # peer_id -> Channeld awaiting fundchannel_complete
@@ -1434,6 +1440,110 @@ class ChannelManager:
             "status": "complete",
         }
 
+    async def xpay(self, invstring: str,
+                   amount_msat: int | None = None,
+                   timeout: float = 60.0,
+                   maxfee_msat: int | None = None) -> dict:
+        """The real MPP engine (pay/xpay.py): min-cost-flow parts over
+        one entry channel, batched through the attached McfService so
+        concurrent payers share one device dispatch.  Entry candidates
+        (payee-direct first, then every graph-known peer — xpay.c's
+        source is always a direct peer) are tried in turn on a no-route
+        answer, matching ``pay``'s all-candidate route search.  Falls
+        back to single-path ``pay`` for setups the engine cannot serve
+        — no candidate channel, or an invoice without the MPP
+        payment_secret — BEFORE any part is offered (no double
+        wallet-recording)."""
+        from ..pay import xpay as XP
+
+        inv = B11.decode(invstring)
+        if inv.amount_msat is not None and amount_msat is not None \
+                and amount_msat != inv.amount_msat:
+            raise ManagerError("amount_msat conflicts with invoice")
+        g = self.gossmap_ref.get("map")
+        payee_in_graph = False
+        if g is not None:
+            try:
+                g.node_index(inv.payee)
+                payee_in_graph = True
+            except KeyError:
+                pass
+        candidates = [cand for cand, _t in self.channels.values()
+                      if cand.peer.node_id == inv.payee]
+        # routed entries only help when the solver can actually reach
+        # the payee; a graph-unknown destination (new node, unannounced
+        # channels only) must fall back to pay's clean no-route answer,
+        # not surface the solver's KeyError
+        if payee_in_graph:
+            for cand, _t in self.channels.values():
+                if cand.peer.node_id == inv.payee:
+                    continue
+                try:
+                    g.node_index(cand.peer.node_id)
+                except KeyError:
+                    continue
+                candidates.append(cand)
+        if not candidates or inv.payment_secret is None:
+            return await self.pay(invstring, amount_msat=amount_msat,
+                                  timeout=timeout,
+                                  maxfee_msat=maxfee_msat)
+        blockheight = self.topology.height \
+            if self.topology is not None and self.topology.height > 0 \
+            else 0
+        deadline = time.monotonic() + timeout
+        last_no_route: ManagerError | None = None
+        for ch in candidates:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                break
+            # a reconnect can replace the Channeld under the same
+            # channel_id while an earlier candidate was being tried —
+            # never offer HTLCs on a superseded snapshot (manager.pay's
+            # identity guard, `is` on purpose)
+            if self.channels.get(ch.channel_id,
+                                 (None, None))[0] is not ch:
+                continue
+            # the engine drives the commitment dance directly, so it
+            # must NEVER be cancelled mid-payment (an abort between
+            # offer and revoke desyncs our commitment view from the
+            # peer's): shield the task — on timeout it keeps running
+            # to completion and settles/fails the wallet row itself
+            task = asyncio.get_running_loop().create_task(
+                XP.xpay(ch, invstring, g, amount_msat=amount_msat,
+                        maxfee_msat=maxfee_msat,
+                        blockheight=blockheight, wallet=self.wallet,
+                        mcf_service=self.mcf, inv=inv))
+            self._xpay_tasks.add(task)
+            task.add_done_callback(self._xpay_tasks.discard)
+            try:
+                res = await asyncio.wait_for(asyncio.shield(task),
+                                             budget)
+            except asyncio.TimeoutError:
+                # outcome genuinely unknown (a preimage may yet
+                # arrive): the row stays pending until the shielded
+                # task resolves it; observe its eventual exception
+                task.add_done_callback(
+                    lambda t: t.cancelled() or t.exception())
+                raise ManagerError(
+                    f"xpay timed out after {timeout:g}s; "
+                    "payment may still complete (listpays to check)")
+            except XP.PayError as e:
+                if getattr(e, "code", None) == 205:
+                    # no route from THIS entry channel; try the next
+                    last_no_route = ManagerError(str(e))
+                    continue
+                raise ManagerError(str(e))
+            except KeyError as e:
+                # residual race: the live map was swapped between the
+                # screening above and the solve
+                last_no_route = ManagerError(f"no route: {e}")
+                continue
+            return res.to_rpc()
+        if last_no_route is not None:
+            raise last_no_route
+        # timeout<=0 before any attempt: nothing was ever in flight
+        raise ManagerError(f"xpay timed out after {timeout:g}s")
+
     async def keysend(self, dest: bytes, amount_msat: int,
                       timeout: float = 60.0) -> dict:
         """Spontaneous payment: the preimage rides the onion
@@ -1583,13 +1693,17 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
                              maxfeepercent=maxfeepercent)
 
     async def xpay(invstring: str, amount_msat=None,
-                   retry_for: int = 60) -> dict:
-        # the dedicated MCF/MPP engine needs per-part onions; until the
-        # manager grows multi-channel parts, xpay == pay single-path
-        return await mgr.pay(invstring,
-                             amount_msat=(int(amount_msat)
-                                          if amount_msat else None),
-                             timeout=float(retry_for))
+                   retry_for: int = 60, maxfee=None) -> dict:
+        # the dedicated MCF/MPP engine: min-cost-flow parts batched
+        # through the mcf dispatch family (manager.xpay falls back to
+        # the single-path pay for setups the engine can't serve)
+        return await mgr.xpay(invstring,
+                              amount_msat=(int(amount_msat)
+                                           if amount_msat else None),
+                              timeout=float(retry_for),
+                              maxfee_msat=(int(maxfee)
+                                           if maxfee is not None
+                                           else None))
 
     async def sendpay(route: list, payment_hash: str,
                       payment_secret: str | None = None,
@@ -1688,7 +1802,14 @@ def attach_manager_commands(rpc, mgr: ChannelManager) -> None:
                     "route fee (destination is not a direct peer)")
             from ..routing import mcf as MCF
 
-            est = MCF.getroutes(g, mgr.node.node_id, dec.payee, total)
+            if mgr.mcf is not None:
+                # coalesce with concurrent payers' solves (one batched
+                # device dispatch; host oracle fallback inside)
+                est = await mgr.mcf.getroutes(mgr.node.node_id,
+                                              dec.payee, total)
+            else:
+                est = MCF.getroutes(g, mgr.node.node_id, dec.payee,
+                                    total)
             fee_est = est["fee_msat"]
         deliver = total - fee_est
         if deliver <= 0:

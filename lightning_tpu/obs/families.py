@@ -55,6 +55,38 @@ ROUTE_ANSWER_SECONDS = REGISTRY.histogram(
     "TRY_AGAIN rejections excluded)",
     buckets=DURATION_BUCKETS)
 
+# -- routing/mcf_device.py: the batched min-cost-flow payment engine -------
+# (doc/routing.md §MCF/MPP; the askrene-parity MPP solver's dispatch
+# family — declared here so jax-free consumers see the series at zero.)
+MCF_FLUSH_SECONDS = REGISTRY.histogram(
+    "clntpu_mcf_flush_seconds",
+    "End-to-end wall time of one mcf flush (lane prep + batched solve + "
+    "flow decomposition, device and host paths together)",
+    buckets=DURATION_BUCKETS)
+MCF_BATCH_QUERIES = REGISTRY.histogram(
+    "clntpu_mcf_batch_queries",
+    "getroutes/xpay queries coalesced per mcf flush", buckets=SIZE_BUCKETS)
+MCF_OCCUPANCY = REGISTRY.histogram(
+    "clntpu_mcf_batch_occupancy_ratio",
+    "Real mcf queries / padded device lanes per dispatch",
+    buckets=RATIO_BUCKETS)
+MCF_QUERIES = REGISTRY.counter(
+    "clntpu_mcf_queries_total",
+    "Min-cost-flow queries solved, by execution path and outcome",
+    labelnames=("path", "outcome"))
+MCF_FALLBACK = REGISTRY.counter(
+    "clntpu_mcf_fallback_total",
+    "Queries diverted from the device mcf solver to the host oracle, "
+    "by reason",
+    labelnames=("reason",))
+MCF_QUEUE = REGISTRY.gauge(
+    "clntpu_mcf_queue_queries",
+    "Min-cost-flow queries currently queued awaiting a flush")
+MCF_PARTS = REGISTRY.histogram(
+    "clntpu_mcf_parts_per_query",
+    "Route parts per successfully solved mcf query (MPP split width)",
+    buckets=SIZE_BUCKETS)
+
 # -- daemon/hsmd.py: the batched-sign paths --------------------------------
 SIGN_BATCH_SIGS = REGISTRY.histogram(
     "clntpu_sign_batch_sigs",

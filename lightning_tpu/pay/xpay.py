@@ -33,9 +33,18 @@ async def xpay(ch, invoice_str: str, gossmap, *,
                maxfee_msat: int | None = None,
                layers: mcf.Layers | None = None,
                max_parts: int = 8, retries: int = 2,
-               blockheight: int = 0, wallet=None) -> PayResult:
-    """Pay a BOLT#11 invoice over `ch` using min-cost-flow routing."""
-    inv = B11.decode(invoice_str)
+               blockheight: int = 0, wallet=None,
+               mcf_service=None, inv=None) -> PayResult:
+    """Pay a BOLT#11 invoice over `ch` using min-cost-flow routing.
+
+    ``mcf_service`` is an optional routing.mcf_device.McfService:
+    the per-attempt getroutes then coalesces with every other
+    concurrent payer's into one batched device solve (mcf.getroutes
+    stays the bit-identical host fallback — breaker-open, oversized
+    amounts, inexpressible layers all land there).  ``inv`` lets a
+    caller that already decoded ``invoice_str`` (manager.xpay screens
+    on payee/payment_secret) skip the second signature recovery."""
+    inv = inv if inv is not None else B11.decode(invoice_str)
     amount = inv.amount_msat or amount_msat
     if amount is None:
         raise PayError("invoice has no amount; amount_msat required")
@@ -50,26 +59,38 @@ async def xpay(ch, invoice_str: str, gossmap, *,
     pay_id = _record_payment(wallet, inv, invoice_str, amount, amount,
                              created)
     last_err: PayError | None = None
-    for attempt in range(retries + 1):
-        try:
-            result = await _attempt(ch, inv, gossmap, amount, layers,
-                                    maxfee_msat, max_parts, final_cltv)
-            _settle_payment(wallet, pay_id, result.preimage,
-                            amount_msat=amount,
-                            amount_sent_msat=result.amount_sent_msat,
-                            payment_hash=inv.payment_hash)
-            return result
-        except _PartFailure as pf:
-            last_err = pf.err
-            if pf.erring_scid is not None:
-                layers.disabled.add(pf.erring_scid)
-                log.info("xpay: disabled %s after failure, retrying",
-                         pf.erring_scid)
-            else:
+    try:
+        for attempt in range(retries + 1):
+            try:
+                result = await _attempt(ch, inv, gossmap, amount,
+                                        layers, maxfee_msat, max_parts,
+                                        final_cltv,
+                                        mcf_service=mcf_service)
+                _settle_payment(wallet, pay_id, result.preimage,
+                                amount_msat=amount,
+                                amount_sent_msat=result.amount_sent_msat,
+                                payment_hash=inv.payment_hash)
+                return result
+            except _PartFailure as pf:
+                last_err = pf.err
+                if pf.erring_scid is not None:
+                    layers.disabled.add(pf.erring_scid)
+                    log.info("xpay: disabled %s after failure, "
+                             "retrying", pf.erring_scid)
+                else:
+                    break
+            except mcf.McfError as e:
+                last_err = PayError(f"no route: {e}", code=205)
                 break
-        except mcf.McfError as e:
-            last_err = PayError(f"no route: {e}", code=205)
-            break
+    except Exception as e:
+        # everything else — Overloaded admission (no part was ever
+        # offered; the RPC layer maps the re-raise to TRY_AGAIN),
+        # KeyError for a graph-unknown node, a stopped/failed service,
+        # a protocol error mid-dance — must still resolve the recorded
+        # payment row: a pending-forever phantom in listpays is worse
+        # than a conservatively-failed row
+        _fail_payment(wallet, pay_id, str(e) or repr(e))
+        raise
     _fail_payment(wallet, pay_id, str(last_err))
     raise last_err
 
@@ -82,15 +103,25 @@ class _PartFailure(Exception):
 
 async def _attempt(ch, inv, gossmap, amount: int, layers,
                    maxfee_msat, max_parts: int,
-                   final_cltv: int) -> PayResult:
+                   final_cltv: int, mcf_service=None) -> PayResult:
     if ch.peer.node_id == inv.payee:
         routes = [{"source_amount_msat": amount,
                    "source_delay": final_cltv, "path": [],
                    "amount_msat": amount}]
     else:
-        res = mcf.getroutes(gossmap, ch.peer.node_id, inv.payee, amount,
-                            layers=layers, maxfee_msat=maxfee_msat,
-                            final_cltv=final_cltv, max_parts=max_parts)
+        if mcf_service is not None:
+            # batched device MPP solve: concurrent payers coalesce into
+            # one dispatch; the service owns the host-oracle fallback
+            res = await mcf_service.getroutes(
+                ch.peer.node_id, inv.payee, amount, layers=layers,
+                maxfee_msat=maxfee_msat, final_cltv=final_cltv,
+                max_parts=max_parts)
+        else:
+            res = mcf.getroutes(gossmap, ch.peer.node_id, inv.payee,
+                                amount, layers=layers,
+                                maxfee_msat=maxfee_msat,
+                                final_cltv=final_cltv,
+                                max_parts=max_parts)
         routes = []
         for r in res["routes"]:
             routes.append({
@@ -178,4 +209,5 @@ async def _attempt(ch, inv, gossmap, amount: int, layers,
         raise _PartFailure(*first_failure)
     if preimage is None:
         raise PayError("no part fulfilled and no failure reported")
-    return PayResult(inv.payment_hash, preimage, amount, sent)
+    return PayResult(inv.payment_hash, preimage, amount, sent,
+                     parts=len(routes))

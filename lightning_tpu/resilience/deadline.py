@@ -29,6 +29,7 @@ a default that kills it would break first-run daemons).  Configure::
     LIGHTNING_TPU_DEADLINE_S            default for every family (0 = off)
     LIGHTNING_TPU_DEADLINE_VERIFY_S     per-family override
     LIGHTNING_TPU_DEADLINE_ROUTE_S
+    LIGHTNING_TPU_DEADLINE_MCF_S
     LIGHTNING_TPU_DEADLINE_INGEST_S
 
 (No sign deadline: hsmd's batched sign is a synchronous call on the

@@ -25,6 +25,7 @@ Cost model (renepay mcf.c semantics, re-derived):
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -33,7 +34,30 @@ from ..gossip.gossmap import Gossmap, scid_parse
 from .dijkstra import BLOCKS_PER_YEAR, NoRoute, RouteHop, hop_fee_msat
 
 log = logging.getLogger("lightning_tpu.mcf")
-_warned_rounds = False
+
+
+class _WarnOnce:
+    """Thread-safe once-latch for the MAX_ROUNDS truncation warning.
+    The solver runs from coalesced McfService worker threads as well as
+    inline RPC handlers; a bare check-then-set module global could emit
+    the WARNING from several racing threads (or never latch at all)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fired = False
+
+    def first(self) -> bool:
+        """True exactly once per process (until reset)."""
+        with self._lock:
+            fired, self._fired = self._fired, True
+            return not fired
+
+    def reset(self) -> None:
+        with self._lock:
+            self._fired = False
+
+
+_warned_rounds = _WarnOnce()
 
 NUM_PIECES = 4
 # slopes of the convex piecewise -log((c+1-x)/(c+1)) approximation,
@@ -408,9 +432,7 @@ def _shortest_path(arcs: Arcs, n_nodes: int, src: int, dst: int):
         # exactly this on 1M-channel graphs; don't hide the cap — but
         # warn once (solve() calls this up to 4*max_parts times per
         # payment; a warning per sweep would flood the routing hot loop)
-        global _warned_rounds
-        level = logging.DEBUG if _warned_rounds else logging.WARNING
-        _warned_rounds = True
+        level = logging.WARNING if _warned_rounds.first() else logging.DEBUG
         log.log(level, "bellman-ford hit MAX_ROUNDS=%d before convergence "
                 "(%d nodes, %d arcs): path may be suboptimal",
                 MAX_ROUNDS, n_nodes, len(a_src))
@@ -466,18 +488,27 @@ def solve(g: Gossmap, source: bytes, destination: bytes, amount_msat: int,
     return _decompose(g, arcs, src, dst, amount_msat)
 
 
-def _decompose(g: Gossmap, arcs: Arcs, src: int, dst: int,
-               amount_msat: int):
-    """Net out per channel-direction flow, then peel source→dest paths
-    (renepay flow decomposition)."""
-    # net flow per (chan, dir): forward arcs' consumed residual
+def flow_from_arcs(arcs: Arcs) -> dict:
+    """Net flow per (channel, direction) from a solved residual graph:
+    each forward arc's reverse residual is the flow pushed through it.
+    Insertion order follows ascending arc index — peel_parts tie-breaks
+    depend on it, so the device solver reconstructs the SAME order from
+    its canonical arc layout (routing/mcf_device.py)."""
     flow: dict[tuple[int, int], int] = {}
     fwd = np.arange(0, len(arcs.src), 2)
     used = fwd[arcs.residual[fwd + 1] > 0]   # reverse residual = flow
     for a in used:
         key = (int(arcs.chan[a]), int(arcs.cdir[a]))
         flow[key] = flow.get(key, 0) + int(arcs.residual[a + 1])
+    return flow
 
+
+def peel_parts(g: Gossmap, flow: dict, src: int, dst: int,
+               amount_msat: int):
+    """Peel source→dest paths off a per-(chan,dir) flow map (renepay
+    flow decomposition).  Deterministic given `flow` and its insertion
+    order: the widest-first edge choice breaks ties on list position,
+    i.e. on the order flow_from_arcs inserted the channels."""
     # adjacency from flow edges
     out: dict[int, list] = {}
     for (c, d), f in flow.items():
@@ -509,8 +540,20 @@ def _decompose(g: Gossmap, arcs: Arcs, src: int, dst: int,
     return parts
 
 
-class McfDecompositionError(AssertionError):
-    """Flow conservation violated — a solver bug, not a routing miss."""
+def _decompose(g: Gossmap, arcs: Arcs, src: int, dst: int,
+               amount_msat: int):
+    """Net out per channel-direction flow, then peel source→dest paths
+    (renepay flow decomposition)."""
+    return peel_parts(g, flow_from_arcs(arcs), src, dst, amount_msat)
+
+
+class McfDecompositionError(McfError):
+    """Flow conservation violated — a solver bug, not a routing miss.
+    An McfError (NOT AssertionError): decomposition failures must stay
+    distinguishable from strippable asserts — under ``python -O`` an
+    AssertionError subclass still raises, but anything treating it as
+    an assertion-class invariant would conflate a real conservation bug
+    with debug-only checks (tests/test_zz_mcf_parity.py pins -O)."""
 
     def __init__(self, node: int):
         super().__init__(f"flow stuck at node {node}")
@@ -587,10 +630,16 @@ def _route_rpc(r: dict) -> dict:
 
 
 def attach_routing_commands(rpc, gossmap_ref: dict,
-                            layers: Layers | None = None) -> None:
+                            layers: Layers | None = None,
+                            service=None) -> None:
     """askrene's RPC surface: getroutes + reservation management +
     per-channel bias/disable layers (askrene.c commands, flattened to a
-    single default layer)."""
+    single default layer).
+
+    ``service`` is an optional routing.mcf_device.McfService: getroutes
+    then coalesces into its batched device dispatches (with this host
+    solver as the bit-identical fallback for anything the device
+    universe can't express); None keeps the inline host path."""
     layers = layers if layers is not None else Layers()
     # named layers (askrene-create-layer ...); "" = the default layer
     named: dict[str, Layers] = {"": layers}
@@ -652,6 +701,14 @@ def attach_routing_commands(rpc, gossmap_ref: dict,
         # the parameter shadows the attach-scope default Layers on
         # purpose; _merged closes over the outer one
         use = _merged(layers)
+        _map()         # same no-graph RpcError on every path
+        if service is not None:
+            # batched device engine; admission-control Overloaded
+            # escapes to the RPC layer's TRY_AGAIN mapping
+            return await service.getroutes(
+                bytes.fromhex(source), bytes.fromhex(destination),
+                int(amount_msat), layers=use, maxfee_msat=maxfee_msat,
+                final_cltv=final_cltv, max_parts=max_parts)
         res = getroutes(_map(), bytes.fromhex(source),
                         bytes.fromhex(destination), int(amount_msat),
                         layers=use, maxfee_msat=maxfee_msat,

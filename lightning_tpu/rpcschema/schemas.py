@@ -93,7 +93,7 @@ COMMANDS: dict[str, dict] = {
     },
     "xpay": {
         "params": {"invstring": "str", "amount_msat": "int?",
-                   "retry_for": "int?"},
+                   "retry_for": "int?", "maxfee": "int?"},
         "result": {"payment_preimage": "hex", "payment_hash": "hex",
                    "amount_msat": "msat", "amount_sent_msat": "msat",
                    "status": "str"},

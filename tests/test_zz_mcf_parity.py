@@ -1,0 +1,559 @@
+"""Host/device min-cost-flow parity: the batched successive-shortest-
+paths solver (routing/mcf_device.py) must produce BYTE-IDENTICAL
+route-part sets to the host oracle mcf.getroutes over randomized synth
+gossmaps — part decomposition, reservations, biases, liquidity
+knowledge, disabled scids/nodes, maxfee two-attempt refinement,
+unreachable destinations — and the McfService front-end must coalesce,
+fall back, admit and meter as documented (doc/routing.md §MCF/MPP).
+
+Every graph here keeps 8 * n_channels <= 256 forward arcs and
+n_nodes <= 64, so the whole file compiles the mcf program at EXACTLY
+one quantized shape (n_pad 64, a_fwd_pad 256, batch 4).
+
+Named test_zz_* to sort last (tier-1 wall-clock budget).
+"""
+from __future__ import annotations
+
+import asyncio
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lightning_tpu import obs
+from lightning_tpu.gossip import gossmap, store as gstore, synth
+from lightning_tpu.obs import flight
+from lightning_tpu.resilience import breaker as RB
+from lightning_tpu.routing import mcf
+from lightning_tpu.routing import mcf_device as MD
+
+Q = 4   # one device query bucket for the whole file (one compile)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_breakers():
+    RB.reset_for_tests()
+    yield
+    RB.reset_for_tests()
+
+
+def _counter(snap: dict, name: str, **labels) -> float:
+    fam = snap["metrics"].get(name, {"samples": []})
+    tot = 0.0
+    for s in fam["samples"]:
+        if all(s.get("labels", {}).get(k) == v
+               for k, v in labels.items()):
+            tot += s["value"]
+    return tot
+
+
+def _net(tmp_path, n_channels, n_nodes, seed, name="m"):
+    p = str(tmp_path / f"{name}{n_channels}_{seed}.gs")
+    synth.make_network_store(p, n_channels=n_channels, n_nodes=n_nodes,
+                             updates_per_channel=2, seed=seed,
+                             sign=False)
+    g = gossmap.from_store(gstore.load_store(p))
+    assert g.n_nodes <= 64 and 8 * g.n_channels <= 256, \
+        "test graph exceeds the shared planes shape"
+    return g
+
+
+def _host(g, q: MD.McfQuery):
+    try:
+        return ("ok", mcf.getroutes(
+            g, q.source, q.destination, q.amount_msat, layers=q.layers,
+            maxfee_msat=q.maxfee_msat, final_cltv=q.final_cltv,
+            max_parts=q.max_parts))
+    except mcf.McfError as e:
+        return ("mcferr", str(e))
+
+
+def _assert_parity(g, queries, results, *, require_device=True):
+    """Device results must be byte-identical to the host oracle:
+    same route-part dicts for solved queries, same McfError message
+    for unroutable ones.  A walk_cap fallback is the device detecting
+    the SAME pathological predecessor state the host's cycle guard
+    raises on — on these <=64-node graphs (any simple path fits in
+    WALK_CAP) the host must then be erroring with its cycle McfError,
+    so the service's host re-solve reproduces it exactly.  Other
+    fallback reasons are allowed only when require_device is False."""
+    answered = 0
+    for q, res in zip(queries, results):
+        exp = _host(g, q)
+        if res[0] == "fallback":
+            if res[1] == MD.R_WALK_CAP:
+                assert exp[0] == "mcferr" and "predecessor cycle" \
+                    in exp[1], (res, exp)
+                continue
+            assert not require_device, (res, q.amount_msat)
+            continue
+        answered += 1
+        assert res[0] == exp[0], (res[0], exp)
+        assert res[1] == exp[1], (res[1], exp[1])
+    return answered
+
+
+def _rand_layers(rng, g, t: int) -> mcf.Layers:
+    ly = mcf.Layers()
+    if t % 3 == 0:
+        for s in rng.choice(g.scids, 3, replace=False):
+            ly.disabled.add(int(s))
+    if t % 4 == 1:
+        for s in rng.choice(g.scids, 4, replace=False):
+            ly.biases[int(s)] = float(rng.integers(-500, 2000))
+    if t % 5 == 2:
+        for s in rng.choice(g.scids, 3, replace=False):
+            ly.reserve(int(s), int(rng.integers(0, 2)),
+                       int(rng.integers(1, 200_000)))
+    if t % 7 == 3:
+        for s in rng.choice(g.scids, 2, replace=False):
+            ly.inform(int(s), int(rng.integers(0, 2)),
+                      max_msat=int(rng.integers(0, 100_000)))
+    if t % 11 == 4:
+        nid = bytes(g.node_ids[int(rng.integers(0, g.n_nodes))])
+        ly.node_biases[nid] = float(rng.integers(-200, 800))
+    return ly
+
+
+def test_randomized_corpus_parity(tmp_path):
+    """Randomized graphs x randomized queries x randomized layers:
+    byte-identical getroutes results, including multi-part splits
+    (amounts above any single channel), reservations, biases,
+    knowledge caps, node biases, and the maxfee refine attempt."""
+    for seed in (3, 17):
+        g = _net(tmp_path, 30, 12, seed)
+        planes = MD.McfPlanes.build(g)
+        rng = np.random.default_rng(100 + seed)
+        cap = np.maximum(g.htlc_max_msat[0],
+                         g.htlc_max_msat[1]).astype(np.int64)
+        big = int(cap.max() * 3 // 2)     # forces MPP decomposition
+        queries = []
+        for t in range(16):
+            a, b = rng.integers(0, g.n_nodes, 2)
+            if a == b:
+                b = (b + 1) % g.n_nodes
+            amt = big if t % 6 == 5 else int(
+                rng.integers(1_000, 8_000_000))
+            maxfee = int(rng.integers(0, 20_000)) if t % 4 == 2 else None
+            queries.append(MD.McfQuery(
+                bytes(g.node_ids[a]), bytes(g.node_ids[b]), amt,
+                layers=_rand_layers(rng, g, t), maxfee_msat=maxfee,
+                max_parts=8, final_cltv=int(rng.integers(9, 30))))
+        results = MD.solve_mcf_batch(planes, queries, batch=Q)
+        answered = _assert_parity(g, queries, results)
+        assert answered >= len(queries) - 2
+        # at least one query actually split into multiple parts
+        # (otherwise "part decomposition parity" tested nothing)
+        assert any(r[0] == "ok" and r[1]["parts"] >= 2
+                   for r in results)
+
+
+def test_unreachable_and_fully_disabled(tmp_path):
+    g = _net(tmp_path, 24, 10, seed=5)
+    planes = MD.McfPlanes.build(g)
+    a, b = bytes(g.node_ids[0]), bytes(g.node_ids[1])
+    # disable EVERY channel: build_arcs' "no usable channels" contract
+    ly = mcf.Layers()
+    for s in g.scids:
+        ly.disabled.add(int(s))
+    queries = [
+        MD.McfQuery(a, b, 100_000, layers=ly),
+        MD.McfQuery(a, a, 100_000),           # source is destination
+    ]
+    results = MD.solve_mcf_batch(planes, queries, batch=Q)
+    _assert_parity(g, queries, results)
+
+
+def test_overflow_amount_falls_back_to_host(tmp_path):
+    """Amounts past 2^48 are inexpressible in the kernel's int64
+    headroom: solve_mcf_batch flags them and the service resolves on
+    the host oracle — identical result dicts either way."""
+    g = _net(tmp_path, 24, 10, seed=6)
+    planes = MD.McfPlanes.build(g)
+    a, b = bytes(g.node_ids[0]), bytes(g.node_ids[2])
+    q = MD.McfQuery(a, b, (1 << 48) + 1)
+    res = MD.solve_mcf_batch(planes, [q], batch=Q)
+    assert res[0] == ("fallback", MD.R_AMOUNT_CAP)
+
+    async def scenario():
+        svc = MD.McfService(lambda: g, flush_ms=1.0, batch=Q,
+                            host_max=0)
+        svc.start()
+        try:
+            return await asyncio.wait_for(asyncio.gather(
+                *(svc.getroutes(a, b, (1 << 48) + 1)
+                  for _ in range(2)), return_exceptions=True),
+                timeout=60)
+        finally:
+            await asyncio.wait_for(svc.close(), timeout=30)
+
+    s0 = obs.snapshot()
+    got = asyncio.run(scenario())
+    s1 = obs.snapshot()
+    exp = _host(g, q)
+    for r in got:
+        if exp[0] == "ok":
+            assert r == exp[1]
+        else:
+            assert isinstance(r, mcf.McfError) and str(r) == exp[1]
+    assert _counter(s1, "clntpu_mcf_fallback_total",
+                    reason=MD.R_AMOUNT_CAP) >= \
+        _counter(s0, "clntpu_mcf_fallback_total",
+                 reason=MD.R_AMOUNT_CAP) + 2
+
+
+def test_planes_version_refresh(tmp_path):
+    """A params bump (accepted channel_update) must refresh the cached
+    parameter lanes — solving against stale fees would silently
+    diverge from the host oracle reading the live graph."""
+    g = _net(tmp_path, 24, 10, seed=8)
+    planes = MD.McfPlanes.current(g, None)
+    a, b = bytes(g.node_ids[0]), bytes(g.node_ids[3])
+    q = MD.McfQuery(a, b, 500_000)
+    r0 = MD.solve_mcf_batch(planes, [q], batch=Q)
+    _assert_parity(g, [q], r0)
+
+    # push every channel's fees up through the accepted-update path
+    for c in range(g.n_channels):
+        for d in (0, 1):
+            g.apply_channel_update(
+                int(g.scids[c]), d,
+                timestamp=int(g.timestamps[d, c]) + 10,
+                disabled=False,
+                cltv_delta=int(g.cltv_delta[d, c]),
+                htlc_min_msat=int(g.htlc_min_msat[d, c]),
+                htlc_max_msat=int(g.htlc_max_msat[d, c]),
+                fee_base_msat=int(g.fee_base_msat[d, c]) + 137,
+                fee_ppm=int(g.fee_ppm[d, c]) + 41)
+    fresh = MD.McfPlanes.current(g, planes)
+    assert fresh is not planes
+    assert fresh.params_version == g.params_version
+    # the topology arrays (and any device uploads) carry over
+    assert fresh.i_src is planes.i_src
+    r1 = MD.solve_mcf_batch(fresh, [q], batch=Q)
+    _assert_parity(g, [q], r1)
+    # the fee bump is visible: priced strictly higher than before
+    if r0[0][0] == "ok" and r1[0][0] == "ok":
+        assert r1[0][1]["fee_msat"] > r0[0][1]["fee_msat"]
+
+
+def test_service_coalesces_into_one_dispatch(tmp_path):
+    """Concurrent getroutes awaiters coalesce into one flight-recorded
+    mcf dispatch; results byte-identical to the host oracle; the
+    below-occupancy floor and the closed-service path both take the
+    host with the documented reasons."""
+    g = _net(tmp_path, 30, 12, seed=9)
+    rng = np.random.default_rng(4)
+    qs = []
+    for _ in range(8):
+        a, b = rng.integers(0, g.n_nodes, 2)
+        if a == b:
+            b = (b + 1) % g.n_nodes
+        qs.append((bytes(g.node_ids[a]), bytes(g.node_ids[b]),
+                   int(rng.integers(10_000, 3_000_000))))
+
+    async def scenario():
+        svc = MD.McfService(lambda: g, flush_ms=1.0, batch=Q,
+                            host_max=1)
+        svc.start()
+        try:
+            got = await asyncio.wait_for(asyncio.gather(
+                *(svc.getroutes(s, d, amt) for s, d, amt in qs),
+                return_exceptions=True), timeout=120)
+            # single query below the occupancy floor -> host path
+            s, d, amt = qs[0]
+            single = await asyncio.wait_for(
+                svc.getroutes(s, d, amt), timeout=60)
+        finally:
+            await asyncio.wait_for(svc.close(), timeout=30)
+        return got, single
+
+    flight.reset_for_tests()
+    s0 = obs.snapshot()
+    got, single = asyncio.run(scenario())
+    s1 = obs.snapshot()
+    for (s, d, amt), r in zip(qs, got):
+        exp = _host(g, MD.McfQuery(s, d, amt))
+        if isinstance(r, mcf.McfError):
+            assert exp == ("mcferr", str(r))
+        else:
+            assert not isinstance(r, BaseException), r
+            assert exp == ("ok", r)
+    assert single == got[0] if not isinstance(got[0], BaseException) \
+        else isinstance(single, dict)
+    recs = flight.recent("mcf")
+    assert recs, "no mcf flight records"
+    assert any(r["outcome"] == "ok" and r["n_real"] >= Q for r in recs)
+    assert _counter(s1, "clntpu_mcf_fallback_total",
+                    reason=MD.R_BELOW_OCCUPANCY) > \
+        _counter(s0, "clntpu_mcf_fallback_total",
+                 reason=MD.R_BELOW_OCCUPANCY)
+    assert _counter(s1, "clntpu_mcf_queries_total",
+                    path="device", outcome="ok") > \
+        _counter(s0, "clntpu_mcf_queries_total",
+                 path="device", outcome="ok")
+
+
+def test_service_admission_try_again(tmp_path):
+    """Past the high watermark getroutes is REJECTED retryably
+    (Overloaded -> the RPC layer's TRY_AGAIN) with a retry-after hint,
+    and queued callers still resolve."""
+    from lightning_tpu.resilience import overload as OV
+
+    g = _net(tmp_path, 24, 10, seed=11)
+    a, b = bytes(g.node_ids[0]), bytes(g.node_ids[4])
+
+    async def scenario():
+        svc = MD.McfService(lambda: g, flush_ms=50.0, batch=Q,
+                            host_max=0, high_wm=4, low_wm=2)
+        svc.start()
+        try:
+            # all 12 coroutines enqueue before the flush loop gets a
+            # turn: the backlog crosses high_wm=4 and the excess is
+            # rejected retryably while the admitted queries resolve
+            return await asyncio.wait_for(asyncio.gather(
+                *(svc.getroutes(a, b, 100_000) for _ in range(12)),
+                return_exceptions=True), timeout=120)
+        finally:
+            await asyncio.wait_for(svc.close(), timeout=30)
+
+    got = asyncio.run(scenario())
+    rejected = [r for r in got if isinstance(r, OV.Overloaded)]
+    assert rejected, "watermark never rejected"
+    assert all(e.retry_after_s > 0 for e in rejected)
+    for r in got:
+        assert isinstance(r, (dict, mcf.McfError, OV.Overloaded)), r
+
+
+def test_layered_topology_goes_to_host(tmp_path):
+    """Layer-created channels are a different topology: the device
+    universe can't express them, so the query lands on the host oracle
+    (which materializes the layered graph) — and still answers."""
+    g = _net(tmp_path, 24, 10, seed=12)
+    planes = MD.McfPlanes.build(g)
+    ly = mcf.Layers()
+    ghost = b"\x03" + b"\x77" * 32
+    ly.created[999_999] = {"source": bytes(g.node_ids[0]),
+                           "destination": ghost,
+                           "capacity_sat": 10_000_000}
+    ly.updates[(999_999, 0)] = {
+        "enabled": True, "htlc_minimum_msat": 0,
+        "htlc_maximum_msat": None, "fee_base_msat": 0,
+        "fee_proportional_millionths": 10, "cltv_expiry_delta": 6}
+    q = MD.McfQuery(bytes(g.node_ids[0]), ghost, 100_000, layers=ly)
+    res = MD.solve_mcf_batch(planes, [q], batch=Q)
+    assert res[0] == ("fallback", MD.R_LAYERED)
+    # the host oracle (what the service falls back to) solves it
+    host = mcf.getroutes(g, q.source, q.destination, q.amount_msat,
+                         layers=ly)
+    assert host["routes"]
+
+
+def test_decomposition_error_is_mcferror_and_survives_O():
+    """McfDecompositionError must be an McfError (not AssertionError),
+    and must survive ``python -O`` — a conservation bug conflated with
+    strippable asserts could vanish under optimized bytecode."""
+    assert issubclass(mcf.McfDecompositionError, mcf.McfError)
+    assert not issubclass(mcf.McfDecompositionError, AssertionError)
+    code = (
+        "from lightning_tpu.routing import mcf\n"
+        "assert True  # stripped under -O; the error must not be\n"
+        "try:\n"
+        "    raise mcf.McfDecompositionError(7)\n"
+        "except mcf.McfError as e:\n"
+        "    assert not isinstance(e, AssertionError)\n"
+        "    print('SURVIVED', e)\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-O", "-c", code],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    assert "SURVIVED flow stuck at node 7" in out.stdout
+
+
+def test_warn_once_latch_is_thread_safe():
+    """The MAX_ROUNDS truncation warning fires WARNING exactly once
+    even under racing solver threads (the once-latch contract)."""
+    import threading
+
+    latch = mcf._WarnOnce.__new__(mcf._WarnOnce)
+    latch.__init__()
+    firsts = []
+    barrier = threading.Barrier(8)
+
+    def race():
+        barrier.wait()
+        firsts.append(latch.first())
+
+    threads = [threading.Thread(target=race) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(firsts) == 1
+    latch.reset()
+    assert latch.first() is True
+
+
+def test_mcf_families_present_at_zero():
+    """tools/obs_snapshot.capture_local must surface the mcf families
+    (declared jax-free in obs/families.py) even before any solve."""
+    sys.path.insert(0, __import__("os").path.join(
+        __import__("os").path.dirname(__import__("os").path.dirname(
+            __import__("os").path.abspath(__file__))), "tools"))
+    import obs_snapshot
+
+    snap = obs_snapshot.capture_local()
+    for name in ("clntpu_mcf_flush_seconds", "clntpu_mcf_batch_queries",
+                 "clntpu_mcf_batch_occupancy_ratio",
+                 "clntpu_mcf_queries_total", "clntpu_mcf_fallback_total",
+                 "clntpu_mcf_queue_queries", "clntpu_mcf_parts_per_query"):
+        assert name in snap["metrics"], name
+
+def test_freeze_layers_is_a_value_snapshot():
+    """Lane prep runs in the flush worker while the event loop mutates
+    the live Layers (askrene-reserve / inform): the queued copy must be
+    fully independent, including knowledge's inner dicts (inform
+    mutates them IN PLACE via setdefault)."""
+    live = mcf.Layers()
+    live.disabled.add(101)
+    live.biases[102] = 500
+    live.reserve(103, 0, 10_000)
+    live.inform(104, 1, max_msat=50_000)
+    frozen = MD._freeze_layers(live)
+
+    live.disabled.add(999)
+    live.biases[102] = -900
+    live.reserve(103, 0, 77_000)
+    live.inform(104, 1, max_msat=1)          # in-place inner-dict write
+    live.node_biases[b"\x02" * 33] = 40
+
+    assert frozen.disabled == {101}
+    assert frozen.biases == {102: 500}
+    assert frozen.reserved == {(103, 0): 10_000}
+    assert frozen.knowledge[(104, 1)]["max"] == 50_000
+    assert frozen.node_biases == {}
+    assert MD._freeze_layers(None) is None
+
+
+def test_stale_planes_mid_dispatch_falls_back_to_host(tmp_path,
+                                                      monkeypatch):
+    """A params bump landing DURING the device dispatch must divert the
+    batch to the host oracle (reason=stale_planes): judging prices hops
+    off the live graph, and mixing the snapshot's flow with the new
+    revision's fees would answer with neither revision's host solve."""
+    g = _net(tmp_path, 30, 12, seed=21)
+    rng = np.random.default_rng(13)
+    qs = []
+    for _ in range(Q):
+        a, b = rng.integers(0, g.n_nodes, 2)
+        if a == b:
+            b = (b + 1) % g.n_nodes
+        qs.append((bytes(g.node_ids[a]), bytes(g.node_ids[b]),
+                   int(rng.integers(10_000, 2_000_000))))
+
+    real_solve = MD._solve_indices
+
+    def bump_mid_dispatch(*args, **kwargs):
+        rb = real_solve(*args, **kwargs)
+        g.apply_channel_update(
+            int(g.scids[0]), 0,
+            timestamp=int(g.timestamps[0, 0]) + 10, disabled=False,
+            cltv_delta=int(g.cltv_delta[0, 0]),
+            htlc_min_msat=int(g.htlc_min_msat[0, 0]),
+            htlc_max_msat=int(g.htlc_max_msat[0, 0]),
+            fee_base_msat=int(g.fee_base_msat[0, 0]) + 137,
+            fee_ppm=int(g.fee_ppm[0, 0]) + 41)
+        return rb
+
+    monkeypatch.setattr(MD, "_solve_indices", bump_mid_dispatch)
+
+    async def scenario():
+        svc = MD.McfService(lambda: g, flush_ms=1.0, batch=Q,
+                            host_max=0)
+        svc.start()
+        try:
+            return await asyncio.wait_for(asyncio.gather(
+                *(svc.getroutes(s, d, amt) for s, d, amt in qs),
+                return_exceptions=True), timeout=120)
+        finally:
+            await asyncio.wait_for(svc.close(), timeout=30)
+
+    s0 = obs.snapshot()
+    got = asyncio.run(scenario())
+    s1 = obs.snapshot()
+    assert _counter(s1, "clntpu_mcf_fallback_total",
+                    reason=MD.R_STALE_PLANES) > \
+        _counter(s0, "clntpu_mcf_fallback_total",
+                 reason=MD.R_STALE_PLANES)
+    # every answer equals the host oracle at the POST-BUMP revision
+    # (the host fallback solved on the live, already-bumped graph)
+    for (s, d, amt), r in zip(qs, got):
+        exp = _host(g, MD.McfQuery(s, d, amt))
+        if isinstance(r, mcf.McfError):
+            assert exp == ("mcferr", str(r))
+        else:
+            assert not isinstance(r, BaseException), r
+            assert exp == ("ok", r)
+
+
+def test_xpay_overloaded_fails_row_and_propagates():
+    """Overloaded from the batched McfService must NOT strand the
+    recorded payment row pending: xpay fails the row (sendpay_failure
+    event) and re-raises so the RPC layer maps it to TRY_AGAIN."""
+    import time as _t
+
+    pytest.importorskip("cryptography")   # bolt.sphinx dependency
+    from lightning_tpu.pay import xpay as X
+    from lightning_tpu.resilience import overload as OV
+    from lightning_tpu.utils import events
+
+    class _Inv:
+        payee = b"\x02" * 33
+        amount_msat = 1_000_000
+        payment_secret = b"\x11" * 32
+        payment_hash = b"\x22" * 32
+        min_final_cltv = 18
+        expires_at = _t.time() + 3600
+
+    class _Peer:
+        node_id = b"\x03" * 33
+
+    class _Ch:
+        peer = _Peer()
+
+    class _Svc:
+        async def getroutes(self, *a, **k):
+            raise OV.Overloaded("mcf", 0.25, 9)
+
+    failures: list = []
+    on_fail = failures.append
+    events.subscribe("sendpay_failure", on_fail)
+    try:
+        with pytest.raises(OV.Overloaded):
+            asyncio.run(X.xpay(_Ch(), "lnstub", None, inv=_Inv(),
+                               mcf_service=_Svc()))
+    finally:
+        events.unsubscribe("sendpay_failure", on_fail)
+    assert failures and "overloaded" in failures[0]["failure"]
+
+def test_fully_reserved_universe_matches_host_error(tmp_path):
+    """Enabled channels with every capacity reserved to zero: build_arcs
+    does NOT raise "no usable channels" (the enabled screens pass), the
+    host solver answers "no residual path" — the device path must reach
+    the kernel and produce the IDENTICAL McfError, not short-circuit on
+    a zero-capacity screen."""
+    g = _net(tmp_path, 20, 10, seed=33)
+    ly = mcf.Layers()
+    for c in range(g.n_channels):
+        for d in (0, 1):
+            ly.reserve(int(g.scids[c]), d, 1 << 40)
+    a, b = bytes(g.node_ids[0]), bytes(g.node_ids[5])
+    q = MD.McfQuery(a, b, 250_000, layers=ly)
+    planes = MD.McfPlanes.current(g, None)
+    res = MD.solve_mcf_batch(planes, [q], batch=Q)
+    exp = _host(g, q)
+    assert exp[0] == "mcferr" and "no residual path" in exp[1], exp
+    assert res[0] == exp

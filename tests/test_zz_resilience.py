@@ -736,6 +736,200 @@ def test_route_close_races_inflight_dispatch_no_hang(mini_graph,
 
 
 # ---------------------------------------------------------------------------
+# mcf workload: breaker + host-oracle fallback + fault-matrix row
+
+
+from lightning_tpu.routing import mcf as MCF  # noqa: E402
+from lightning_tpu.routing import mcf_device as MDV  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def mcf_graph(tmp_path_factory):
+    from lightning_tpu.gossip import synth
+
+    p = str(tmp_path_factory.mktemp("mcfres") / "net.gs")
+    synth.make_network_store(p, n_channels=24, n_nodes=10,
+                             updates_per_channel=2, seed=21,
+                             sign=False)
+    return GM.from_store(gstore.load_store(p))
+
+
+def _mcf_host(g, src, dst, amt):
+    try:
+        return ("ok", MCF.getroutes(g, src, dst, amt))
+    except MCF.McfError as e:
+        return ("mcferr", str(e))
+
+
+def test_mcf_workload_end_to_end(mcf_graph):
+    """The fault-matrix row for the mcf family: a real coalesced
+    getroutes run through the service (with whatever faults the
+    environment has armed) must produce EXACTLY the host oracle's
+    results — injected dispatch failures degrade throughput, never
+    answers."""
+    g = mcf_graph
+    rng = np.random.default_rng(6)
+    qs = []
+    for _ in range(6):
+        a, b = rng.integers(0, g.n_nodes, 2)
+        if a == b:
+            b = (b + 1) % g.n_nodes
+        qs.append((bytes(g.node_ids[a]), bytes(g.node_ids[b]),
+                   int(rng.integers(10_000, 3_000_000))))
+
+    async def scenario():
+        svc = MDV.McfService(lambda: g, flush_ms=1.0, batch=4,
+                             host_max=0)
+        svc.start()
+        try:
+            return await asyncio.wait_for(asyncio.gather(
+                *(svc.getroutes(s, d, amt) for s, d, amt in qs),
+                return_exceptions=True), timeout=120)
+        finally:
+            await asyncio.wait_for(svc.close(), timeout=30)
+
+    got = asyncio.run(scenario())
+    for (s, d, amt), r in zip(qs, got):
+        exp = _mcf_host(g, s, d, amt)
+        if isinstance(r, MCF.McfError):
+            assert exp == ("mcferr", str(r))
+        else:
+            assert not isinstance(r, BaseException), r
+            assert exp == ("ok", r)
+
+
+def test_mcf_device_error_falls_back_to_host(mcf_graph, monkeypatch):
+    """Every failed mcf dispatch resolves the batch on the host oracle
+    — zero stranded futures, breaker failure + quarantine metered."""
+
+    def broken(*a, **kw):
+        raise RuntimeError("XLA launch failed")
+
+    monkeypatch.setattr(MDV, "_solve_indices", broken)
+    g = mcf_graph
+    a, b = bytes(g.node_ids[0]), bytes(g.node_ids[1])
+
+    async def scenario():
+        svc = MDV.McfService(lambda: g, flush_ms=2.0, batch=4,
+                             host_max=0)
+        svc.start()
+        try:
+            return await asyncio.wait_for(asyncio.gather(
+                *(svc.getroutes(a, b, 1_000_000) for _ in range(4)),
+                return_exceptions=True), timeout=60)
+        finally:
+            await asyncio.wait_for(svc.close(), timeout=30)
+
+    s0 = obs.snapshot()
+    got = asyncio.run(scenario())
+    s1 = obs.snapshot()
+    exp = _mcf_host(g, a, b, 1_000_000)
+    for r in got:
+        if exp[0] == "ok":
+            assert r == exp[1]
+        else:
+            assert isinstance(r, MCF.McfError) and str(r) == exp[1]
+    assert _counter(s1, "clntpu_breaker_failures_total",
+                    family="mcf") > \
+        _counter(s0, "clntpu_breaker_failures_total", family="mcf")
+    assert _counter(s1, "clntpu_quarantine_total", family="mcf",
+                    reason="dispatch") >= \
+        _counter(s0, "clntpu_quarantine_total", family="mcf",
+                 reason="dispatch") + 4
+    assert _counter(s1, "clntpu_mcf_fallback_total",
+                    reason=MDV.R_DEVICE_ERROR) > \
+        _counter(s0, "clntpu_mcf_fallback_total",
+                 reason=MDV.R_DEVICE_ERROR)
+
+
+def test_mcf_breaker_open_short_circuits_to_host(mcf_graph,
+                                                 monkeypatch):
+    g = mcf_graph
+    a, b = bytes(g.node_ids[0]), bytes(g.node_ids[2])
+    calls = []
+
+    def counting(*args, **kw):
+        calls.append(1)
+        raise AssertionError("device path must not run with open breaker")
+
+    monkeypatch.setattr(MDV, "_solve_indices", counting)
+    RB.get("mcf").force_open()
+
+    async def scenario():
+        svc = MDV.McfService(lambda: g, flush_ms=2.0, batch=4,
+                             host_max=0)
+        svc.start()
+        try:
+            return await asyncio.wait_for(asyncio.gather(
+                *(svc.getroutes(a, b, 500_000) for _ in range(4)),
+                return_exceptions=True), timeout=60)
+        finally:
+            await asyncio.wait_for(svc.close(), timeout=30)
+
+    s0 = obs.snapshot()
+    got = asyncio.run(scenario())
+    s1 = obs.snapshot()
+    assert not calls
+    exp = _mcf_host(g, a, b, 500_000)
+    for r in got:
+        if exp[0] == "ok":
+            assert r == exp[1]
+        else:
+            assert isinstance(r, MCF.McfError) and str(r) == exp[1]
+    assert _counter(s1, "clntpu_mcf_fallback_total",
+                    reason=MDV.R_BREAKER) >= \
+        _counter(s0, "clntpu_mcf_fallback_total",
+                 reason=MDV.R_BREAKER) + 4
+
+
+def test_mcf_dispatch_deadline_fails_batch_to_host(mcf_graph,
+                                                   monkeypatch):
+    """A hung mcf dispatch blows the family deadline; the batch
+    re-solves on the host oracle and every future resolves.  (Env
+    faults off: a matrix-armed dispatch raise would preempt the hang
+    and re-label the fallback device_error instead of deadline.)"""
+    monkeypatch.delenv("LIGHTNING_TPU_FAULT", raising=False)
+    monkeypatch.setenv("LIGHTNING_TPU_DEADLINE_MCF_S", "0.1")
+
+    def hung(*a, **kw):
+        time.sleep(1.0)
+        raise AssertionError("result of a hung dispatch must be unused")
+
+    monkeypatch.setattr(MDV, "_solve_indices", hung)
+    g = mcf_graph
+    a, b = bytes(g.node_ids[0]), bytes(g.node_ids[3])
+
+    async def scenario():
+        svc = MDV.McfService(lambda: g, flush_ms=1.0, batch=4,
+                             host_max=0)
+        svc.start()
+        try:
+            return await asyncio.wait_for(asyncio.gather(
+                *(svc.getroutes(a, b, 200_000) for _ in range(4)),
+                return_exceptions=True), timeout=60)
+        finally:
+            await asyncio.wait_for(svc.close(), timeout=30)
+
+    s0 = obs.snapshot()
+    got = asyncio.run(scenario())
+    s1 = obs.snapshot()
+    exp = _mcf_host(g, a, b, 200_000)
+    for r in got:
+        if exp[0] == "ok":
+            assert r == exp[1]
+        else:
+            assert isinstance(r, MCF.McfError) and str(r) == exp[1]
+    assert _counter(s1, "clntpu_deadline_exceeded_total",
+                    family="mcf", seam="dispatch") > \
+        _counter(s0, "clntpu_deadline_exceeded_total",
+                 family="mcf", seam="dispatch")
+    assert _counter(s1, "clntpu_mcf_fallback_total",
+                    reason=MDV.R_DEADLINE) >= \
+        _counter(s0, "clntpu_mcf_fallback_total",
+                 reason=MDV.R_DEADLINE) + 4
+
+
+# ---------------------------------------------------------------------------
 # sign workload: breaker + host-oracle fallback
 
 

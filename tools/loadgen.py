@@ -80,6 +80,11 @@ def parse_args(argv=None):
     ap.add_argument("--route-wm", type=int, default=12,
                     help="route admission high watermark (queries; "
                     "keep above the batch of 8 — in-flight counts)")
+    ap.add_argument("--mcf-conc", type=int, default=8,
+                    help="concurrent getroutes (MPP) RPC clients")
+    ap.add_argument("--mcf-wm", type=int, default=3,
+                    help="mcf admission high watermark (queries; sized "
+                    "below --mcf-conc so TRY_AGAIN must engage)")
     ap.add_argument("--ingest-wm", type=int, default=256,
                     help="ingest high watermark (signatures)")
     ap.add_argument("--seed", type=int, default=7)
@@ -291,9 +296,23 @@ async def run_load(args, slo: dict) -> dict:
     router = RouteService(lambda: gossmap_ref.get("map"), batch=8,
                           host_max=2, high_wm=args.route_wm,
                           low_wm=max(1, args.route_wm // 2))
+    # the MPP payment engine (doc/routing.md §MCF/MPP), host-pinned:
+    # the soak budget has no room for an in-process mcf kernel compile,
+    # and admission control / reservations / coalescing are identical
+    # either way (device parity is tests/test_zz_mcf_parity.py's job)
+    from lightning_tpu.routing.mcf import Layers, attach_routing_commands
+    from lightning_tpu.routing.mcf_device import McfService
+
+    mcf_service = McfService(lambda: gossmap_ref.get("map"), batch=4,
+                             host_max=1, device=False,
+                             high_wm=args.mcf_wm,
+                             low_wm=max(1, args.mcf_wm // 2))
+    mcf_layers = Layers()
     rpc_path = os.path.join(tmp, "rpc.sock")
     rpc = JsonRpcServer(rpc_path)
     attach_core_commands(rpc, node, gossmap_ref, router=router)
+    attach_routing_commands(rpc, gossmap_ref, layers=mcf_layers,
+                            service=mcf_service)
 
     async def getmetrics() -> dict:
         # the daemon's getmetrics shape (jsonrpc.attach_admin_commands
@@ -325,6 +344,7 @@ async def run_load(args, slo: dict) -> dict:
     await rpc.start()
     gossipd.start()
     router.start()
+    mcf_service.start()
     print("loadgen: warming verify/route programs...", flush=True)
     await ing.warmup()
     await router.warmup()
@@ -403,6 +423,73 @@ async def run_load(args, slo: dict) -> dict:
         finally:
             await cli.close()
 
+    mcf_stats = {"ok": 0, "noroute": 0, "try_again": 0, "error": 0,
+                 "reserves": 0, "unreserves": 0, "hint_missing": 0,
+                 "parts": 0}
+
+    async def mpp_client(ci: int):
+        """One MPP payer: getroutes, reserve every part's path for the
+        simulated in-flight window, then unreserve — the askrene
+        reserve lifecycle xpay drives per payment attempt.  The full
+        cycle completes even when the storm ends mid-payment, so the
+        post-storm reservation state must match an unthrottled run's:
+        empty."""
+        import numpy as _np
+
+        crng = _np.random.default_rng(5000 + ci)
+        # only graph-known endpoints: a synth node with no channels is
+        # absent from the gossmap, and an unknown-node KeyError is the
+        # query's own error, not admission/solver behavior under storm
+        known = []
+        for h in node_hexes:
+            try:
+                g.node_index(bytes.fromhex(h))
+            except KeyError:
+                continue
+            known.append(h)
+        cli = await _RpcClient(rpc_path).connect()
+        try:
+            while not storm_done.is_set():
+                src = known[int(crng.integers(0, len(known)))]
+                dst = known[int(crng.integers(0, len(known)))]
+                if src == dst:
+                    continue
+                resp = await cli.call("getroutes", {
+                    "source": src, "destination": dst,
+                    "amount_msat": int(crng.integers(10_000, 500_000)),
+                    "max_parts": 4})
+                err = resp.get("error")
+                if err is None:
+                    routes = resp["result"]["routes"]
+                    mcf_stats["ok"] += 1
+                    mcf_stats["parts"] += len(routes)
+                    paths = [r["path"] for r in routes if r["path"]]
+                    for path in paths:
+                        await cli.call("askrene-reserve",
+                                       {"path": path})
+                        mcf_stats["reserves"] += 1
+                    await asyncio.sleep(0.01)   # in-flight window
+                    for path in paths:
+                        await cli.call("askrene-unreserve",
+                                       {"path": path})
+                        mcf_stats["unreserves"] += 1
+                elif err["code"] == 429:
+                    mcf_stats["try_again"] += 1
+                    hint = (err.get("data") or {}).get("retry_after_s")
+                    if hint is None:
+                        mcf_stats["hint_missing"] += 1
+                        hint = 0.1
+                    await asyncio.sleep(min(float(hint), 0.5))
+                elif "no route" in err.get("message", "") \
+                        or "no residual path" in err.get("message", "") \
+                        or "could not place" in err.get("message", "") \
+                        or "no usable channels" in err.get("message", ""):
+                    mcf_stats["noroute"] += 1
+                else:
+                    mcf_stats["error"] += 1
+        finally:
+            await cli.close()
+
     health_seen = {"states": set(), "breached": set(), "observed": set()}
 
     async def health_watch():
@@ -436,12 +523,19 @@ async def run_load(args, slo: dict) -> dict:
     await asyncio.gather(storm_task(),
                          *(route_client(i)
                            for i in range(args.route_conc)),
+                         *(mpp_client(i)
+                           for i in range(args.mcf_conc)),
                          sign_task(), health_watch())
     await ing.drain()
 
     # -- post-storm: metrics surface still live ---------------------------
     cli = await _RpcClient(rpc_path).connect()
     metrics = (await cli.call("getmetrics"))["result"]
+    # reservation-state parity: every storm payment completed its
+    # reserve/unreserve cycle, so the surviving state must equal an
+    # unthrottled run's — empty (sheds never half-apply reservations)
+    reservations = (await cli.call(
+        "askrene-listreservations"))["result"]["reservations"]
     # the live engine must RECOVER once the storm drains (hysteresis:
     # recover_ticks clean ticks after the last breach window rolls out)
     health_final = (await cli.call("gethealth"))["result"]
@@ -462,6 +556,7 @@ async def run_load(args, slo: dict) -> dict:
     stats = ing.stats
     await gossipd.close()
     await router.close()
+    await mcf_service.close()
     await rpc.close()
     heng.stop()
     _health.install(None)
@@ -489,6 +584,8 @@ async def run_load(args, slo: dict) -> dict:
         "max_flush_batch": stats.max_batch,
         "route": {k: v for k, v in route_stats.items()
                   if k != "latencies"},
+        "mcf": dict(mcf_stats),
+        "reservations_after": len(reservations),
         "route_answered": answered,
         "route_p99_s": round(p99, 4),
         "sign_batches": sign_stats["batches"],
@@ -550,6 +647,31 @@ async def run_load(args, slo: dict) -> dict:
         # TRY_AGAIN path is a regression, not a quiet success
         failures.append("route admission control never fired "
                         "(expected TRY_AGAIN under selfcheck load)")
+    # -- the MPP storm (mcf family, doc/routing.md §MCF/MPP) --------------
+    if mcf_stats["ok"] == 0:
+        failures.append("no getroutes (MPP) query ever succeeded")
+    if mcf_stats["error"]:
+        failures.append(f"{mcf_stats['error']} getroutes hard errors")
+    if args.selfcheck and mcf_stats["try_again"] == 0:
+        # sized to saturate: --mcf-conc clients vs the --mcf-wm
+        # watermark — the mcf family's admission control MUST engage
+        failures.append("mcf admission control never fired "
+                        "(expected TRY_AGAIN under selfcheck load)")
+    if mcf_stats["hint_missing"]:
+        failures.append(
+            f"{mcf_stats['hint_missing']} mcf TRY_AGAIN rejections "
+            "lacked the retry_after_s hint")
+    if "mcf" not in ovl.get("families", {}):
+        failures.append("getmetrics overload section lacks the mcf "
+                        "family")
+    if mcf_stats["reserves"] != mcf_stats["unreserves"]:
+        failures.append(
+            f"reserve/unreserve imbalance: {mcf_stats['reserves']} vs "
+            f"{mcf_stats['unreserves']}")
+    if reservations:
+        failures.append(
+            f"{len(reservations)} reservations survived the storm "
+            "(parity with an unthrottled run demands zero)")
 
     # -- live health engine vs. this harness (doc/health.md) --------------
     # While the storm exceeds the watermarks the engine must leave
@@ -677,6 +799,12 @@ def main(argv=None) -> int:
               f"p99={r['route_p99_s']}s "
               f"sign_batches={r['sign_batches']} "
               f"replay_identical={r['replay_identical']}")
+        m = r.get("mcf", {})
+        print(f"loadgen: mcf ok={m.get('ok')} parts={m.get('parts')} "
+              f"noroute={m.get('noroute')} "
+              f"try_again={m.get('try_again')} "
+              f"reserves={m.get('reserves')}/{m.get('unreserves')} "
+              f"reservations_after={r.get('reservations_after')}")
         h = r.get("health", {})
         print(f"loadgen: health states={h.get('states_seen')} "
               f"breached={h.get('breached_seen')} "

@@ -129,7 +129,7 @@ timeout 300 python tools/perf_report.py --compare \
 # proves the breakers/quarantine/host-fallback paths complete every
 # replay/route/sign workload bit-identically under sustained failure.
 echo "fault-matrix pass (LIGHTNING_TPU_FAULT armed)"
-LIGHTNING_TPU_FAULT="dispatch:verify:raise:0.25,dispatch:route:raise:0.5,mesh:mesh:raise:0.5,sign:sign:raise:0.5,readback:verify:raise:0.125" \
+LIGHTNING_TPU_FAULT="dispatch:verify:raise:0.25,dispatch:route:raise:0.5,dispatch:mcf:raise:0.5,mesh:mesh:raise:0.5,sign:sign:raise:0.5,readback:verify:raise:0.125" \
 LIGHTNING_TPU_DEADLINE_VERIFY_S=120 \
 LIGHTNING_TPU_DEADLINE_ROUTE_S=120 \
 LIGHTNING_TPU_DEADLINE_INGEST_S=240 \
