@@ -86,6 +86,24 @@ async def amain(args) -> int:
     health_engine = _health.ensure_engine()
     health_engine.start()
 
+    # black-box flight recorder (doc/incidents.md): a breaker opening,
+    # an SLO breach entry, a blown deadline, or an unhandled crash
+    # freezes a correlated forensic bundle (metrics + flight rings +
+    # trace export + health report + resilience state + knobs) under
+    # <data-dir>/incidents (LIGHTNING_TPU_INCIDENT_DIR overrides;
+    # ..._DISABLE=1 turns it off).  Capture runs on its own thread;
+    # the listincidents/getincident RPCs serve the bundles.
+    from ..obs import incident as _incident
+
+    incident_rec = _incident.install_from_env(
+        default_dir=(_os.path.join(args.data_dir, "incidents")
+                     if args.data_dir else None),
+        process_hooks=True)
+    if incident_rec is not None:
+        incident_rec.start()
+        print(f"incident recorder armed {incident_rec.directory}",
+              flush=True)
+
     if args.proxy:
         host, _, p_ = args.proxy.rpartition(":")
         node.tor_proxy = (host, int(p_))
@@ -560,6 +578,10 @@ async def amain(args) -> int:
     from ..utils import events as _EV
 
     _EV.emit("shutdown", {})
+    if incident_rec is not None:
+        # flush pending captures + finalize the open episode's manifest
+        # BEFORE the health engine stops feeding it triggers
+        incident_rec.stop()
     health_engine.stop()
     if node.plugin_host is not None:
         await node.plugin_host.close()

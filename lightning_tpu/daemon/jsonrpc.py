@@ -999,6 +999,8 @@ def attach_admin_commands(rpc: JsonRpcServer, cfg, ring) -> None:
     rpc.register("gettrace", gettrace)
     rpc.register("getperf", getperf)
     rpc.register("gethealth", make_gethealth())
+    rpc.register("listincidents", make_listincidents())
+    rpc.register("getincident", make_getincident())
 
 
 def make_gethealth(engine=None):
@@ -1037,3 +1039,57 @@ def make_gethealth(engine=None):
         return eng.report(series=series, points=points)
 
     return gethealth
+
+
+def make_listincidents(recorder=None):
+    """The listincidents handler (doc/incidents.md): bound to
+    `recorder`, or to the process singleton at call time when None —
+    shared by attach_admin_commands and the harness daemons
+    (tools/loadgen.py, tools/health_smoke.py)."""
+
+    async def listincidents(limit: int = 50) -> dict:
+        """Incident bundles on disk, newest first (doc/incidents.md):
+        id, naming trigger class, capture time/age, byte size,
+        suppressed-trigger count, and the correlation block.  `limit`
+        bounds the rows; count/total_bytes always cover the whole
+        store.  A daemon without a recorder answers enabled=false."""
+        from ..obs import incident as _incident
+
+        try:
+            limit = int(limit)
+        except (TypeError, ValueError):
+            raise RpcError(INVALID_PARAMS, "limit must be an integer")
+        if limit < 0:
+            raise RpcError(INVALID_PARAMS, "limit must be >= 0")
+        rec = recorder if recorder is not None else _incident.current()
+        if rec is None:
+            return {"incidents": [], "count": 0, "total_bytes": 0,
+                    "dir": None, "enabled": False}
+        return rec.summary(limit=limit)
+
+    return listincidents
+
+
+def make_getincident(recorder=None):
+    """The getincident handler (doc/incidents.md): the bundle manifest,
+    plus one named artifact's full content on request."""
+
+    async def getincident(id: str, artifact: str | None = None) -> dict:  # noqa: A002
+        """One incident bundle (doc/incidents.md): the manifest
+        (trigger, correlation, history, suppressed counts, artifact
+        index) and, with `artifact` (metrics.json, flight.json,
+        trace.json, health.json, resilience.json, knobs.json), that
+        artifact's frozen content."""
+        from ..obs import incident as _incident
+
+        rec = recorder if recorder is not None else _incident.current()
+        if rec is None:
+            raise RpcError(RPC_ERROR, "no incident recorder installed")
+        try:
+            return rec.get(id, artifact=artifact)
+        except ValueError as e:
+            raise RpcError(INVALID_PARAMS, str(e))
+        except KeyError:
+            raise RpcError(RPC_ERROR, f"unknown incident {id!r}")
+
+    return getincident

@@ -235,6 +235,25 @@ SLO_BREACH = REGISTRY.counter(
     "per transition into breach, not per breached tick), by SLO name",
     labelnames=("slo",))
 
+# -- obs/incident.py: the black-box flight recorder (doc/incidents.md) -----
+INCIDENTS = REGISTRY.counter(
+    "clntpu_incidents_total",
+    "Incident bundles frozen to disk by the black-box recorder, by the "
+    "trigger class that names the bundle (escalations re-count under "
+    "the new class)",
+    labelnames=("trigger",))
+INCIDENT_TRIGGERS = REGISTRY.counter(
+    "clntpu_incident_triggers_total",
+    "Incident triggers observed, by class and what the episode "
+    "debouncer did with them (capture = opened a bundle, escalate = "
+    "re-froze the open bundle under a higher-severity class, absorb = "
+    "suppressed inside the cooldown window)",
+    labelnames=("trigger", "action"))
+INCIDENT_BYTES = REGISTRY.gauge(
+    "clntpu_incident_store_bytes",
+    "Total bytes of incident bundles on disk (bounded by "
+    "LIGHTNING_TPU_INCIDENT_MAX_BYTES with oldest-first rotation)")
+
 # -- obs/flight.py: the dispatch flight recorder (doc/tracing.md) ----------
 DISPATCHES = REGISTRY.counter(
     "clntpu_dispatches_total",

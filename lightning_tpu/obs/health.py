@@ -395,6 +395,7 @@ class HealthEngine:
         taps = self._collect_taps()
         now = self._now()
         eval_errors = ()
+        breach_entries = ()
         with self._lock:
             self._ticks += 1
             elapsed = (now - self._last_mono
@@ -405,7 +406,7 @@ class HealthEngine:
             self._sample_taps(now, taps)
             transition = None
             if elapsed is not None:
-                eval_errors = self._evaluate(now)
+                eval_errors, breach_entries = self._evaluate(now)
                 transition = self._roll_up()
         # the events bus runs arbitrary subscriber callbacks
         # synchronously — emitting OUTSIDE the lock keeps a subscriber
@@ -414,6 +415,11 @@ class HealthEngine:
         # same for logging, whose handlers are pluggable
         for name, tb in eval_errors:
             log.error("SLO %s evaluation failed:\n%s", name, tb)
+        for entry in breach_entries:
+            # one emission per transition INTO breach (the same edge
+            # clntpu_slo_breach_total meters) — the incident recorder's
+            # slo_breach trigger surface (doc/incidents.md)
+            events.emit("slo_breach", entry)
         if transition is not None:
             state, breached = transition
             log.log(logging.WARNING if state != HEALTHY else logging.INFO,
@@ -681,8 +687,9 @@ class HealthEngine:
             return inc > p.get("max", 0.0), inc
         raise ValueError(f"unknown SLO kind {spec.kind!r}")
 
-    def _evaluate(self, now: float) -> list:
+    def _evaluate(self, now: float) -> tuple[list, list]:
         errors: list = []
+        entries: list = []
         for spec in self.slos:
             st = self._slo_state[spec.name]
             try:
@@ -707,12 +714,19 @@ class HealthEngine:
                 if not st["was_violated"]:
                     st["breaches_total"] += 1
                     _f.SLO_BREACH.labels(spec.name).inc()
+                    entries.append({
+                        "slo": spec.name, "kind": spec.kind,
+                        "window": spec.window,
+                        "severity": spec.severity,
+                        "observed": observed,
+                        "breaches_total": st["breaches_total"],
+                    })
             elif st["burn_short"] > 1.0:
                 st["status"] = WARN
             else:
                 st["status"] = OK
             st["was_violated"] = bool(violated)
-        return errors
+        return errors, entries
 
     # -- roll-up state machine (lock held) ---------------------------------
 

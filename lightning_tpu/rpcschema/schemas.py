@@ -321,6 +321,18 @@ COMMANDS: dict[str, dict] = {
         "params": {"family": "str?", "limit": "int?"},
         "result": {"dispatches": "list", "ring_size": "int"},
     },
+    "listincidents": {
+        "params": {"limit": "int?"},
+        "result": {"incidents": "list", "count": "int",
+                   "total_bytes": "int", "dir": "str?",
+                   "enabled": "bool"},
+    },
+    "getincident": {
+        "params": {"id": "str", "artifact": "str?"},
+        "result": {"id": "str", "manifest": "dict"},
+        # the requested artifact's content rides in `.extra`
+        # (doc/incidents.md for the bundle layout)
+    },
     "gettrace": {
         "params": {"dispatches": "int?"},
         "result": {"traceEvents": "list", "displayTimeUnit": "str"},

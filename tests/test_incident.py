@@ -1,0 +1,516 @@
+"""Black-box flight recorder (lightning_tpu/obs/incident.py,
+doc/incidents.md): episode/cooldown debouncing, severity escalation,
+retention rotation, the listincidents/getincident handlers, the
+slo_breach trigger surface, and the crash path (sys/threading
+excepthooks + faulthandler) driven in real subprocesses.
+
+Jax-free and fast — the recorder is exposition-layer code.
+"""
+from __future__ import annotations
+
+import asyncio
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightning_tpu.daemon.jsonrpc import (RpcError, make_getincident,  # noqa: E402
+                                          make_listincidents)
+from lightning_tpu.obs import families, flight  # noqa: E402,F401
+from lightning_tpu.obs import incident  # noqa: E402
+from lightning_tpu.utils import events, trace  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPORT = os.path.join(_REPO, "tools", "incident_report.py")
+
+
+def _load_report_tool():
+    spec = importlib.util.spec_from_file_location("incident_report",
+                                                  _REPORT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _recorder(tmp_path, **kw):
+    kw.setdefault("cooldown_s", 30.0)
+    rec = incident.IncidentRecorder(str(tmp_path / "inc"), **kw)
+    rec.start()
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# episode semantics
+
+
+def test_cooldown_debounce_and_new_episode(tmp_path):
+    clk = FakeClock()
+    rec = _recorder(tmp_path, now=clk)
+    try:
+        rec._trigger("breaker_open", {"family": "verify", "seq": 1})
+        assert rec.drain(10)
+        s = rec.summary()
+        assert s["count"] == 1
+        assert s["incidents"][0]["trigger"] == "breaker_open"
+        # duplicate inside the window: absorbed, no second bundle
+        rec._trigger("breaker_open", {"family": "verify", "seq": 2})
+        assert rec.drain(10)
+        s = rec.summary()
+        assert s["count"] == 1
+        assert s["incidents"][0]["suppressed"] == 1
+        # past the cooldown: a fresh episode mints a second bundle
+        clk.t += 31.0
+        rec._trigger("breaker_open", {"family": "route", "seq": 3})
+        assert rec.drain(10)
+        s = rec.summary()
+        assert s["count"] == 2
+        # newest first
+        assert s["incidents"][0]["correlation"]["family"] == "route"
+    finally:
+        rec.stop()
+
+
+def test_escalation_single_bundle_named_by_highest_severity(tmp_path):
+    rec = _recorder(tmp_path, now=FakeClock())
+    try:
+        rec._trigger("quarantine", {"family": "verify", "row": 7})
+        rec._trigger("breaker_open", {"family": "verify", "seq": 1})
+        rec._trigger("slow_dispatch", {"family": "verify",
+                                       "dispatch_id": 9})
+        assert rec.drain(10)
+        s = rec.summary()
+        assert s["count"] == 1
+        row = s["incidents"][0]
+        assert row["trigger"] == "breaker_open"
+        man = rec.get(row["id"])["manifest"]
+        actions = [(h["class"], h["action"]) for h in man["history"]]
+        assert actions[0] == ("quarantine", "capture")
+        assert ("breaker_open", "escalate") in actions
+        assert man["correlation"]["family"] == "verify"
+        # the absorbed lower-severity trigger is only counted
+        assert row["suppressed"] == 1
+    finally:
+        rec.stop()
+
+
+def test_bus_subscription_filters_and_unsubscribe(tmp_path):
+    rec = _recorder(tmp_path, now=FakeClock())
+    try:
+        # non-incident-shaped emissions are ignored
+        events.emit("breaker_transition", {"family": "verify",
+                                           "to": "closed", "seq": 1})
+        events.emit("health_state", {"state": "healthy",
+                                     "breached": []})
+        assert rec.drain(5)
+        assert rec.summary()["count"] == 0
+        events.emit("health_state", {"state": "degraded",
+                                     "breached": ["shed_ratio"]})
+        assert rec.drain(10)
+        s = rec.summary()
+        assert s["count"] == 1
+        assert s["incidents"][0]["trigger"] == "health_degraded"
+    finally:
+        rec.stop()
+    # stop() unsubscribed: later emissions must not touch the store
+    events.emit("breaker_transition", {"family": "verify",
+                                       "to": "open", "seq": 2})
+    time.sleep(0.05)
+    assert rec.summary()["count"] == 1
+
+
+def test_trigger_allowlist_restricts_classes(tmp_path):
+    rec = _recorder(tmp_path, now=FakeClock(),
+                    triggers=("breaker_open",))
+    try:
+        rec._trigger("quarantine", {"family": "verify"})
+        rec._trigger("health_degraded", {"state": "degraded"})
+        assert rec.drain(5)
+        assert rec.summary()["count"] == 0
+        rec._trigger("breaker_open", {"family": "verify"})
+        assert rec.drain(10)
+        assert rec.summary()["count"] == 1
+    finally:
+        rec.stop()
+
+
+def test_disable_knob_and_install_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("LIGHTNING_TPU_INCIDENT_DISABLE", "1")
+    assert incident.install_from_env(default_dir=str(tmp_path)) is None
+    monkeypatch.delenv("LIGHTNING_TPU_INCIDENT_DISABLE")
+    # no dir resolvable -> no recorder
+    monkeypatch.delenv("LIGHTNING_TPU_INCIDENT_DIR", raising=False)
+    assert incident.install_from_env(default_dir=None) is None
+    # env dir wins and knobs are read
+    monkeypatch.setenv("LIGHTNING_TPU_INCIDENT_DIR",
+                       str(tmp_path / "envdir"))
+    monkeypatch.setenv("LIGHTNING_TPU_INCIDENT_MAX_BUNDLES", "3")
+    monkeypatch.setenv("LIGHTNING_TPU_INCIDENT_COOLDOWN_S", "7.5")
+    rec = incident.install_from_env()
+    try:
+        assert rec is not None
+        assert rec.directory == str(tmp_path / "envdir")
+        assert rec.max_bundles == 3
+        assert rec.cooldown_s == 7.5
+        assert incident.current() is rec
+    finally:
+        incident.reset_for_tests()
+    # a disabled recorder records nothing even when triggered directly
+    rec2 = incident.IncidentRecorder(str(tmp_path / "d2"),
+                                     disabled=True)
+    rec2.start()
+    rec2._trigger("breaker_open", {"family": "verify"})
+    assert rec2.summary()["count"] == 0
+    assert not rec2.summary()["enabled"]
+    rec2.stop()
+
+
+# ---------------------------------------------------------------------------
+# retention
+
+
+def test_rotation_by_count_oldest_first(tmp_path):
+    clk = FakeClock()
+    rec = _recorder(tmp_path, now=clk, cooldown_s=1.0, max_bundles=2)
+    try:
+        ids = []
+        for i in range(3):
+            rec._trigger("breaker_open", {"family": "verify",
+                                          "seq": i})
+            assert rec.drain(10)
+            ids.append(rec.summary()["incidents"][0]["id"])
+            clk.t += 2.0
+            # distinct wall-ms in the bundle id
+            time.sleep(0.002)
+        s = rec.summary()
+        assert s["count"] == 2
+        kept = {r["id"] for r in s["incidents"]}
+        assert ids[0] not in kept          # oldest rotated away
+        assert ids[1] in kept and ids[2] in kept
+        assert not os.path.isdir(os.path.join(rec.directory, ids[0]))
+    finally:
+        rec.stop()
+
+
+def test_rotation_by_bytes_never_drops_newest(tmp_path):
+    clk = FakeClock()
+    probe = _recorder(tmp_path / "probe", now=clk, cooldown_s=1.0)
+    try:
+        probe._trigger("breaker_open", {"family": "verify"})
+        assert probe.drain(10)
+        one_bundle = probe.summary()["total_bytes"]
+        assert one_bundle > 0
+    finally:
+        probe.stop()
+    # budget for ~1.5 bundles: the third capture must rotate the oldest
+    rec = _recorder(tmp_path, now=clk, cooldown_s=1.0,
+                    max_bundles=100,
+                    max_bytes=max(1 << 12, int(one_bundle * 1.5)))
+    try:
+        ids = []
+        for i in range(3):
+            rec._trigger("breaker_open", {"family": "verify",
+                                          "seq": i})
+            assert rec.drain(10)
+            ids.append(rec.summary()["incidents"][0]["id"])
+            clk.t += 2.0
+            time.sleep(0.002)
+        s = rec.summary()
+        assert s["count"] < 3
+        kept = {r["id"] for r in s["incidents"]}
+        assert ids[2] in kept              # newest always survives
+        assert ids[0] not in kept
+    finally:
+        rec.stop()
+
+
+# ---------------------------------------------------------------------------
+# bundle content + validation + report CLI
+
+
+def _fresh_workload(family: str, n_ok: int = 4, n_err: int = 1):
+    """Fresh flight rings with a correlated span chain so the bundle's
+    trace export has flow arrows and the ring<->counter reconciliation
+    starts from zero (the rings AND their lifetime counts reset
+    together; clntpu_dispatches_total label children for a fresh
+    family name start at zero too)."""
+    flight.reset_for_tests()
+    for i in range(n_ok + n_err):
+        with trace.span("ingest/submit"):
+            carrier = trace.new_corr()
+        with trace.span("verify/dispatch", corr=carrier):
+            try:
+                with flight.dispatch(
+                        family, corr_ids=flight.corr_ids([carrier]),
+                        shape=(8, 2), n_real=6, lanes=8) as rec:
+                    if i >= n_ok:
+                        rec["faults"].append("dispatch:" + family)
+                        raise RuntimeError("boom")
+            except RuntimeError:
+                pass
+
+
+def test_bundle_artifacts_validate_and_render(tmp_path):
+    tool = _load_report_tool()
+    _fresh_workload("inctest")
+    rec = _recorder(tmp_path, now=FakeClock())
+    try:
+        rec._trigger("breaker_open", {"family": "inctest", "seq": 1})
+        assert rec.drain(10)
+        row = rec.summary()["incidents"][0]
+        bundle_dir = os.path.join(rec.directory, row["id"])
+        bundle = tool.load_bundle(bundle_dir)
+        man = bundle["manifest"]
+        assert man["schema"] == incident.MANIFEST_SCHEMA
+        assert set(man["artifacts"]) == set(incident.ARTIFACTS)
+        assert man["capture_errors"] == {}
+        assert man["trace_problems"] == 0
+        # the frozen verify-style ring holds the failing dispatch
+        recs = [r for r in bundle["flight.json"]["records"]
+                if r["family"] == "inctest"]
+        assert len(recs) == 5
+        assert sum(1 for r in recs if r["outcome"] == "error") == 1
+        # knobs artifact resolves the registry with sources
+        knobs = bundle["knobs.json"]
+        assert any(v.get("source") == "default"
+                   for v in knobs.values())
+        assert all("PASSPHRASE" not in (v.get("value") or "")
+                   or v["value"] == "<redacted>"
+                   for v in knobs.values())
+        # the full validation gate
+        assert tool.validate_bundle(bundle) == []
+        text = tool.render(bundle)
+        assert row["id"] in text and "breaker_open" in text
+        assert "inctest" in text
+    finally:
+        rec.stop()
+
+
+def test_validate_catches_tampering(tmp_path):
+    tool = _load_report_tool()
+    _fresh_workload("inctest2")
+    rec = _recorder(tmp_path, now=FakeClock())
+    try:
+        rec._trigger("breaker_open", {"family": "inctest2"})
+        assert rec.drain(10)
+        bundle_dir = os.path.join(rec.directory,
+                                  rec.summary()["incidents"][0]["id"])
+    finally:
+        rec.stop()
+    # corrupt the trace export: validation must name it
+    tpath = os.path.join(bundle_dir, "trace.json")
+    with open(tpath) as f:
+        tr = json.load(f)
+    tr["traceEvents"].append({"ph": "X", "name": "bad"})  # no ts/dur
+    with open(tpath, "w") as f:
+        json.dump(tr, f)
+    problems = tool.validate_bundle(tool.load_bundle(bundle_dir))
+    assert any("trace.json" in p for p in problems)
+    # delete an artifact: size/presence check fires
+    os.unlink(os.path.join(bundle_dir, "health.json"))
+    problems = tool.validate_bundle(tool.load_bundle(bundle_dir))
+    assert any("health.json" in p for p in problems)
+
+
+def test_report_diff_two_bundles(tmp_path):
+    tool = _load_report_tool()
+    clk = FakeClock()
+    _fresh_workload("inctest3")
+    rec = _recorder(tmp_path, now=clk, cooldown_s=1.0)
+    try:
+        rec._trigger("breaker_open", {"family": "inctest3"})
+        assert rec.drain(10)
+        clk.t += 2.0
+        time.sleep(0.002)
+        _fresh_workload("inctest3", n_ok=8, n_err=2)
+        rec._trigger("deadline", {"family": "inctest3",
+                                  "seam": "flush"})
+        assert rec.drain(10)
+        rows = rec.summary()["incidents"]
+        assert len(rows) == 2
+        a = tool.load_bundle(os.path.join(rec.directory,
+                                          rows[1]["id"]))
+        b = tool.load_bundle(os.path.join(rec.directory,
+                                          rows[0]["id"]))
+        d = tool.diff_bundles(a, b)
+        assert d["a"]["trigger"] == "breaker_open"
+        assert d["b"]["trigger"] == "deadline"
+        assert "metrics_delta" in d
+    finally:
+        rec.stop()
+
+
+# ---------------------------------------------------------------------------
+# RPC handlers
+
+
+def test_listincidents_getincident_handlers(tmp_path):
+    rec = _recorder(tmp_path, now=FakeClock())
+    try:
+        rec._trigger("breaker_open", {"family": "verify"})
+        assert rec.drain(10)
+        listh = make_listincidents(rec)
+        geth = make_getincident(rec)
+        out = asyncio.run(listh(limit=5))
+        assert out["count"] == 1 and out["enabled"]
+        # limit=0 is counts-only: totals without rows
+        zero = asyncio.run(listh(limit=0))
+        assert zero["incidents"] == [] and zero["count"] == 1
+        iid = out["incidents"][0]["id"]
+        got = asyncio.run(geth(id=iid))
+        assert got["manifest"]["trigger"]["class"] == "breaker_open"
+        got = asyncio.run(geth(id=iid, artifact="metrics.json"))
+        assert "clntpu_incidents_total" in \
+            got["artifact"]["content"]["metrics"]
+        # param validation
+        with pytest.raises(RpcError):
+            asyncio.run(listh(limit="junk"))
+        with pytest.raises(RpcError):
+            asyncio.run(listh(limit=-1))
+        with pytest.raises(RpcError):                 # path traversal
+            asyncio.run(geth(id="../../etc/passwd"))
+        with pytest.raises(RpcError):                 # unknown id
+            asyncio.run(geth(id="inc-123-9"))
+        with pytest.raises(RpcError):                 # junk artifact
+            asyncio.run(geth(id=iid, artifact="../manifest.json"))
+    finally:
+        rec.stop()
+    # no recorder installed: listincidents answers disabled, not error
+    incident.install(None)
+    out = asyncio.run(make_listincidents()())
+    assert out == {"incidents": [], "count": 0, "total_bytes": 0,
+                   "dir": None, "enabled": False}
+    with pytest.raises(RpcError):
+        asyncio.run(make_getincident()(id="inc-1-1"))
+
+
+# ---------------------------------------------------------------------------
+# the slo_breach trigger surface (obs/health.py emits breach ENTRIES)
+
+
+def test_health_engine_emits_slo_breach_entries():
+    from lightning_tpu.obs import REGISTRY
+    from lightning_tpu.obs.health import HealthEngine, SloSpec
+
+    clk = FakeClock()
+    spec = SloSpec("inc_deadline", "increase_max",
+                   {"family": "clntpu_deadline_exceeded_total",
+                    "max": 0.0,
+                    "labels": {"seam": "inc_slo_test"}},
+                   severity="major")
+    eng = HealthEngine(interval_s=0.05, ring=16, slos=[spec],
+                       registry=REGISTRY, now=clk)
+    seen: list = []
+    events.subscribe("slo_breach", seen.append)
+    try:
+        eng.tick()
+        clk.t += 5.0
+        eng.tick()          # baseline: no increase, no breach
+        assert seen == []
+        families.DEADLINE_EXCEEDED.labels("verify",
+                                          "inc_slo_test").inc()
+        clk.t += 5.0
+        eng.tick()          # the increment lands in this window
+        assert len(seen) == 1
+        assert seen[0]["slo"] == "inc_deadline"
+        assert seen[0]["severity"] == "major"
+        clk.t += 5.0
+        eng.tick()          # still violated: ENTRY already recorded
+        assert len(seen) == 1
+    finally:
+        events.unsubscribe("slo_breach", seen.append)
+
+
+# ---------------------------------------------------------------------------
+# crash path: real subprocesses
+
+
+_CRASH_COMMON = """\
+import os, sys, threading
+sys.path.insert(0, {repo!r})
+from lightning_tpu.obs import incident
+rec = incident.install(incident.IncidentRecorder(
+    {incdir!r}, process_hooks=True))
+rec.start()
+"""
+
+
+def _run_py(code: str, expect_rc) -> subprocess.CompletedProcess:
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          cwd=_REPO)
+    assert proc.returncode == expect_rc, (proc.returncode,
+                                          proc.stdout, proc.stderr)
+    return proc
+
+
+def _one_bundle(incdir: str) -> dict:
+    names = [n for n in os.listdir(incdir) if n.startswith("inc-")]
+    assert len(names) == 1, names
+    with open(os.path.join(incdir, names[0], "manifest.json")) as f:
+        man = json.load(f)
+    man["_dir"] = os.path.join(incdir, names[0])
+    return man
+
+
+def test_worker_thread_crash_produces_bundle_and_faulthandler(tmp_path):
+    incdir = str(tmp_path / "inc")
+    code = _CRASH_COMMON.format(repo=_REPO, incdir=incdir) + """
+def boom():
+    raise ValueError("worker died at 3am")
+t = threading.Thread(target=boom, name="hw-campaign-worker")
+t.start()
+t.join()
+assert rec.drain(10)
+rec.stop()
+print("survived")
+"""
+    proc = _run_py(code, expect_rc=0)
+    assert "survived" in proc.stdout     # the daemon process lives on
+    man = _one_bundle(incdir)
+    trig = man["trigger"]
+    assert trig["class"] == "thread_crash"
+    assert trig["payload"]["exception"] == "ValueError"
+    assert trig["payload"]["thread"] == "hw-campaign-worker"
+    assert "worker died at 3am" in trig["payload"]["traceback"]
+    # the faulthandler file was armed next to the bundles
+    assert os.path.isfile(os.path.join(incdir, "faulthandler.log"))
+    # incident_report renders and validates the crash bundle
+    for args in ([man["_dir"]], ["--validate", man["_dir"]]):
+        out = subprocess.run([sys.executable, _REPORT, *args],
+                             capture_output=True, text=True,
+                             timeout=120, cwd=_REPO)
+        assert out.returncode == 0, (args, out.stdout, out.stderr)
+    render = subprocess.run([sys.executable, _REPORT, man["_dir"]],
+                            capture_output=True, text=True,
+                            timeout=120, cwd=_REPO)
+    assert "thread_crash" in render.stdout
+
+
+def test_mainthread_crash_excepthook_flushes_before_exit(tmp_path):
+    incdir = str(tmp_path / "inc")
+    code = _CRASH_COMMON.format(repo=_REPO, incdir=incdir) + """
+raise RuntimeError("unhandled at top level")
+"""
+    proc = _run_py(code, expect_rc=1)
+    # the original excepthook still ran (traceback on stderr)
+    assert "unhandled at top level" in proc.stderr
+    man = _one_bundle(incdir)
+    assert man["trigger"]["class"] == "crash"
+    assert man["trigger"]["payload"]["exception"] == "RuntimeError"
+    assert man["correlation"]["exception"] == "RuntimeError"
+    assert (man["artifacts"].get("metrics.json") or {}).get("bytes")

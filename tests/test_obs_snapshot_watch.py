@@ -177,6 +177,35 @@ def test_watch_folds_health_report():
     assert "health" not in u1
 
 
+def test_watch_announces_new_incident():
+    """Captures carrying the listincidents fold (doc/incidents.md):
+    the tick a NEW bundle lands prints a `# NEW INCIDENT` line plus the
+    bundle summary in the delta; ticks without a new bundle stay
+    silent about incidents."""
+    def snap(n, rows):
+        s = _snap(n, 0, 0)
+        s["incidents"] = {"enabled": True, "count": len(rows),
+                          "total_bytes": 1000 * len(rows),
+                          "incidents": rows}
+        return s
+
+    b1 = {"id": "inc-1000-1", "trigger": "breaker_open",
+          "bytes": 1000, "age_s": 1.0}
+    b2 = {"id": "inc-2000-2", "trigger": "deadline",
+          "bytes": 1000, "age_s": 0.5}
+    snaps = [snap(0, [b1]), snap(1, [b1]), snap(2, [b2, b1])]
+    it = iter(snaps)
+    out = io.StringIO()
+    obs_snapshot.watch(lambda: next(it), 5.0, out=out, ticks=2,
+                       sleep=lambda s: None)
+    text = out.getvalue()
+    t1, t2 = _ticks_of(text)
+    assert "incidents" not in t1          # pre-existing bundle: quiet
+    assert [r["id"] for r in t2["incidents"]["new"]] == ["inc-2000-2"]
+    assert text.count("# NEW INCIDENT") == 1
+    assert "# NEW INCIDENT inc-2000-2 trigger=deadline" in text
+
+
 def test_cli_watch_local_with_ticks(capsys, monkeypatch):
     """End-to-end through main(): --local --watch --ticks captures this
     process's registry (the resilience families are present-at-zero via

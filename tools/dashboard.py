@@ -13,7 +13,10 @@ JSON-RPC socket:
     rings (the SAME rings `obs_snapshot capture --watch` folds into
     its ticks, so the two surfaces always agree);
   * the breaker / overload / shed panel (circuit-breaker states,
-    degradation-ladder states, shed counts by priority:reason).
+    degradation-ladder states, shed counts by priority:reason);
+  * the incidents panel (doc/incidents.md) — the black-box recorder's
+    recent bundles from ``listincidents``: naming trigger, age, size,
+    and how many duplicate triggers the cooldown suppressed.
 
 Stdlib only (ANSI escapes, no curses dependency), jax-free.  Live mode
 redraws every ``--interval`` seconds until Ctrl-C; ``--once`` prints a
@@ -103,18 +106,46 @@ def merge_family_points(rings: dict, family: str) -> list:
     return merged
 
 
-def fetch(rpc_path: str, points: int = 40) -> tuple[dict, dict]:
-    """One (gethealth, getmetrics) pair; the ring extract asks for the
-    headline families the sparkline panel draws."""
+def fetch(rpc_path: str, points: int = 40,
+          incident_rows: int = 5) -> tuple[dict, dict, dict | None]:
+    """One (gethealth, getmetrics, listincidents) triple; the ring
+    extract asks for the headline families the sparkline panel draws.
+    A daemon without the listincidents command (older harness) yields
+    None for the incidents panel."""
     health = rpc_call(rpc_path, "gethealth",
                       {"series": sorted(set(HEADLINE_RATES.values())),
                        "points": points})
     metrics = rpc_call(rpc_path, "getmetrics")
-    return health, metrics
+    try:
+        incidents = rpc_call(rpc_path, "listincidents",
+                             {"limit": incident_rows})
+    except SystemExit:
+        incidents = None
+    return health, metrics, incidents
 
 
-def render(health: dict, metrics: dict, color: bool = False,
-           width: int = 40) -> str:
+def _fmt_bytes(n) -> str:
+    if not isinstance(n, (int, float)):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return "-"
+
+
+def _fmt_age(s) -> str:
+    if not isinstance(s, (int, float)):
+        return "-"
+    if s < 120:
+        return f"{s:.0f}s"
+    if s < 7200:
+        return f"{s / 60:.0f}m"
+    return f"{s / 3600:.1f}h"
+
+
+def render(health: dict, metrics: dict, incidents: dict | None = None,
+           color: bool = False, width: int = 40) -> str:
     """One text frame (shared by --once and the live loop)."""
     lines: list[str] = []
     state = health.get("state", "unknown")
@@ -167,6 +198,28 @@ def render(health: dict, metrics: dict, color: bool = False,
             f"widen={_fmt(o.get('widen_factor'))}")
         for key, n in sorted((o.get("shed") or {}).items()):
             lines.append(f"    shed {key}: {n}")
+
+    # incidents panel (doc/incidents.md): the black-box recorder's
+    # recent bundles, fed from listincidents — trigger, age, size
+    if incidents is not None:
+        lines.append("")
+        rows = incidents.get("incidents") or []
+        head = (f"incidents ({incidents.get('count', 0)} bundles, "
+                f"{_fmt_bytes(incidents.get('total_bytes', 0))})"
+                if incidents.get("enabled")
+                else "incidents (recorder not installed)")
+        lines.append(_color(head, "31" if rows else "0",
+                            color and bool(rows)))
+        for row in rows:
+            supp = (f" suppressed={row.get('suppressed')}"
+                    if row.get("suppressed") else "")
+            lines.append(
+                f"  {row.get('id', '?'):<24} "
+                f"{row.get('trigger', '?'):<16} "
+                f"age={_fmt_age(row.get('age_s')):<6} "
+                f"{_fmt_bytes(row.get('bytes'))}{supp}")
+        if incidents.get("enabled") and not rows:
+            lines.append("  (none)")
     return "\n".join(lines)
 
 
@@ -192,19 +245,20 @@ def main(argv=None) -> int:
         ap.error("--points must be positive")
 
     if args.once:
-        health, metrics = fetch(args.rpc, points=args.points)
+        health, metrics, incidents = fetch(args.rpc, points=args.points)
         if args.json:
             print(json.dumps(health, indent=1, default=str))
         else:
-            print(render(health, metrics, color=False,
+            print(render(health, metrics, incidents, color=False,
                          width=args.points))
         return 0
 
     color = sys.stdout.isatty()
     try:
         while True:
-            health, metrics = fetch(args.rpc, points=args.points)
-            frame = render(health, metrics, color=color,
+            health, metrics, incidents = fetch(args.rpc,
+                                               points=args.points)
+            frame = render(health, metrics, incidents, color=color,
                            width=args.points)
             # ANSI full redraw: clear + home (stdlib-portable; no
             # curses dependency so --once and CI pipes behave)

@@ -21,6 +21,14 @@ The drive (in one process, like tools/loadgen.py's harness):
      breaker, the breach clears, and after the hysteresis ticks
      gethealth recovers to healthy.
 
+Black-box recorder assertions (doc/incidents.md) ride the same drive:
+the fault-armed phase must produce EXACTLY ONE incident bundle whose
+manifest names the breaker-open trigger and the verify family, whose
+embedded flight ring holds the failing dispatch records, and whose
+cooldown suppressed the duplicate triggers; the bundle must pass
+tools/incident_report.py --validate and render; and the recovered
+phase must produce no further bundle.
+
 Pins the suite's jax config (8-device CPU, read-only compile cache) so
 the warmed verify programs are reused — same reasoning as loadgen's
 selfcheck.
@@ -77,13 +85,17 @@ async def run_smoke() -> dict:
     from lightning_tpu.gossip import store as gstore
     from lightning_tpu.gossip import gossmap as GM
     from lightning_tpu.gossip import synth
+    from lightning_tpu.daemon.jsonrpc import (make_getincident,
+                                              make_listincidents)
     from lightning_tpu.gossip.gossipd import Gossipd
     from lightning_tpu.obs import health as _health
+    from lightning_tpu.obs import incident as _incident
     from lightning_tpu.resilience import breaker as _breaker
     from lightning_tpu.resilience import faultinject
 
     loadgen = _load_tool("loadgen")
     dashboard = _load_tool("dashboard")
+    incident_report = _load_tool("incident_report")
 
     failures: list[str] = []
     report: dict = {}
@@ -132,6 +144,15 @@ async def run_smoke() -> dict:
         interval_s=0.2, short_ticks=5, long_ticks=50, recover_ticks=3,
         slos=specs))
     rpc.register("gethealth", make_gethealth(heng))
+    # black-box recorder (doc/incidents.md): one cooldown window covers
+    # the whole degrade->recover cycle, so the drive must yield exactly
+    # one bundle, escalated to the breaker-open trigger
+    inc_dir = os.path.join(tmp, "incidents")
+    inc_rec = _incident.install(_incident.IncidentRecorder(
+        inc_dir, cooldown_s=120.0))
+    inc_rec.start()
+    rpc.register("listincidents", make_listincidents(inc_rec))
+    rpc.register("getincident", make_getincident(inc_rec))
     await rpc.start()
     rest = RestServer(rpc)
     rest_port = await rest.start()
@@ -201,6 +222,84 @@ async def run_smoke() -> dict:
             and "breaker_open" in (r.get("breached") or ()),
             12.0, "degraded with breaker_open breached")
         degraded_state = rep.get("state")
+
+        # -- black-box recorder: exactly one bundle, escalated to the
+        #    breaker-open trigger, verify family named, failing
+        #    dispatches in the frozen ring (doc/incidents.md)
+        bundle_id = None
+        deadline = time.monotonic() + 10.0
+        inc_sum: dict = {}
+        while time.monotonic() < deadline:
+            await asyncio.to_thread(inc_rec.drain, 2.0)
+            inc_sum = (await cli.call("listincidents"))["result"]
+            rows = inc_sum.get("incidents") or []
+            if rows and rows[0].get("trigger") == "breaker_open":
+                bundle_id = rows[0]["id"]
+                break
+            await asyncio.sleep(0.2)
+        if inc_sum.get("count") != 1:
+            failures.append(
+                f"expected exactly one incident bundle, found "
+                f"{inc_sum.get('count')} ({inc_sum.get('incidents')})")
+        if bundle_id is None:
+            failures.append(
+                "no bundle named breaker_open appeared "
+                f"(listincidents: {inc_sum.get('incidents')})")
+        else:
+            got = (await cli.call(
+                "getincident",
+                {"id": bundle_id, "artifact": "flight.json"}))["result"]
+            man = got["manifest"]
+            if (man.get("correlation") or {}).get("family") != "verify":
+                failures.append(
+                    "incident manifest does not name the verify family "
+                    f"({man.get('correlation')})")
+            v_recs = [r for r in got["artifact"]["content"]["records"]
+                      if r.get("family") == "verify"]
+            if not any("dispatch:verify" in (r.get("faults") or ())
+                       for r in v_recs):
+                failures.append("frozen verify ring lacks the failing "
+                                "dispatch records")
+            # the cooldown must absorb the follow-on triggers (the SLO
+            # breach entries and health transitions the open breaker
+            # causes) into the SAME episode instead of minting bundles;
+            # listincidents merges the open episode's live counts
+            suppressed = 0
+            supp_deadline = time.monotonic() + 10.0
+            while time.monotonic() < supp_deadline:
+                row = ((await cli.call("listincidents"))["result"]
+                       .get("incidents") or [{}])[0]
+                suppressed = row.get("suppressed") or 0
+                if suppressed >= 1:
+                    break
+                await asyncio.sleep(0.3)
+            if suppressed < 1:
+                failures.append(
+                    "cooldown suppressed no duplicate triggers")
+            bundle_dir = os.path.join(inc_dir, bundle_id)
+            if await asyncio.to_thread(
+                    incident_report.main,
+                    ["--validate", bundle_dir]) != 0:
+                failures.append("incident_report --validate rejected "
+                                "the bundle")
+            buf_r = io.StringIO()
+            with contextlib.redirect_stdout(buf_r):
+                rc = await asyncio.to_thread(
+                    incident_report.main, [bundle_dir])
+            if rc != 0 or "breaker_open" not in buf_r.getvalue():
+                failures.append("incident_report does not render the "
+                                "bundle with its trigger")
+            # the RPC load path feeds the same renderer
+            rpc_bundle = await asyncio.to_thread(
+                incident_report.load_bundle_rpc, rpc_path, bundle_id)
+            if incident_report.build_report(rpc_bundle).get(
+                    "trigger", {}).get("class") != "breaker_open":
+                failures.append("RPC-loaded bundle does not name "
+                                "breaker_open")
+        report["incident"] = {"id": bundle_id,
+                              "count": inc_sum.get("count"),
+                              "bytes": inc_sum.get("total_bytes")}
+
         status, body = await _rest_get(rest_port, "/health")
         if body.get("status") != degraded_state:
             failures.append(
@@ -217,6 +316,9 @@ async def run_smoke() -> dict:
         if "breaker_open" not in frame:
             failures.append("dashboard --once does not list the "
                             "breaker_open SLO")
+        if bundle_id is not None and bundle_id not in frame:
+            failures.append("dashboard --once incidents panel does "
+                            f"not list the bundle {bundle_id}")
         snap = (await cli.call("getmetrics"))["result"]
         breaches_after = _slo_breach_count(snap, "breaker_open")
         if not breaches_after > breaches_before:
@@ -244,7 +346,16 @@ async def run_smoke() -> dict:
     status, body = await _rest_get(rest_port, "/health")
     if body.get("status") != "healthy" or not body.get("ready"):
         failures.append(f"REST /health did not recover: {body}")
-    report["recovered"] = {"state": rep.get("state"), "rest": body}
+    # the drained/recovered run must produce no further bundle: the
+    # fault episode stays the only incident on disk
+    await asyncio.to_thread(inc_rec.drain, 2.0)
+    inc_after = (await cli.call("listincidents"))["result"]
+    if inc_after.get("count") != 1:
+        failures.append(
+            f"recovery produced incident bundles: count went to "
+            f"{inc_after.get('count')} ({inc_after.get('incidents')})")
+    report["recovered"] = {"state": rep.get("state"), "rest": body,
+                           "incidents": inc_after.get("count")}
 
     await cli.close()
     await gossipd.close()
@@ -252,6 +363,8 @@ async def run_smoke() -> dict:
     await rpc.close()
     heng.stop()
     _health.install(None)
+    inc_rec.stop()
+    _incident.install(None)
     report["failures"] = failures
     report["ok"] = not failures
     return report

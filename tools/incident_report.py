@@ -1,0 +1,597 @@
+#!/usr/bin/env python
+"""Postmortem renderer for incident bundles (doc/incidents.md).
+
+The black-box recorder (lightning_tpu/obs/incident.py) freezes a
+correlated forensic bundle when a trigger fires; this CLI turns a
+bundle back into the perf-report/health vocabulary an operator already
+reads:
+
+  incident_report.py BUNDLE_DIR           render one bundle
+  incident_report.py --rpc SOCK [--id I]  render over a live daemon's
+                                          getincident RPC (default:
+                                          the newest bundle)
+  incident_report.py --diff A B           what changed between two
+                                          bundles: trigger/manifest
+                                          deltas + the metrics diff
+                                          (obs_snapshot vocabulary)
+  incident_report.py --validate DIR       schema/consistency gate:
+                                          manifest fields, artifact
+                                          presence+sizes, Chrome-trace
+                                          validation, flight-ring <->
+                                          clntpu_dispatches_total
+                                          reconciliation
+  incident_report.py --selfcheck          jax-free synthetic drive for
+                                          tools/run_suite.sh: a
+                                          fault-shaped mini workload
+                                          must produce exactly one
+                                          bundle that passes --validate
+                                          and renders
+
+``--json`` dumps the structured report instead of the text frame.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _TOOLS)
+
+from lightning_tpu.obs import incident as _incident  # noqa: E402
+
+# flight-ring lifetime counts vs clntpu_dispatches_total: both are
+# lifetime totals frozen milliseconds apart during capture (the ring
+# append lands before the counter inc), so a busy daemon may be off by
+# the dispatches in flight at freeze time
+_RECONCILE_ABS = 3
+_RECONCILE_REL = 0.01
+
+
+# ---------------------------------------------------------------------------
+# loading
+
+
+def load_bundle(path: str) -> dict:
+    """Bundle dir -> {"manifest": ..., "<artifact>": ...} for whatever
+    is present on disk."""
+    out: dict = {"_path": os.path.abspath(path)}
+    man = os.path.join(path, "manifest.json")
+    with open(man, encoding="utf8") as f:
+        out["manifest"] = json.load(f)
+    for name in _incident.ARTIFACTS:
+        p = os.path.join(path, name)
+        if os.path.isfile(p):
+            with open(p, encoding="utf8") as f:
+                out[name] = json.load(f)
+    return out
+
+
+def load_bundle_rpc(rpc_path: str, incident_id: str | None = None) -> dict:
+    """The same bundle shape fetched over a live daemon's
+    listincidents/getincident RPCs."""
+    from obs_snapshot import rpc_call
+
+    if incident_id is None:
+        listing = rpc_call(rpc_path, "listincidents", {"limit": 1})
+        rows = listing.get("incidents") or []
+        if not rows:
+            raise SystemExit("no incident bundles on this daemon")
+        incident_id = rows[0]["id"]
+    got = rpc_call(rpc_path, "getincident", {"id": incident_id})
+    out: dict = {"_path": f"rpc:{incident_id}",
+                 "manifest": got["manifest"]}
+    for name in got["manifest"].get("artifacts", {}):
+        try:
+            art = rpc_call(rpc_path, "getincident",
+                           {"id": incident_id, "artifact": name})
+            out[name] = art["artifact"]["content"]
+        except SystemExit:
+            pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rendering
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _age(ts) -> str:
+    if not ts:
+        return "-"
+    s = max(0.0, time.time() - ts)
+    if s < 120:
+        return f"{s:.0f}s"
+    if s < 7200:
+        return f"{s / 60:.0f}m"
+    return f"{s / 3600:.1f}h"
+
+
+def _flight_digest(flight_art: dict) -> dict:
+    """Per-family outcome histogram + worst dispatch off the embedded
+    ring."""
+    fams: dict = {}
+    for rec in flight_art.get("records", ()):
+        fam = rec.get("family", "?")
+        d = fams.setdefault(fam, {"ring": 0, "outcomes": {},
+                                  "faults": 0, "quarantined": 0,
+                                  "slowest_ms": 0.0, "slowest_id": None})
+        d["ring"] += 1
+        out = rec.get("outcome") or "?"
+        d["outcomes"][out] = d["outcomes"].get(out, 0) + 1
+        if rec.get("faults"):
+            d["faults"] += 1
+        d["quarantined"] += rec.get("quarantined") or 0
+        total_ms = ((rec.get("queue_wait_ms") or 0)
+                    + (rec.get("prep_ms") or 0)
+                    + (rec.get("dispatch_ms") or 0))
+        if total_ms > d["slowest_ms"]:
+            d["slowest_ms"] = round(total_ms, 1)
+            d["slowest_id"] = rec.get("dispatch_id")
+    for fam, summ in (flight_art.get("summary", {})
+                      .get("families", {})).items():
+        fams.setdefault(fam, {"ring": 0, "outcomes": {}, "faults": 0,
+                              "quarantined": 0, "slowest_ms": 0.0,
+                              "slowest_id": None})["total"] = \
+            summ.get("total")
+    return fams
+
+
+def build_report(bundle: dict) -> dict:
+    """The structured report (--json form; render() draws it)."""
+    man = bundle.get("manifest", {})
+    rep: dict = {
+        "id": man.get("id"),
+        "trigger": man.get("trigger"),
+        "correlation": man.get("correlation"),
+        "episode": man.get("episode"),
+        "history": man.get("history"),
+        "suppressed": man.get("suppressed"),
+        "captured_at": man.get("captured_at"),
+        "recaptures": man.get("recaptures"),
+        "capture_errors": man.get("capture_errors"),
+        "artifacts": man.get("artifacts"),
+        "trace_problems": man.get("trace_problems"),
+    }
+    health = bundle.get("health.json")
+    if health:
+        rep["health"] = {
+            "state": health.get("state"),
+            "breached": health.get("breached"),
+            "slos": {n: {"status": s.get("status"),
+                         "observed": s.get("observed"),
+                         "threshold": s.get("threshold"),
+                         "burn_short": s.get("burn_short"),
+                         "burn_long": s.get("burn_long"),
+                         "breaches_total": s.get("breaches_total")}
+                     for n, s in (health.get("slos") or {}).items()},
+            "rates": health.get("rates"),
+        }
+    res = bundle.get("resilience.json")
+    if res:
+        rep["breakers"] = (res.get("resilience") or {}).get("breakers")
+        rep["faults_armed"] = (res.get("resilience") or {}).get(
+            "faults_armed")
+        rep["overload"] = {
+            f: {"state": o.get("state"),
+                "backlog": o.get("backlog"),
+                "peak_backlog": o.get("peak_backlog"),
+                "shed": o.get("shed")}
+            for f, o in ((res.get("overload") or {})
+                         .get("families") or {}).items()}
+    flight_art = bundle.get("flight.json")
+    if flight_art:
+        rep["flight"] = _flight_digest(flight_art)
+        # the perf-observatory vocabulary over the FROZEN rings — the
+        # same attribution model getperf/perf_report serve live
+        metrics = (bundle.get("metrics.json") or {}).get("metrics", {})
+        try:
+            from lightning_tpu.obs import attribution
+
+            perf = attribution.report_from_snapshot({
+                "metrics": metrics,
+                "dispatch_log": flight_art.get("records", ()),
+                "dispatches": flight_art.get("summary", {}),
+            })
+            rep["perf"] = attribution.compact(perf)
+        except Exception as e:
+            rep["perf_error"] = f"{type(e).__name__}: {e}"
+    knobs = bundle.get("knobs.json")
+    if knobs:
+        rep["knobs_set"] = {k: v.get("value")
+                            for k, v in sorted(knobs.items())
+                            if v.get("source") == "env"}
+    trace_art = bundle.get("trace.json")
+    if trace_art:
+        rep["trace_events"] = len(trace_art.get("traceEvents") or ())
+    return rep
+
+
+def render(bundle: dict) -> str:
+    rep = build_report(bundle)
+    trig = rep.get("trigger") or {}
+    lines = [
+        f"incident {rep.get('id')}  trigger={trig.get('class')}"
+        f"  severity={trig.get('severity')}"
+        f"  captured={_age(rep.get('captured_at'))} ago"
+        f"  recaptures={rep.get('recaptures', 0)}",
+        f"  correlation: {json.dumps(rep.get('correlation') or {})}",
+    ]
+    hist = rep.get("history") or []
+    if hist:
+        lines.append("  history: " + " -> ".join(
+            f"{h.get('class')}({h.get('action')})" for h in hist))
+    supp = rep.get("suppressed") or {}
+    if supp:
+        lines.append("  suppressed in cooldown: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(supp.items())))
+    errs = rep.get("capture_errors") or {}
+    if errs:
+        lines.append("  CAPTURE ERRORS: " + ", ".join(
+            f"{k}: {v}" for k, v in sorted(errs.items())))
+    h = rep.get("health")
+    if h:
+        lines.append("")
+        lines.append(f"health at capture: {h.get('state')}"
+                     + (f"  breached={','.join(h.get('breached') or [])}"
+                        if h.get("breached") else ""))
+        lines.append("  SLO                 status   observed   "
+                     "threshold  burn_s  burn_l  breaches")
+        for name, s in sorted((h.get("slos") or {}).items()):
+            lines.append(
+                f"    {name:<17} {s.get('status', '?'):<8} "
+                f"{_fmt(s.get('observed')):>9}  "
+                f"{_fmt(s.get('threshold')):>9}  "
+                f"{_fmt(s.get('burn_short')):>6}  "
+                f"{_fmt(s.get('burn_long')):>6}  "
+                f"{s.get('breaches_total', 0):>8}")
+    brk = rep.get("breakers")
+    if brk:
+        lines.append("")
+        lines.append("breakers: " + "  ".join(
+            f"{f}={b.get('state')}(trips {b.get('trips', 0)})"
+            for f, b in sorted(brk.items())))
+    if rep.get("faults_armed"):
+        lines.append("faults armed: " + ",".join(rep["faults_armed"]))
+    ovl = rep.get("overload")
+    if ovl:
+        for fam, o in sorted(ovl.items()):
+            shed = o.get("shed") or {}
+            lines.append(
+                f"overload {fam:<7} {o.get('state', '?'):<9} "
+                f"backlog={o.get('backlog', 0)} "
+                f"peak={o.get('peak_backlog', 0)}"
+                + (f" shed={sum(shed.values())}" if shed else ""))
+    fl = rep.get("flight")
+    if fl:
+        lines.append("")
+        lines.append("flight rings (frozen)")
+        for fam, d in sorted(fl.items()):
+            outcomes = ",".join(f"{k}:{v}" for k, v in
+                                sorted(d.get("outcomes", {}).items()))
+            lines.append(
+                f"  {fam:<8} ring={d.get('ring', 0)}"
+                f"/{_fmt(d.get('total'))} lifetime  [{outcomes}]"
+                + (f" faults={d['faults']}" if d.get("faults") else "")
+                + (f" quarantined={d['quarantined']}"
+                   if d.get("quarantined") else "")
+                + (f" slowest={d['slowest_ms']}ms"
+                   f"(id {d['slowest_id']})"
+                   if d.get("slowest_id") else ""))
+    perf = rep.get("perf")
+    if perf:
+        lines.append("")
+        lines.append("perf attribution (frozen rings; doc/perf.md)")
+        for fam, row in sorted((perf.get("families") or {}).items()):
+            lines.append(
+                f"  {fam:<8} bottleneck={row.get('bottleneck')}"
+                f"  critical_path={_fmt(row.get('critical_path_s'))}s"
+                f"  overlap={_fmt(row.get('overlap_ratio'))}")
+        if perf.get("retraces"):
+            lines.append(f"  retraces: {perf['retraces']}")
+    lines.append("")
+    lines.append(
+        f"trace: {rep.get('trace_events', 0)} events, "
+        f"{rep.get('trace_problems') if rep.get('trace_problems') is not None else '?'} "
+        "validation problems")
+    knobs = rep.get("knobs_set")
+    if knobs:
+        lines.append("knobs set via env: " + ", ".join(
+            f"{k}={v}" for k, v in knobs.items()))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# diff
+
+
+def diff_bundles(a: dict, b: dict) -> dict:
+    """What changed between two bundles: the manifest-level deltas plus
+    the metrics diff in tools/obs_snapshot.py's vocabulary."""
+    from obs_snapshot import diff_snapshots
+
+    am, bm = a.get("manifest", {}), b.get("manifest", {})
+    out: dict = {
+        "a": {"id": am.get("id"),
+              "trigger": (am.get("trigger") or {}).get("class"),
+              "captured_at": am.get("captured_at")},
+        "b": {"id": bm.get("id"),
+              "trigger": (bm.get("trigger") or {}).get("class"),
+              "captured_at": bm.get("captured_at")},
+    }
+    ah = a.get("health.json") or {}
+    bh = b.get("health.json") or {}
+    if ah or bh:
+        out["health"] = {"a": ah.get("state"), "b": bh.get("state"),
+                         "breached_a": ah.get("breached"),
+                         "breached_b": bh.get("breached")}
+    if "metrics.json" in a and "metrics.json" in b:
+        out["metrics_delta"] = diff_snapshots(a["metrics.json"],
+                                              b["metrics.json"])
+    abrk = ((a.get("resilience.json") or {}).get("resilience")
+            or {}).get("breakers") or {}
+    bbrk = ((b.get("resilience.json") or {}).get("resilience")
+            or {}).get("breakers") or {}
+    changed = {f: {"a": abrk.get(f, {}).get("state"),
+                   "b": bbrk.get(f, {}).get("state")}
+               for f in sorted(set(abrk) | set(bbrk))
+               if abrk.get(f, {}).get("state")
+               != bbrk.get(f, {}).get("state")}
+    if changed:
+        out["breakers_changed"] = changed
+    return out
+
+
+# ---------------------------------------------------------------------------
+# validation
+
+
+def validate_bundle(bundle: dict) -> list[str]:
+    """Consistency gate over one loaded bundle; returns problems
+    (empty == valid)."""
+    problems: list[str] = []
+    man = bundle.get("manifest")
+    if not isinstance(man, dict):
+        return ["manifest.json missing or not an object"]
+    if man.get("schema") != _incident.MANIFEST_SCHEMA:
+        problems.append(f"manifest schema {man.get('schema')!r} != "
+                        f"{_incident.MANIFEST_SCHEMA}")
+    trig = man.get("trigger") or {}
+    if trig.get("class") not in _incident.SEVERITY:
+        problems.append(f"unknown trigger class {trig.get('class')!r}")
+    for key in ("id", "correlation", "episode", "history",
+                "captured_at", "artifacts"):
+        if man.get(key) is None:
+            problems.append(f"manifest lacks {key!r}")
+    if (man.get("correlation") or {}).get("class") != trig.get("class"):
+        problems.append("correlation block does not name the trigger "
+                        "class")
+    # artifact presence + recorded sizes (on-disk bundles only)
+    path = bundle.get("_path", "")
+    for name, info in (man.get("artifacts") or {}).items():
+        if name not in bundle:
+            problems.append(f"artifact {name} listed but not loaded")
+            continue
+        if path and not path.startswith("rpc:"):
+            p = os.path.join(path, name)
+            if not os.path.isfile(p):
+                problems.append(f"artifact {name} missing on disk")
+            elif os.path.getsize(p) != info.get("bytes"):
+                problems.append(
+                    f"artifact {name} size {os.path.getsize(p)} != "
+                    f"manifest {info.get('bytes')}")
+    # trace export must satisfy the Perfetto-enforced subset
+    trace_art = bundle.get("trace.json")
+    if trace_art is not None:
+        from lightning_tpu.obs import traceexport
+
+        errs = traceexport.validate(trace_art)
+        if errs:
+            problems.append(
+                f"trace.json fails validation ({len(errs)}): {errs[0]}")
+    elif "trace.json" in (man.get("artifacts") or {}):
+        problems.append("trace.json listed but unreadable")
+    # ring<->counter reconciliation: the embedded flight summary's
+    # lifetime totals must agree with clntpu_dispatches_total in the
+    # frozen metrics snapshot (both lifetime counts, frozen together)
+    flight_art = bundle.get("flight.json")
+    metrics = (bundle.get("metrics.json") or {}).get("metrics")
+    if flight_art is not None and metrics is not None:
+        fam_counts: dict[str, float] = {}
+        disp = metrics.get("clntpu_dispatches_total") or {}
+        for s in disp.get("samples", ()):
+            fam = (s.get("labels") or {}).get("family")
+            fam_counts[fam] = fam_counts.get(fam, 0.0) \
+                + s.get("value", 0.0)
+        for fam, summ in (flight_art.get("summary", {})
+                          .get("families", {})).items():
+            ring_total = summ.get("total", 0)
+            counter = fam_counts.get(fam, 0.0)
+            tol = max(_RECONCILE_ABS, _RECONCILE_REL * max(ring_total,
+                                                           counter))
+            if abs(counter - ring_total) > tol:
+                problems.append(
+                    f"ring<->counter reconciliation failed for {fam}: "
+                    f"flight lifetime {ring_total} vs "
+                    f"clntpu_dispatches_total {counter}")
+            ring_len = summ.get("ring", 0)
+            in_ring = sum(1 for r in flight_art.get("records", ())
+                          if r.get("family") == fam)
+            if in_ring != ring_len:
+                problems.append(
+                    f"{fam}: summary says ring={ring_len} but "
+                    f"{in_ring} records embedded")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# selfcheck (the run_suite.sh incident-smoke pass)
+
+
+def selfcheck() -> int:
+    """Jax-free synthetic drive: a fault-shaped mini workload against
+    the REAL recorder must produce exactly one bundle whose manifest
+    names the breaker-open trigger, whose embedded verify ring holds
+    the failing dispatch records, and which passes validate_bundle()
+    and renders."""
+    import tempfile
+
+    from lightning_tpu.obs import flight
+    from lightning_tpu.resilience import breaker
+    from lightning_tpu.utils import events, trace
+
+    failures: list[str] = []
+    tmp = tempfile.mkdtemp(prefix="incident_selfcheck_")
+    rec = _incident.IncidentRecorder(tmp, cooldown_s=120,
+                                     max_bundles=4)
+    rec.start()
+
+    # a mini "daemon": correlated enqueue -> dispatch spans + flight
+    # records, two of which eat an injected-fault-shaped failure
+    n_ok, n_err = 6, 2
+    for i in range(n_ok + n_err):
+        failing = i >= n_ok
+        with trace.span("ingest/submit"):
+            carrier = trace.new_corr()
+        with trace.span("verify/dispatch", corr=carrier):
+            try:
+                with flight.dispatch(
+                        "verify", corr_ids=flight.corr_ids([carrier]),
+                        shape=(64, 8), n_real=50 + i, lanes=64) as drec:
+                    if failing:
+                        drec["faults"].append("dispatch:verify")
+                        raise RuntimeError("selfcheck injected failure")
+            except RuntimeError:
+                pass
+    # quarantine first (low severity), then the breaker opens: ONE
+    # bundle, escalated to breaker_open, quarantine in its history
+    events.emit("quarantine",
+                {"family": "verify", "row": 1, "reason": "bisect"})
+    brk = breaker.get("verify")
+    brk.force_open()
+    brk.force_open()    # duplicate inside the cooldown -> absorbed
+    if not rec.drain(15.0):
+        failures.append("capture worker did not drain")
+    rec.stop()
+
+    summ = rec.summary()
+    if summ["count"] != 1:
+        failures.append(f"expected exactly 1 bundle, found "
+                        f"{summ['count']}")
+    report_txt = ""
+    if summ["incidents"]:
+        row = summ["incidents"][0]
+        if row["trigger"] != "breaker_open":
+            failures.append(
+                f"bundle named {row['trigger']!r}, want breaker_open")
+        bundle = load_bundle(os.path.join(tmp, row["id"]))
+        man = bundle["manifest"]
+        if (man.get("correlation") or {}).get("family") != "verify":
+            failures.append("manifest correlation does not name the "
+                            "verify family")
+        if not any(h.get("class") == "quarantine"
+                   for h in man.get("history", ())):
+            failures.append("quarantine trigger missing from history")
+        if man.get("suppressed", {}).get("breaker_open", 0) < 1:
+            failures.append("cooldown did not record the suppressed "
+                            "duplicate breaker_open")
+        recs = [r for r in bundle.get("flight.json", {})
+                .get("records", ()) if r.get("family") == "verify"]
+        if len(recs) != n_ok + n_err:
+            failures.append(f"verify ring holds {len(recs)} records, "
+                            f"want {n_ok + n_err}")
+        if sum(1 for r in recs if r.get("outcome") == "error") != n_err:
+            failures.append("failing dispatches missing from the "
+                            "embedded ring")
+        if not any("dispatch:verify" in (r.get("faults") or ())
+                   for r in recs):
+            failures.append("fault annotation missing from the ring")
+        problems = validate_bundle(bundle)
+        for p in problems:
+            failures.append(f"validate: {p}")
+        try:
+            report_txt = render(bundle)
+            if "breaker_open" not in report_txt:
+                failures.append("render does not name the trigger")
+        except Exception as e:
+            failures.append(f"render raised {type(e).__name__}: {e}")
+        # --diff plumbing against itself must run clean
+        try:
+            diff_bundles(bundle, bundle)
+        except Exception as e:
+            failures.append(f"diff raised {type(e).__name__}: {e}")
+    breaker.get("verify").reset()
+    if report_txt:
+        print(report_txt)
+        print()
+    for f in failures:
+        print(f"incident-selfcheck: FAIL: {f}", file=sys.stderr)
+    print("incident-selfcheck: PASS" if not failures
+          else "incident-selfcheck: FAIL")
+    return 0 if not failures else 1
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/incident_report.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("bundle", nargs="?",
+                    help="incident bundle directory to render")
+    ap.add_argument("--rpc", help="daemon unix socket: render via "
+                                  "listincidents/getincident")
+    ap.add_argument("--id", help="bundle id (with --rpc; default "
+                                 "newest)")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                    help="diff two bundle directories")
+    ap.add_argument("--validate", metavar="DIR",
+                    help="validate a bundle directory (exit 1 on any "
+                         "problem)")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="synthetic end-to-end gate (run_suite.sh)")
+    ap.add_argument("--json", action="store_true",
+                    help="structured output instead of the text frame")
+    args = ap.parse_args(argv)
+
+    if args.selfcheck:
+        return selfcheck()
+    if args.validate:
+        problems = validate_bundle(load_bundle(args.validate))
+        for p in problems:
+            print(f"INVALID: {p}", file=sys.stderr)
+        print("valid" if not problems else
+              f"{len(problems)} problem(s)")
+        return 0 if not problems else 1
+    if args.diff:
+        a, b = (load_bundle(p) for p in args.diff)
+        print(json.dumps(diff_bundles(a, b), indent=1, default=str))
+        return 0
+    if args.rpc:
+        bundle = load_bundle_rpc(args.rpc, args.id)
+    elif args.bundle:
+        bundle = load_bundle(args.bundle)
+    else:
+        ap.error("need a bundle dir, --rpc, --diff, --validate, or "
+                 "--selfcheck")
+    if args.json:
+        print(json.dumps(build_report(bundle), indent=1, default=str))
+    else:
+        print(render(bundle))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

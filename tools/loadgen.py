@@ -341,6 +341,26 @@ async def run_load(args, slo: dict) -> dict:
         slos=_health.default_slo_specs(slo)))
     rpc.register("gethealth", make_gethealth(heng))
     heng.start()
+
+    # black-box recorder (doc/incidents.md), restricted to the
+    # FAULT-shaped trigger classes: a storm handled by shedding and
+    # admission control is the system working as designed — breaching
+    # overload SLOs is expected here, but a breaker opening, a blown
+    # deadline, a quarantine, or a crash would be a real defect.  The
+    # post-storm assertion is therefore ZERO bundles: the drained and
+    # recovered run leaves no forensic incident behind.
+    from lightning_tpu.daemon.jsonrpc import (make_getincident,
+                                              make_listincidents)
+    from lightning_tpu.obs import incident as _incident
+
+    inc_rec = _incident.install(_incident.IncidentRecorder(
+        os.path.join(tmp, "incidents"),
+        triggers=("breaker_open", "deadline", "quarantine",
+                  "thread_crash", "crash"),
+        process_hooks=True))    # crash classes need the excepthooks
+    inc_rec.start()
+    rpc.register("listincidents", make_listincidents(inc_rec))
+    rpc.register("getincident", make_getincident(inc_rec))
     await rpc.start()
     gossipd.start()
     router.start()
@@ -544,6 +564,11 @@ async def run_load(args, slo: dict) -> dict:
             time.monotonic() < recover_deadline:
         await asyncio.sleep(0.5)
         health_final = (await cli.call("gethealth"))["result"]
+    # the black-box recorder saw the whole storm: a fault-class bundle
+    # means a breaker opened / a deadline blew / rows quarantined —
+    # never expected from a clean overload drive
+    await asyncio.to_thread(inc_rec.drain, 5.0)
+    incidents_after = (await cli.call("listincidents"))["result"]
     await cli.close()
     ovl = metrics.get("overload", {})
     if "ingest" not in ovl.get("families", {}) or \
@@ -560,6 +585,8 @@ async def run_load(args, slo: dict) -> dict:
     await rpc.close()
     heng.stop()
     _health.install(None)
+    inc_rec.stop()
+    _incident.install(None)
 
     # -- SLO evaluation ----------------------------------------------------
     storm_wall = max(report.get("storm_wall_s", 0.001), 0.001)
@@ -590,6 +617,7 @@ async def run_load(args, slo: dict) -> dict:
         "route_p99_s": round(p99, 4),
         "sign_batches": sign_stats["batches"],
         "ingest_state_after": bp.get("state"),
+        "incidents_after": incidents_after.get("count"),
         "health": {
             "states_seen": sorted(s for s in health_seen["states"] if s),
             "breached_seen": sorted(health_seen["breached"]),
@@ -689,6 +717,13 @@ async def run_load(args, slo: dict) -> dict:
             f"health engine did not recover after drain (state "
             f"{health_final.get('state')}, breached "
             f"{health_final.get('breached')})")
+    # the drained/recovered run produces no forensic incident: the
+    # fault-class recorder must have captured NOTHING (doc/incidents.md
+    # — overload handled by design is not an incident)
+    if incidents_after.get("count"):
+        failures.append(
+            f"storm left {incidents_after.get('count')} fault-class "
+            f"incident bundle(s): {incidents_after.get('incidents')}")
     # agreement between the two evaluators on the shared SLOs — the
     # drift check this harness exists to catch.  The live engine is
     # windowed (strictly more sensitive than one whole-storm number),
@@ -808,7 +843,8 @@ def main(argv=None) -> int:
         h = r.get("health", {})
         print(f"loadgen: health states={h.get('states_seen')} "
               f"breached={h.get('breached_seen')} "
-              f"final={h.get('final_state')}")
+              f"final={h.get('final_state')} "
+              f"incidents={r.get('incidents_after')}")
     for f in report["failures"]:
         print(f"loadgen: SLO FAIL: {f}", file=sys.stderr)
     print("loadgen: PASS" if report["ok"] else "loadgen: FAIL")

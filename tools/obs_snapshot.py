@@ -43,6 +43,11 @@ rolled-up state, per-SLO statuses, and window rates read from the
 engine's time-series rings — the same numbers tools/dashboard.py
 renders — falling back to plain local diffing on daemons without the
 engine.
+
+Captures additionally carry the `listincidents` summary when the
+daemon runs the black-box recorder (doc/incidents.md); --watch prints
+a `# NEW INCIDENT ...` line (plus the bundle summary in the delta)
+the tick a new bundle lands mid-watch.
 """
 from __future__ import annotations
 
@@ -96,6 +101,12 @@ def capture_rpc(rpc_path: str, dispatches: int | None = None) -> dict:
             snap["health"] = health
     except (SystemExit, OSError, ValueError, KeyError):
         pass
+    try:
+        inc = rpc_call(rpc_path, "listincidents", {"limit": 8})
+        if inc.get("enabled"):
+            snap["incidents"] = inc
+    except (SystemExit, OSError, ValueError, KeyError):
+        pass  # no black-box recorder behind this socket
     return snap
 
 
@@ -125,6 +136,12 @@ def capture_url(url: str, rune: str | None = None,
             snap["health"] = health
     except Exception:
         pass  # no health engine behind this gateway: local diffing only
+    try:
+        inc = post("listincidents", {"limit": 8})
+        if inc.get("enabled"):
+            snap["incidents"] = inc
+    except Exception:
+        pass  # no black-box recorder behind this gateway
     return snap
 
 
@@ -144,6 +161,11 @@ def capture_local(dispatches: int | None = None) -> dict:
     snap["perf"] = attribution.report_local(metrics=snap["metrics"])
     if dispatches:
         snap["dispatch_log"] = flight.recent(limit=dispatches)
+    from lightning_tpu.obs import incident as _incident
+
+    rec = _incident.current()
+    if rec is not None:
+        snap["incidents"] = rec.summary(limit=8)
     return snap
 
 
@@ -203,6 +225,21 @@ def diff_snapshots(a: dict, b: dict) -> dict:
             out["health"] = _health.compact(b["health"])
         except Exception:
             out["health"] = b["health"]
+    # incident bundles (listincidents, doc/incidents.md): the diff
+    # keeps only the bundles NEW since `a` — the "--watch prints a line
+    # when a new incident lands" hook reads this
+    if "incidents" in b:
+        seen_inc = {r.get("id")
+                    for r in (a.get("incidents") or {}).get(
+                        "incidents", [])}
+        new_inc = [r for r in b["incidents"].get("incidents", [])
+                   if r.get("id") not in seen_inc]
+        if new_inc:
+            out["incidents"] = {
+                "new": new_inc,
+                "count": b["incidents"].get("count"),
+                "total_bytes": b["incidents"].get("total_bytes"),
+            }
     # flight records captured with --dispatches: the diff keeps only
     # the dispatches NEW since `a`, so a --watch tick shows WHICH
     # dispatch blew up a counter delta, not just that one did
@@ -238,6 +275,13 @@ def watch(capture, interval: float, out=None,
             stamp = datetime.datetime.now().isoformat(timespec="seconds")
             delta = diff_snapshots(prev, cur)
             print(f"# {stamp} (+{interval:g}s)", file=out, flush=False)
+            for row in (delta.get("incidents") or {}).get("new", []):
+                # a bundle landed mid-watch: call it out on its own
+                # line, not just inside the delta JSON
+                print(f"# NEW INCIDENT {row.get('id')} "
+                      f"trigger={row.get('trigger')} "
+                      f"bytes={row.get('bytes')}", file=out,
+                      flush=False)
             print(json.dumps(delta if delta else {}, indent=1),
                   file=out, flush=True)
             prev = cur

@@ -120,6 +120,20 @@ timeout 300 python tools/perf_report.py --selfcheck \
 timeout 300 python tools/perf_report.py --compare \
   || { echo "perf compare gate failed"; exit 1; }
 
+# Incident-smoke pass (doc/incidents.md): the black-box recorder is
+# driven with a jax-free fault-shaped mini workload — correlated flight
+# records and trace spans, quarantine then breaker-open triggers — and
+# must produce exactly ONE bundle, escalated to the breaker-open
+# trigger with the quarantine history and the suppressed duplicate
+# recorded, that passes the full bundle validation (manifest schema,
+# Chrome-trace export, flight-ring <-> clntpu_dispatches_total
+# reconciliation) and renders.  The LIVE-daemon incident acceptance
+# (dispatch:verify:raise:1 -> one breaker-open bundle) rides the
+# health-smoke pass below.
+echo "incident-smoke pass (tools/incident_report.py --selfcheck)"
+timeout 300 python tools/incident_report.py --selfcheck \
+  || { echo "incident selfcheck failed"; exit 1; }
+
 # Fault-matrix pass (doc/resilience.md): re-run the resilience suite
 # with deterministic faults armed at every named device seam — dispatch
 # raises for verify/route, the mesh reshard and the sign kernel fail
@@ -141,8 +155,12 @@ LIGHTNING_TPU_DEADLINE_INGEST_S=240 \
 # grammar must trip the verify breaker, flip gethealth (and REST
 # GET /health and tools/dashboard.py --once) to degraded with
 # breaker_open named and clntpu_slo_breach_total incremented, then
-# recover to healthy after disarm.  Pins the same jax config as the
-# soak-lite pass so the warmed verify programs are reused.
+# recover to healthy after disarm.  The black-box recorder rides the
+# same drive (doc/incidents.md): the fault phase must freeze exactly
+# one breaker-open bundle with the verify family and failing
+# dispatches inside, validated + rendered by incident_report.py, and
+# recovery must add none.  Pins the same jax config as the soak-lite
+# pass so the warmed verify programs are reused.
 echo "health-smoke pass (tools/health_smoke.py)"
 timeout 1200 python tools/health_smoke.py \
   || { echo "health-smoke failed"; exit 1; }
@@ -159,4 +177,4 @@ timeout 1200 python tools/health_smoke.py \
 echo "overload soak-lite pass (tools/loadgen.py --selfcheck)"
 timeout 1200 python tools/loadgen.py --selfcheck \
   || { echo "loadgen selfcheck failed"; exit 1; }
-echo "suite green (2 slices + graftlint + perf smoke + fault matrix + health smoke + soak-lite)"
+echo "suite green (2 slices + graftlint + perf smoke + incident smoke + fault matrix + health smoke + soak-lite)"
