@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import signal
 import sys
 
 from .node import LightningNode
@@ -66,6 +67,49 @@ async def amain(args) -> int:
         from .hsmd import Hsm
 
         hsm = Hsm(privkey.to_bytes(32, "big") if privkey else _os.urandom(32))
+    # boot recovery phase (doc/recovery.md): BEFORE anything reads the
+    # gossip store or serves RPC.  The clean-shutdown marker says whether
+    # the last run crashed; if so, discover its incident bundles, recover
+    # the store (torn tail truncated, crc-bad rows quarantined), sweep
+    # the db (phantom pending payments → retryable-failed, journal blobs
+    # validated, hook replica reconciled).
+    recovery_report = None
+    db_replica = None
+    if args.data_dir:
+        from . import recovery as _recovery
+
+        rep_knob = _os.environ.get("LIGHTNING_TPU_DB_REPLICA")
+        if rep_knob and wallet is not None:
+            from ..wallet.db import FileReplica
+
+            rep_path = (_os.path.join(args.data_dir, "db_replica.jsonl")
+                        if rep_knob == "1" else rep_knob)
+            db_replica = FileReplica(rep_path)
+        gpath_boot = args.gossip_store or _os.path.join(
+            args.data_dir, "gossip_store")
+        recovery_report = _recovery.boot_recover(
+            args.data_dir, store_path=gpath_boot,
+            db=wallet.db if wallet is not None else None,
+            replica=db_replica)
+        if recovery_report["state"] == "crash":
+            srep = recovery_report.get("store") or {}
+            print(f"crash recovery: store {srep.get('records', 0)} "
+                  f"records ({srep.get('truncated_bytes', 0)} torn bytes "
+                  f"truncated, {srep.get('dropped', 0)} dropped), "
+                  f"{len(recovery_report['incidents'])} prior incident "
+                  f"bundle(s), db fixups "
+                  f"{recovery_report['db_fixups']}", flush=True)
+        if db_replica is not None:
+            # journal every committed transaction from here on (the
+            # db_write hook streams pre-commit; see wallet/db.py)
+            wallet.db.set_db_write_hook(db_replica)
+
+    def finish_clean() -> None:
+        if args.data_dir and recovery_report is not None:
+            from . import recovery as _recovery
+
+            _recovery.mark_clean(args.data_dir)
+
     if hsm is not None:
         # the node's network identity IS the hsm node key, so payment
         # onions addressed to our node_id are peelable (hsmd ECDH parity)
@@ -140,7 +184,14 @@ async def amain(args) -> int:
         from ..gossip import gossmap as GM
         from ..gossip import store as gstore
 
-        store_idx = gstore.load_store(args.gossip_store)
+        # the boot recovery phase already scanned (and possibly
+        # repaired) this exact file — reuse its index instead of
+        # paying a second mmap+scan
+        if (recovery_report is not None
+                and recovery_report.get("_store_idx") is not None):
+            store_idx = recovery_report["_store_idx"]
+        else:
+            store_idx = gstore.load_store(args.gossip_store)
         gossmap_ref["map"] = GM.from_store(store_idx)
         g = gossmap_ref["map"]
         print(f"gossmap: {g.n_channels} channels, {g.n_nodes} nodes",
@@ -206,7 +257,13 @@ async def amain(args) -> int:
         gpath = args.gossip_store or _os.path.join(args.data_dir,
                                                    "gossip_store")
         gossipd = Gossipd(node, gpath, gossmap_ref=gossmap_ref)
-        loaded = gossipd.load_existing(gpath, idx=store_idx)
+        boot_idx = store_idx
+        if (boot_idx is None and recovery_report is not None
+                and recovery_report.get("_store_idx") is not None):
+            # recovery scanned this same file (gpath == the boot store
+            # path whenever --data-dir is set)
+            boot_idx = recovery_report["_store_idx"]
+        loaded = gossipd.load_existing(gpath, idx=boot_idx)
         gossipd.start()
         # pre-compile the verify kernels off the live path (a cold
         # first compile inside a live gossip flush stalls acceptance
@@ -307,6 +364,18 @@ async def amain(args) -> int:
 
     rpc = None
     stop_event = asyncio.Event()
+    # SIGINT/SIGTERM request an ORDERLY shutdown via stop_event, so the
+    # serve loop below runs the full teardown and writes the "clean"
+    # marker last.  Without handlers, asyncio.run's KeyboardInterrupt
+    # path cancels the teardown mid-await and the next boot would treat
+    # an operator ^C as a crash (doc/recovery.md marker semantics).
+    # kill -9 (the crashmatrix path) bypasses handlers by construction.
+    try:
+        _loop = asyncio.get_running_loop()
+        for _sig in (signal.SIGINT, signal.SIGTERM):
+            _loop.add_signal_handler(_sig, stop_event.set)
+    except (NotImplementedError, RuntimeError):
+        pass   # non-main thread or platform without signal support
     rpc_path = args.rpc_file or (
         _os.path.join(args.data_dir, "lightning-rpc") if args.data_dir
         else None
@@ -454,6 +523,16 @@ async def amain(args) -> int:
             without deadlocking the loop on its own plugin pipe."""
             if db is None:
                 return
+            if db.db_write_hook is not None and not getattr(
+                    db.db_write_hook, "_plugin_bridge", False):
+                # a non-plugin hook (the LIGHTNING_TPU_DB_REPLICA file
+                # replica) owns the slot; a plugin db_write hook cannot
+                # displace the durability journal
+                if plugin_host.hooks.get("db_write"):
+                    print("db_write plugin hook ignored: the file "
+                          "replica owns the db_write slot",
+                          file=sys.stderr, flush=True)
+                return
             if not plugin_host.hooks.get("db_write"):
                 if db.db_write_hook is not None and \
                         getattr(db.db_write_hook, "_plugin_bridge", False):
@@ -561,6 +640,7 @@ async def amain(args) -> int:
             if wss is not None:
                 await wss.close()
             await node.close()
+            finish_clean()
             return 1
         if not args.stay:
             if rpc is not None:
@@ -568,6 +648,7 @@ async def amain(args) -> int:
             if wss is not None:
                 await wss.close()
             await node.close()
+            finish_clean()
             return 0
 
     # serve until interrupted or `stop` RPC
@@ -600,6 +681,13 @@ async def amain(args) -> int:
     if topology is not None:
         await topology.stop()
     await node.close()
+    if db_replica is not None:
+        if wallet is not None and wallet.db.db_write_hook is db_replica:
+            wallet.db.set_db_write_hook(None)
+        db_replica.close()
+    # the LAST shutdown act: everything above has flushed, so the next
+    # boot may trust the marker (doc/recovery.md marker semantics)
+    finish_clean()
     return 0
 
 

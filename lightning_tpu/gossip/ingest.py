@@ -497,8 +497,7 @@ class GossipIngest:
             self.writer.append_many(
                 [it.raw for it in self._accepted],
                 [getattr(it.parsed, "timestamp", 0)
-                 for it in self._accepted])
-            self.writer.sync()
+                 for it in self._accepted], sync=True)
             self.stats.accepted += len(self._accepted)
             _M_ACCEPTED.inc(len(self._accepted))
             if self.on_accept is not None:

@@ -12,13 +12,19 @@ flat numpy arrays; no per-record Python objects anywhere.
 """
 from __future__ import annotations
 
+import logging
 import mmap
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs import families as _f
+from ..resilience import faultinject as _fault
+from ..resilience import quarantine as _quarantine
 from ..utils import native
+
+log = logging.getLogger("lightning_tpu.gossip.store")
 
 VERSION_BYTE = 0x10  # major 0, minor 16
 # flag bits per the reference's common/gossip_store.h
@@ -64,14 +70,31 @@ class StoreIndex:
         return len(self.offsets)
 
 
+def _empty_index() -> StoreIndex:
+    """A zero-record StoreIndex (the fresh-daemon bootstrap view)."""
+    return StoreIndex(
+        np.frombuffer(bytes([VERSION_BYTE]), dtype=np.uint8),
+        np.zeros(0, np.uint64), np.zeros(0, np.uint32),
+        np.zeros(0, np.uint16), np.zeros(0, np.uint32),
+        np.zeros(0, np.uint32), np.zeros(0, np.uint16))
+
+
 def load_store(path: str) -> StoreIndex:
     """mmap the store (zero-copy — at the 1M-record scale the file is
     hundreds of MB) and scan it natively.  The mmap stays alive as long
-    as the returned StoreIndex's buf does."""
+    as the returned StoreIndex's buf does.
+
+    A missing or empty store (or the 1-byte version header only) is the
+    fresh-daemon bootstrap case and loads as a zero-record index; a
+    TORN store (partial record at EOF) still raises — callers that must
+    survive a crash mid-append go through recover_store(), which
+    truncates the torn tail CLN-style and re-loads."""
+    if not os.path.exists(path):
+        return _empty_index()
     with open(path, "rb") as f:
         size = os.fstat(f.fileno()).st_size
         if size < 1:
-            raise ValueError("empty gossip store")
+            return _empty_index()
         mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
     buf = np.frombuffer(mm, dtype=np.uint8)
     ver = int(buf[0])
@@ -79,6 +102,137 @@ def load_store(path: str) -> StoreIndex:
         raise ValueError(f"incompatible gossip store major version {ver >> 5}")
     d = native.gossip_store_scan(buf, start_off=1)
     return StoreIndex(buf, **d)
+
+
+def scan_valid_prefix(path: str) -> int:
+    """Length in bytes of the longest prefix holding only COMPLETE
+    records (record walk off the be16 length fields; == file size when
+    the store is intact).  Pure Python, used only on the recovery path:
+    the native scanner reports THAT a store is torn, not where."""
+    with open(path, "rb") as f:
+        data = f.read()
+    size = len(data)
+    if size < 1:
+        return 0
+    off = 1
+    while off + 12 <= size:
+        ln = int.from_bytes(data[off + 2 : off + 4], "big")
+        if off + 12 + ln > size:
+            break
+        off += 12 + ln
+    return off
+
+
+@dataclass
+class StoreRecovery:
+    """What recover_store() found and did (doc/recovery.md)."""
+
+    path: str
+    bootstrapped: bool = False     # store was missing/empty, created fresh
+    size: int = 0                  # byte size after recovery
+    truncated_bytes: int = 0       # torn tail dropped (0 = tail was clean)
+    crc_bad: int = 0               # rows that failed check_crcs()
+    requalified: int = 0           # crc-bad rows the host re-check kept
+    dropped: int = 0               # crc-bad rows flagged deleted
+    records: int = 0               # records in the recovered index
+    dropped_rows: list = field(default_factory=list)
+
+
+def recover_store(path: str, *, check_sigs=None,
+                  check_crc: bool = True) -> tuple[StoreIndex, StoreRecovery]:
+    """Load a store that may have been torn by a crash.
+
+    CLN's gossip_store load truncates at the first bad record and
+    carries on; this is that, with the write-then-rename discipline
+    compact_store() documents (never truncate in place — loaded
+    StoreIndexes are live mmaps) and the PR-4 quarantine accounting:
+
+    * missing/empty store → created fresh (bootstrap);
+    * partial record at EOF (crash mid-append) → the torn tail is
+      truncated via tmp-file + fsync + os.replace, logged and metered;
+    * rows failing check_crcs() are NOT silently trusted: each is
+      diverted through quarantine accounting and host re-checked via
+      ``check_sigs(msgs) -> [bool]`` (daemon/recovery.py injects a
+      pure-host signature oracle); rows that fail get FLAG_DELETED
+      flipped in place, rows that pass are kept (the crc covers
+      timestamp+msg, so a corrupt timestamp can fail crc while the
+      self-authenticating signature still proves the message).
+      ``check_sigs=None`` drops every crc-bad row.
+
+    Returns (index, StoreRecovery).  Raises only on an incompatible
+    version byte — there is nothing safe to salvage behind that."""
+    rep = StoreRecovery(path=path)
+    if not os.path.exists(path) or os.path.getsize(path) == 0:
+        with open(path, "wb") as f:
+            f.write(bytes([VERSION_BYTE]))
+            f.flush()
+            os.fsync(f.fileno())
+        rep.bootstrapped = True
+        rep.size = 1
+        log.info("gossip store %s missing/empty: bootstrapped fresh", path)
+        return _empty_index(), rep
+
+    size = os.path.getsize(path)
+    valid_end = scan_valid_prefix(path)
+    if valid_end < size:
+        # torn tail: crash mid-append.  Write-then-rename, never
+        # truncate in place (live mmaps of the old inode stay valid).
+        with open(path, "rb") as f:
+            good = f.read(valid_end)
+        tmp = path + f".recover.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(good)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        rep.truncated_bytes = size - valid_end
+        _f.RECOVERY_STORE_TRUNCATED_BYTES.inc(rep.truncated_bytes)
+        log.warning("gossip store %s: torn tail (%d bytes past offset %d) "
+                    "truncated", path, rep.truncated_bytes, valid_end)
+
+    idx = load_store(path)
+    rep.records = len(idx)
+    rep.size = os.path.getsize(path)
+    if not check_crc or len(idx) == 0:
+        return idx, rep
+
+    ok = idx.check_crcs()
+    bad = np.flatnonzero(~ok)
+    if len(bad) == 0:
+        return idx, rep
+    rep.crc_bad = int(len(bad))
+    _quarantine.note("store", "crc_mismatch", rep.crc_bad)
+    keep = np.zeros(len(bad), bool)
+    if check_sigs is not None:
+        msgs = [idx.message(int(i)) for i in bad]
+        try:
+            keep = np.asarray(check_sigs(msgs), bool)
+        except Exception:
+            log.exception("host re-check of crc-bad rows failed; "
+                          "dropping all %d", rep.crc_bad)
+            keep = np.zeros(len(bad), bool)
+    rep.requalified = int(keep.sum())
+    drop = bad[~keep]
+    rep.dropped = int(len(drop))
+    rep.dropped_rows = [int(i) for i in drop]
+    if rep.requalified:
+        _f.RECOVERY_STORE_ROWS.labels("requalified").inc(rep.requalified)
+    if rep.dropped:
+        _f.RECOVERY_STORE_ROWS.labels("dropped").inc(rep.dropped)
+        # flag-flip in place (the mark_deleted discipline: the crc
+        # covers timestamp+msg only, so flag writes never tear records)
+        with open(path, "r+b") as f:
+            for i in drop:
+                f.seek(int(idx.offsets[i]) - 12)
+                f.write((int(idx.flags[i]) | FLAG_DELETED)
+                        .to_bytes(2, "big"))
+            f.flush()
+            os.fsync(f.fileno())
+        idx.flags[drop] |= FLAG_DELETED
+    log.warning("gossip store %s: %d crc-bad row(s) — %d requalified by "
+                "host re-check, %d dropped", path, rep.crc_bad,
+                rep.requalified, rep.dropped)
+    return idx, rep
 
 
 class StoreWriter:
@@ -92,6 +246,23 @@ class StoreWriter:
         if fresh:
             self.f.write(bytes([VERSION_BYTE]))
 
+    def _write(self, blob: bytes) -> None:
+        """One seam-instrumented store write.  When a crash fault is
+        armed at the append seam, the write is split so the kill lands
+        MID-record — modelling the real torn-append window a SIGKILL
+        leaves (recover_store truncates it on the next boot); for
+        raise/hang actions the seam fires before any byte is written,
+        so an injected error never corrupts the store."""
+        if blob and _fault.crash_armed("append", "store"):
+            half = max(1, len(blob) // 2)
+            self.f.write(blob[:half])
+            self.f.flush()
+            _fault.fire("append", "store")
+            self.f.write(blob[half:])
+        else:
+            _fault.fire("append", "store")
+            self.f.write(blob)
+
     def append(self, msg: bytes, timestamp: int = 0, flags: int = 0,
                sync: bool = False):
         """Append one record.  sync=True makes the record durable before
@@ -104,7 +275,7 @@ class StoreWriter:
             + crc.to_bytes(4, "big")
             + int(timestamp).to_bytes(4, "big")
         )
-        self.f.write(hdr + msg)
+        self._write(hdr + msg)
         if sync:
             self.sync()
 
@@ -112,7 +283,16 @@ class StoreWriter:
         self.f.flush()
         os.fsync(self.f.fileno())
 
-    def append_many(self, msgs, timestamps=None):
+    def append_many(self, msgs, timestamps=None, sync: bool = False):
+        """Append a batch as ONE contiguous write.
+
+        Same durability contract as append(): sync=True makes the whole
+        batch durable before returning.  Ordering guarantee: records
+        reach the file in argument order within one write(2)-sized
+        burst, so a crash can only lose a SUFFIX of the batch (plus, if
+        it lands mid-write, one torn record at the cut that
+        recover_store() truncates) — it can never persist record i+1
+        without record i, and never reorders records."""
         parts = []
         for i, msg in enumerate(msgs):
             ts = int(timestamps[i]) if timestamps is not None else 0
@@ -121,7 +301,9 @@ class StoreWriter:
                 (0).to_bytes(2, "big") + len(msg).to_bytes(2, "big")
                 + crc.to_bytes(4, "big") + ts.to_bytes(4, "big") + msg
             )
-        self.f.write(b"".join(parts))
+        self._write(b"".join(parts))
+        if sync:
+            self.sync()
 
     def close(self):
         self.f.close()

@@ -691,6 +691,30 @@ def _select_device_fn(bucket: int, n_sigs: int):
     return _fused_device_fn(bucket)
 
 
+def _host_device_fn(items: "VerifyItems", roi: np.ndarray, bucket: int):
+    """LIGHTNING_TPU_VERIFY_DEVICE=off: a bucket dispatcher that routes
+    straight to the host oracle — the FULL pipeline still runs (producer
+    overlap, breaker/quarantine supervision, fault seams, flight
+    records), but no device program is ever compiled or dispatched.
+    Bit-identical to the device path by the oracle's construction.
+
+    For CPU-only daemons and subprocess harnesses (tools/crashmatrix.py
+    children) where a one-core jax compile would stall startup for
+    minutes; the kill-seam coverage of the verify pipeline depends on
+    the real pipeline machinery running, which a verify_items() stub
+    would bypass."""
+
+    def dispatch(pb: "_PreparedBucket") -> np.ndarray:
+        _M_R_BUCKETS.labels("host_off").inc()
+        ok = np.zeros(bucket, bool)
+        if pb.n_real:
+            ok[:pb.n_real] = _host_verify_selected(
+                items, roi, pb.sel[: pb.n_real])
+        return ok
+
+    return dispatch
+
+
 _DONE = object()
 
 
@@ -854,7 +878,10 @@ def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
     if depth is None:
         depth = int(_os.environ.get("LIGHTNING_TPU_REPLAY_DEPTH", "2"))
     if device_fn is None:
-        device_fn = _select_device_fn(bucket, N)
+        if _os.environ.get("LIGHTNING_TPU_VERIFY_DEVICE", "auto") == "off":
+            device_fn = _host_device_fn(items, roi, bucket)
+        else:
+            device_fn = _select_device_fn(bucket, N)
     # every bucket dispatch (injected test doubles included) runs under
     # the verify breaker + quarantine supervision, and each is one
     # flight-recorded dispatch whose record lands in `flight_recs`

@@ -254,6 +254,43 @@ INCIDENT_BYTES = REGISTRY.gauge(
     "Total bytes of incident bundles on disk (bounded by "
     "LIGHTNING_TPU_INCIDENT_MAX_BYTES with oldest-first rotation)")
 
+# -- daemon/recovery.py + gossip/store.py: crash-consistent restart --------
+# (doc/recovery.md owns the semantics: the clean-shutdown marker, the
+# torn-tail truncation rules, and the boot reconciliation sweep.)
+RECOVERY_BOOTS = REGISTRY.counter(
+    "clntpu_recovery_boots_total",
+    "Daemon boots by what the clean-shutdown marker said about the "
+    "previous run (first_boot = no marker, clean = orderly shutdown, "
+    "crash = the marker still said running)",
+    labelnames=("state",))
+RECOVERY_STORE_ROWS = REGISTRY.counter(
+    "clntpu_recovery_store_rows_total",
+    "Store records handled by the recovery scan, by action "
+    "(requalified = crc-bad but host re-check passed, dropped = "
+    "crc-bad and failed the re-check, flagged deleted)",
+    labelnames=("action",))
+RECOVERY_STORE_TRUNCATED_BYTES = REGISTRY.counter(
+    "clntpu_recovery_store_truncated_bytes_total",
+    "Torn-tail bytes truncated off the gossip store at recovery "
+    "(a crash mid-append leaves at most one partial record at EOF)")
+RECOVERY_DB_FIXUPS = REGISTRY.counter(
+    "clntpu_recovery_db_fixups_total",
+    "Rows fixed by the boot db reconciliation sweep, by kind "
+    "(payment_failed = pending payment older than the crash marked "
+    "retryable-failed, retransmit_reset / inflight_reset = journal "
+    "blob invalid against channel state, replica_dropped = hook "
+    "replica was ahead by one and its tail record was dropped)",
+    labelnames=("kind",))
+RECOVERY_INCIDENTS_FOUND = REGISTRY.counter(
+    "clntpu_recovery_incidents_found_total",
+    "Incident bundles from the previous (crashed) run discovered and "
+    "logged during boot recovery")
+RECOVERY_SECONDS = REGISTRY.histogram(
+    "clntpu_recovery_seconds",
+    "Wall time of the whole boot recovery phase (marker check + store "
+    "scan + optional verify replay + db reconciliation)",
+    buckets=DURATION_BUCKETS)
+
 # -- obs/flight.py: the dispatch flight recorder (doc/tracing.md) ----------
 DISPATCHES = REGISTRY.counter(
     "clntpu_dispatches_total",

@@ -320,6 +320,20 @@ class IncidentRecorder:
             return self._cond.wait_for(lambda: self._pending == 0,
                                        timeout)
 
+    def note_crash(self, reason: str, extra: dict | None = None,
+                   timeout: float = 10.0) -> bool:
+        """Synchronously freeze a crash bundle: trigger + drain.
+
+        The excepthook path captures interpreter-unwinding crashes, but
+        a deliberate ``os._exit`` (the fault grammar's crash action)
+        skips every hook — callers about to hard-exit use this to make
+        sure the bundle the next boot will look for is on disk first.
+        Returns False when the capture did not flush within timeout."""
+        self._trigger("crash", {"reason": reason,
+                                "thread": threading.current_thread().name,
+                                **(extra or {})})
+        return self.drain(timeout)
+
     # -- trigger intake (emitter threads; must stay cheap) -----------------
 
     def _make_subscriber(self, topic: str):

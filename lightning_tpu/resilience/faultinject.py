@@ -16,11 +16,17 @@ Spec grammar (comma-separated specs in ``LIGHTNING_TPU_FAULT`` or
     seam:family:action:rate[:arg]
 
 * ``seam``   — where: ``prep``, ``dispatch``, ``readback``, ``mesh``,
-               ``sign``, ``producer`` (or ``*``).
+               ``sign``, ``producer``, ``append`` (store append),
+               ``commit`` (db commit) (or ``*``).
 * ``family`` — which dispatch family: ``verify``, ``route``, ``sign``,
-               ``mesh``, ``ingest`` (or ``*``).
-* ``action`` — ``raise`` (throw ``FaultInjected``) or ``hang``
-               (sleep ``arg`` seconds, default 0.05, then continue).
+               ``mesh``, ``ingest``, ``store``, ``db`` (or ``*``).
+* ``action`` — ``raise`` (throw ``FaultInjected``), ``hang``
+               (sleep ``arg`` seconds, default 0.05, then continue),
+               or ``crash`` (freeze a crash incident bundle, flush
+               output, then ``os._exit(arg)`` — default exit code 137,
+               the kill -9 convention; tools/crashmatrix.py drives
+               every seam through this and asserts the restart
+               recovers).
 * ``rate``   — fraction of matching calls that fire, in (0, 1];
                default 1.  Firing is DETERMINISTIC, not random: spec
                call counts walk a Bresenham schedule
@@ -32,6 +38,7 @@ Examples::
     LIGHTNING_TPU_FAULT=dispatch:verify:raise:0.1
     LIGHTNING_TPU_FAULT=sign:sign:raise:0.5,mesh:mesh:raise:1
     LIGHTNING_TPU_FAULT=producer:verify:hang:1:30     # 30 s hang, every call
+    LIGHTNING_TPU_FAULT=append:store:crash:1          # die mid-append
 
 Disarmed (no env, nothing ``arm()``-ed), ``fire()`` is one dict lookup
 — cheap enough for per-bucket dispatch sites.
@@ -52,8 +59,9 @@ from ..utils import events
 
 log = logging.getLogger("lightning_tpu.resilience.faultinject")
 
-SEAMS = ("prep", "dispatch", "readback", "mesh", "sign", "producer")
-ACTIONS = ("raise", "hang")
+SEAMS = ("prep", "dispatch", "readback", "mesh", "sign", "producer",
+         "append", "commit")
+ACTIONS = ("raise", "hang", "crash")
 
 
 class FaultInjected(RuntimeError):
@@ -102,7 +110,11 @@ def parse(spec_str: str) -> list[_Spec]:
         rate = float(fields[3]) if len(fields) > 3 and fields[3] else 1.0
         if not 0.0 < rate <= 1.0:
             raise ValueError(f"fault spec {part!r}: rate must be in (0, 1]")
-        arg = float(fields[4]) if len(fields) > 4 else 0.05
+        # arg: hang = sleep seconds; crash = exit code (137 mirrors the
+        # shell's kill -9 convention, so harnesses can tell an injected
+        # kill from an ordinary nonzero exit)
+        default_arg = 137.0 if action == "crash" else 0.05
+        arg = float(fields[4]) if len(fields) > 4 else default_arg
         out.append(_Spec(seam, family, action, rate, arg, part))
     return out
 
@@ -152,9 +164,57 @@ def fire(seam: str, family: str) -> None:
                     {"seam": seam, "family": family, "spec": spec.raw})
         if spec.action == "hang":
             time.sleep(spec.arg)
+        elif spec.action == "crash":
+            _crash(seam, family, spec)
         else:
             raise FaultInjected(
                 f"injected fault at {seam}:{family} (spec {spec.raw!r})")
+
+
+def crash_armed(seam: str, family: str) -> bool:
+    """True when a crash-action spec matches this seam+family.  Does NOT
+    consume any spec's Bresenham schedule — seams that must stage a
+    partial write for the kill to land mid-record (the store append
+    torn-tail window) check this before deciding where to place their
+    ``fire()`` call."""
+    if not _armed and not os.environ.get("LIGHTNING_TPU_FAULT"):
+        return False
+    return any(
+        s.action == "crash"
+        and s.seam in ("*", seam) and s.family in ("*", family)
+        for s in (*_env_specs(), *_armed))
+
+
+def _crash(seam: str, family: str, spec: _Spec) -> None:
+    """The crash action: freeze a crash bundle, flush, ``os._exit``.
+
+    ``os._exit`` skips atexit/excepthook on purpose — the whole point is
+    to model a SIGKILL-grade death that gives NOTHING a chance to clean
+    up — so the incident bundle the black box owes the next boot
+    (doc/recovery.md: "prior crash bundle discovered") must be captured
+    synchronously here, before the exit."""
+    log.critical("injected crash at %s:%s (spec %r): freezing incident "
+                 "bundle, then os._exit", seam, family, spec.raw)
+    try:
+        from ..obs import incident as _incident
+
+        rec = _incident.current()
+        if rec is not None and rec.running:
+            rec.note_crash(
+                f"injected crash at {seam}:{family}",
+                {"seam": seam, "family": family, "spec": spec.raw})
+    except Exception:
+        log.exception("crash-bundle capture failed; exiting anyway")
+    try:
+        import sys as _sys
+
+        _sys.stdout.flush()
+        _sys.stderr.flush()
+        for h in logging.getLogger().handlers:
+            h.flush()
+    except Exception:
+        pass
+    os._exit(int(spec.arg))
 
 
 @contextlib.contextmanager

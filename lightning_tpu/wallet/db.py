@@ -11,9 +11,16 @@ checkpoint; there is no other state.
 """
 from __future__ import annotations
 
+import json
+import logging
+import os
 import sqlite3
 import threading
 from contextlib import contextmanager
+
+from ..resilience import faultinject as _fault
+
+log = logging.getLogger("lightning_tpu.wallet.db")
 
 # Append-only migration list (wallet/migrations.c pattern).
 MIGRATIONS: list[str] = [
@@ -173,6 +180,7 @@ class Db:
         self.db_write_hook = None    # fn(data_version, [(sql, None)])
         self._batching = False       # `batching` RPC: defer commits
         self._version_lock = threading.Lock()
+        self._data_version = 0   # provisional: transaction() reads it
         self._migrate()
         v = self.get_var("data_version")
         self._data_version = int(v) if v is not None else 0
@@ -293,8 +301,23 @@ class Db:
             return
         try:
             yield c
+            v_before = self._data_version
             if self.db_write_hook is not None:
                 self._flush_writes(c)   # pre-commit: hook can veto
+            # the commit fault seam sits in the hook-replica
+            # "ahead by one" window (hook delivered, COMMIT not yet
+            # durable) — a crash armed here is exactly the case the
+            # boot reconciliation resolves (doc/recovery.md)
+            try:
+                _fault.fire("commit", "db")
+            except BaseException:
+                # an injected pre-commit failure must give the version
+                # number back, same as a hook veto, or the replica
+                # stream would skip a version
+                with self._version_lock:
+                    if self._data_version == v_before + 1:
+                        self._data_version = v_before
+                raise
             c.commit()
         except BaseException:
             c.rollback()
@@ -337,3 +360,125 @@ class Db:
         if conn is not None:
             conn.close()
             self._local.conn = None
+
+    def reconcile_replica(self, replica_version: int | None) -> str:
+        """Classify a db_write-hook replica's last-seen data_version
+        against the primary's durable one (the docstring's monotone
+        lock-step contract).  Pure classification — the caller applies
+        the fix; reconcile_file_replica() is the boot-time driver.
+
+        * ``empty``        — replica has seen nothing yet (fresh);
+        * ``in_sync``      — versions match;
+        * ``ahead_by_one`` — the documented crash window: the hook
+          streamed a transaction whose COMMIT never became durable.
+          The replica must DROP its tail record;
+        * ``behind``       — the replica missed transactions (only
+          possible if it was attached late or lost data; needs a
+          full resync, not a tail fix);
+        * ``diverged``     — ahead by more than one: impossible under
+          the hook contract, so something rewrote history."""
+        if replica_version is None:
+            return "empty"
+        rv, dv = int(replica_version), self._data_version
+        if rv == dv:
+            return "in_sync"
+        if rv == dv + 1:
+            return "ahead_by_one"
+        if rv < dv:
+            return "behind"
+        return "diverged"
+
+
+class FileReplica:
+    """Durable db_write-hook consumer: a line-JSON journal of every
+    streamed transaction batch (``{"v": data_version, "writes":
+    [sql...]}``), fsynced BEFORE the primary's COMMIT returns — the
+    tested stand-in for the reference's backup plugin.
+
+    Because the hook streams pre-commit, a crash inside the commit
+    window leaves this journal AHEAD of the primary by exactly one
+    record (Db docstring); a crash mid-journal-append leaves a torn
+    last LINE instead, which the reader ignores.  Both cases resolve on
+    boot via reconcile_file_replica(): the unacknowledged tail record
+    is dropped write-then-rename, never truncated in place."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "ab")
+
+    def __call__(self, version: int, batch) -> None:
+        line = json.dumps(
+            {"v": int(version), "writes": [sql for sql, _ in batch]},
+            separators=(",", ":")) + "\n"
+        with self._lock:
+            self._f.write(line.encode())
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def records(self) -> list[dict]:
+        """Parsed journal records; a torn/partial last line (crash
+        mid-append) is dropped silently — it was never acknowledged."""
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return []
+        out = []
+        for ln in data.split(b"\n"):
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                break   # torn tail: everything after it is garbage
+            if not isinstance(rec, dict) or "v" not in rec:
+                break
+            out.append(rec)
+        return out
+
+    def last_version(self) -> int | None:
+        recs = self.records()
+        return int(recs[-1]["v"]) if recs else None
+
+    def drop_last(self) -> None:
+        """Drop the newest complete record (write-then-rename)."""
+        recs = self.records()
+        if not recs:
+            return
+        blob = b"".join(
+            json.dumps(r, separators=(",", ":")).encode() + b"\n"
+            for r in recs[:-1])
+        tmp = self.path + f".reconcile.{os.getpid()}"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._f.close()
+            self._f = open(self.path, "ab")
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+def reconcile_file_replica(db: Db, replica: FileReplica) -> str:
+    """Boot-time replica reconciliation (doc/recovery.md): classify via
+    Db.reconcile_replica and resolve the one self-healable verdict —
+    ahead-by-one drops the replica's unacknowledged tail record.
+    Returns the verdict ("dropped_ahead" when a tail was dropped)."""
+    verdict = db.reconcile_replica(replica.last_version())
+    if verdict == "ahead_by_one":
+        replica.drop_last()
+        log.warning("db replica %s was ahead by one (crash between "
+                    "db_write hook and commit); dropped its tail record",
+                    replica.path)
+        return "dropped_ahead"
+    if verdict in ("behind", "diverged"):
+        log.error("db replica %s is %s the primary (replica v%s, "
+                  "primary v%d): needs a full resync",
+                  replica.path, verdict, replica.last_version(),
+                  db._data_version)
+    return verdict

@@ -1,0 +1,275 @@
+"""Torn-store recovery, bootstrap, and append-ordering guarantees
+(gossip/store.py recovery surface; doc/recovery.md).
+
+Parity: the reference's gossip_store load truncates at the first bad
+record and carries on (gossipd/gossip_store.c) — these tests pin that
+behavior plus the parts the reference doesn't have: crc quarantine with
+host requalification, and the crash-armed mid-record write seam.
+"""
+import os
+
+import pytest
+
+from lightning_tpu.gossip import store as gstore
+from lightning_tpu.resilience import faultinject as fault
+
+
+def msg(i: int, n: int = 40) -> bytes:
+    """Distinct fake gossip message (type word + payload)."""
+    return (257).to_bytes(2, "big") + bytes([i] * n)
+
+
+def build(path: str, n: int = 3) -> list[bytes]:
+    msgs = [msg(i) for i in range(n)]
+    with gstore.StoreWriter(path) as w:
+        for i, m in enumerate(msgs):
+            w.append(m, timestamp=100 + i)
+        w.sync()
+    return msgs
+
+
+# -- bootstrap --------------------------------------------------------------
+
+def test_load_store_missing_and_empty(tmp_path):
+    missing = str(tmp_path / "nope.gs")
+    assert len(gstore.load_store(missing)) == 0
+
+    empty = str(tmp_path / "empty.gs")
+    open(empty, "wb").close()
+    assert len(gstore.load_store(empty)) == 0
+
+    header_only = str(tmp_path / "hdr.gs")
+    with open(header_only, "wb") as f:
+        f.write(bytes([gstore.VERSION_BYTE]))
+    assert len(gstore.load_store(header_only)) == 0
+
+
+def test_recover_bootstrap(tmp_path):
+    path = str(tmp_path / "fresh.gs")
+    idx, rep = gstore.recover_store(path)
+    assert rep.bootstrapped and rep.records == 0 and len(idx) == 0
+    with open(path, "rb") as f:
+        assert f.read() == bytes([gstore.VERSION_BYTE])
+    # second boot: the store exists now, nothing to bootstrap
+    _, rep2 = gstore.recover_store(path)
+    assert not rep2.bootstrapped and rep2.truncated_bytes == 0
+    # a bootstrapped store is appendable and loadable round-trip
+    with gstore.StoreWriter(path) as w:
+        w.append(msg(7), timestamp=1, sync=True)
+    assert len(gstore.load_store(path)) == 1
+
+
+# -- torn tail --------------------------------------------------------------
+
+def test_scan_valid_prefix(tmp_path):
+    path = str(tmp_path / "s.gs")
+    build(path, 3)
+    size = os.path.getsize(path)
+    assert gstore.scan_valid_prefix(path) == size
+
+    # torn: half of a 4th record's bytes at EOF
+    blob = (0).to_bytes(2, "big") + (40).to_bytes(2, "big") + bytes(8) \
+        + bytes(40)
+    with open(path, "ab") as f:
+        f.write(blob[: len(blob) // 2])
+    assert gstore.scan_valid_prefix(path) == size
+
+
+def test_torn_tail_truncated(tmp_path):
+    path = str(tmp_path / "torn.gs")
+    msgs = build(path, 3)
+    size = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b"\x00\x00\x00\x28garbage")     # header + partial body
+    torn = os.path.getsize(path) - size
+
+    with pytest.raises(ValueError):
+        gstore.load_store(path)                  # native scan: torn
+    idx, rep = gstore.recover_store(path)
+    assert rep.truncated_bytes == torn
+    assert rep.records == 3 and os.path.getsize(path) == size
+    assert [idx.message(i) for i in range(3)] == msgs
+    # idempotent: a second recovery finds nothing to do
+    _, rep2 = gstore.recover_store(path)
+    assert rep2.truncated_bytes == 0 and rep2.records == 3
+
+
+# -- crc quarantine ---------------------------------------------------------
+
+def _flip(path, offset):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_crc_bad_payload_dropped(tmp_path):
+    path = str(tmp_path / "crc.gs")
+    build(path, 4)
+    idx0 = gstore.load_store(path)
+    _flip(path, int(idx0.offsets[1]) + 10)       # payload byte of rec 1
+
+    idx, rep = gstore.recover_store(
+        path, check_sigs=lambda msgs: [False] * len(msgs))
+    assert rep.crc_bad == 1 and rep.dropped == 1 and rep.requalified == 0
+    assert rep.dropped_rows == [1]
+    assert idx.flags[1] & gstore.FLAG_DELETED
+    # the flag flip is durable: a plain reload sees 3 alive records
+    again = gstore.load_store(path)
+    assert int(again.alive().sum()) == 3
+    assert not again.alive()[1]
+
+
+def test_crc_bad_timestamp_requalified(tmp_path):
+    path = str(tmp_path / "req.gs")
+    build(path, 3)
+    idx0 = gstore.load_store(path)
+    # corrupt the HEADER timestamp of rec 2: crc covers (timestamp,
+    # msg) so it breaks, but the message bytes are intact — exactly
+    # the case the host signature re-check exists to requalify
+    _flip(path, int(idx0.offsets[2]) - 4)
+
+    seen = []
+
+    def check_sigs(msgs):
+        seen.extend(msgs)
+        return [True] * len(msgs)
+
+    idx, rep = gstore.recover_store(path, check_sigs=check_sigs)
+    assert rep.crc_bad == 1 and rep.requalified == 1 and rep.dropped == 0
+    assert seen == [msg(2)]                      # message bytes intact
+    assert int(idx.alive().sum()) == 3           # nothing flagged
+
+
+def test_check_sigs_failure_fails_closed(tmp_path):
+    path = str(tmp_path / "closed.gs")
+    build(path, 2)
+    idx0 = gstore.load_store(path)
+    _flip(path, int(idx0.offsets[0]) + 5)
+
+    def boom(msgs):
+        raise RuntimeError("oracle down")
+
+    _, rep = gstore.recover_store(path, check_sigs=boom)
+    assert rep.crc_bad == 1 and rep.dropped == 1 and rep.requalified == 0
+
+
+def test_check_crc_off_trusts_rows(tmp_path):
+    path = str(tmp_path / "trust.gs")
+    build(path, 2)
+    idx0 = gstore.load_store(path)
+    _flip(path, int(idx0.offsets[0]) + 5)
+    _, rep = gstore.recover_store(path, check_crc=False)
+    assert rep.crc_bad == 0 and rep.records == 2
+
+
+# -- append_many ordering / durability contract -----------------------------
+
+def test_append_many_sync_and_suffix_only_loss(tmp_path):
+    path = str(tmp_path / "many.gs")
+    msgs = [msg(i) for i in range(5)]
+    with gstore.StoreWriter(path) as w:
+        w.append_many(msgs, [10 + i for i in range(5)], sync=True)
+    data = open(path, "rb").read()
+    assert len(gstore.load_store(path)) == 5
+
+    # regression for the ordering guarantee: ANY byte-prefix of the
+    # batch recovers to a record-PREFIX of the argument order — never a
+    # reorder, never record i+1 without record i
+    for cut in range(1, len(data)):
+        part = str(tmp_path / "cut.gs")
+        with open(part, "wb") as f:
+            f.write(data[:cut])
+        idx, _ = gstore.recover_store(part)
+        got = [idx.message(i) for i in range(len(idx))]
+        assert got == msgs[: len(got)], f"cut at {cut}"
+
+
+# -- the crash-armed append seam --------------------------------------------
+
+def test_raise_action_never_corrupts_store(tmp_path):
+    path = str(tmp_path / "raise.gs")
+    with gstore.StoreWriter(path) as w:
+        with fault.arm("append:store:raise:1"):
+            with pytest.raises(fault.FaultInjected):
+                w.append(msg(0), timestamp=1)
+        # the seam fires BEFORE any byte is written: store still clean
+        w.append(msg(1), timestamp=2, sync=True)
+    idx = gstore.load_store(path)
+    assert len(idx) == 1 and idx.message(0) == msg(1)
+
+
+def test_crash_armed_append_tears_midrecord(tmp_path, monkeypatch):
+    """The split-write window: when a crash spec is armed the seam
+    fires with HALF the record on disk, modelling the mid-append kill.
+    (The real action os._exits; here fire() is stubbed to raise so the
+    torn file can be inspected in-process.)"""
+    path = str(tmp_path / "tear.gs")
+    build(path, 2)
+    size = os.path.getsize(path)
+
+    monkeypatch.setattr(gstore._fault, "crash_armed",
+                        lambda seam, family: True)
+
+    def fake_fire(seam, family):
+        raise RuntimeError("killed here")
+
+    monkeypatch.setattr(gstore._fault, "fire", fake_fire)
+    w = gstore.StoreWriter(path)
+    with pytest.raises(RuntimeError):
+        w.append(msg(9), timestamp=9)
+    w.f.close()
+
+    assert os.path.getsize(path) > size          # half the record landed
+    monkeypatch.undo()
+    idx, rep = gstore.recover_store(path)
+    assert rep.truncated_bytes > 0 and rep.records == 2
+    assert os.path.getsize(path) == size
+
+
+# -- compact_store crash safety ---------------------------------------------
+
+def _mark_deleted_row(path, row):
+    idx = gstore.load_store(path)
+    off = int(idx.offsets[row]) - 12
+    flags = int(idx.flags[row]) | gstore.FLAG_DELETED
+    del idx
+    with open(path, "r+b") as f:
+        f.seek(off)
+        f.write(flags.to_bytes(2, "big"))
+
+
+def test_compact_store_kill_before_rename(tmp_path, monkeypatch):
+    """Write-then-rename discipline: a crash BETWEEN writing the tmp
+    file and the rename must leave the old store fully loadable."""
+    path = str(tmp_path / "c.gs")
+    msgs = build(path, 4)
+    _mark_deleted_row(path, 1)
+
+    def no_rename(src, dst):
+        raise OSError("killed between write and rename")
+
+    monkeypatch.setattr(gstore.os, "replace", no_rename)
+    with pytest.raises(OSError):
+        gstore.compact_store(path, path)
+    monkeypatch.undo()
+
+    idx = gstore.load_store(path)                # old store intact
+    assert len(idx) == 4 and int(idx.alive().sum()) == 3
+    assert [idx.message(i) for i in range(4)] == msgs
+
+
+def test_compact_store_after_rename(tmp_path):
+    """...and after the rename the compacted store is the loadable one,
+    with the deleted row gone."""
+    path = str(tmp_path / "c2.gs")
+    msgs = build(path, 4)
+    _mark_deleted_row(path, 1)
+    assert gstore.compact_store(path, path) == 3
+    idx = gstore.load_store(path)
+    assert len(idx) == 3
+    assert [idx.message(i) for i in range(3)] == [
+        msgs[0], msgs[2], msgs[3]]
+    # no stray tmp files left behind
+    assert [n for n in os.listdir(tmp_path) if ".compact." in n] == []

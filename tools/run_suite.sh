@@ -177,4 +177,18 @@ timeout 1200 python tools/health_smoke.py \
 echo "overload soak-lite pass (tools/loadgen.py --selfcheck)"
 timeout 1200 python tools/loadgen.py --selfcheck \
   || { echo "loadgen selfcheck failed"; exit 1; }
-echo "suite green (2 slices + graftlint + perf smoke + incident smoke + fault matrix + health smoke + soak-lite)"
+
+# Crash-matrix lite pass (doc/recovery.md): kill a real child daemon
+# at three seams — the store append mid-record (torn tail), the db
+# commit inside the hook-replica window, and the append seam again
+# with payload bitrot injected on the dead store — then restart and
+# assert byte-for-byte convergence to the durable-prefix oracle plus
+# the quarantine/fixup/marker accounting.  Children run with
+# LIGHTNING_TPU_VERIFY_DEVICE=off (host-oracle dispatcher, no device
+# programs, no jax cache writes) so this pass is safe alongside the
+# read-only compile cache and costs seconds, not compiles.  The full
+# five-seam matrix is `python tools/crashmatrix.py --selfcheck`.
+echo "crash-matrix lite pass (tools/crashmatrix.py --lite)"
+timeout 600 python tools/crashmatrix.py --lite \
+  || { echo "crash-matrix lite failed"; exit 1; }
+echo "suite green (2 slices + graftlint + perf smoke + incident smoke + fault matrix + health smoke + soak-lite + crash-matrix lite)"
