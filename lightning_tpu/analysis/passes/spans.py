@@ -24,6 +24,12 @@ NAMED_SITES = {"span", "device_span", "annotation", "emit",
 # dataclass's own `begin()` or an unrelated `emit` is not flagged)
 NAMED_BASES = {"trace", "_trace", "events", "_ev", "_nev", "flight",
                "_flight"}
+# journey hop sites: the first argument must ALSO be a member of the
+# closed hop vocabulary (obs/journey.py HOPS) — a literal-but-unknown
+# hop name would silently fragment the per-hop histograms and the
+# tools/journey.py timeline lanes
+HOP_SITES = {"hop"}
+HOP_BASES = {"journey", "_journey"}
 
 
 def is_constructed_str(node: ast.AST) -> bool:
@@ -52,7 +58,7 @@ class SpanVocabularyPass(Pass):
                    ".labels() values must be fixed-vocabulary constants")
     default_scope = ("lightning_tpu/obs", "lightning_tpu/gossip",
                      "lightning_tpu/routing", "lightning_tpu/resilience",
-                     "lightning_tpu/parallel",
+                     "lightning_tpu/parallel", "lightning_tpu/pay",
                      "lightning_tpu/daemon/hsmd.py")
     node_types = (ast.Call,)
 
@@ -60,7 +66,31 @@ class SpanVocabularyPass(Pass):
         fn = node.func
         if not isinstance(fn, ast.Attribute):
             return
-        if fn.attr in NAMED_SITES:
+        if fn.attr in HOP_SITES:
+            base = fn.value
+            if not (isinstance(base, ast.Name)
+                    and base.id in HOP_BASES):
+                return
+            if not node.args:
+                return
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                self.emit(
+                    ctx, node.lineno, "constructed-name",
+                    "journey hop name must be a string literal "
+                    "(fixed vocabulary, doc/journeys.md)",
+                    f"{base.id}.{fn.attr}({ast.unparse(first)})")
+                return
+            from ...obs.journey import HOP_SET
+            if first.value not in HOP_SET:
+                self.emit(
+                    ctx, node.lineno, "unknown-hop",
+                    "hop name is not in obs/journey.py HOPS — add it "
+                    "to the vocabulary or fix the typo "
+                    "(doc/journeys.md)",
+                    f"{base.id}.hop({first.value!r})")
+        elif fn.attr in NAMED_SITES:
             base = fn.value
             if not (isinstance(base, ast.Name)
                     and base.id in NAMED_BASES):

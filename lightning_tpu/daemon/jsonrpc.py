@@ -1001,6 +1001,7 @@ def attach_admin_commands(rpc: JsonRpcServer, cfg, ring) -> None:
     rpc.register("gethealth", make_gethealth())
     rpc.register("listincidents", make_listincidents())
     rpc.register("getincident", make_getincident())
+    rpc.register("getjourney", make_getjourney())
 
 
 def make_gethealth(engine=None):
@@ -1078,8 +1079,8 @@ def make_getincident(recorder=None):
         """One incident bundle (doc/incidents.md): the manifest
         (trigger, correlation, history, suppressed counts, artifact
         index) and, with `artifact` (metrics.json, flight.json,
-        trace.json, health.json, resilience.json, knobs.json), that
-        artifact's frozen content."""
+        trace.json, health.json, resilience.json, knobs.json,
+        journeys.json), that artifact's frozen content."""
         from ..obs import incident as _incident
 
         rec = recorder if recorder is not None else _incident.current()
@@ -1093,3 +1094,70 @@ def make_getincident(recorder=None):
             raise RpcError(RPC_ERROR, f"unknown incident {id!r}")
 
     return getincident
+
+
+def make_getjourney():
+    """The getjourney handler (doc/journeys.md) — shared by
+    attach_admin_commands and the harness daemons so every surface
+    validates params the same way."""
+
+    async def getjourney(scid=None, payment_hash: str | None = None,
+                         node_id: str | None = None,
+                         limit: int = 20) -> dict:
+        """Per-entity journeys through the batched pipeline
+        (doc/journeys.md): with `scid`, `payment_hash`, or `node_id`
+        (at most one), that entity's hop-by-hop record — each hop with
+        queue-wait/service split and the flight-ring dispatch_id it
+        rode; an entity that was never sampled answers with empty
+        journeys, not an error.  With no selector, the `limit` most
+        recently touched journeys plus the rolling summary (per-hop
+        quantiles, e2e tail, slowest finished journey)."""
+        from ..gossip.gossmap import scid_parse
+        from ..obs import journey as _journey
+
+        selectors = [s for s in (scid, payment_hash, node_id)
+                     if s is not None]
+        if len(selectors) > 1:
+            raise RpcError(
+                INVALID_PARAMS,
+                "give at most one of scid|payment_hash|node_id")
+        try:
+            limit = int(limit)
+        except (TypeError, ValueError):
+            raise RpcError(INVALID_PARAMS, "limit must be an integer")
+        if limit < 0:
+            raise RpcError(INVALID_PARAMS, "limit must be >= 0")
+        out = {"enabled": _journey.enabled(),
+               "summary": _journey.summary()}
+        if scid is not None:
+            try:
+                key = scid_parse(scid)
+            except (TypeError, ValueError, AttributeError):
+                raise RpcError(INVALID_PARAMS,
+                               f"bad scid {scid!r} (want BLOCKxTXxOUT "
+                               "or an integer)")
+            j = _journey.lookup("channel", key)
+        elif payment_hash is not None:
+            j = _journey.lookup("payment",
+                                _hex_param(payment_hash,
+                                           "payment_hash", 32))
+        elif node_id is not None:
+            j = _journey.lookup("node",
+                                _hex_param(node_id, "node_id", 33))
+        else:
+            out["journeys"] = _journey.recent(limit)
+            return out
+        out["journeys"] = [j] if j is not None else []
+        return out
+
+    return getjourney
+
+
+def _hex_param(s, what: str, nbytes: int) -> bytes:
+    if not isinstance(s, str):
+        raise RpcError(INVALID_PARAMS, f"{what} must be a hex string")
+    b = _hex(s, what)
+    if len(b) != nbytes:
+        raise RpcError(INVALID_PARAMS,
+                       f"{what} must be {nbytes} bytes, got {len(b)}")
+    return b

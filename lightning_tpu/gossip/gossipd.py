@@ -20,9 +20,10 @@ import logging
 import struct
 import time
 
+from ..obs import journey as _journey
 from ..wire import messages as M
 from . import wire as gwire
-from .ingest import GossipIngest
+from .ingest import GossipIngest, _journey_entity
 
 log = logging.getLogger("lightning_tpu.gossipd")
 
@@ -165,6 +166,17 @@ class Gossipd:
         # every peer together when the backlog drains — no peer
         # starves, and messages that still arrive saturated are shed
         # by priority inside submit(), metered, never silently lost.
+        if _journey.enabled():
+            # the journey's first hop: the raw bytes reached gossipd
+            # from a peer.  Parse only when sampling is on — the hop
+            # must not tax the disabled-by-default hot path.
+            try:
+                p = gwire.parse_gossip(raw)
+            except Exception:
+                p = None
+            if p is not None:
+                jk, jkey = _journey_entity(gwire.msg_type(raw), p)
+                _journey.hop("recv", jk, jkey, outcome="ok")
         await self.ingest.wait_capacity()
         await self.ingest.submit(raw, source=peer.node_id)
 
@@ -178,6 +190,7 @@ class Gossipd:
                 f"cu{p.direction}"] = raw
             g = self.gossmap_ref.get("map")
             if g is not None:
+                t0 = time.perf_counter()
                 g.apply_channel_update(
                     p.short_channel_id, p.direction,
                     timestamp=p.timestamp,
@@ -187,6 +200,10 @@ class Gossipd:
                     htlc_max_msat=p.htlc_maximum_msat,
                     fee_base_msat=p.fee_base_msat,
                     fee_ppm=p.fee_proportional_millionths)
+                _journey.hop("fold", "channel", p.short_channel_id,
+                             outcome="ok",
+                             service_s=time.perf_counter() - t0,
+                             direction=int(p.direction))
         else:
             self.node_msgs[p.node_id] = raw
         ts = getattr(p, "timestamp", int(time.time()))

@@ -26,6 +26,7 @@ import numpy as np
 
 from .. import obs
 from ..obs import families as _families
+from ..obs import journey as _journey
 from ..resilience import deadline as _deadline
 from ..resilience import overload as _overload
 from ..utils import events, native, trace
@@ -95,6 +96,17 @@ class _QItem:
     # this message's enqueue span to the flush/dispatch spans that
     # eventually verify it, across the to_thread hop (doc/tracing.md)
     corr: object = None
+    # enqueue time (self.now() at admission): the per-item queue-wait
+    # anchor for the journey verify hop (doc/journeys.md §semantics)
+    t_enq: float = 0.0
+
+
+def _journey_entity(kind: int, parsed) -> tuple[str, object]:
+    """The journey entity a gossip message narrates: channel messages
+    key on their scid, node announcements on the node id."""
+    if kind == wire.MSG_NODE_ANNOUNCEMENT:
+        return "node", parsed.node_id
+    return "channel", int(parsed.short_channel_id)
 
 
 def _shed_key(kind: int, parsed) -> dict:
@@ -248,14 +260,24 @@ class GossipIngest:
                 self.stats.drop(R_SHED)
                 self.overload.shed(prio, "queue_full",
                                    **_shed_key(kind, parsed))
+                jk, jkey = _journey_entity(kind, parsed)
+                _journey.hop("shed", jk, jkey, outcome=R_SHED,
+                             reason="queue_full")
                 return
             if kind == wire.MSG_CHANNEL_UPDATE and not self._ratelimit_ok(
                     (parsed.short_channel_id, parsed.direction)):
                 self.stats.drop(R_RATELIMIT)
+                jk, jkey = _journey_entity(kind, parsed)
+                _journey.hop("drop", jk, jkey, outcome=R_RATELIMIT)
                 return
-            self._queue.append(_QItem(kind, parsed, raw, source, n_sigs,
-                                      corr=trace.new_corr()))
+            it = _QItem(kind, parsed, raw, source, n_sigs,
+                        corr=trace.new_corr(), t_enq=self.now())
+            self._queue.append(it)
             self._queued_sigs += n_sigs
+            jk, jkey = _journey_entity(kind, parsed)
+            _journey.hop("admit", jk, jkey, outcome="ok",
+                         corr_id=it.corr.corr_id,
+                         queued_sigs=self._queued_sigs)
         self._note_backlog()
         if self._flush_due is None:
             # adaptive flush window: the latency budget stretches as
@@ -308,11 +330,15 @@ class GossipIngest:
         if kind == wire.MSG_CHANNEL_ANNOUNCEMENT:
             if parsed.short_channel_id in self.channels:
                 self.stats.drop(R_DUP)
+                _journey.hop("drop", "channel",
+                             parsed.short_channel_id, outcome=R_DUP)
                 return False
         elif kind == wire.MSG_CHANNEL_UPDATE:
             key = (parsed.short_channel_id, parsed.direction)
             if self.updates.get(key, -1) >= parsed.timestamp:
                 self.stats.drop(R_STALE)
+                _journey.hop("drop", "channel",
+                             parsed.short_channel_id, outcome=R_STALE)
                 return False
             if parsed.short_channel_id not in self.channels:
                 # can't verify yet — the signer is node[direction] of a
@@ -334,6 +360,10 @@ class GossipIngest:
                         self.overload.shed(self._priority(kind, parsed),
                                            "pending_cap",
                                            **_shed_key(kind, parsed))
+                        _journey.hop("shed", "channel",
+                                     parsed.short_channel_id,
+                                     outcome=R_SHED,
+                                     reason="pending_cap")
                         return False
                     self.pending_updates.setdefault(
                         parsed.short_channel_id, {})[parsed.direction] = \
@@ -347,6 +377,8 @@ class GossipIngest:
         elif kind == wire.MSG_NODE_ANNOUNCEMENT:
             if self.nodes.get(parsed.node_id, -1) >= parsed.timestamp:
                 self.stats.drop(R_STALE)
+                _journey.hop("drop", "node", parsed.node_id,
+                             outcome=R_STALE)
                 return False
         else:
             self.stats.drop(R_MALFORMED)
@@ -473,31 +505,73 @@ class GossipIngest:
         # cross the to_thread hop explicitly (contextvars won't), so
         # every bucket dispatched for this flush flows back to the
         # submit spans in the exported timeline.
+        # per-item provenance (doc/journeys.md): dispatch_map receives,
+        # per signature, the dispatch_id of the flight record whose
+        # bucket verified it; the batch-side queue-wait counter sums
+        # (flush_start − enqueue) over EVERY queued item so the sampled
+        # journeys' waits reconcile against it within ε
+        jw = _journey.enabled()
+        dmap = np.full(len(items), -1, np.int64) if jw else None
+        t_flush0 = self.now()
+        if jw:
+            _journey.note_batch_wait(
+                "verify", sum(max(0.0, t_flush0 - it.t_enq)
+                              for it in batch if it.t_enq))
+        t_verify0 = time.perf_counter()
         with trace.span("gossip/flush", corr=corrs, sigs=len(items)):
             ok = await _deadline.guard(
                 asyncio.to_thread(gverify.verify_items, items,
                                   self.bucket, depth=self.replay_depth,
-                                  corr=corrs),
+                                  corr=corrs, dispatch_map=dmap),
                 family="ingest", seam="flush")
+        verify_dt = time.perf_counter() - t_verify0
         # fold per-sig results to per-message (CAs have 4 sigs)
         sig_ok: list[bool] = []
+        first_sig: list[int] = []
         pos = 0
         for it in batch:
             sig_ok.append(bool(ok[pos: pos + it.n_sigs].all()))
+            first_sig.append(pos)
             pos += it.n_sigs
+        if jw:
+            for it, good, fs in zip(batch, sig_ok, first_sig):
+                jk, jkey = _journey_entity(it.kind, it.parsed)
+                did = int(dmap[fs]) if dmap is not None \
+                    and fs < len(dmap) and dmap[fs] >= 0 else None
+                _journey.hop(
+                    "verify", jk, jkey,
+                    outcome="ok" if good else R_BADSIG,
+                    wait_s=max(0.0, t_flush0 - it.t_enq)
+                    if it.t_enq else 0.0,
+                    service_s=verify_dt, dispatch_id=did,
+                    corr_id=it.corr.corr_id
+                    if it.corr is not None else None)
         self._accepted = []
         for it, good in zip(batch, sig_ok):
             if not good:
                 self.stats.drop(R_BADSIG)
+                if jw:
+                    jk, jkey = _journey_entity(it.kind, it.parsed)
+                    _journey.hop("drop", jk, jkey, outcome=R_BADSIG)
                 continue
             await self._apply(it)
         if self._accepted:
             # write-ahead: ONE append_many + fsync for the whole batch,
             # then stream — nothing reaches peers before it is durable
+            t_store0 = time.perf_counter()
             self.writer.append_many(
                 [it.raw for it in self._accepted],
                 [getattr(it.parsed, "timestamp", 0)
                  for it in self._accepted], sync=True)
+            store_dt = time.perf_counter() - t_store0
+            if jw:
+                for it in self._accepted:
+                    jk, jkey = _journey_entity(it.kind, it.parsed)
+                    _journey.hop(
+                        "store", jk, jkey, outcome="ok",
+                        service_s=store_dt,
+                        corr_id=it.corr.corr_id
+                        if it.corr is not None else None)
             self.stats.accepted += len(self._accepted)
             _M_ACCEPTED.inc(len(self._accepted))
             if self.on_accept is not None:
@@ -512,11 +586,14 @@ class GossipIngest:
             scid = p.short_channel_id
             if scid in self.channels:       # raced within one batch
                 self.stats.drop(R_DUP)
+                _journey.hop("drop", "channel", scid, outcome=R_DUP)
                 return
             if self.utxo_check is not None:
                 sat = await self.utxo_check(scid)
                 if sat is None:
                     self.stats.drop(R_NO_UTXO)
+                    _journey.hop("drop", "channel", scid,
+                                 outcome=R_NO_UTXO)
                     return
             self.channels[scid] = (p.node_id_1, p.node_id_2)
             self._channeled_nodes.update((p.node_id_1, p.node_id_2))
@@ -535,6 +612,7 @@ class GossipIngest:
             scid, d = p.short_channel_id, p.direction
             if self.updates.get((scid, d), -1) >= p.timestamp:
                 self.stats.drop(R_STALE)   # raced within one batch
+                _journey.hop("drop", "channel", scid, outcome=R_STALE)
                 return
             self.updates[(scid, d)] = p.timestamp
             self._accept(it)
@@ -564,6 +642,7 @@ class GossipIngest:
                 return
             if self.nodes.get(nid, -1) >= p.timestamp:
                 self.stats.drop(R_STALE)
+                _journey.hop("drop", "node", nid, outcome=R_STALE)
                 return
             self.nodes[nid] = p.timestamp
             self._accept(it)

@@ -770,7 +770,8 @@ def _subbucket(pb: _PreparedBucket, lanes: np.ndarray,
 
 
 def _wrap_resilient(device_fn, items: VerifyItems, roi: np.ndarray,
-                    bucket: int, corrs=(), sink: list | None = None):
+                    bucket: int, corrs=(), sink: list | None = None,
+                    dispatch_map: np.ndarray | None = None):
     """Supervise one bucket dispatcher with the "verify" circuit
     breaker and poisoned-batch quarantine (doc/resilience.md):
 
@@ -842,6 +843,11 @@ def _wrap_resilient(device_fn, items: VerifyItems, roi: np.ndarray,
             prep_ms=pb.prep_seconds * 1e3, breaker_state=brk.state)
         if sink is not None:
             sink.append(rec)
+        if dispatch_map is not None:
+            # per-item provenance (doc/journeys.md): pb.sel holds the
+            # ORIGINAL signature indices this bucket carries, so the
+            # caller learns which flight record verified each item
+            dispatch_map[pb.sel[:pb.n_real]] = rec["dispatch_id"]
         t0 = time.perf_counter()
         try:
             with trace.span("verify/dispatch", corr=corrs,
@@ -864,7 +870,8 @@ def _wrap_resilient(device_fn, items: VerifyItems, roi: np.ndarray,
 
 def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
                   depth: int | None, device_fn,
-                  corrs=()) -> tuple[np.ndarray, int]:
+                  corrs=(), dispatch_map: np.ndarray | None = None,
+                  ) -> tuple[np.ndarray, int]:
     """Sort signatures by row, cut self-contained buckets, and stream
     them: a producer thread preps bucket i+1 while bucket i's fused
     program runs on device.  depth bounds the prepared-bucket queue
@@ -888,7 +895,8 @@ def _run_pipeline(items: VerifyItems, roi: np.ndarray, bucket: int,
     # (dispatch order, so the readback loop below can set late fields)
     flight_recs: list[dict] = []
     device_fn = _wrap_resilient(device_fn, items, roi, bucket,
-                                corrs=corrs, sink=flight_recs)
+                                corrs=corrs, sink=flight_recs,
+                                dispatch_map=dispatch_map)
     prep = functools.partial(_prep_bucket, items, order, roi_sorted,
                              bucket, corrs=corrs)
 
@@ -1130,7 +1138,8 @@ def _verify_items_unfused(items: VerifyItems, roi: np.ndarray,
 
 def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET, *,
                  depth: int | None = None, device_fn=None,
-                 corr=None) -> np.ndarray:
+                 corr=None,
+                 dispatch_map: np.ndarray | None = None) -> np.ndarray:
     """Streaming fused-bucket replay (doc/replay_pipeline.md).
 
     Signatures are sorted by message row and cut into self-contained
@@ -1166,7 +1175,14 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET, *,
     exported timeline links each bucket back to its enqueue span
     across the producer/dispatch threads (doc/tracing.md).  When
     LIGHTNING_TPU_PROFILE=<dir> is set the whole replay runs inside a
-    jax.profiler session with per-dispatch TraceAnnotations."""
+    jax.profiler session with per-dispatch TraceAnnotations.
+
+    ``dispatch_map`` (caller-allocated int64 (N,), conventionally
+    filled with -1) receives, per SIGNATURE index, the dispatch_id of
+    the flight record whose bucket verified it — the per-item
+    provenance link doc/journeys.md stitches journeys with.  The
+    legacy unfused chain has one coarse record covering the whole
+    replay, so every lane maps to it."""
     N = len(items)
     if N == 0:
         return np.zeros(0, bool)
@@ -1193,6 +1209,8 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET, *,
                     shape=(bucket, MAX_BLOCKS), n_real=N,
                     lanes=n_buckets * bucket,
                     breaker_state=brk.state) as frec:
+                if dispatch_map is not None:
+                    dispatch_map[:] = frec["dispatch_id"]
                 with trace.span("verify/dispatch", corr=corrs,
                                 dispatch_id=frec["dispatch_id"]):
                     if not brk.allow():
@@ -1223,7 +1241,8 @@ def verify_items(items: VerifyItems, bucket: int = DEFAULT_BUCKET, *,
                             frec["outcome"] = "ok"
         else:
             out, n_buckets = _run_pipeline(items, roi, bucket, depth,
-                                           device_fn, corrs=corrs)
+                                           device_fn, corrs=corrs,
+                                           dispatch_map=dispatch_map)
 
     # oversized rows: the device hashed garbage for them; their host
     # sha256d was computed at extraction — verify those few serially.

@@ -302,3 +302,32 @@ SLOW_DISPATCH = REGISTRY.counter(
     "Dispatches flagged by the slow-dispatch watchdog (over "
     "LIGHTNING_TPU_SLOW_DISPATCH_S, or the rolling per-family p99)",
     labelnames=("family",))
+
+# -- obs/journey.py: per-item journeys (doc/journeys.md) -------------------
+JOURNEY_SAMPLED = REGISTRY.counter(
+    "clntpu_journey_sampled_total",
+    "Entities admitted to the journey table by the deterministic "
+    "sampler (one per entity, not per hop), by entity kind",
+    labelnames=("kind",))
+JOURNEY_TABLE = REGISTRY.gauge(
+    "clntpu_journey_table_size",
+    "Journeys currently held in the bounded per-entity table "
+    "(LRU-rotated at LIGHTNING_TPU_JOURNEY_MAX)")
+JOURNEY_HOP_WAIT = REGISTRY.histogram(
+    "clntpu_journey_hop_wait_seconds",
+    "Per-ITEM queue-induced wait at each journey hop (time the item "
+    "sat queued before its batch dispatched — the batching tax, split "
+    "from service time per doc/journeys.md)",
+    labelnames=("hop",), buckets=DURATION_BUCKETS)
+JOURNEY_HOP_SERVICE = REGISTRY.histogram(
+    "clntpu_journey_hop_service_seconds",
+    "Per-ITEM service time at each journey hop (the batch execution "
+    "the item shared, split from queue wait per doc/journeys.md)",
+    labelnames=("hop",), buckets=DURATION_BUCKETS)
+JOURNEY_BATCH_WAIT = REGISTRY.counter(
+    "clntpu_journey_batch_wait_seconds_total",
+    "Batch-side queue-wait accounting by pipeline stage: "
+    "Σ(flush_start − enqueue) over EVERY item of every batch, sampled "
+    "or not — the reconciliation target the summed per-item journey "
+    "waits must match within ε when sampling is 1",
+    labelnames=("stage",))

@@ -110,7 +110,7 @@ _TOPIC_CLASSES = {
 # artifact file names inside a bundle directory (manifest.json rides
 # beside them); getincident validates requested names against this
 ARTIFACTS = ("metrics.json", "flight.json", "trace.json", "health.json",
-             "resilience.json", "knobs.json")
+             "resilience.json", "knobs.json", "journeys.json")
 
 _ID_RE = re.compile(r"^inc-[0-9]+-[0-9]+$")
 _REDACT_RE = re.compile(r"PASSPHRASE|SECRET|TOKEN|PASSWORD")
@@ -495,7 +495,8 @@ class IncidentRecorder:
                 ("trace.json", self._art_trace),
                 ("health.json", self._art_health),
                 ("resilience.json", self._art_resilience),
-                ("knobs.json", self._art_knobs)):
+                ("knobs.json", self._art_knobs),
+                ("journeys.json", self._art_journeys)):
             try:
                 obj = builder()
                 if name == "trace.json":
@@ -592,6 +593,17 @@ class IncidentRecorder:
     @staticmethod
     def _art_knobs() -> dict:
         return resolve_knobs()
+
+    @staticmethod
+    def _art_journeys() -> dict:
+        """The per-item journey table at incident time
+        (doc/journeys.md): what each recently-sampled entity was doing
+        when the trigger fired, stitched by dispatch_id to the
+        flight.json records frozen beside it."""
+        from . import journey as _journey
+        return {"enabled": _journey.enabled(),
+                "summary": _journey.summary(),
+                "journeys": _journey.recent(limit=50)}
 
     # -- retention ---------------------------------------------------------
 

@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass, field
 
 from .. import obs
+from ..obs import journey as _journey
 
 log = logging.getLogger("lightning_tpu.htlc_set")
 
@@ -105,6 +106,9 @@ class HtlcSets:
                                       fulfill, fail)
         _M_PARTS.labels(result).inc()
         _M_OPEN.set(len(self.sets))
+        _journey.hop("htlc_part", "payment", payment_hash,
+                     outcome=result, amount_msat=int(amount_msat),
+                     total_msat=int(total_msat))
         return result
 
     async def _add_part(self, payment_hash: bytes, amount_msat: int,

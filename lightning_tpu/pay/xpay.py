@@ -19,6 +19,7 @@ import time
 
 from ..bolt import bolt11 as B11
 from ..bolt import sphinx as SX
+from ..obs import journey as _journey
 from ..routing import mcf
 from ..wire import messages as M
 from .payer import (FAILURE_NAMES, PayError, PayResult, RouteStep,
@@ -111,11 +112,14 @@ async def _attempt(ch, inv, gossmap, amount: int, layers,
     else:
         if mcf_service is not None:
             # batched device MPP solve: concurrent payers coalesce into
-            # one dispatch; the service owns the host-oracle fallback
+            # one dispatch; the service owns the host-oracle fallback.
+            # payment_hash rides along as the journey key so the
+            # enqueue/mcf_flush/parts hops land on this payment's
+            # journey (doc/journeys.md)
             res = await mcf_service.getroutes(
                 ch.peer.node_id, inv.payee, amount, layers=layers,
                 maxfee_msat=maxfee_msat, final_cltv=final_cltv,
-                max_parts=max_parts)
+                max_parts=max_parts, journey_key=inv.payment_hash)
         else:
             res = mcf.getroutes(gossmap, ch.peer.node_id, inv.payee,
                                 amount, layers=layers,
@@ -155,6 +159,9 @@ async def _attempt(ch, inv, gossmap, amount: int, layers,
         hid = await ch.offer_htlc(r["source_amount_msat"],
                                   inv.payment_hash,
                                   r["source_delay"], onion=onion)
+        _journey.hop("htlc_add", "payment", inv.payment_hash,
+                     outcome="ok", htlc_id=int(hid),
+                     amount_msat=int(r["source_amount_msat"]))
         parts_by_hid[hid] = ([0] + [s for _, s, _, _ in r["path"]],
                              secrets)
         sent += r["source_amount_msat"]
@@ -170,8 +177,12 @@ async def _attempt(ch, inv, gossmap, amount: int, layers,
         upd = await ch.recv_update()
         if isinstance(upd, M.UpdateFulfillHtlc):
             preimage = upd.payment_preimage
+            _journey.hop("htlc_settle", "payment", inv.payment_hash,
+                         outcome="ok", htlc_id=int(upd.id))
             continue
         if isinstance(upd, M.UpdateFailMalformedHtlc):
+            _journey.hop("htlc_fail", "payment", inv.payment_hash,
+                         outcome="malformed", htlc_id=int(upd.id))
             if first_failure is None:
                 first_failure = (PayError(
                     f"part failed: malformed onion "
@@ -191,6 +202,9 @@ async def _attempt(ch, inv, gossmap, amount: int, layers,
                     pass
             name = FAILURE_NAMES.get(code,
                                      f"code {code:#x}" if code else "?")
+            _journey.hop("htlc_fail", "payment", inv.payment_hash,
+                         outcome=name, htlc_id=int(upd.id),
+                         erring_hop=hop_idx)
             err = PayError(f"part failed at hop {hop_idx}: {name}",
                            code=code, erring_index=hop_idx)
             # disable the erring node's OUTGOING channel (xpay's

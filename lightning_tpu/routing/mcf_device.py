@@ -79,6 +79,7 @@ from jax.experimental import enable_x64
 from ..obs import attribution as _attr
 from ..obs import families as _families
 from ..obs import flight as _flight
+from ..obs import journey as _journey
 from ..resilience import breaker as _breaker
 from ..resilience import deadline as _deadline
 from ..resilience import faultinject as _fault
@@ -199,6 +200,10 @@ class McfPlanes:
     i_dst: np.ndarray      # (2*a_fwd_pad,) int32
     dirs: tuple            # (_DirLanes, _DirLanes)
     dev: dict = field(default_factory=dict)
+    # cursor into the gossmap's (channel, direction) change log at the
+    # time the lanes were derived: current() reads the entries since to
+    # name the scids a params refresh folded in (journey mcf_planes hop)
+    params_log_pos: int = 0
 
     @classmethod
     def build(cls, g) -> "McfPlanes":
@@ -229,6 +234,7 @@ class McfPlanes:
             a_fwd_real=a_fwd_real, a_fwd_pad=a_fwd_pad,
             i_src=i_src, i_dst=i_dst,
             dirs=tuple(cls._dir_lanes(g, d) for d in (0, 1)),
+            params_log_pos=getattr(g, "param_log_pos", 0),
         )
 
     @staticmethod
@@ -262,6 +268,7 @@ class McfPlanes:
         return dataclasses.replace(
             self,
             params_version=getattr(self.g, "params_version", 0),
+            params_log_pos=getattr(self.g, "param_log_pos", 0),
             dirs=tuple(self._dir_lanes(self.g, d) for d in (0, 1)),
         )
 
@@ -276,7 +283,18 @@ class McfPlanes:
                 != getattr(g, "topology_version", 0)):
             return cls.build(g)
         if cached.params_version != getattr(g, "params_version", 0):
-            return cached.with_fresh_params()
+            fresh = cached.with_fresh_params()
+            if _journey.enabled() and hasattr(g, "param_entries_since"):
+                # journey terminus for the MCF view: the sampled
+                # channel_update's parameters are now in the lanes the
+                # next batched solve prices against (doc/journeys.md)
+                entries = g.param_entries_since(cached.params_log_pos)
+                if entries is not None:
+                    for c, d in set(entries):
+                        _journey.hop("mcf_planes", "channel",
+                                     int(g.scids[int(c)]),
+                                     outcome="fresh", direction=int(d))
+            return fresh
         return cached
 
 
@@ -558,6 +576,13 @@ class McfQuery:
     future: object = None
     # correlation carrier minted in the enqueue span (doc/tracing.md)
     corr: object = None
+    # journey identity (doc/journeys.md): xpay passes its payment_hash
+    # so the query's hops land on the payment's journey; None for
+    # plain getroutes callers (no journey recorded)
+    journey_key: object = None
+    # enqueue time (service.now() at admission): the per-query
+    # queue-wait anchor for the mcf_flush hop
+    t_enq: float = 0.0
 
 
 def _expressible(q: McfQuery) -> str | None:
@@ -852,6 +877,11 @@ class McfService:
         self._wakeup = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._closed = False
+        # (t_flush0, t_svc0, flight rec) of the flush being resolved —
+        # flushes are serialized on the loop, so one slot suffices;
+        # None on the inline post-close host path (no batch, no
+        # mcf_flush journey hop)
+        self._flush_ctx: tuple | None = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -883,7 +913,11 @@ class McfService:
                         final_cltv: int = 18,
                         max_parts: int = MCF.MAX_PARTS,
                         prob_weight: float = 1.0,
-                        delay_weight: float = 1.0) -> dict:
+                        delay_weight: float = 1.0,
+                        journey_key=None) -> dict:
+        """``journey_key`` (a payment_hash, optional) attributes this
+        query's pipeline hops to that payment's journey
+        (doc/journeys.md); xpay threads it through automatically."""
         g = self.get_map()
         if g is None:
             raise MCF.McfError("no gossip graph loaded")
@@ -894,7 +928,12 @@ class McfService:
                 maxfee_msat, int(final_cltv), int(max_parts),
                 float(prob_weight), float(delay_weight),
                 future=asyncio.get_running_loop().create_future(),
-                corr=trace.new_corr())
+                corr=trace.new_corr(), journey_key=journey_key,
+                t_enq=self.now())
+            if journey_key is not None:
+                _journey.hop("enqueue", "payment", journey_key,
+                             outcome="ok", corr_id=q.corr.corr_id,
+                             amount_msat=int(amount_msat))
             if self._closed or self._task is None or self._task.done():
                 # no flush loop to resolve the future: behave like the
                 # plain host oracle instead of queueing forever
@@ -906,6 +945,10 @@ class McfService:
             # RPC callers as TRY_AGAIN with the retry-after hint
             if not self.overload.admit(_overload.PRIO_QUERY):
                 self.overload.shed(_overload.PRIO_QUERY, "admission")
+                if journey_key is not None:
+                    _journey.hop("shed", "payment", journey_key,
+                                 outcome="overload",
+                                 reason="admission")
                 raise self.overload.overloaded()
             self._queue.append(q)
             self._note_backlog()
@@ -1006,6 +1049,15 @@ class McfService:
     async def _flush_batch(self, batch: list[McfQuery]) -> None:
         corrs = trace.as_carriers(q.corr for q in batch)
         brk = _breaker.get("mcf")
+        t_flush0 = self.now()
+        if _journey.enabled():
+            # batch-level queue-wait over EVERY query — the
+            # reconciliation target for summed per-item journey waits
+            # (doc/journeys.md)
+            _journey.note_batch_wait(
+                "mcf", sum(max(0.0, t_flush0 - q.t_enq)
+                           for q in batch if q.t_enq))
+        t_svc0 = time.perf_counter()
         with _flight.dispatch(
                 "mcf", corr_ids=_flight.corr_ids(corrs),
                 n_real=len(batch), lanes=len(batch),
@@ -1013,7 +1065,11 @@ class McfService:
             with trace.span("mcf/flush", corr=corrs,
                             dispatch_id=rec["dispatch_id"],
                             queries=len(batch)):
-                await self._flush_batch_inner(batch, brk, rec)
+                self._flush_ctx = (t_flush0, t_svc0, rec)
+                try:
+                    await self._flush_batch_inner(batch, brk, rec)
+                finally:
+                    self._flush_ctx = None
             if rec["outcome"] is None:
                 rec["outcome"] = "host"
 
@@ -1158,6 +1214,28 @@ class McfService:
         fut = q.future
         if fut is None or fut.done():
             return
+        if q.journey_key is not None:
+            ctx = self._flush_ctx
+            if ctx is not None:
+                # the batched-solve hop, stamped BEFORE the parts hop
+                # so the journey reads in pipeline order (enqueue →
+                # mcf_flush → parts); wait/service split per
+                # doc/journeys.md §semantics
+                t_flush0, t_svc0, rec = ctx
+                _journey.hop(
+                    "mcf_flush", "payment", q.journey_key,
+                    outcome=path,
+                    wait_s=max(0.0, t_flush0 - q.t_enq)
+                    if q.t_enq else 0.0,
+                    service_s=time.perf_counter() - t_svc0,
+                    dispatch_id=rec["dispatch_id"],
+                    corr_id=q.corr.corr_id if q.corr else None)
+            _journey.hop(
+                "parts", "payment", q.journey_key, outcome=res[0],
+                corr_id=q.corr.corr_id if q.corr else None,
+                path=path,
+                **({"parts": res[1]["parts"]}
+                   if res[0] == "ok" else {}))
         if res[0] == "ok":
             _M_QUERIES.labels(path, "ok").inc()
             _M_PARTS.observe(res[1]["parts"])

@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..gossip.gossmap import Gossmap
+from ..obs import journey as _journey
 
 # htlc_max is u64 on the wire; the device cost model runs in int64.
 # Values past the clamp are "effectively unlimited" (2^62 msat is
@@ -37,6 +38,18 @@ def _pow2_pad(n: int, floor: int) -> int:
     while p < n:
         p <<= 1
     return p
+
+
+def _note_planes_journey(g, entries, outcome: str) -> None:
+    """Journey hop per refreshed (channel, direction) pair: the
+    sampled channel_update's provenance ends here — the route planes
+    picked its parameters up (doc/journeys.md).  Gate + dedup keep
+    this off the hot path: nothing runs when sampling is disabled."""
+    if not _journey.enabled():
+        return
+    for c, d in set(entries):
+        _journey.hop("planes", "channel", int(g.scids[int(c)]),
+                     outcome=outcome, direction=int(d))
 
 
 @dataclass
@@ -245,8 +258,13 @@ class RoutePlanes:
             # touches few lanes — exactly the case patching amortizes
             if entries is not None and len(set(entries)) <= max(
                     64, cached.e_real // cls._PATCH_MAX_FRACTION):
-                return cached.with_patched_params(entries)
-            return cached.with_fresh_params()
+                fresh = cached.with_patched_params(entries)
+                _note_planes_journey(g, entries, "patched")
+                return fresh
+            fresh = cached.with_fresh_params()
+            if entries is not None:
+                _note_planes_journey(g, entries, "fresh")
+            return fresh
         return cached
 
     # -- query-side helpers ----------------------------------------------

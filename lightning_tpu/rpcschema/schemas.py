@@ -337,6 +337,14 @@ COMMANDS: dict[str, dict] = {
         "params": {"dispatches": "int?"},
         "result": {"traceEvents": "list", "displayTimeUnit": "str"},
     },
+    "getjourney": {
+        "params": {"scid": "any?", "payment_hash": "hex?",
+                   "node_id": "hex?", "limit": "int?"},
+        "result": {"enabled": "bool", "summary": "dict",
+                   "journeys": "list"},
+        # per-entity hop records with dispatch_ids resolvable against
+        # listdispatches (doc/journeys.md)
+    },
     "listnodes": {
         "params": {},
         "result": {"nodes": "list"},

@@ -1,0 +1,418 @@
+"""Per-item journeys (doc/journeys.md): deterministic entity-keyed
+sampling, bounded journey tables, the hop-record schema, the getjourney
+RPC surface, and the end-to-end stitch — a signed channel_update
+through the REAL ingest pipeline and a real MCF query must leave
+journeys whose batched hops resolve into the flight ring and whose
+queue-waits reconcile with the batch-level stage counter.
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lightning_tpu import obs
+from lightning_tpu.daemon.jsonrpc import RpcError, make_getjourney
+from lightning_tpu.obs import flight
+from lightning_tpu.obs import journey as J
+
+from test_ingest import K1, K2, SCID, make_ca, make_cu  # noqa: E402
+
+
+@pytest.fixture
+def jconf(monkeypatch):
+    """Configure the journey knobs and re-read them; restores the
+    defaults (sampling off) afterwards."""
+    keys = ("LIGHTNING_TPU_JOURNEY_SAMPLE", "LIGHTNING_TPU_JOURNEY_MAX",
+            "LIGHTNING_TPU_JOURNEY_HOPS")
+
+    def conf(sample, max_entities=None, hop_cap=None):
+        monkeypatch.setenv(keys[0], str(sample))
+        if max_entities is not None:
+            monkeypatch.setenv(keys[1], str(max_entities))
+        if hop_cap is not None:
+            monkeypatch.setenv(keys[2], str(hop_cap))
+        J.reset_for_tests()
+
+    yield conf
+    for k in keys:
+        monkeypatch.delenv(k, raising=False)
+    J.reset_for_tests()
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 120))
+
+
+# -- sampling ---------------------------------------------------------------
+
+
+def test_sampling_off_by_default(jconf):
+    jconf(0)
+    assert not J.enabled()
+    assert not J.sampled("channel", SCID)
+    assert not J.hop("recv", "channel", SCID)
+    assert J.lookup("channel", SCID) is None
+    assert J.summary()["entities"] == 0
+
+
+def test_sampling_deterministic_and_stable(jconf):
+    jconf(7)
+    scids = range(1_000_000, 1_000_400)
+    first = [J.sampled("channel", s) for s in scids]
+    # stable across repeated calls and call order
+    assert [J.sampled("channel", s) for s in reversed(scids)] == \
+        list(reversed(first))
+    # a mod-7 hash picks roughly 1/7th — not none, not all
+    picked = sum(first)
+    assert 0 < picked < len(first) // 3
+    # sample=1 admits everything
+    jconf(1)
+    assert all(J.sampled("channel", s) for s in scids)
+    assert J.sampled("payment", b"\xee" * 32)
+    assert J.sampled("node", "02" + "ab" * 32)
+
+
+def test_sampling_nested_subsets(jconf):
+    """crc % 14 == 0 implies crc % 7 == 0: raising the sampling stride
+    to a multiple keeps sampling the SAME entities (fleet-wide
+    correlation survives a config change)."""
+    scids = range(2_000_000, 2_003_000)
+    jconf(14)
+    at14 = {s for s in scids if J.sampled("channel", s)}
+    jconf(7)
+    at7 = {s for s in scids if J.sampled("channel", s)}
+    assert at14 and at14 <= at7
+
+
+def test_bytes_and_hex_keys_canonicalize_together(jconf):
+    jconf(1)
+    key = b"\xab" * 32
+    J.hop("enqueue", "payment", key)
+    assert J.lookup("payment", key.hex())["hops"][0]["hop"] == "enqueue"
+    assert J.lookup("payment", key.hex().upper()) is not None
+
+
+# -- recording --------------------------------------------------------------
+
+
+def test_unknown_hop_kind_stage_raise(jconf):
+    jconf(1)
+    with pytest.raises(ValueError):
+        J.hop("teleport", "channel", SCID)
+    with pytest.raises(ValueError):
+        J.hop("recv", "wormhole", SCID)
+    with pytest.raises(ValueError):
+        J.note_batch_wait("teleport", 1.0)
+
+
+def test_hop_record_schema(jconf):
+    jconf(1)
+    assert J.hop("verify", "channel", SCID, outcome="ok", wait_s=0.5,
+                 service_s=0.25, dispatch_id=7, corr_id=9, n_sigs=4)
+    j = J.lookup("channel", SCID)
+    assert j["kind"] == "channel" and j["key"] == SCID
+    assert not j["done"] and j["truncated"] == 0
+    assert j["e2e_ms"] >= 0.0
+    (h,) = j["hops"]
+    assert h["hop"] == "verify" and h["outcome"] == "ok"
+    assert h["wait_ms"] == 500.0 and h["service_ms"] == 250.0
+    assert h["dispatch_id"] == 7 and h["corr_id"] == 9
+    assert h["attrs"] == {"n_sigs": 4}
+    assert isinstance(h["t_ns"], int)
+
+
+def test_terminal_hop_finishes_journey(jconf):
+    jconf(1)
+    J.hop("recv", "channel", SCID)
+    J.hop("shed", "channel", SCID, outcome="overload")
+    j = J.lookup("channel", SCID)
+    assert j["done"]
+    s = J.summary()
+    assert s["finished"] == 1
+    assert s["e2e_ms_p99"] is not None
+    assert s["slowest"]["key"] == SCID
+    assert J.e2e_p99_ms() is not None
+
+
+def test_table_bounds_lru(jconf):
+    jconf(1, max_entities=4)
+    for i in range(6):
+        J.hop("recv", "channel", 100 + i)
+    s = J.summary()
+    assert s["entities"] == 4 and s["evicted"] == 2
+    assert J.lookup("channel", 100) is None
+    assert J.lookup("channel", 105) is not None
+    # touching an entity refreshes it: 102 survives the next eviction
+    J.hop("admit", "channel", 102)
+    J.hop("recv", "channel", 200)
+    assert J.lookup("channel", 102) is not None
+    assert J.lookup("channel", 103) is None
+
+
+def test_hop_cap_truncation(jconf):
+    jconf(1, hop_cap=3)
+    for _ in range(5):
+        J.hop("recv", "channel", SCID)
+    j = J.lookup("channel", SCID)
+    assert len(j["hops"]) == 3 and j["truncated"] == 2
+
+
+def test_recent_newest_first(jconf):
+    jconf(1)
+    for i in range(5):
+        J.hop("recv", "channel", 300 + i)
+    got = [j["key"] for j in J.recent(limit=3)]
+    assert got == [304, 303, 302]
+
+
+def test_summary_by_hop_quantiles(jconf):
+    jconf(1)
+    for i in range(10):
+        J.hop("verify", "channel", 400 + i, wait_s=i / 100.0,
+              service_s=0.01)
+    bh = J.summary()["by_hop"]["verify"]
+    assert bh["count"] == 10
+    assert bh["wait_ms_p50"] <= bh["wait_ms_p99"]
+    assert bh["service_ms_p50"] == 10.0
+
+
+def test_journey_span_records_shape(jconf):
+    jconf(1)
+    J.hop("recv", "channel", SCID, corr_id=55)
+    J.hop("verify", "channel", SCID, wait_s=0.01, service_s=0.02,
+          dispatch_id=3)
+    recs = J.journey_span_records()
+    assert len(recs) == 2
+    for r in recs:
+        assert r["name"].startswith("journey/")
+        assert r["tid"] >= J.JOURNEY_TID_BASE
+        assert r["duration_ns"] >= 1_000
+        assert r["span_id"] < 0
+    assert recs[0]["corr_ids"] == [55]
+    assert recs[1]["attributes"]["dispatch_id"] == 3
+
+
+def test_reset_for_tests_clears(jconf):
+    jconf(1)
+    J.hop("recv", "channel", SCID)
+    J.reset_for_tests()
+    s = J.summary()
+    assert s["entities"] == 0 and s["evicted"] == 0
+    assert s["by_hop"] == {} and s["e2e_ms_p99"] is None
+
+
+# -- the getjourney RPC surface ---------------------------------------------
+
+
+def test_getjourney_params_and_answers(jconf):
+    jconf(1)
+    J.hop("recv", "channel", SCID)
+    J.hop("enqueue", "payment", b"\xcd" * 32)
+    gj = make_getjourney()
+
+    async def body():
+        # selector answers
+        out = await gj(scid=SCID)
+        assert out["enabled"] and len(out["journeys"]) == 1
+        assert out["journeys"][0]["hops"][0]["hop"] == "recv"
+        out = await gj(payment_hash="cd" * 32)
+        assert out["journeys"][0]["kind"] == "payment"
+        # unknown entity: empty journeys, NOT an error
+        assert (await gj(payment_hash="ee" * 32))["journeys"] == []
+        assert (await gj(node_id="02" + "ab" * 32))["journeys"] == []
+        # no selector: recent + summary
+        out = await gj(limit=1)
+        assert len(out["journeys"]) == 1
+        assert out["summary"]["entities"] == 2
+        # validation
+        with pytest.raises(RpcError):
+            await gj(scid=SCID, payment_hash="cd" * 32)
+        with pytest.raises(RpcError):
+            await gj(scid="not-a-scid")
+        with pytest.raises(RpcError):
+            await gj(payment_hash="zz" * 32)
+        with pytest.raises(RpcError):
+            await gj(payment_hash="cd" * 31)
+        with pytest.raises(RpcError):
+            await gj(node_id="02" + "ab" * 31)
+        with pytest.raises(RpcError):
+            await gj(limit=-1)
+        with pytest.raises(RpcError):
+            await gj(limit="many")
+
+    run(body())
+
+
+def test_getjourney_disabled_daemon(jconf):
+    jconf(0)
+    gj = make_getjourney()
+
+    async def body():
+        out = await gj()
+        assert out["enabled"] is False and out["journeys"] == []
+
+    run(body())
+
+
+# -- the end-to-end stitch (ISSUE-20 acceptance) ----------------------------
+
+
+def _counter(name, **labels):
+    for s in obs.snapshot()["metrics"].get(name, {}).get("samples", []):
+        if all((s.get("labels") or {}).get(k) == v
+               for k, v in labels.items()):
+            return float(s.get("value", 0.0))
+    return 0.0
+
+
+def test_gossip_journey_stitches_into_flight_ring(jconf, monkeypatch,
+                                                  tmp_path):
+    """A sampled channel_update through the REAL ingest pipeline (host
+    verify mode): admit → verify → store hops with monotonic
+    timestamps, the verify hop's dispatch_id resolving to a flight-ring
+    record, and the summed per-item queue-wait reconciling with
+    clntpu_journey_batch_wait_seconds_total{stage=verify} within ε."""
+    monkeypatch.setenv("LIGHTNING_TPU_VERIFY_DEVICE", "off")
+    jconf(1)
+    wait0 = _counter("clntpu_journey_batch_wait_seconds_total",
+                     stage="verify")
+
+    from lightning_tpu.gossip import ingest as gi
+
+    async def body():
+        ing = gi.GossipIngest(str(tmp_path / "j.gs"), flush_size=64,
+                              flush_ms=1.0, bucket=64)
+        ing.start()
+        await ing.submit(make_ca(K1, K2, SCID))
+        await ing.drain()   # serialize the batches: CA first, CU next
+        await ing.submit(make_cu(K1, K2, SCID, 0, ts=100))
+        await ing.drain()
+        await ing.close()
+
+    run(body())
+    j = J.lookup("channel", SCID)
+    assert j is not None and not j["done"]
+    hops = [h["hop"] for h in j["hops"]]
+    # CA admit/verify/store, then the CU's own admit/verify/store
+    assert hops == ["admit", "verify", "store"] * 2
+    ts = [h["t_ns"] for h in j["hops"]]
+    assert ts == sorted(ts)
+    ring = {r["dispatch_id"] for r in flight.recent("verify")}
+    for h in j["hops"]:
+        if h["hop"] == "verify":
+            assert h["dispatch_id"] in ring
+            assert h["wait_ms"] >= 0.0 and h["service_ms"] >= 0.0
+    item_wait = sum(h["wait_ms"] for h in j["hops"]) / 1e3
+    batch_wait = _counter("clntpu_journey_batch_wait_seconds_total",
+                          stage="verify") - wait0
+    assert abs(batch_wait - item_wait) < 0.05
+
+
+def test_rejected_update_journey_ends_in_drop(jconf, monkeypatch,
+                                              tmp_path):
+    monkeypatch.setenv("LIGHTNING_TPU_VERIFY_DEVICE", "off")
+    jconf(1)
+
+    from lightning_tpu.gossip import ingest as gi
+
+    async def body():
+        ing = gi.GossipIngest(str(tmp_path / "j.gs"), flush_size=64,
+                              flush_ms=1.0, bucket=64)
+        ing.start()
+        await ing.submit(make_ca(K1, K2, SCID))
+        await ing.submit(make_cu(K1, K2, SCID, 0, ts=100))
+        await ing.drain()
+        # exact duplicate: precheck drops it before any batch
+        await ing.submit(make_cu(K1, K2, SCID, 0, ts=100))
+        await ing.drain()
+        await ing.close()
+
+    run(body())
+    j = J.lookup("channel", SCID)
+    assert j["done"]
+    last = j["hops"][-1]
+    assert last["hop"] == "drop"
+    assert last["outcome"] == gi.R_STALE   # same-ts CU is a stale dup
+
+
+def test_payment_journey_through_mcf_service(jconf, tmp_path):
+    """A getroutes query with a journey_key through the real McfService
+    (host-oracle path): enqueue → mcf_flush → parts, the flush hop's
+    dispatch_id in the mcf flight ring, waits reconciling with the mcf
+    stage counter."""
+    jconf(1)
+    from lightning_tpu.gossip import gossmap as GM
+    from lightning_tpu.gossip import store as gstore
+    from lightning_tpu.gossip import synth
+    from lightning_tpu.routing import mcf_device as MDV
+
+    p = str(tmp_path / "net.gs")
+    synth.make_network_store(p, n_channels=24, n_nodes=10,
+                             updates_per_channel=2, seed=21, sign=False)
+    g = GM.from_store(gstore.load_store(p))
+    phash = b"\x7a" * 32
+    wait0 = _counter("clntpu_journey_batch_wait_seconds_total",
+                     stage="mcf")
+
+    async def body():
+        # host_max above the batch size: the host oracle answers, no
+        # device program is compiled, the dispatch is still metered
+        svc = MDV.McfService(lambda: g, flush_ms=1.0, batch=4,
+                             host_max=8)
+        svc.start()
+        try:
+            return await svc.getroutes(
+                bytes(g.node_ids[0]), bytes(g.node_ids[1]), 1_000_000,
+                journey_key=phash)
+        finally:
+            await svc.close()
+
+    try:
+        run(body())
+    except Exception:
+        pass   # no route is fine — the journey is what's under test
+    j = J.lookup("payment", phash)
+    assert j is not None
+    hops = [h["hop"] for h in j["hops"]]
+    assert hops[:2] == ["enqueue", "mcf_flush"]
+    ts = [h["t_ns"] for h in j["hops"]]
+    assert ts == sorted(ts)
+    by = {h["hop"]: h for h in j["hops"]}
+    ring = {r["dispatch_id"] for r in flight.recent("mcf")}
+    assert by["mcf_flush"]["dispatch_id"] in ring
+    if "parts" in by:
+        assert by["parts"]["outcome"] == "ok"
+    item_wait = sum(h["wait_ms"] for h in j["hops"]) / 1e3
+    batch_wait = _counter("clntpu_journey_batch_wait_seconds_total",
+                          stage="mcf") - wait0
+    assert abs(batch_wait - item_wait) < 0.05
+
+
+def test_htlc_part_hop_lands_on_payment_journey(jconf):
+    jconf(1)
+    from lightning_tpu.pay.htlc_set import HtlcSets
+    from lightning_tpu.pay.invoices import InvoiceRegistry
+
+    async def body():
+        reg = InvoiceRegistry(0xAA11)
+        rec = reg.create("journey-mpp", 100_000, "multi")
+
+        async def ff(pre):
+            pass
+
+        async def fl(code):
+            pass
+
+        sets = HtlcSets(reg, timeout=60.0)
+        await sets.add_part(rec.payment_hash, 60_000,
+                            rec.payment_secret, 100_000, ff, fl)
+        await sets.add_part(rec.payment_hash, 40_000,
+                            rec.payment_secret, 100_000, ff, fl)
+        return rec.payment_hash
+
+    phash = run(body())
+    j = J.lookup("payment", phash)
+    hops = [h["hop"] for h in j["hops"]]
+    assert hops == ["htlc_part", "htlc_part"]
+    assert [h["outcome"] for h in j["hops"]] == ["held", "complete"]

@@ -106,12 +106,13 @@ def merge_family_points(rings: dict, family: str) -> list:
     return merged
 
 
-def fetch(rpc_path: str, points: int = 40,
-          incident_rows: int = 5) -> tuple[dict, dict, dict | None]:
-    """One (gethealth, getmetrics, listincidents) triple; the ring
-    extract asks for the headline families the sparkline panel draws.
-    A daemon without the listincidents command (older harness) yields
-    None for the incidents panel."""
+def fetch(rpc_path: str, points: int = 40, incident_rows: int = 5,
+          journey_rows: int = 5,
+          ) -> tuple[dict, dict, dict | None, dict | None]:
+    """One (gethealth, getmetrics, listincidents, getjourney) tuple;
+    the ring extract asks for the headline families the sparkline panel
+    draws.  A daemon without the listincidents/getjourney commands
+    (older harness) yields None for that panel."""
     health = rpc_call(rpc_path, "gethealth",
                       {"series": sorted(set(HEADLINE_RATES.values())),
                        "points": points})
@@ -121,7 +122,12 @@ def fetch(rpc_path: str, points: int = 40,
                              {"limit": incident_rows})
     except SystemExit:
         incidents = None
-    return health, metrics, incidents
+    try:
+        journeys = rpc_call(rpc_path, "getjourney",
+                            {"limit": journey_rows})
+    except SystemExit:
+        journeys = None
+    return health, metrics, incidents, journeys
 
 
 def _fmt_bytes(n) -> str:
@@ -145,6 +151,7 @@ def _fmt_age(s) -> str:
 
 
 def render(health: dict, metrics: dict, incidents: dict | None = None,
+           journeys: dict | None = None,
            color: bool = False, width: int = 40) -> str:
     """One text frame (shared by --once and the live loop)."""
     lines: list[str] = []
@@ -220,6 +227,32 @@ def render(health: dict, metrics: dict, incidents: dict | None = None,
                 f"{_fmt_bytes(row.get('bytes'))}{supp}")
         if incidents.get("enabled") and not rows:
             lines.append("  (none)")
+
+    # journeys panel (doc/journeys.md): the most recently touched
+    # sampled entities with their last hop and e2e latency, plus the
+    # rolling tail — fed from getjourney
+    if journeys is not None:
+        lines.append("")
+        summ = journeys.get("summary") or {}
+        if not journeys.get("enabled"):
+            lines.append("journeys (sampling disabled — set "
+                         "LIGHTNING_TPU_JOURNEY_SAMPLE)")
+        else:
+            lines.append(
+                f"journeys (1/{summ.get('sample', '?')} sampled, "
+                f"{summ.get('entities', 0)} tracked, "
+                f"e2e p99={_fmt(summ.get('e2e_ms_p99'))}ms)")
+            for j in journeys.get("journeys") or []:
+                last = j["hops"][-1] if j.get("hops") else None
+                state = "done" if j.get("done") else "open"
+                lines.append(
+                    f"  {j.get('kind', '?'):<8} {str(j.get('key')):<20.20} "
+                    f"{(last or {}).get('hop', '-'):<11} "
+                    f"{(last or {}).get('outcome', '-'):<10} "
+                    f"{len(j.get('hops') or [])} hops "
+                    f"{_fmt(j.get('e2e_ms'))}ms {state}")
+            if not journeys.get("journeys"):
+                lines.append("  (none)")
     return "\n".join(lines)
 
 
@@ -245,21 +278,22 @@ def main(argv=None) -> int:
         ap.error("--points must be positive")
 
     if args.once:
-        health, metrics, incidents = fetch(args.rpc, points=args.points)
+        health, metrics, incidents, journeys = fetch(
+            args.rpc, points=args.points)
         if args.json:
             print(json.dumps(health, indent=1, default=str))
         else:
-            print(render(health, metrics, incidents, color=False,
-                         width=args.points))
+            print(render(health, metrics, incidents, journeys,
+                         color=False, width=args.points))
         return 0
 
     color = sys.stdout.isatty()
     try:
         while True:
-            health, metrics, incidents = fetch(args.rpc,
-                                               points=args.points)
-            frame = render(health, metrics, incidents, color=color,
-                           width=args.points)
+            health, metrics, incidents, journeys = fetch(
+                args.rpc, points=args.points)
+            frame = render(health, metrics, incidents, journeys,
+                           color=color, width=args.points)
             # ANSI full redraw: clear + home (stdlib-portable; no
             # curses dependency so --once and CI pipes behave)
             sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")

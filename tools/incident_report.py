@@ -432,6 +432,39 @@ def validate_bundle(bundle: dict) -> list[str]:
                 problems.append(
                     f"{fam}: summary says ring={ring_len} but "
                     f"{in_ring} records embedded")
+    # journeys.json (doc/journeys.md): hop vocabulary, per-journey
+    # timestamp monotonicity, and every hop's dispatch_id must resolve
+    # into the flight.json records frozen beside it
+    journeys_art = bundle.get("journeys.json")
+    if journeys_art is not None:
+        from lightning_tpu.obs.journey import HOP_SET
+        ring_ids = {r.get("dispatch_id")
+                    for r in (flight_art or {}).get("records", ())}
+        # the flight ring is bounded: a dispatch older than the oldest
+        # record still in the ring has been legitimately evicted, not
+        # lost — only ids inside the ring's span must resolve
+        ring_floor = min(ring_ids) if ring_ids else None
+        for j in journeys_art.get("journeys", ()):
+            label = f"{j.get('kind')} {j.get('key')}"
+            ts = [h.get("t_ns") for h in j.get("hops", ())]
+            if ts != sorted(ts):
+                problems.append(
+                    f"journeys.json: {label} has non-monotonic hops")
+            for h in j.get("hops", ()):
+                if h.get("hop") not in HOP_SET:
+                    problems.append(
+                        f"journeys.json: {label} carries unknown hop "
+                        f"{h.get('hop')!r}")
+                did = h.get("dispatch_id")
+                if (did is not None and flight_art is not None
+                        and did not in ring_ids
+                        and (ring_floor is None or did >= ring_floor)):
+                    problems.append(
+                        f"journeys.json: {label} hop {h.get('hop')} "
+                        f"rode dispatch #{did} which is not in "
+                        "flight.json")
+    elif "journeys.json" in (man.get("artifacts") or {}):
+        problems.append("journeys.json listed but unreadable")
     return problems
 
 

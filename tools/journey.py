@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Render per-entity journeys (doc/journeys.md) from a running daemon.
+
+The flight ring answers "what did batch #417 do"; `getjourney` answers
+"what happened to THIS scid / THIS payment".  This CLI turns those
+hop records into an operator timeline — one line per hop with the
+queue-wait/service split and the flight-ring dispatch each hop rode —
+and can splice the journeys into a Chrome trace-event export whose
+corr-ids bind to the daemon's existing Perfetto flow chains.
+
+Modes:
+  --rpc <unix-socket> [--scid S | --payment-hash H | --node-id N]
+      Call `getjourney` and render the timeline(s).  With no selector,
+      the most recent journeys plus the rolling summary.
+  --rpc <unix-socket> --trace journeys.json
+      Fetch `gettrace` AND `getjourney`, convert each journey hop to a
+      synthetic span slice (tid band 1<<29, one track per journey) and
+      merge both event lists: Perfetto binds flow events by id, so the
+      journey slices hook into the same corr-id arrows as the live
+      enqueue/flush spans.  Open at https://ui.perfetto.dev.
+  --selfcheck
+      Record a synthetic gossip + payment journey in-process, export,
+      and validate the schema + the journey/flow splice.  Exit 1 on
+      any problem (wired into tools/run_suite.sh).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from obs_snapshot import rpc_call  # noqa: E402  (shared unix-RPC helper)
+
+
+def _fmt_key(kind: str, key) -> str:
+    if kind == "channel":
+        from lightning_tpu.gossip.gossmap import scid_str
+
+        return scid_str(int(key))
+    s = str(key)
+    return s[:16] + "…" if len(s) > 16 else s
+
+
+def render_journey(j: dict, out=sys.stdout) -> None:
+    """One journey as a text timeline, hops offset from the first."""
+    state = "done" if j.get("done") else "open"
+    head = (f"{j['kind']} {_fmt_key(j['kind'], j['key'])} — "
+            f"{len(j['hops'])} hop(s), {j.get('e2e_ms', 0)} ms e2e, "
+            f"{state}")
+    if j.get("truncated"):
+        head += f" ({j['truncated']} hop(s) truncated)"
+    print(head, file=out)
+    t0 = j["hops"][0]["t_ns"] if j["hops"] else 0
+    for h in j["hops"]:
+        off_ms = (h["t_ns"] - t0) / 1e6
+        line = (f"  +{off_ms:9.3f}ms  {h['hop']:<11} {h['outcome']}"
+                f"  (wait {h['wait_ms']}ms, service {h['service_ms']}ms")
+        if h.get("dispatch_id") is not None:
+            line += f", dispatch #{h['dispatch_id']}"
+        if h.get("corr_id") is not None:
+            line += f", corr {h['corr_id']}"
+        line += ")"
+        for k, v in (h.get("attrs") or {}).items():
+            line += f" {k}={v}"
+        print(line, file=out)
+
+
+def render_summary(s: dict, out=sys.stdout) -> None:
+    print(f"journeys: sample=1/{s['sample']} entities={s['entities']} "
+          f"finished={s['finished']} evicted={s['evicted']} "
+          f"e2e p50={s['e2e_ms_p50']} p99={s['e2e_ms_p99']} ms",
+          file=out)
+    for name, row in sorted(s.get("by_hop", {}).items()):
+        print(f"  {name:<11} n={row['count']:<5} "
+              f"wait p50/p99 {row['wait_ms_p50']}/{row['wait_ms_p99']} ms"
+              f"  service p50/p99 {row['service_ms_p50']}/"
+              f"{row['service_ms_p99']} ms", file=out)
+
+
+def journeys_to_span_records(journeys: list[dict]) -> list[dict]:
+    """Hop records → span-record dicts chrome_trace() understands.
+    Client-side twin of obs/journey.journey_span_records (which reads
+    the in-process table; this one works off the RPC payload)."""
+    from lightning_tpu.obs.journey import JOURNEY_TID_BASE
+
+    out = []
+    for j in journeys:
+        tid = JOURNEY_TID_BASE + j["seq"]
+        for i, h in enumerate(j["hops"]):
+            busy_ns = int((h["wait_ms"] + h["service_ms"]) * 1e6)
+            out.append({
+                "name": "journey/" + h["hop"],
+                "start_ns": h["t_ns"] - max(busy_ns, 1_000),
+                "duration_ns": max(busy_ns, 1_000),
+                "tid": tid,
+                "thread": "journey:" + j["kind"],
+                "span_id": -(j["seq"] * 1_000 + i),
+                "corr_ids": ([h["corr_id"]]
+                             if h.get("corr_id") is not None else []),
+                "attributes": {
+                    "kind": j["kind"], "key": str(j["key"]),
+                    "outcome": h["outcome"],
+                    "dispatch_id": h.get("dispatch_id"),
+                },
+            })
+    return out
+
+
+def splice_trace(trace_obj: dict, journeys: list[dict]) -> dict:
+    """Merge journey slices into a gettrace export.  Perfetto binds
+    flow events ('s'/'t'/'f') by id across the whole file, so the
+    journey events' corr-ids chain into the daemon's existing arrows."""
+    from lightning_tpu.obs import traceexport
+
+    jtrace = traceexport.chrome_trace(journeys_to_span_records(journeys))
+    merged = dict(trace_obj)
+    merged["traceEvents"] = (list(trace_obj.get("traceEvents", []))
+                             + jtrace["traceEvents"])
+    return merged
+
+
+def selfcheck() -> list[str]:
+    """Synthesize both journey shapes, export, validate.  Returns
+    problems (empty == pass)."""
+    os.environ["LIGHTNING_TPU_JOURNEY_SAMPLE"] = "1"
+    from lightning_tpu.obs import journey, traceexport
+    from lightning_tpu.utils import trace
+
+    journey.reset_for_tests()
+    errs: list[str] = []
+
+    corr = trace.new_corr()
+    journey.hop("recv", "channel", 0x123, outcome="ok")
+    journey.hop("admit", "channel", 0x123, corr_id=corr.corr_id)
+    journey.hop("verify", "channel", 0x123, wait_s=0.004,
+                service_s=0.002, dispatch_id=1, corr_id=corr.corr_id)
+    journey.hop("fold", "channel", 0x123, service_s=0.001)
+    journey.hop("planes", "channel", 0x123, outcome="patched")
+    journey.hop("enqueue", "payment", b"\x01" * 32)
+    journey.hop("mcf_flush", "payment", b"\x01" * 32, wait_s=0.003,
+                service_s=0.008, dispatch_id=2)
+    journey.hop("parts", "payment", b"\x01" * 32, parts=2)
+    journey.hop("htlc_settle", "payment", b"\x01" * 32)
+
+    js = journey.recent()
+    if len(js) != 2:
+        errs.append(f"want 2 journeys, got {len(js)}")
+    for j in js:
+        render_journey(j)
+        ts = [h["t_ns"] for h in j["hops"]]
+        if ts != sorted(ts):
+            errs.append(f"{j['kind']} {j['key']}: non-monotonic hops")
+    render_summary(journey.summary())
+
+    trace_obj = splice_trace({"traceEvents": [],
+                              "displayTimeUnit": "ms"}, js)
+    errs += traceexport.validate(trace_obj)
+    ev = trace_obj["traceEvents"]
+    if not any(e.get("ph") == "X"
+               and str(e.get("name", "")).startswith("journey/")
+               for e in ev):
+        errs.append("no journey slices in the export")
+    if not any(e.get("ph") in ("s", "t", "f")
+               and e.get("id") == corr.corr_id for e in ev):
+        errs.append("journey corr-id produced no flow events — the "
+                    "Perfetto splice is broken")
+    journey.reset_for_tests()
+    return errs
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(prog="journey")
+    p.add_argument("--rpc", help="daemon unix socket (lightning-rpc)")
+    p.add_argument("--scid", help="one channel's journey (BLOCKxTXxOUT)")
+    p.add_argument("--payment-hash", help="one payment's journey (hex)")
+    p.add_argument("--node-id", help="one node's journey (hex)")
+    p.add_argument("--limit", type=int, default=20,
+                   help="recent journeys to fetch (no selector)")
+    p.add_argument("--trace", metavar="OUT_JSON",
+                   help="write a Perfetto export splicing journeys "
+                        "into the daemon's gettrace flow chains")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw getjourney payload")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="synthetic journeys + export/splice validation")
+    args = p.parse_args()
+
+    if args.selfcheck:
+        errs = selfcheck()
+        if errs:
+            print("journey selfcheck FAILED:")
+            for e in errs:
+                print(f"  {e}")
+            return 1
+        print("journey selfcheck: timelines render, export valid, "
+              "corr flows spliced")
+        return 0
+
+    if not args.rpc:
+        p.error("need --rpc or --selfcheck")
+    params: dict = {}
+    if args.scid:
+        params["scid"] = args.scid
+    elif args.payment_hash:
+        params["payment_hash"] = args.payment_hash
+    elif args.node_id:
+        params["node_id"] = args.node_id
+    else:
+        params["limit"] = args.limit
+    res = rpc_call(args.rpc, "getjourney", params)
+
+    if args.json:
+        print(json.dumps(res, indent=1))
+        return 0
+
+    if args.trace:
+        trace_obj = rpc_call(args.rpc, "gettrace", {})
+        merged = splice_trace(trace_obj, res.get("journeys", []))
+        with open(args.trace, "w") as f:
+            json.dump(merged, f, indent=1)
+        n = len(merged["traceEvents"])
+        print(f"wrote {args.trace} ({n} events) — open at "
+              "https://ui.perfetto.dev", file=sys.stderr)
+        return 0
+
+    if not res.get("enabled"):
+        print("journey sampling disabled "
+              "(set LIGHTNING_TPU_JOURNEY_SAMPLE)")
+    journeys = res.get("journeys", [])
+    if not journeys:
+        print("no journeys recorded for that selector" if params
+              and "limit" not in params else "no journeys recorded")
+    for j in journeys:
+        render_journey(j)
+    if "summary" in res:
+        render_summary(res["summary"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

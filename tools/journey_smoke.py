@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""journey-smoke: end-to-end per-item provenance check (doc/journeys.md).
+
+Drives the REAL gossip machinery (Gossipd shell → GossipIngest →
+batched verify pipeline → store append → gossmap fold → route-planes
+patch) in one process with journey sampling at 1 and the verify
+pipeline in host mode (LIGHTNING_TPU_VERIFY_DEVICE=off — the same
+jax-free harness trick tools/crashmatrix.py children use: the full
+pipeline machinery runs, no device compile stalls the smoke), then
+asserts the journeys a signed channel_update leaves behind:
+
+  * an ACCEPTED update's journey reaches the planes-patch hop through
+    recv → admit → verify → store → fold → planes, with monotonic
+    timestamps, and its verify hop's dispatch_id resolves to a real
+    record in the verify flight ring;
+  * per-item queue-waits reconcile against the batch-level
+    clntpu_journey_batch_wait_seconds_total{stage=verify} counter;
+  * a SHED message's journey terminates at the shed hop;
+  * the getjourney RPC handler answers for both entities, answers
+    empty (not an error) for a never-sampled entity, and rejects bad
+    params.
+
+Exit 1 on any problem — wired into tools/run_suite.sh.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import tempfile
+
+# harness env BEFORE any lightning_tpu import: host-mode verify, full
+# sampling (journey.py reads the knobs at import)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LIGHTNING_TPU_VERIFY_DEVICE", "off")
+os.environ["LIGHTNING_TPU_JOURNEY_SAMPLE"] = "1"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightning_tpu.crypto import ref_python as ref            # noqa: E402
+from lightning_tpu.daemon.jsonrpc import (                    # noqa: E402
+    RpcError, make_getjourney)
+from lightning_tpu.gossip import gossmap as GM                # noqa: E402
+from lightning_tpu.gossip import ingest as gi                 # noqa: E402
+from lightning_tpu.gossip import store as gstore              # noqa: E402
+from lightning_tpu.gossip import wire                         # noqa: E402
+from lightning_tpu.gossip.gossipd import Gossipd              # noqa: E402
+from lightning_tpu.obs import flight, journey                 # noqa: E402
+from lightning_tpu import obs                                 # noqa: E402
+from lightning_tpu.routing.planes import RoutePlanes          # noqa: E402
+
+K1, K2 = 11111, 22222
+SCID = (600000 << 40) | (1 << 16) | 0
+SCID_FILL = (600000 << 40) | (8 << 16) | 0
+SCID_SHED = (600000 << 40) | (9 << 16) | 0
+RECONCILE_EPS = 0.05
+
+
+def _pub(k: int) -> bytes:
+    return ref.pubkey_serialize(ref.pubkey_create(k))
+
+
+def _ordered(ka, kb):
+    return (ka, kb) if _pub(ka) < _pub(kb) else (kb, ka)
+
+
+def make_ca(ka: int, kb: int, scid: int) -> bytes:
+    ka, kb = _ordered(ka, kb)
+    ca = wire.ChannelAnnouncement(
+        short_channel_id=scid,
+        node_id_1=_pub(ka), node_id_2=_pub(kb),
+        bitcoin_key_1=_pub(ka), bitcoin_key_2=_pub(kb))
+    m = bytearray(ca.serialize())
+    h = ref.sha256d(bytes(m[wire.CA_SIGNED_OFFSET:]))
+    for off, k in zip(wire.CA_SIG_OFFSETS, (ka, kb, ka, kb)):
+        r, s = ref.ecdsa_sign(h, k)
+        m[off:off + 64] = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    return bytes(m)
+
+
+def make_cu(ka: int, kb: int, scid: int, direction: int, ts: int,
+            fee_base: int = 1000) -> bytes:
+    ka, kb = _ordered(ka, kb)
+    cu = wire.ChannelUpdate(
+        short_channel_id=scid, timestamp=ts, channel_flags=direction,
+        htlc_maximum_msat=10 ** 9, fee_base_msat=fee_base,
+        fee_proportional_millionths=10)
+    m = bytearray(cu.serialize())
+    h = ref.sha256d(bytes(m[wire.CU_SIGNED_OFFSET:]))
+    r, s = ref.ecdsa_sign(h, ka if direction == 0 else kb)
+    m[wire.CU_SIG_OFFSET:wire.CU_SIG_OFFSET + 64] = (
+        r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+    return bytes(m)
+
+
+class _StubNode:
+    """The minimum Gossipd needs of a LightningNode."""
+
+    node_id = b"\x02" + b"\x11" * 32
+
+    def __init__(self):
+        self.raw_handlers = {}
+        self.peers = {}
+
+    def register(self, msg_type, handler) -> None:
+        pass
+
+
+class _StubPeer:
+    node_id = b"\x03" + b"\x22" * 32
+    connected = True
+
+
+def _counter_value(name: str, **labels) -> float:
+    for s in obs.snapshot()["metrics"].get(name, {}).get("samples", []):
+        if all((s.get("labels") or {}).get(k) == v
+               for k, v in labels.items()):
+            return float(s.get("value", 0.0))
+    return 0.0
+
+
+async def run() -> list[str]:
+    problems: list[str] = []
+    tmp = tempfile.mkdtemp(prefix="journey-smoke-")
+    store_path = os.path.join(tmp, "gossip.gs")
+
+    # -- seed: channel + both directions on disk, graph loaded -------------
+    ing0 = gi.GossipIngest(store_path, flush_ms=1.0, bucket=64)
+    ing0.start()
+    await ing0.submit(make_ca(K1, K2, SCID))
+    await ing0.submit(make_cu(K1, K2, SCID, 0, ts=100))
+    await ing0.submit(make_cu(K1, K2, SCID, 1, ts=100))
+    await ing0.drain()
+    await ing0.close()
+    g = GM.from_store(gstore.load_store(store_path))
+    planes = RoutePlanes.build(g)
+
+    # -- phase A: a live signed update through the daemon shell ------------
+    journey.reset_for_tests()   # the smoke narrates only the live update
+    wait_before = _counter_value("clntpu_journey_batch_wait_seconds_total",
+                                 stage="verify")
+    node = _StubNode()
+    gd = Gossipd(node, store_path, flush_ms=1.0, bucket=64,
+                 gossmap_ref={"map": g})
+    gd.load_existing(store_path)
+    gd.start()
+    await gd._on_gossip(_StubPeer(), make_cu(K1, K2, SCID, 0, ts=200,
+                                             fee_base=1234))
+    await gd.ingest.drain()
+    await gd.close()
+    planes = RoutePlanes.current(g, planes)
+
+    j = journey.lookup("channel", SCID)
+    if j is None:
+        return ["accepted update left no journey at all"]
+    hops = [h["hop"] for h in j["hops"]]
+    want = ["recv", "admit", "verify", "store", "fold", "planes"]
+    if hops != want:
+        problems.append(f"accepted journey hops {hops} != {want}")
+    ts = [h["t_ns"] for h in j["hops"]]
+    if ts != sorted(ts):
+        problems.append(f"accepted journey timestamps not monotonic: {ts}")
+    if j["done"]:
+        problems.append("accepted journey marked done without a "
+                        "terminal hop")
+    by_hop = {h["hop"]: h for h in j["hops"]}
+    did = by_hop.get("verify", {}).get("dispatch_id")
+    ring_ids = {r["dispatch_id"] for r in flight.recent("verify")}
+    if did is None:
+        problems.append("verify hop carries no dispatch_id")
+    elif did not in ring_ids:
+        problems.append(f"verify hop dispatch #{did} not in the "
+                        f"flight ring {sorted(ring_ids)}")
+    # per-item waits vs the batch-level stage counter (sampling is 1,
+    # one item in the batch: the sums must agree within ε)
+    wait_delta = _counter_value("clntpu_journey_batch_wait_seconds_total",
+                                stage="verify") - wait_before
+    item_wait = sum(h["wait_ms"] for h in j["hops"]) / 1e3
+    if abs(wait_delta - item_wait) > RECONCILE_EPS:
+        problems.append(
+            f"queue-wait reconciliation failed: batch counter "
+            f"{wait_delta:.4f}s vs per-item {item_wait:.4f}s")
+
+    # -- phase B: a shed message terminates at the shed hop ----------------
+    shed_store = os.path.join(tmp, "shed.gs")
+    # high_wm=4: the first 4-sig CA admits (PRIO_FRESH limit is
+    # high_wm + headroom = 5), the second cannot fit and sheds
+    ing = gi.GossipIngest(shed_store, flush_ms=1e9, bucket=64,
+                          high_wm=4, low_wm=4)
+    await ing.submit(make_ca(K1, K2, SCID_FILL))   # fills the queue
+    await ing.submit(make_ca(K1, K2, SCID_SHED))   # over the watermark
+    await ing.close()
+    js = journey.lookup("channel", SCID_SHED)
+    if js is None:
+        problems.append("shed message left no journey")
+    else:
+        shed_hops = [h["hop"] for h in js["hops"]]
+        if shed_hops != ["shed"]:
+            problems.append(f"shed journey hops {shed_hops} != ['shed']")
+        if not js["done"]:
+            problems.append("shed journey not marked done (shed is "
+                            "terminal)")
+
+    # -- phase C: the getjourney RPC surface -------------------------------
+    getjourney = make_getjourney()
+    out = await getjourney(scid=GM.scid_str(SCID))
+    rpc_hops = [h["hop"] for h in (out["journeys"] or [{}])[0].get(
+        "hops", [])]
+    if rpc_hops != want:
+        problems.append(f"getjourney(scid) hops {rpc_hops} != {want}")
+    empty = await getjourney(payment_hash="ee" * 32)
+    if empty["journeys"] != []:
+        problems.append("getjourney for a never-sampled payment_hash "
+                        "should answer empty journeys")
+    try:
+        await getjourney(scid="not-a-scid")
+        problems.append("getjourney accepted a malformed scid")
+    except RpcError:
+        pass
+    try:
+        await getjourney(scid=GM.scid_str(SCID), node_id="aa" * 33)
+        problems.append("getjourney accepted two selectors")
+    except RpcError:
+        pass
+    summ = (await getjourney())["summary"]
+    for name in want:
+        if name not in summ["by_hop"]:
+            problems.append(f"summary by_hop lacks {name}")
+    return problems
+
+
+def main() -> int:
+    problems = asyncio.run(run())
+    journey.reset_for_tests()
+    if problems:
+        print("journey-smoke FAILED:")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print("journey-smoke: accepted update reached planes-patch with "
+          "monotonic hops + resolvable dispatch_ids, queue-waits "
+          "reconcile, shed journey terminated at shed, getjourney "
+          "validates")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

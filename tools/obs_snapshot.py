@@ -48,6 +48,12 @@ Captures additionally carry the `listincidents` summary when the
 daemon runs the black-box recorder (doc/incidents.md); --watch prints
 a `# NEW INCIDENT ...` line (plus the bundle summary in the delta)
 the tick a new bundle lands mid-watch.
+
+When the daemon samples per-item journeys (doc/journeys.md,
+LIGHTNING_TPU_JOURNEY_SAMPLE) the `getjourney` summary rides along
+too; --watch then prints a `# SLOW JOURNEY ...` line naming the
+slowest finished entity the tick the rolling e2e p99 breaches
+--slow-journey-ms (default 1000).
 """
 from __future__ import annotations
 
@@ -107,6 +113,12 @@ def capture_rpc(rpc_path: str, dispatches: int | None = None) -> dict:
             snap["incidents"] = inc
     except (SystemExit, OSError, ValueError, KeyError):
         pass  # no black-box recorder behind this socket
+    try:
+        jr = rpc_call(rpc_path, "getjourney", {"limit": 5})
+        if jr.get("enabled"):
+            snap["journeys"] = jr
+    except (SystemExit, OSError, ValueError, KeyError):
+        pass  # no journey sampling behind this socket
     return snap
 
 
@@ -142,6 +154,12 @@ def capture_url(url: str, rune: str | None = None,
             snap["incidents"] = inc
     except Exception:
         pass  # no black-box recorder behind this gateway
+    try:
+        jr = post("getjourney", {"limit": 5})
+        if jr.get("enabled"):
+            snap["journeys"] = jr
+    except Exception:
+        pass  # no journey sampling behind this gateway
     return snap
 
 
@@ -166,6 +184,12 @@ def capture_local(dispatches: int | None = None) -> dict:
     rec = _incident.current()
     if rec is not None:
         snap["incidents"] = rec.summary(limit=8)
+    from lightning_tpu.obs import journey as _journey
+
+    if _journey.enabled():
+        snap["journeys"] = {"enabled": True,
+                            "summary": _journey.summary(),
+                            "journeys": _journey.recent(5)}
     return snap
 
 
@@ -240,6 +264,26 @@ def diff_snapshots(a: dict, b: dict) -> dict:
                 "count": b["incidents"].get("count"),
                 "total_bytes": b["incidents"].get("total_bytes"),
             }
+    # the journey summary (getjourney, doc/journeys.md) is
+    # point-in-time like the perf/health sections: a --watch tick
+    # carries the compact view — table occupancy, the rolling e2e
+    # tail, and the slowest finished entity — so the SLOW JOURNEY
+    # hook below has its numbers in the delta too
+    if "journeys" in b:
+        s = b["journeys"].get("summary") or {}
+        slowest = s.get("slowest")
+        out["journeys"] = {
+            "entities": s.get("entities"),
+            "finished": s.get("finished"),
+            "evicted": s.get("evicted"),
+            "e2e_ms_p50": s.get("e2e_ms_p50"),
+            "e2e_ms_p99": s.get("e2e_ms_p99"),
+            "slowest": None if not slowest else {
+                "kind": slowest.get("kind"),
+                "key": str(slowest.get("key")),
+                "e2e_ms": slowest.get("e2e_ms"),
+            },
+        }
     # flight records captured with --dispatches: the diff keeps only
     # the dispatches NEW since `a`, so a --watch tick shows WHICH
     # dispatch blew up a counter delta, not just that one did
@@ -253,12 +297,15 @@ def diff_snapshots(a: dict, b: dict) -> dict:
 
 
 def watch(capture, interval: float, out=None,
-          ticks: int | None = None, sleep=None) -> None:
+          ticks: int | None = None, sleep=None,
+          slow_journey_ms: float = 1000.0) -> None:
     """Capture every `interval` seconds, printing the per-tick delta
     (the live view of a replay's clntpu_replay_* stage counters, or of
     the clntpu_breaker_* / clntpu_quarantine_* resilience families
     while a fault plays out).  `ticks` bounds the number of deltas
-    printed (None = until Ctrl-C); `sleep` injects a waiter (tests)."""
+    printed (None = until Ctrl-C); `sleep` injects a waiter (tests).
+    A tick whose journey e2e p99 exceeds `slow_journey_ms` calls it out
+    on a `# SLOW JOURNEY` line naming the slowest finished entity."""
     import datetime
     import time
 
@@ -281,6 +328,15 @@ def watch(capture, interval: float, out=None,
                 print(f"# NEW INCIDENT {row.get('id')} "
                       f"trigger={row.get('trigger')} "
                       f"bytes={row.get('bytes')}", file=out,
+                      flush=False)
+            jsum = delta.get("journeys") or {}
+            p99 = jsum.get("e2e_ms_p99")
+            if isinstance(p99, (int, float)) and p99 > slow_journey_ms:
+                slow = jsum.get("slowest") or {}
+                print(f"# SLOW JOURNEY e2e p99={p99:.1f}ms > "
+                      f"{slow_journey_ms:g}ms slowest="
+                      f"{slow.get('kind')} {slow.get('key')} "
+                      f"({slow.get('e2e_ms')}ms)", file=out,
                       flush=False)
             print(json.dumps(delta if delta else {}, indent=1),
                   file=out, flush=True)
@@ -312,6 +368,11 @@ def main() -> int:
                           "(listdispatches) in the capture; with "
                           "--watch, each tick prints only the "
                           "dispatches NEW since the previous tick")
+    cap.add_argument("--slow-journey-ms", type=float, default=1000.0,
+                     metavar="MS",
+                     help="with --watch: print a SLOW JOURNEY line "
+                          "when the rolling journey e2e p99 exceeds "
+                          "MS (doc/journeys.md)")
     cap.add_argument("-o", "--out", default="-")
     d = sub.add_parser("diff")
     d.add_argument("a")
@@ -336,11 +397,15 @@ def main() -> int:
                 p.error("--watch interval must be positive")
             if args.ticks is not None and args.ticks <= 0:
                 p.error("--ticks must be positive")
+            if args.slow_journey_ms <= 0:
+                p.error("--slow-journey-ms must be positive")
             if args.out == "-":
-                watch(capture, args.watch, ticks=args.ticks)
+                watch(capture, args.watch, ticks=args.ticks,
+                      slow_journey_ms=args.slow_journey_ms)
             else:
                 with open(args.out, "w") as f:
-                    watch(capture, args.watch, out=f, ticks=args.ticks)
+                    watch(capture, args.watch, out=f, ticks=args.ticks,
+                          slow_journey_ms=args.slow_journey_ms)
             return 0
         snap = capture()
         text = json.dumps(snap, indent=1)

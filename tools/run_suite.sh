@@ -191,4 +191,18 @@ timeout 1200 python tools/loadgen.py --selfcheck \
 echo "crash-matrix lite pass (tools/crashmatrix.py --lite)"
 timeout 600 python tools/crashmatrix.py --lite \
   || { echo "crash-matrix lite failed"; exit 1; }
-echo "suite green (2 slices + graftlint + perf smoke + incident smoke + fault matrix + health smoke + soak-lite + crash-matrix lite)"
+
+# Journey-smoke pass (doc/journeys.md): per-item provenance through
+# the REAL batched pipeline — a signed channel_update driven through
+# Gossipd → ingest → verify → store → gossmap fold must leave a
+# journey that reaches the planes-patch hop with monotonic timestamps
+# and a dispatch_id resolving into the verify flight ring, per-item
+# queue-waits must reconcile against the batch-level stage counter, a
+# shed message's journey must terminate at the shed hop, and the
+# getjourney RPC surface must validate.  Runs with
+# LIGHTNING_TPU_VERIFY_DEVICE=off (host pipeline, no device programs)
+# so it is jax-cache-safe and costs seconds.
+echo "journey-smoke pass (tools/journey_smoke.py)"
+timeout 300 python tools/journey_smoke.py \
+  || { echo "journey-smoke failed"; exit 1; }
+echo "suite green (2 slices + graftlint + perf smoke + incident smoke + fault matrix + health smoke + soak-lite + crash-matrix lite + journey smoke)"
